@@ -1,0 +1,60 @@
+# tpu-acx native runtime build.
+# Counterpart of the reference's nvcc Makefile (reference Makefile:1-49), but
+# plain g++: the device compiler on TPU is XLA/Pallas, reached from Python;
+# everything here is host-side runtime.
+
+CXX      ?= g++
+CXXFLAGS ?= -O2 -g -Wall -Wextra -std=c++17 -fPIC -pthread
+INCLUDES  = -Iinclude
+LDFLAGS   = -pthread
+
+BUILD := build
+
+CORE_SRCS := src/core/flagtable.cc src/core/proxy.cc
+SHIM_SRCS := src/shim/transport.cc src/shim/mpi_shim.cc
+RT_SRCS   := src/runtime/stream.cc src/runtime/cuda_shim.cc
+API_SRCS  := src/api/mpix.cc
+
+LIB_SRCS := $(CORE_SRCS) $(SHIM_SRCS) $(RT_SRCS) $(API_SRCS)
+LIB_OBJS := $(LIB_SRCS:%.cc=$(BUILD)/%.o)
+
+LIB       = $(BUILD)/libtpuacx.so
+STATICLIB = $(BUILD)/libtpuacx.a
+
+CTEST_BINS = $(BUILD)/test_core
+
+.PHONY: all lib clean check ctest
+
+all: lib tools ctest
+
+lib: $(LIB) $(STATICLIB)
+
+$(BUILD)/%.o: %.cc
+	@mkdir -p $(dir $@)
+	$(CXX) $(CXXFLAGS) $(INCLUDES) -c $< -o $@
+
+$(LIB): $(LIB_OBJS)
+	$(CXX) -shared $(LIB_OBJS) -o $@ $(LDFLAGS)
+
+$(STATICLIB): $(LIB_OBJS)
+	ar rcs $@ $(LIB_OBJS)
+
+# --- unit tests (no transport needed) ---
+ctest: $(CTEST_BINS)
+
+$(BUILD)/test_core: ctests/test_core.cc $(BUILD)/src/core/flagtable.o $(BUILD)/src/core/proxy.o
+	$(CXX) $(CXXFLAGS) $(INCLUDES) $^ -o $@ $(LDFLAGS)
+
+check: ctest
+	$(BUILD)/test_core
+
+# --- launcher ---
+.PHONY: tools
+tools: $(BUILD)/acxrun
+
+$(BUILD)/acxrun: tools/acxrun.cc
+	@mkdir -p $(BUILD)
+	$(CXX) $(CXXFLAGS) $(INCLUDES) $< -o $@ $(LDFLAGS)
+
+clean:
+	rm -rf $(BUILD)

@@ -1,0 +1,266 @@
+// Unit tests for the core slot table + state machine + proxy engine.
+// Pure host code, no devices needed (SURVEY.md §4: "add a unit layer around
+// the slot table/state machine"). Plain asserts; exits nonzero on failure.
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include "acx/proxy.h"
+#include "acx/state.h"
+#include "acx/transport.h"
+
+using namespace acx;
+
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::fprintf(stderr, "CHECK failed %s:%d: %s\n", __FILE__, __LINE__, \
+                   #cond);                                                \
+      std::exit(1);                                                       \
+    }                                                                     \
+  } while (0)
+
+// A loopback transport: Isend/Irecv complete against an in-process mailbox.
+// Lets us drive the full state machine without sockets.
+namespace {
+
+struct FakeTicket : Ticket {
+  std::atomic<bool>* done;
+  Status st;
+  explicit FakeTicket(std::atomic<bool>* d, Status s) : done(d), st(s) {}
+  bool Test(Status* out) override {
+    if (!done->load(std::memory_order_acquire)) return false;
+    *out = st;
+    return true;
+  }
+};
+
+struct FakeChan : PartitionedChan {
+  std::vector<std::atomic<bool>> wire;
+  explicit FakeChan(int parts) : wire(parts) {
+    partitions = parts;
+    StartRound();
+  }
+  void Pready(int p) override { wire[p].store(true, std::memory_order_release); }
+  bool Parrived(int p) override { return wire[p].load(std::memory_order_acquire); }
+  void StartRound() override {
+    for (auto& w : wire) w.store(false, std::memory_order_relaxed);
+  }
+  void FinishRound(Status*) override {}
+};
+
+struct FakeTransport : Transport {
+  std::atomic<bool> sends_done{false};
+  std::atomic<int> isends{0}, irecvs{0};
+  int rank() const override { return 0; }
+  int size() const override { return 1; }
+  Ticket* Isend(const void*, size_t bytes, int dst, int tag, int) override {
+    isends.fetch_add(1);
+    Status st;
+    st.source = 0;
+    st.tag = tag;
+    st.bytes = bytes;
+    (void)dst;
+    return new FakeTicket(&sends_done, st);
+  }
+  Ticket* Irecv(void*, size_t bytes, int src, int tag, int) override {
+    irecvs.fetch_add(1);
+    Status st;
+    st.source = src;
+    st.tag = tag;
+    st.bytes = bytes;
+    return new FakeTicket(&sends_done, st);
+  }
+  PartitionedChan* PsendInit(const void*, int parts, size_t pb, int, int,
+                             int) override {
+    auto* c = new FakeChan(parts);
+    c->part_bytes = pb;
+    c->is_send = true;
+    return c;
+  }
+  PartitionedChan* PrecvInit(void*, int parts, size_t pb, int, int,
+                             int) override {
+    auto* c = new FakeChan(parts);
+    c->part_bytes = pb;
+    return c;
+  }
+  void Barrier(int) override {}
+  void AllreduceInt(int32_t*, int, int, int) override {}
+  void Abort(int code) override { std::exit(code); }
+};
+
+void SpinUntil(FlagTable& t, int idx, int32_t want) {
+  while (t.Load(idx) != want) std::this_thread::yield();
+}
+
+void test_allocator_exhaustion() {
+  FlagTable t(8);
+  std::vector<int> got;
+  for (int i = 0; i < 8; i++) {
+    int s = t.Allocate();
+    CHECK(s >= 0);
+    got.push_back(s);
+  }
+  CHECK(t.Allocate() == -1);
+  for (int s : got) t.Free(s);
+  CHECK(t.Allocate() >= 0);
+  std::printf("  allocator exhaustion: ok\n");
+}
+
+void test_concurrent_allocator() {
+  FlagTable t(256);
+  std::atomic<bool> stop{false};
+  std::atomic<int64_t> total{0};
+  std::vector<std::thread> threads;
+  for (int k = 0; k < 4; k++) {
+    threads.emplace_back([&] {
+      while (!stop.load()) {
+        int s = t.Allocate();
+        if (s >= 0) {
+          total.fetch_add(1);
+          t.Free(s);
+        }
+      }
+    });
+  }
+  std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  stop.store(true);
+  for (auto& th : threads) th.join();
+  CHECK(t.active.load() == 0);
+  CHECK(total.load() > 0);
+  // Every slot must be AVAILABLE again — no lost or duplicated slots.
+  for (int i = 0; i < 256; i++) CHECK(t.Load(i) == kAvailable);
+  std::printf("  concurrent allocator (%lld cycles): ok\n",
+              static_cast<long long>(total.load()));
+}
+
+void test_sendrecv_lifecycle() {
+  FlagTable t(16);
+  FakeTransport tr;
+  Proxy proxy(&t, &tr);
+  proxy.Start();
+
+  int slot = t.Allocate();
+  CHECK(slot >= 0);
+  Op& op = t.op(slot);
+  op.kind = OpKind::kIsend;
+  op.sbuf = &op;
+  op.bytes = 4;
+  op.peer = 0;
+  op.tag = 7;
+  // "Device reaches the trigger point":
+  t.Store(slot, kPending);
+  proxy.Kick();
+
+  SpinUntil(t, slot, kIssued);
+  CHECK(tr.isends.load() == 1);
+  // Transfer completes on the wire:
+  tr.sends_done.store(true, std::memory_order_release);
+  SpinUntil(t, slot, kCompleted);
+  CHECK(t.op(slot).status.tag == 7);
+  // Consumer (wait point) takes it to CLEANUP via CAS; proxy reclaims.
+  CHECK(t.Cas(slot, kCompleted, kCleanup));
+  proxy.Kick();
+  SpinUntil(t, slot, kAvailable);
+  CHECK(t.active.load() == 0);
+  proxy.Stop();
+  std::printf("  sendrecv lifecycle: ok\n");
+}
+
+void test_cleanup_never_leaks() {
+  // Regression for the reference's leak: a slot entering CLEANUP while the
+  // proxy is elsewhere must still be reclaimed.
+  FlagTable t(16);
+  FakeTransport tr;
+  Proxy proxy(&t, &tr);
+
+  int slot = t.Allocate();
+  t.op(slot).kind = OpKind::kIsend;
+  t.Store(slot, kCleanup);  // straight to CLEANUP before proxy even starts
+  proxy.Start();
+  proxy.Kick();
+  SpinUntil(t, slot, kAvailable);
+  proxy.Stop();
+  std::printf("  cleanup reclaim: ok\n");
+}
+
+void test_partitioned_lifecycle() {
+  FlagTable t(64);
+  FakeTransport tr;
+  Proxy proxy(&t, &tr);
+  proxy.Start();
+
+  const int P = 10;
+  PartitionedChan* send_chan = tr.PsendInit(nullptr, P, 4, 0, 0, 0);
+  PartitionedChan* recv_chan = tr.PrecvInit(nullptr, P, 4, 0, 0, 0);
+  // Wire the fake: sends land on the recv side's wire.
+  // (Same FakeChan instance semantics: use send_chan as the shared wire.)
+  std::vector<int> send_slots(P), recv_slots(P);
+  for (int p = 0; p < P; p++) {
+    int s = t.Allocate();
+    t.op(s).kind = OpKind::kPready;
+    t.op(s).chan = send_chan;
+    t.op(s).partition = p;
+    send_slots[p] = s;
+
+    int r = t.Allocate();
+    t.op(r).kind = OpKind::kParrived;
+    t.op(r).chan = send_chan;  // poll the same wire the sender writes
+    t.op(r).partition = p;
+    recv_slots[p] = r;
+  }
+  (void)recv_chan;
+  // Start: recv partitions -> ISSUED (proxy now polls them).
+  for (int p = 0; p < P; p++) t.Store(recv_slots[p], kIssued);
+  // Device marks partitions ready out of order:
+  for (int p = P - 1; p >= 0; p--) t.Store(send_slots[p], kPending);
+  proxy.Kick();
+  for (int p = 0; p < P; p++) {
+    SpinUntil(t, send_slots[p], kCompleted);
+    SpinUntil(t, recv_slots[p], kCompleted);
+  }
+  // Host Waitall: reset everything to RESERVED for the next round.
+  for (int p = 0; p < P; p++) {
+    t.Store(send_slots[p], kReserved);
+    t.Store(recv_slots[p], kReserved);
+  }
+  for (int p = 0; p < P; p++) {
+    t.Free(send_slots[p]);
+    t.Free(recv_slots[p]);
+  }
+  proxy.Stop();
+  delete send_chan;
+  delete recv_chan;
+  std::printf("  partitioned lifecycle: ok\n");
+}
+
+void test_proxy_idle_is_cheap() {
+  FlagTable t(4096);
+  FakeTransport tr;
+  Proxy proxy(&t, &tr);
+  proxy.Start();
+  std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  auto s = proxy.stats();
+  proxy.Stop();
+  // With an empty table the proxy must park, not spin (reference busy-spins
+  // O(nflags) forever). 200ms parked in 50ms naps => a handful of sweeps.
+  CHECK(s.sweeps < 1000);
+  std::printf("  idle proxy sweeps in 200ms: %llu (parked): ok\n",
+              static_cast<unsigned long long>(s.sweeps));
+}
+
+}  // namespace
+
+int main() {
+  std::printf("test_core:\n");
+  test_allocator_exhaustion();
+  test_concurrent_allocator();
+  test_sendrecv_lifecycle();
+  test_cleanup_never_leaks();
+  test_partitioned_lifecycle();
+  test_proxy_idle_is_cheap();
+  std::printf("test_core: ALL OK\n");
+  return 0;
+}

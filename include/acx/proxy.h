@@ -1,0 +1,64 @@
+// tpu-acx: proxy (progress) engine.
+//
+// TPU-native counterpart of the reference's progress thread
+// (src/init.cpp:55-154): a host thread that sweeps the flag table and drives
+// the data plane on behalf of device-ordered execution. Differences from the
+// reference, deliberately:
+//   * CLEANUP is scanned as a top-level state every sweep (the reference only
+//     reclaims CLEANUP inside its ISSUED branch and can leak slots);
+//   * no completion mutex: the proxy publishes op.status with a release store
+//     of COMPLETED, and consumers arbitrate COMPLETED->CLEANUP by CAS;
+//   * adaptive backoff (spin -> yield -> sleep -> idle condvar) instead of a
+//     hot O(nflags) busy spin, so a shared-core host is not starved.
+#pragma once
+
+#include <condition_variable>
+#include <mutex>
+#include <thread>
+
+#include "acx/state.h"
+#include "acx/transport.h"
+
+namespace acx {
+
+class Proxy {
+ public:
+  Proxy(FlagTable* table, Transport* transport);
+  ~Proxy();
+
+  void Start();
+  void Stop();  // joins; safe to call twice
+
+  // Wake the proxy from idle sleep (call after making any flag PENDING from
+  // the host, or after enqueueing work that will).
+  void Kick();
+
+  // Stats (observability the reference lacks).
+  struct Stats {
+    uint64_t sweeps = 0;
+    uint64_t ops_issued = 0;
+    uint64_t ops_completed = 0;
+    uint64_t slots_reclaimed = 0;
+  };
+  Stats stats() const;
+
+ private:
+  void Run();
+  // One sweep over the table; returns true if any transition was made.
+  bool Sweep();
+
+  FlagTable* table_;
+  Transport* transport_;
+  std::thread thread_;
+  std::atomic<bool> exit_{false};
+  std::atomic<bool> running_{false};
+
+  std::mutex idle_mu_;
+  std::condition_variable idle_cv_;
+  std::atomic<uint64_t> kicks_{0};
+
+  mutable std::mutex stats_mu_;
+  Stats stats_;
+};
+
+}  // namespace acx

@@ -1,0 +1,138 @@
+// tpu-acx: core operation state machine.
+//
+// Redesign of the reference's slot/flag state machine
+// (mpi-acx-internal.h:143-203 in NVIDIA/mpi-acx) for a TPU-native runtime:
+//   * flags are std::atomic<int32_t> with acquire/release ordering instead of
+//     `volatile int` (the reference relies on x86 coherence of mapped pinned
+//     memory; see its FIXME at triggered.cpp:40-44),
+//   * CLEANUP is a first-class proxy-scanned state (the reference leaks slots
+//     that enter CLEANUP outside the proxy's ISSUED branch),
+//   * all transitions that can race are CAS transitions.
+//
+// State machine (same shape as the reference, mpi-acx-internal.h:143-189):
+//
+//   enqueued send/recv (stream):
+//     AVAILABLE -> RESERVED   slot allocated by the enqueue call
+//     RESERVED  -> PENDING    the execution queue reaches the trigger point
+//     PENDING   -> ISSUED     proxy posts the transfer on the data plane
+//     ISSUED    -> COMPLETED  proxy observes transfer completion
+//     COMPLETED -> CLEANUP    the queue's wait point (or host wait) consumed it
+//     CLEANUP   -> AVAILABLE  proxy reclaims ticket + slot
+//
+//   enqueued send/recv (graph): identical until COMPLETED; the graph's wait
+//     only *observes* COMPLETED so the op can re-fire on every graph launch;
+//     slot reclaimed when the graph is destroyed.
+//
+//   partitioned, per-partition slot:
+//     AVAILABLE -> RESERVED   at Psend/Precv_init
+//     (recv)  RESERVED -> ISSUED    at Start
+//     (send)  RESERVED -> PENDING   at Pready (host or device)
+//     PENDING  -> COMPLETED   proxy pushed the partition to the wire
+//     ISSUED   -> COMPLETED   proxy observed the partition's arrival
+//     COMPLETED -> RESERVED   host Wait resets the partition for restart
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+#include <memory>
+
+namespace acx {
+
+enum Flag : int32_t {
+  kAvailable = 0,
+  kReserved = 1,
+  kPending = 2,
+  kIssued = 3,
+  kCompleted = 4,
+  kCleanup = 5,
+};
+
+const char* FlagName(int32_t f);
+
+enum class OpKind : int32_t {
+  kNone = 0,
+  kIsend,
+  kIrecv,
+  kPready,    // send-side partition readiness
+  kParrived,  // recv-side partition arrival poll
+};
+
+// Transfer completion status (maps onto MPI_Status in the compat layer).
+struct Status {
+  int source = -1;
+  int tag = -1;
+  int error = 0;
+  size_t bytes = 0;
+};
+
+class Ticket;            // transport.h
+struct PartitionedChan;  // transport.h
+
+// Per-slot operation descriptor read by the proxy thread. Fields are written
+// by the enqueueing thread strictly before the flag is made PENDING
+// (release store), and read by the proxy strictly after observing PENDING
+// (acquire load), so no further synchronization is needed.
+struct Op {
+  OpKind kind = OpKind::kNone;
+
+  // -- enqueued send/recv --
+  const void* sbuf = nullptr;
+  void* rbuf = nullptr;
+  size_t bytes = 0;
+  int peer = -1;
+  int tag = 0;
+  int ctx = 0;             // communicator context id
+  Ticket* ticket = nullptr;        // owned; posted by proxy at PENDING->ISSUED
+  Status status;                   // written by proxy before COMPLETED
+  void* owner = nullptr;           // MPIX request to free at CLEANUP (or null)
+
+  // -- partitioned --
+  PartitionedChan* chan = nullptr;
+  int partition = -1;
+
+  void Reset() { *this = Op{}; }
+};
+
+// Lock-free slot table: an array of atomic flags plus parallel Op
+// descriptors. Allocation is CAS(AVAILABLE->RESERVED) with a rotating hint
+// (fixes the reference's single-issuing-thread-only allocator,
+// triggered.cpp:40-44).
+class FlagTable {
+ public:
+  explicit FlagTable(size_t n);
+  ~FlagTable();
+
+  // Returns a slot index whose flag is now RESERVED, or -1 if exhausted.
+  int Allocate();
+  // Resets the op and makes the slot AVAILABLE again (release).
+  void Free(int idx);
+
+  size_t size() const { return n_; }
+  Op& op(int idx) { return ops_[idx]; }
+
+  int32_t Load(int idx, std::memory_order mo = std::memory_order_acquire) const {
+    return flags_[idx].load(mo);
+  }
+  void Store(int idx, int32_t v, std::memory_order mo = std::memory_order_release) {
+    flags_[idx].store(v, mo);
+  }
+  bool Cas(int idx, int32_t expect, int32_t desired) {
+    return flags_[idx].compare_exchange_strong(expect, desired,
+                                               std::memory_order_acq_rel,
+                                               std::memory_order_acquire);
+  }
+  // Raw pointer to the flag word array (exposed to Python / device mirrors).
+  std::atomic<int32_t>* raw() { return flags_.get(); }
+
+  // Number of non-AVAILABLE slots; the proxy idles when zero.
+  std::atomic<int64_t> active{0};
+
+ private:
+  size_t n_;
+  std::unique_ptr<std::atomic<int32_t>[]> flags_;
+  std::unique_ptr<Op[]> ops_;
+  std::atomic<uint32_t> hint_{0};
+};
+
+}  // namespace acx

@@ -1,0 +1,78 @@
+// tpu-acx: data-plane abstraction.
+//
+// The reference's data plane is MPI itself (CUDA-aware MPI_Isend/Irecv plus
+// the MPI 4.0 partitioned API; SURVEY.md §2 "Distributed communication
+// backend"). The TPU rebuild splits the data plane in two:
+//   * the ICI plane lives in XLA (jax collectives / Pallas remote DMA) and
+//     never passes through this interface;
+//   * the host/DCN plane is this Transport: a native message-passing backend
+//     the proxy thread drives on the device's behalf.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+#include "acx/state.h"
+
+namespace acx {
+
+// Completion handle for a posted nonblocking transfer. Owned by the op slot;
+// deleted by whoever reclaims the slot.
+class Ticket {
+ public:
+  virtual ~Ticket() = default;
+  // Nonblocking completion probe; fills *st and returns true exactly once
+  // the transfer is done. Must be cheap — the proxy calls it every sweep.
+  virtual bool Test(Status* st) = 0;
+};
+
+// A partitioned channel: one logical N-partition message in flight
+// (send side or recv side), matching the shape of MPI_Psend_init /
+// MPI_Precv_init. Created once, restarted many times.
+struct PartitionedChan {
+  virtual ~PartitionedChan() = default;
+  // Send side: push partition p to the wire (buffer region is
+  // [p*part_bytes, (p+1)*part_bytes)).
+  virtual void Pready(int p) = 0;
+  // Recv side: has partition p of the current round landed in the buffer?
+  virtual bool Parrived(int p) = 0;
+  // Start a new round: reset arrival/readiness accounting.
+  virtual void StartRound() = 0;
+  // Block until the whole round is on the wire (send) / landed (recv).
+  virtual void FinishRound(Status* st) = 0;
+
+  int partitions = 0;
+  size_t part_bytes = 0;
+  bool is_send = false;
+};
+
+class Transport {
+ public:
+  virtual ~Transport() = default;
+
+  virtual int rank() const = 0;
+  virtual int size() const = 0;
+
+  // Nonblocking point-to-point. ctx is the communicator context id; matching
+  // is FIFO per (src, tag, ctx). Returned Ticket is owned by the caller.
+  virtual Ticket* Isend(const void* buf, size_t bytes, int dst, int tag,
+                        int ctx) = 0;
+  virtual Ticket* Irecv(void* buf, size_t bytes, int src, int tag, int ctx) = 0;
+
+  // Partitioned channels (persistent, restartable).
+  virtual PartitionedChan* PsendInit(const void* buf, int partitions,
+                                     size_t part_bytes, int dst, int tag,
+                                     int ctx) = 0;
+  virtual PartitionedChan* PrecvInit(void* buf, int partitions,
+                                     size_t part_bytes, int src, int tag,
+                                     int ctx) = 0;
+
+  // Control-plane collectives used by init/teardown and the compat layer.
+  virtual void Barrier(int ctx) = 0;
+  // op: 0=MAX 1=MIN 2=SUM over int32 elements, in place.
+  virtual void AllreduceInt(int32_t* data, int count, int op, int ctx) = 0;
+
+  virtual void Abort(int code) = 0;
+};
+
+}  // namespace acx

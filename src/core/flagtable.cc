@@ -1,0 +1,49 @@
+#include "acx/state.h"
+
+namespace acx {
+
+const char* FlagName(int32_t f) {
+  switch (f) {
+    case kAvailable: return "AVAILABLE";
+    case kReserved: return "RESERVED";
+    case kPending: return "PENDING";
+    case kIssued: return "ISSUED";
+    case kCompleted: return "COMPLETED";
+    case kCleanup: return "CLEANUP";
+    default: return "<invalid>";
+  }
+}
+
+FlagTable::FlagTable(size_t n)
+    : n_(n),
+      flags_(new std::atomic<int32_t>[n]),
+      ops_(new Op[n]) {
+  for (size_t i = 0; i < n_; i++)
+    flags_[i].store(kAvailable, std::memory_order_relaxed);
+  std::atomic_thread_fence(std::memory_order_release);
+}
+
+FlagTable::~FlagTable() = default;
+
+int FlagTable::Allocate() {
+  const uint32_t start = hint_.fetch_add(1, std::memory_order_relaxed);
+  for (size_t probe = 0; probe < n_; probe++) {
+    const size_t i = (start + probe) % n_;
+    int32_t expect = kAvailable;
+    if (flags_[i].compare_exchange_strong(expect, kReserved,
+                                          std::memory_order_acq_rel,
+                                          std::memory_order_relaxed)) {
+      active.fetch_add(1, std::memory_order_relaxed);
+      return static_cast<int>(i);
+    }
+  }
+  return -1;
+}
+
+void FlagTable::Free(int idx) {
+  ops_[idx].Reset();
+  flags_[idx].store(kAvailable, std::memory_order_release);
+  active.fetch_sub(1, std::memory_order_relaxed);
+}
+
+}  // namespace acx

@@ -1,0 +1,164 @@
+#include "acx/proxy.h"
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+
+namespace acx {
+
+Proxy::Proxy(FlagTable* table, Transport* transport)
+    : table_(table), transport_(transport) {}
+
+Proxy::~Proxy() { Stop(); }
+
+void Proxy::Start() {
+  if (running_.exchange(true)) return;
+  exit_.store(false, std::memory_order_release);
+  thread_ = std::thread([this] { Run(); });
+}
+
+void Proxy::Stop() {
+  if (!running_.exchange(false)) return;
+  exit_.store(true, std::memory_order_release);
+  Kick();
+  if (thread_.joinable()) thread_.join();
+}
+
+void Proxy::Kick() {
+  kicks_.fetch_add(1, std::memory_order_release);
+  std::lock_guard<std::mutex> lk(idle_mu_);
+  idle_cv_.notify_all();
+}
+
+Proxy::Stats Proxy::stats() const {
+  std::lock_guard<std::mutex> lk(stats_mu_);
+  return stats_;
+}
+
+bool Proxy::Sweep() {
+  bool progressed = false;
+  Stats local{};
+  const size_t n = table_->size();
+  for (size_t i = 0; i < n; i++) {
+    const int32_t f = table_->Load(i);
+    Op& op = table_->op(i);
+    switch (f) {
+      case kPending: {
+        switch (op.kind) {
+          case OpKind::kIsend:
+            op.ticket = transport_->Isend(op.sbuf, op.bytes, op.peer, op.tag,
+                                          op.ctx);
+            table_->Store(i, kIssued);
+            local.ops_issued++;
+            progressed = true;
+            break;
+          case OpKind::kIrecv:
+            op.ticket = transport_->Irecv(op.rbuf, op.bytes, op.peer, op.tag,
+                                          op.ctx);
+            table_->Store(i, kIssued);
+            local.ops_issued++;
+            progressed = true;
+            break;
+          case OpKind::kPready:
+            // Send-side partition became ready (host call or device-mirrored
+            // flag write): push it to the wire and complete the slot.
+            op.chan->Pready(op.partition);
+            table_->Store(i, kCompleted);
+            local.ops_completed++;
+            progressed = true;
+            break;
+          default:
+            std::fprintf(stderr,
+                         "tpu-acx proxy: invalid PENDING op kind %d slot %zu\n",
+                         static_cast<int>(op.kind), i);
+            transport_->Abort(13);
+        }
+        break;
+      }
+      case kIssued: {
+        switch (op.kind) {
+          case OpKind::kIsend:
+          case OpKind::kIrecv: {
+            // op.status is written before the release store of COMPLETED, so
+            // any thread that acquires COMPLETED sees a coherent status (the
+            // reference needed a mutex here; see its init.cpp:119-141).
+            if (op.ticket != nullptr && op.ticket->Test(&op.status)) {
+              table_->Store(i, kCompleted);
+              local.ops_completed++;
+              progressed = true;
+            }
+            break;
+          }
+          case OpKind::kParrived: {
+            if (op.chan->Parrived(op.partition)) {
+              table_->Store(i, kCompleted);
+              local.ops_completed++;
+              progressed = true;
+            }
+            break;
+          }
+          default:
+            break;  // kPready never sits in ISSUED
+        }
+        break;
+      }
+      case kCleanup: {
+        // First-class reclaim state (fixes the reference's slot leak).
+        delete op.ticket;
+        op.ticket = nullptr;
+        std::free(op.owner);
+        op.owner = nullptr;
+        table_->Free(static_cast<int>(i));
+        local.slots_reclaimed++;
+        progressed = true;
+        break;
+      }
+      default:
+        break;  // AVAILABLE / RESERVED / COMPLETED need no proxy action
+    }
+  }
+  if (local.ops_issued | local.ops_completed | local.slots_reclaimed) {
+    std::lock_guard<std::mutex> lk(stats_mu_);
+    stats_.ops_issued += local.ops_issued;
+    stats_.ops_completed += local.ops_completed;
+    stats_.slots_reclaimed += local.slots_reclaimed;
+  }
+  return progressed;
+}
+
+void Proxy::Run() {
+  // Backoff ladder: spin a few sweeps, then yield, then sleep with
+  // exponential growth capped at 200us; park on the condvar when the table
+  // is fully idle. Kick() wakes us immediately in all cases.
+  int idle_sweeps = 0;
+  while (!exit_.load(std::memory_order_acquire)) {
+    const uint64_t kicks_before = kicks_.load(std::memory_order_acquire);
+    bool progressed = Sweep();
+    {
+      std::lock_guard<std::mutex> lk(stats_mu_);
+      stats_.sweeps++;
+    }
+    if (progressed) {
+      idle_sweeps = 0;
+      continue;
+    }
+    idle_sweeps++;
+    if (table_->active.load(std::memory_order_relaxed) == 0) {
+      // Nothing in flight: park until someone enqueues work.
+      std::unique_lock<std::mutex> lk(idle_mu_);
+      idle_cv_.wait_for(lk, std::chrono::milliseconds(50), [&] {
+        return exit_.load(std::memory_order_acquire) ||
+               kicks_.load(std::memory_order_acquire) != kicks_before ||
+               table_->active.load(std::memory_order_relaxed) != 0;
+      });
+      idle_sweeps = 0;
+    } else if (idle_sweeps < 64) {
+      std::this_thread::yield();
+    } else {
+      const int exp = idle_sweeps - 64 < 8 ? idle_sweeps - 64 : 8;
+      std::this_thread::sleep_for(std::chrono::microseconds(1 << exp));
+    }
+  }
+}
+
+}  // namespace acx
