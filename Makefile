@@ -1,31 +1,39 @@
 # tpu-acx native runtime build.
 # Counterpart of the reference's nvcc Makefile (reference Makefile:1-49), but
 # plain g++: the device compiler on TPU is XLA/Pallas, reached from Python;
-# everything here is host-side runtime.
+# everything here is host-side runtime (proxy, transport, stream/graph queue,
+# public MPIX API, launcher).
+#
+# Knobs (mirroring reference Makefile:1-6):
+#   CXX              host compiler (default g++)
+#   ACX_DEBUG=1      compile in debug logging (reference: -DDEBUG)
 
 CXX      ?= g++
 CXXFLAGS ?= -O2 -g -Wall -Wextra -std=c++17 -fPIC -pthread
 INCLUDES  = -Iinclude
 LDFLAGS   = -pthread
 
+ifeq ($(ACX_DEBUG), 1)
+CXXFLAGS += -DACX_DEBUG
+endif
+
 BUILD := build
 
-CORE_SRCS := src/core/flagtable.cc src/core/proxy.cc
-SHIM_SRCS := src/shim/transport.cc src/shim/mpi_shim.cc
-RT_SRCS   := src/runtime/stream.cc src/runtime/cuda_shim.cc
-API_SRCS  := src/api/mpix.cc
-
-LIB_SRCS := $(CORE_SRCS) $(SHIM_SRCS) $(RT_SRCS) $(API_SRCS)
+# Sources are wildcarded: every directory below is part of the library the
+# moment its files exist, and `make all` never references a file that does not.
+LIB_SRCS := $(wildcard src/core/*.cc) \
+            $(wildcard src/net/*.cc) \
+            $(wildcard src/runtime/*.cc) \
+            $(wildcard src/shim/*.cc) \
+            $(wildcard src/api/*.cc)
 LIB_OBJS := $(LIB_SRCS:%.cc=$(BUILD)/%.o)
 
 LIB       = $(BUILD)/libtpuacx.so
 STATICLIB = $(BUILD)/libtpuacx.a
 
-CTEST_BINS = $(BUILD)/test_core
+.PHONY: all lib tools ctest itest check reftests clean
 
-.PHONY: all lib clean check ctest
-
-all: lib tools ctest
+all: lib tools ctest itest
 
 lib: $(LIB) $(STATICLIB)
 
@@ -39,22 +47,65 @@ $(LIB): $(LIB_OBJS)
 $(STATICLIB): $(LIB_OBJS)
 	ar rcs $@ $(LIB_OBJS)
 
-# --- unit tests (no transport needed) ---
-ctest: $(CTEST_BINS)
+# --- launcher (reference: mpiexec; ours: acxrun) ---
+TOOL_SRCS := $(wildcard tools/*.cc)
+TOOL_BINS := $(TOOL_SRCS:tools/%.cc=$(BUILD)/%)
 
-$(BUILD)/test_core: ctests/test_core.cc $(BUILD)/src/core/flagtable.o $(BUILD)/src/core/proxy.o
-	$(CXX) $(CXXFLAGS) $(INCLUDES) $^ -o $@ $(LDFLAGS)
+tools: $(TOOL_BINS)
 
-check: ctest
-	$(BUILD)/test_core
-
-# --- launcher ---
-.PHONY: tools
-tools: $(BUILD)/acxrun
-
-$(BUILD)/acxrun: tools/acxrun.cc
+$(BUILD)/%: tools/%.cc
 	@mkdir -p $(BUILD)
 	$(CXX) $(CXXFLAGS) $(INCLUDES) $< -o $@ $(LDFLAGS)
+
+# --- unit tests (single process, fake transport) ---
+CTEST_SRCS := $(wildcard ctests/*.cc)
+CTEST_BINS := $(CTEST_SRCS:ctests/%.cc=$(BUILD)/%)
+
+ctest: $(CTEST_BINS)
+
+$(BUILD)/%: ctests/%.cc $(STATICLIB)
+	$(CXX) $(CXXFLAGS) $(INCLUDES) $< $(STATICLIB) -o $@ $(LDFLAGS)
+
+# --- integration tests (multi-process, run under acxrun) ---
+# Ports of the reference's six ring programs (reference test/src/*); built
+# against the same compat headers (include/compat) the reference tests use.
+ITEST_SRCS := $(wildcard itests/*.c) $(wildcard itests/*.cc)
+ITEST_BINS := $(patsubst itests/%.c,$(BUILD)/itests/%,$(filter %.c,$(ITEST_SRCS))) \
+              $(patsubst itests/%.cc,$(BUILD)/itests/%,$(filter %.cc,$(ITEST_SRCS)))
+
+itest: $(ITEST_BINS)
+
+$(BUILD)/itests/%: itests/%.c $(STATICLIB)
+	@mkdir -p $(BUILD)/itests
+	$(CXX) -x c++ $(CXXFLAGS) $(INCLUDES) -Iinclude/compat $< $(STATICLIB) -o $@ $(LDFLAGS)
+
+$(BUILD)/itests/%: itests/%.cc $(STATICLIB)
+	@mkdir -p $(BUILD)/itests
+	$(CXX) $(CXXFLAGS) $(INCLUDES) -Iinclude/compat $< $(STATICLIB) -o $@ $(LDFLAGS)
+
+# --- reference-test source compatibility ---
+# Compiles NVIDIA/mpi-acx's own C test programs UNCHANGED from
+# /root/reference/test/src against our compat headers (mpi.h, cuda_runtime.h,
+# mpi-acx.h) and runs them under acxrun. This is the north-star check:
+# "test/ builds unchanged". (ring-partitioned.cu needs nvcc and is covered by
+# our itests/ring-partitioned port instead.)
+REF_TEST_DIR ?= /root/reference/test/src
+REF_TESTS := ring ring-all ring-all-device ring-all-graph ring-all-graph-construction
+REF_BINS  := $(REF_TESTS:%=$(BUILD)/reftests/%)
+
+reftests: $(REF_BINS) tools
+	@for t in $(REF_BINS); do echo "== acxrun -np 2 $$t"; $(BUILD)/acxrun -np 2 $$t || exit 1; done
+	@echo "ALL REFERENCE TESTS PASSED"
+
+$(BUILD)/reftests/%: $(REF_TEST_DIR)/%.c $(STATICLIB)
+	@mkdir -p $(BUILD)/reftests
+	$(CXX) -x c++ $(CXXFLAGS) -Wno-unused-parameter $(INCLUDES) -Iinclude/compat $< $(STATICLIB) -o $@ $(LDFLAGS)
+
+# --- run everything ---
+check: ctest itest tools
+	@for t in $(CTEST_BINS); do echo "== $$t"; $$t || exit 1; done
+	@for t in $(ITEST_BINS); do echo "== acxrun -np 2 $$t"; $(BUILD)/acxrun -np 2 $$t || exit 1; done
+	@echo "ALL NATIVE TESTS PASSED"
 
 clean:
 	rm -rf $(BUILD)

@@ -33,7 +33,8 @@ class Proxy {
   // the host, or after enqueueing work that will).
   void Kick();
 
-  // Stats (observability the reference lacks).
+  // Stats (observability the reference lacks). Counters are plain atomics so
+  // the hot sweep loop never takes a lock.
   struct Stats {
     uint64_t sweeps = 0;
     uint64_t ops_issued = 0;
@@ -57,8 +58,10 @@ class Proxy {
   std::condition_variable idle_cv_;
   std::atomic<uint64_t> kicks_{0};
 
-  mutable std::mutex stats_mu_;
-  Stats stats_;
+  std::atomic<uint64_t> sweeps_{0};
+  std::atomic<uint64_t> ops_issued_{0};
+  std::atomic<uint64_t> ops_completed_{0};
+  std::atomic<uint64_t> slots_reclaimed_{0};
 };
 
 }  // namespace acx

@@ -1,5 +1,7 @@
 #include "acx/state.h"
 
+#include "acx/transport.h"
+
 namespace acx {
 
 const char* FlagName(int32_t f) {
@@ -23,7 +25,11 @@ FlagTable::FlagTable(size_t n)
   std::atomic_thread_fence(std::memory_order_release);
 }
 
-FlagTable::~FlagTable() = default;
+FlagTable::~FlagTable() {
+  // Tickets on still-live slots (teardown with in-flight ops) are reclaimed
+  // here so destruction is leak-safe, matching the Free() guarantee.
+  for (size_t i = 0; i < n_; i++) delete ops_[i].ticket;
+}
 
 int FlagTable::Allocate() {
   const uint32_t start = hint_.fetch_add(1, std::memory_order_relaxed);
@@ -41,6 +47,11 @@ int FlagTable::Allocate() {
 }
 
 void FlagTable::Free(int idx) {
+  // Release any completion ticket still attached to the op so that Free is
+  // leak-safe from every path (proxy CLEANUP, host Wait, graph teardown).
+  // `owner` (the public request object) is deliberately NOT released here:
+  // its lifetime belongs to whichever side consumed the op.
+  delete ops_[idx].ticket;
   ops_[idx].Reset();
   flags_[idx].store(kAvailable, std::memory_order_release);
   active.fetch_sub(1, std::memory_order_relaxed);

@@ -31,8 +31,12 @@ void Proxy::Kick() {
 }
 
 Proxy::Stats Proxy::stats() const {
-  std::lock_guard<std::mutex> lk(stats_mu_);
-  return stats_;
+  Stats s;
+  s.sweeps = sweeps_.load(std::memory_order_relaxed);
+  s.ops_issued = ops_issued_.load(std::memory_order_relaxed);
+  s.ops_completed = ops_completed_.load(std::memory_order_relaxed);
+  s.slots_reclaimed = slots_reclaimed_.load(std::memory_order_relaxed);
+  return s;
 }
 
 bool Proxy::Sweep() {
@@ -104,8 +108,7 @@ bool Proxy::Sweep() {
       }
       case kCleanup: {
         // First-class reclaim state (fixes the reference's slot leak).
-        delete op.ticket;
-        op.ticket = nullptr;
+        // op.ticket is deleted inside FlagTable::Free.
         std::free(op.owner);
         op.owner = nullptr;
         table_->Free(static_cast<int>(i));
@@ -117,12 +120,9 @@ bool Proxy::Sweep() {
         break;  // AVAILABLE / RESERVED / COMPLETED need no proxy action
     }
   }
-  if (local.ops_issued | local.ops_completed | local.slots_reclaimed) {
-    std::lock_guard<std::mutex> lk(stats_mu_);
-    stats_.ops_issued += local.ops_issued;
-    stats_.ops_completed += local.ops_completed;
-    stats_.slots_reclaimed += local.slots_reclaimed;
-  }
+  if (local.ops_issued) ops_issued_.fetch_add(local.ops_issued, std::memory_order_relaxed);
+  if (local.ops_completed) ops_completed_.fetch_add(local.ops_completed, std::memory_order_relaxed);
+  if (local.slots_reclaimed) slots_reclaimed_.fetch_add(local.slots_reclaimed, std::memory_order_relaxed);
   return progressed;
 }
 
@@ -134,10 +134,7 @@ void Proxy::Run() {
   while (!exit_.load(std::memory_order_acquire)) {
     const uint64_t kicks_before = kicks_.load(std::memory_order_acquire);
     bool progressed = Sweep();
-    {
-      std::lock_guard<std::mutex> lk(stats_mu_);
-      stats_.sweeps++;
-    }
+    sweeps_.fetch_add(1, std::memory_order_relaxed);
     if (progressed) {
       idle_sweeps = 0;
       continue;
