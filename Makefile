@@ -59,11 +59,12 @@ $(BUILD)/%: tools/%.cc
 
 # --- unit tests (single process, fake transport) ---
 CTEST_SRCS := $(wildcard ctests/*.cc)
-CTEST_BINS := $(CTEST_SRCS:ctests/%.cc=$(BUILD)/%)
+CTEST_BINS := $(CTEST_SRCS:ctests/%.cc=$(BUILD)/ctests/%)
 
 ctest: $(CTEST_BINS)
 
-$(BUILD)/%: ctests/%.cc $(STATICLIB)
+$(BUILD)/ctests/%: ctests/%.cc $(STATICLIB)
+	@mkdir -p $(BUILD)/ctests
 	$(CXX) $(CXXFLAGS) $(INCLUDES) $< $(STATICLIB) -o $@ $(LDFLAGS)
 
 # --- integration tests (multi-process, run under acxrun) ---

@@ -1,0 +1,223 @@
+// Unit tests for SocketTransport + partitioned channels + proxy integration.
+//
+// Two transports live in one process, connected by a real socketpair, with
+// rank 1 driven from a second thread — the same shape the reference only
+// ever tests via two mpiexec ranks (reference test/src/ring.c), but
+// unit-testable. Covers: basic sendrecv, FIFO (src,tag,ctx) matching with
+// out-of-order tags, large (multi-MB, > socket buffer) payloads, self-send,
+// barrier, allreduce, partitioned rounds with out-of-order Pready, and the
+// full proxy-driven enqueued lifecycle over a real wire.
+
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <memory>
+#include <thread>
+#include <vector>
+
+#include "acx/net.h"
+#include "acx/proxy.h"
+#include "acx/state.h"
+
+#define CHECK(cond)                                                        \
+  do {                                                                     \
+    if (!(cond)) {                                                         \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      std::exit(1);                                                        \
+    }                                                                      \
+  } while (0)
+
+namespace {
+
+struct Pair {
+  std::unique_ptr<acx::Transport> t0, t1;
+  Pair() {
+    int a[2], b[2];
+    CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, a) == 0);
+    // fds vector: index = peer rank; own slot unused.
+    t0.reset(acx::CreateSocketTransport(0, 2, {-1, a[0]}));
+    t1.reset(acx::CreateSocketTransport(1, 2, {a[1], -1}));
+    (void)b;
+  }
+};
+
+void WaitDone(acx::Ticket* t, acx::Status* st) {
+  while (!t->Test(st)) std::this_thread::yield();
+}
+
+void test_basic_sendrecv() {
+  Pair p;
+  int sv = 42, rv = -1;
+  std::unique_ptr<acx::Ticket> s(p.t0->Isend(&sv, sizeof sv, 1, 7, 0));
+  std::unique_ptr<acx::Ticket> r(p.t1->Irecv(&rv, sizeof rv, 0, 7, 0));
+  acx::Status st;
+  WaitDone(r.get(), &st);
+  WaitDone(s.get(), nullptr);
+  CHECK(rv == 42);
+  CHECK(st.source == 0 && st.tag == 7 && st.error == 0 &&
+        st.bytes == sizeof sv);
+  std::printf("  transport basic sendrecv: ok\n");
+}
+
+void test_matching_out_of_order_tags() {
+  Pair p;
+  int a = 1, b = 2, ra = 0, rb = 0;
+  // Send tag 5 then tag 6; recv tag 6 first. Matching is by tag, FIFO
+  // within a tag.
+  std::unique_ptr<acx::Ticket> s1(p.t0->Isend(&a, sizeof a, 1, 5, 0));
+  std::unique_ptr<acx::Ticket> s2(p.t0->Isend(&b, sizeof b, 1, 6, 0));
+  acx::Status st;
+  std::unique_ptr<acx::Ticket> r2(p.t1->Irecv(&rb, sizeof rb, 0, 6, 0));
+  WaitDone(r2.get(), &st);
+  CHECK(rb == 2 && st.tag == 6);
+  std::unique_ptr<acx::Ticket> r1(p.t1->Irecv(&ra, sizeof ra, 0, 5, 0));
+  WaitDone(r1.get(), &st);
+  CHECK(ra == 1 && st.tag == 5);
+  WaitDone(s1.get(), nullptr);
+  WaitDone(s2.get(), nullptr);
+  std::printf("  transport tag matching: ok\n");
+}
+
+void test_large_message() {
+  Pair p;
+  const size_t n = 8u << 20;  // 8 MiB, far beyond AF_UNIX buffering
+  std::vector<char> src(n), dst(n, 0);
+  for (size_t i = 0; i < n; i++) src[i] = static_cast<char>(i * 31 + 7);
+  // Both sides must make progress concurrently: run rank 1 in a thread.
+  std::thread peer([&] {
+    std::unique_ptr<acx::Ticket> r(p.t1->Irecv(dst.data(), n, 0, 1, 0));
+    acx::Status st;
+    WaitDone(r.get(), &st);
+    CHECK(st.bytes == n);
+  });
+  std::unique_ptr<acx::Ticket> s(p.t0->Isend(src.data(), n, 1, 1, 0));
+  WaitDone(s.get(), nullptr);
+  peer.join();
+  CHECK(memcmp(src.data(), dst.data(), n) == 0);
+  std::printf("  transport 8MiB message: ok\n");
+}
+
+void test_self_send() {
+  std::unique_ptr<acx::Transport> t(acx::CreateSelfTransport());
+  int sv = 9, rv = 0;
+  std::unique_ptr<acx::Ticket> s(t->Isend(&sv, sizeof sv, 0, 3, 0));
+  std::unique_ptr<acx::Ticket> r(t->Irecv(&rv, sizeof rv, 0, 3, 0));
+  acx::Status st;
+  WaitDone(r.get(), &st);
+  WaitDone(s.get(), nullptr);
+  CHECK(rv == 9 && st.source == 0);
+  std::printf("  self transport loopback: ok\n");
+}
+
+void test_barrier_allreduce() {
+  Pair p;
+  std::thread peer([&] {
+    p.t1->Barrier(0);
+    int32_t v[2] = {5, -3};
+    p.t1->AllreduceInt(v, 2, 0, 0);  // MAX
+    CHECK(v[0] == 7 && v[1] == -3);
+  });
+  p.t0->Barrier(0);
+  int32_t v[2] = {7, -9};
+  p.t0->AllreduceInt(v, 2, 0, 0);
+  CHECK(v[0] == 7 && v[1] == -3);
+  peer.join();
+  std::printf("  barrier + allreduce(max): ok\n");
+}
+
+void test_partitioned_round_trip() {
+  Pair p;
+  constexpr int kParts = 10;
+  constexpr int kIters = 3;
+  int send[kParts], recv[kParts];
+  std::unique_ptr<acx::PartitionedChan> tx(
+      p.t0->PsendInit(send, kParts, sizeof(int), 1, 2, 0));
+  std::unique_ptr<acx::PartitionedChan> rx(
+      p.t1->PrecvInit(recv, kParts, sizeof(int), 0, 2, 0));
+  for (int it = 0; it < kIters; it++) {
+    for (int i = 0; i < kParts; i++) {
+      send[i] = it * 100 + i;
+      recv[i] = -1;
+    }
+    tx->StartRound();
+    rx->StartRound();
+    // Mark partitions ready out of order — per-partition messages make
+    // this legal by construction.
+    for (int i = kParts - 1; i >= 0; i--) tx->Pready(i);
+    acx::Status st;
+    rx->FinishRound(&st);
+    tx->FinishRound(nullptr);
+    CHECK(st.bytes == sizeof(int) * kParts);
+    for (int i = 0; i < kParts; i++) CHECK(recv[i] == it * 100 + i);
+  }
+  std::printf("  partitioned %d-part x%d rounds (out-of-order Pready): ok\n",
+              kParts, kIters);
+}
+
+// The full L1+L2+L0 stack over a real wire: two proxies, two flag tables,
+// enqueued isend/irecv lifecycle driven purely by flag transitions — the
+// unit-level equivalent of the reference's ring.c flow (sendrecv.cu:129-327
+// + init.cpp:55-154).
+void test_proxy_over_wire() {
+  Pair p;
+  acx::FlagTable ft0(64), ft1(64);
+  acx::Proxy px0(&ft0, p.t0.get()), px1(&ft1, p.t1.get());
+  px0.Start();
+  px1.Start();
+
+  int sv = 1234, rv = -1;
+  // Rank 0: enqueue a send op and trigger it (as the stream would).
+  int si = ft0.Allocate();
+  CHECK(si >= 0);
+  acx::Op& so = ft0.op(si);
+  so.kind = acx::OpKind::kIsend;
+  so.sbuf = &sv;
+  so.bytes = sizeof sv;
+  so.peer = 1;
+  so.tag = 9;
+  ft0.Store(si, acx::kPending);
+  px0.Kick();
+
+  // Rank 1: enqueue the matching recv.
+  int ri = ft1.Allocate();
+  CHECK(ri >= 0);
+  acx::Op& ro = ft1.op(ri);
+  ro.kind = acx::OpKind::kIrecv;
+  ro.rbuf = &rv;
+  ro.bytes = sizeof rv;
+  ro.peer = 0;
+  ro.tag = 9;
+  ft1.Store(ri, acx::kPending);
+  px1.Kick();
+
+  // Host-wait on both (spin until COMPLETED), then CLEANUP.
+  while (ft1.Load(ri) != acx::kCompleted) std::this_thread::yield();
+  CHECK(rv == 1234);
+  CHECK(ro.status.source == 0 && ro.status.tag == 9);
+  while (ft0.Load(si) != acx::kCompleted) std::this_thread::yield();
+  ft0.Store(si, acx::kCleanup);
+  ft1.Store(ri, acx::kCleanup);
+  px0.Kick();
+  px1.Kick();
+  while (ft0.active.load() != 0 || ft1.active.load() != 0)
+    std::this_thread::yield();
+  px0.Stop();
+  px1.Stop();
+  std::printf("  proxy-driven enqueued sendrecv over wire: ok\n");
+}
+
+}  // namespace
+
+int main() {
+  test_basic_sendrecv();
+  test_matching_out_of_order_tags();
+  test_large_message();
+  test_self_send();
+  test_barrier_allreduce();
+  test_partitioned_round_trip();
+  test_proxy_over_wire();
+  std::printf("test_transport: ALL OK\n");
+  return 0;
+}

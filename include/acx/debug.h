@@ -1,0 +1,35 @@
+// tpu-acx: debug logging (counterpart of the reference's DEBUGMSG,
+// mpi-acx-internal.h:129-139, compiled in with -DDEBUG).
+//
+// Two gates, matching the reference's compile-time + our own runtime knob:
+//   * compile-time: build with ACX_DEBUG=1 (make) -> -DACX_DEBUG
+//   * run-time:     env ACX_DEBUG=1 enables output in debug builds
+#pragma once
+
+#include <cstdio>
+#include <cstdlib>
+
+namespace acx {
+
+inline bool DebugEnabled() {
+#ifdef ACX_DEBUG
+  static const bool on = [] {
+    const char* e = std::getenv("ACX_DEBUG");
+    return e != nullptr && e[0] != '0';
+  }();
+  return on;
+#else
+  return false;
+#endif
+}
+
+}  // namespace acx
+
+#define ACX_DLOG(...)                              \
+  do {                                             \
+    if (::acx::DebugEnabled()) {                   \
+      std::fprintf(stderr, "[acx debug] %s:%d: ", __FILE__, __LINE__); \
+      std::fprintf(stderr, __VA_ARGS__);           \
+      std::fprintf(stderr, "\n");                  \
+    }                                              \
+  } while (0)

@@ -1,0 +1,38 @@
+// tpu-acx: concrete data-plane backends.
+//
+// The reference's data plane is the MPI library itself (SURVEY.md §2
+// "Distributed communication backend"; reference src/init.cpp:66-141 posts
+// MPI_Isend/Irecv/Test through it). tpu-acx replaces that with its own
+// native backends:
+//   * SocketTransport — multi-process message passing over pre-connected
+//     AF_UNIX socketpairs set up by the `acxrun` launcher (tools/acxrun.cc),
+//     the role `mpiexec` plays for the reference. This is the host/DCN
+//     plane; on a TPU pod the equivalent wires are the DCN links between
+//     hosts, while intra-slice traffic rides ICI via XLA collectives from
+//     the Python layer (mpi_acx_tpu.parallel).
+//   * SelfTransport — size-1 loopback used by unit tests and by
+//     single-process Python sessions.
+#pragma once
+
+#include <vector>
+
+#include "acx/transport.h"
+
+namespace acx {
+
+// Builds the process's transport from the environment:
+//   ACX_RANK / ACX_SIZE  — set by acxrun
+//   ACX_FDS              — comma-separated socket fds, one per peer rank,
+//                          "-1" at our own position
+// Falls back to SelfTransport when ACX_SIZE is absent or 1.
+// Caller owns the result.
+Transport* CreateTransportFromEnv();
+
+// Direct constructor used by unit tests: rank/size plus one connected
+// stream-socket fd per peer (fds[rank] ignored). Takes ownership of the fds.
+Transport* CreateSocketTransport(int rank, int size,
+                                 const std::vector<int>& fds);
+
+Transport* CreateSelfTransport();
+
+}  // namespace acx

@@ -1,5 +1,7 @@
 #include "acx/state.h"
 
+#include <cstdlib>
+
 #include "acx/transport.h"
 
 namespace acx {
@@ -26,9 +28,13 @@ FlagTable::FlagTable(size_t n)
 }
 
 FlagTable::~FlagTable() {
-  // Tickets on still-live slots (teardown with in-flight ops) are reclaimed
-  // here so destruction is leak-safe, matching the Free() guarantee.
-  for (size_t i = 0; i < n_; i++) delete ops_[i].ticket;
+  // Tickets and owners on still-live slots (teardown with in-flight ops) are
+  // reclaimed here so destruction is leak-safe. `owner` is malloc'd by the
+  // API layer by contract (see Op::owner in state.h).
+  for (size_t i = 0; i < n_; i++) {
+    delete ops_[i].ticket;
+    std::free(ops_[i].owner);
+  }
 }
 
 int FlagTable::Allocate() {

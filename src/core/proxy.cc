@@ -4,6 +4,8 @@
 #include <cstdio>
 #include <cstdlib>
 
+#include "acx/debug.h"
+
 namespace acx {
 
 Proxy::Proxy(FlagTable* table, Transport* transport)
@@ -50,6 +52,8 @@ bool Proxy::Sweep() {
       case kPending: {
         switch (op.kind) {
           case OpKind::kIsend:
+            ACX_DLOG("slot %zu: isend %zuB -> peer %d tag %d", i, op.bytes,
+                     op.peer, op.tag);
             op.ticket = transport_->Isend(op.sbuf, op.bytes, op.peer, op.tag,
                                           op.ctx);
             table_->Store(i, kIssued);
@@ -57,6 +61,8 @@ bool Proxy::Sweep() {
             progressed = true;
             break;
           case OpKind::kIrecv:
+            ACX_DLOG("slot %zu: irecv %zuB <- peer %d tag %d", i, op.bytes,
+                     op.peer, op.tag);
             op.ticket = transport_->Irecv(op.rbuf, op.bytes, op.peer, op.tag,
                                           op.ctx);
             table_->Store(i, kIssued);

@@ -1,0 +1,509 @@
+// tpu-acx: SocketTransport — the multi-process data plane.
+//
+// Plays the role the MPI library plays for the reference (SURVEY.md §2 L0;
+// reference src/init.cpp:66-141 posts MPI_Isend/Irecv/Test): nonblocking
+// point-to-point with FIFO matching per (src, tag, ctx), partitioned
+// channels, and the two control collectives (Barrier, AllreduceInt) the
+// runtime and compat layer need.
+//
+// Wires are AF_UNIX stream socketpairs pre-connected by `acxrun`
+// (tools/acxrun.cc), one per peer, passed down via ACX_FDS. All sockets are
+// nonblocking; Progress() flushes pending writes and drains arrivals, and is
+// driven from Ticket::Test so the proxy's sweep loop is also the transport's
+// progress engine. A single mutex serializes the proxy thread and app
+// threads — the message-rate ceiling of this backend is host-side anyway
+// (on-TPU traffic rides ICI via XLA collectives, not this path).
+
+#include "acx/net.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <sched.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <vector>
+
+namespace acx {
+namespace {
+
+constexpr uint32_t kMagic = 0xAC0C0101u;
+
+// Internal context ids. User contexts are >= 0; the control plane and the
+// partitioned layer get their own namespaces so they can never match user
+// point-to-point traffic.
+constexpr int kCtrlCtx = -2;
+inline int PartCtx(int ctx) { return -1000 - ctx; }
+// Partition p of a tag-tagged partitioned channel travels as its own
+// message; 4096 partitions per channel (the reference's whole slot table is
+// 4096, mpi-acx-internal.h:141, so this bounds nothing in practice).
+inline int PartTag(int tag, int p) { return tag * 4096 + p; }
+
+#pragma pack(push, 1)
+struct WireHeader {
+  uint32_t magic;
+  int32_t tag;
+  int32_t ctx;
+  uint64_t bytes;
+};
+#pragma pack(pop)
+
+struct SendReq {
+  std::vector<char> data;  // header + payload
+  size_t off = 0;
+  bool done = false;
+  Status st;
+};
+
+struct RecvReq {
+  void* buf = nullptr;
+  size_t bytes = 0;
+  int src = -1, tag = 0, ctx = 0;
+  bool done = false;
+  Status st;
+};
+
+struct Msg {
+  int tag = 0, ctx = 0;
+  std::vector<char> payload;
+};
+
+// Incoming-byte-stream assembly state for one peer socket.
+struct InState {
+  WireHeader hdr{};
+  size_t hdr_got = 0;
+  std::vector<char> payload;
+  size_t payload_got = 0;
+};
+
+class SocketTransport;
+
+class SockTicket : public Ticket {
+ public:
+  SockTicket(SocketTransport* t, std::shared_ptr<SendReq> s)
+      : t_(t), send_(std::move(s)) {}
+  SockTicket(SocketTransport* t, std::shared_ptr<RecvReq> r)
+      : t_(t), recv_(std::move(r)) {}
+  bool Test(Status* st) override;
+
+ private:
+  SocketTransport* t_;
+  std::shared_ptr<SendReq> send_;
+  std::shared_ptr<RecvReq> recv_;
+};
+
+class SocketTransport : public Transport {
+ public:
+  SocketTransport(int rank, int size, std::vector<int> fds)
+      : rank_(rank), size_(size), fds_(std::move(fds)), peers_(size) {
+    for (int i = 0; i < size_; i++) {
+      if (i == rank_ || fds_[i] < 0) continue;
+      const int fl = fcntl(fds_[i], F_GETFL, 0);
+      fcntl(fds_[i], F_SETFL, fl | O_NONBLOCK);
+    }
+  }
+
+  ~SocketTransport() override {
+    for (int i = 0; i < size_; i++)
+      if (i != rank_ && fds_[i] >= 0) close(fds_[i]);
+  }
+
+  int rank() const override { return rank_; }
+  int size() const override { return size_; }
+
+  Ticket* Isend(const void* buf, size_t bytes, int dst, int tag,
+                int ctx) override {
+    std::lock_guard<std::mutex> lk(mu_);
+    return IsendLocked(buf, bytes, dst, tag, ctx);
+  }
+
+  Ticket* Irecv(void* buf, size_t bytes, int src, int tag, int ctx) override {
+    std::lock_guard<std::mutex> lk(mu_);
+    return IrecvLocked(buf, bytes, src, tag, ctx);
+  }
+
+  PartitionedChan* PsendInit(const void* buf, int partitions,
+                             size_t part_bytes, int dst, int tag,
+                             int ctx) override;
+  PartitionedChan* PrecvInit(void* buf, int partitions, size_t part_bytes,
+                             int src, int tag, int ctx) override;
+
+  // Fan-in/fan-out barrier through rank 0 on the control context. The
+  // reference gets this from MPI_Barrier for free; sufficient at host-plane
+  // process counts.
+  void Barrier(int /*ctx*/) override {
+    if (rank_ == 0) {
+      int token = 0;
+      for (int p = 1; p < size_; p++) RecvB(&token, sizeof token, p, 1);
+      for (int p = 1; p < size_; p++) SendB(&token, sizeof token, p, 2);
+    } else {
+      int token = rank_;
+      SendB(&token, sizeof token, 0, 1);
+      RecvB(&token, sizeof token, 0, 2);
+    }
+  }
+
+  void AllreduceInt(int32_t* data, int count, int op, int /*ctx*/) override {
+    const size_t nb = sizeof(int32_t) * static_cast<size_t>(count);
+    if (rank_ == 0) {
+      std::vector<int32_t> tmp(count);
+      for (int p = 1; p < size_; p++) {
+        RecvB(tmp.data(), nb, p, 3);
+        for (int i = 0; i < count; i++) {
+          switch (op) {
+            case 0: data[i] = data[i] > tmp[i] ? data[i] : tmp[i]; break;
+            case 1: data[i] = data[i] < tmp[i] ? data[i] : tmp[i]; break;
+            default: data[i] += tmp[i]; break;
+          }
+        }
+      }
+      for (int p = 1; p < size_; p++) SendB(data, nb, p, 4);
+    } else {
+      SendB(data, nb, 0, 3);
+      RecvB(data, nb, 0, 4);
+    }
+  }
+
+  void Abort(int code) override {
+    std::fprintf(stderr, "tpu-acx[%d]: abort(%d)\n", rank_, code);
+    _exit(code);
+  }
+
+  // Called from SockTicket::Test.
+  bool TestReq(const std::shared_ptr<SendReq>& s,
+               const std::shared_ptr<RecvReq>& r, Status* st) {
+    std::lock_guard<std::mutex> lk(mu_);
+    ProgressLocked();
+    if (s) {
+      if (s->done && st) *st = s->st;
+      return s->done;
+    }
+    if (r->done && st) *st = r->st;
+    return r->done;
+  }
+
+ private:
+  friend class SockPsendChan;
+  friend class SockPrecvChan;
+
+  struct Peer {
+    std::deque<std::shared_ptr<SendReq>> outq;
+    InState in;
+    std::deque<Msg> arrived;                     // unmatched arrivals, FIFO
+    std::deque<std::shared_ptr<RecvReq>> posted; // unmatched recvs, FIFO
+  };
+
+  Ticket* IsendLocked(const void* buf, size_t bytes, int dst, int tag,
+                      int ctx) {
+    auto s = std::make_shared<SendReq>();
+    s->st = Status{rank_, tag, 0, bytes};
+    if (dst == rank_) {
+      // Self-send: loop straight back through the matching queues.
+      Msg m;
+      m.tag = tag;
+      m.ctx = ctx;
+      m.payload.assign(static_cast<const char*>(buf),
+                       static_cast<const char*>(buf) + bytes);
+      DeliverLocked(rank_, std::move(m));
+      s->done = true;
+      return new SockTicket(this, s);
+    }
+    WireHeader h{kMagic, tag, ctx, bytes};
+    s->data.resize(sizeof h + bytes);
+    memcpy(s->data.data(), &h, sizeof h);
+    memcpy(s->data.data() + sizeof h, buf, bytes);
+    peers_[dst].outq.push_back(s);
+    FlushOutLocked(dst);
+    return new SockTicket(this, s);
+  }
+
+  Ticket* IrecvLocked(void* buf, size_t bytes, int src, int tag, int ctx) {
+    auto r = std::make_shared<RecvReq>();
+    r->buf = buf;
+    r->bytes = bytes;
+    r->src = src;
+    r->tag = tag;
+    r->ctx = ctx;
+    // Try the unexpected queue first (FIFO per (src, tag, ctx)).
+    auto& q = peers_[src].arrived;
+    for (auto it = q.begin(); it != q.end(); ++it) {
+      if (it->tag == tag && it->ctx == ctx) {
+        CompleteRecv(r.get(), src, *it);
+        q.erase(it);
+        return new SockTicket(this, r);
+      }
+    }
+    peers_[src].posted.push_back(r);
+    return new SockTicket(this, r);
+  }
+
+  static void CompleteRecv(RecvReq* r, int src, const Msg& m) {
+    const size_t n = m.payload.size() < r->bytes ? m.payload.size() : r->bytes;
+    memcpy(r->buf, m.payload.data(), n);
+    r->st = Status{src, m.tag, 0, n};
+    r->done = true;
+  }
+
+  void DeliverLocked(int src, Msg&& m) {
+    auto& posted = peers_[src].posted;
+    for (auto it = posted.begin(); it != posted.end(); ++it) {
+      if ((*it)->tag == m.tag && (*it)->ctx == m.ctx) {
+        CompleteRecv(it->get(), src, m);
+        posted.erase(it);
+        return;
+      }
+    }
+    peers_[src].arrived.push_back(std::move(m));
+  }
+
+  void FlushOutLocked(int p) {
+    auto& q = peers_[p].outq;
+    while (!q.empty()) {
+      auto& s = q.front();
+      ssize_t n = write(fds_[p], s->data.data() + s->off,
+                        s->data.size() - s->off);
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+        std::fprintf(stderr, "tpu-acx[%d]: write to %d failed: %s\n", rank_,
+                     p, strerror(errno));
+        _exit(14);
+      }
+      s->off += static_cast<size_t>(n);
+      if (s->off == s->data.size()) {
+        s->done = true;
+        s->data.clear();
+        q.pop_front();
+      }
+    }
+  }
+
+  void DrainInLocked(int p) {
+    InState& in = peers_[p].in;
+    for (;;) {
+      if (in.hdr_got < sizeof(WireHeader)) {
+        ssize_t n = read(fds_[p], reinterpret_cast<char*>(&in.hdr) + in.hdr_got,
+                         sizeof(WireHeader) - in.hdr_got);
+        if (n <= 0) {
+          if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
+          if (n == 0) return;  // peer exited; pending data already drained
+          std::fprintf(stderr, "tpu-acx[%d]: read from %d failed: %s\n",
+                       rank_, p, strerror(errno));
+          _exit(14);
+        }
+        in.hdr_got += static_cast<size_t>(n);
+        if (in.hdr_got < sizeof(WireHeader)) return;
+        if (in.hdr.magic != kMagic) {
+          std::fprintf(stderr, "tpu-acx[%d]: bad wire magic from %d\n", rank_,
+                       p);
+          _exit(14);
+        }
+        in.payload.resize(in.hdr.bytes);
+        in.payload_got = 0;
+      }
+      while (in.payload_got < in.payload.size()) {
+        ssize_t n = read(fds_[p], in.payload.data() + in.payload_got,
+                         in.payload.size() - in.payload_got);
+        if (n <= 0) {
+          if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
+          if (n == 0) return;
+          std::fprintf(stderr, "tpu-acx[%d]: read from %d failed: %s\n",
+                       rank_, p, strerror(errno));
+          _exit(14);
+        }
+        in.payload_got += static_cast<size_t>(n);
+      }
+      Msg m;
+      m.tag = in.hdr.tag;
+      m.ctx = in.hdr.ctx;
+      m.payload = std::move(in.payload);
+      in.payload.clear();
+      in.hdr_got = 0;
+      DeliverLocked(p, std::move(m));
+    }
+  }
+
+  void ProgressLocked() {
+    for (int p = 0; p < size_; p++) {
+      if (p == rank_) continue;
+      FlushOutLocked(p);
+      DrainInLocked(p);
+    }
+  }
+
+  // Blocking control-plane helpers (used by Barrier/AllreduceInt only).
+  void SendB(const void* buf, size_t bytes, int dst, int tag) {
+    std::unique_ptr<Ticket> t(Isend(buf, bytes, dst, tag, kCtrlCtx));
+    Status st;
+    while (!t->Test(&st)) sched_yield();
+  }
+  void RecvB(void* buf, size_t bytes, int src, int tag) {
+    std::unique_ptr<Ticket> t(Irecv(buf, bytes, src, tag, kCtrlCtx));
+    Status st;
+    while (!t->Test(&st)) sched_yield();
+  }
+
+  int rank_, size_;
+  std::vector<int> fds_;
+  std::vector<Peer> peers_;
+  std::mutex mu_;
+};
+
+bool SockTicket::Test(Status* st) { return t_->TestReq(send_, recv_, st); }
+
+// -- Partitioned channels -------------------------------------------------
+//
+// One logical N-partition message per round (reference MPI_Psend_init /
+// MPI_Precv_init, partitioned.cu:36-123); each partition travels as an
+// independent point-to-point message on (PartTag(tag,p), PartCtx(ctx)), so
+// out-of-order Pready works and per-partition arrival is observable — the
+// property ring-partitioned.cu's device polling depends on.
+//
+// Thread-safety contract: Pready/Parrived are called by the proxy while the
+// round is in flight; StartRound/FinishRound are called by the app thread
+// only when every partition's flag has been observed RESERVED/COMPLETED
+// (acquire), which happens-after the proxy's last touch (release) — so no
+// extra locking is needed here beyond the transport's own mutex.
+
+class SockPsendChan : public PartitionedChan {
+ public:
+  SockPsendChan(SocketTransport* t, const void* buf, int parts, size_t pb,
+                int dst, int tag, int ctx)
+      : t_(t), buf_(static_cast<const char*>(buf)), dst_(dst), tag_(tag),
+        ctx_(ctx) {
+    partitions = parts;
+    part_bytes = pb;
+    is_send = true;
+    inflight_.reserve(parts);
+  }
+
+  void Pready(int p) override {
+    inflight_.emplace_back(t_->Isend(buf_ + static_cast<size_t>(p) * part_bytes,
+                                     part_bytes, dst_, PartTag(tag_, p),
+                                     PartCtx(ctx_)));
+  }
+  bool Parrived(int) override { return false; }  // send side has no arrivals
+  void StartRound() override { inflight_.clear(); }
+  void FinishRound(Status* st) override {
+    Status tmp;
+    for (auto& tk : inflight_) {
+      while (!tk->Test(&tmp)) sched_yield();
+    }
+    if (st) *st = Status{t_->rank(), tag_, 0,
+                         part_bytes * static_cast<size_t>(partitions)};
+    inflight_.clear();
+  }
+
+ private:
+  SocketTransport* t_;
+  const char* buf_;
+  int dst_, tag_, ctx_;
+  std::vector<std::unique_ptr<Ticket>> inflight_;
+};
+
+class SockPrecvChan : public PartitionedChan {
+ public:
+  SockPrecvChan(SocketTransport* t, void* buf, int parts, size_t pb, int src,
+                int tag, int ctx)
+      : t_(t), buf_(static_cast<char*>(buf)), src_(src), tag_(tag), ctx_(ctx),
+        tickets_(parts), done_(parts, false) {
+    partitions = parts;
+    part_bytes = pb;
+    is_send = false;
+  }
+
+  void Pready(int) override {}
+  bool Parrived(int p) override {
+    if (done_[p]) return true;
+    Status st;
+    if (tickets_[p] && tickets_[p]->Test(&st)) {
+      done_[p] = true;
+      return true;
+    }
+    return false;
+  }
+  void StartRound() override {
+    for (int p = 0; p < partitions; p++) {
+      done_[p] = false;
+      tickets_[p].reset(
+          t_->Irecv(buf_ + static_cast<size_t>(p) * part_bytes, part_bytes,
+                    src_, PartTag(tag_, p), PartCtx(ctx_)));
+    }
+  }
+  void FinishRound(Status* st) override {
+    for (int p = 0; p < partitions; p++) {
+      while (!Parrived(p)) sched_yield();
+      tickets_[p].reset();
+    }
+    if (st) *st = Status{src_, tag_, 0,
+                         part_bytes * static_cast<size_t>(partitions)};
+  }
+
+ private:
+  SocketTransport* t_;
+  char* buf_;
+  int src_, tag_, ctx_;
+  std::vector<std::unique_ptr<Ticket>> tickets_;
+  std::vector<bool> done_;
+};
+
+PartitionedChan* SocketTransport::PsendInit(const void* buf, int partitions,
+                                            size_t part_bytes, int dst,
+                                            int tag, int ctx) {
+  return new SockPsendChan(this, buf, partitions, part_bytes, dst, tag, ctx);
+}
+
+PartitionedChan* SocketTransport::PrecvInit(void* buf, int partitions,
+                                            size_t part_bytes, int src,
+                                            int tag, int ctx) {
+  return new SockPrecvChan(this, buf, partitions, part_bytes, src, tag, ctx);
+}
+
+}  // namespace
+
+Transport* CreateSocketTransport(int rank, int size,
+                                 const std::vector<int>& fds) {
+  return new SocketTransport(rank, size, fds);
+}
+
+Transport* CreateSelfTransport() {
+  // A SocketTransport of size 1 is pure loopback: every send routes through
+  // DeliverLocked and never touches a socket.
+  return new SocketTransport(0, 1, {-1});
+}
+
+Transport* CreateTransportFromEnv() {
+  const char* size_s = getenv("ACX_SIZE");
+  const int size = size_s ? atoi(size_s) : 1;
+  if (size <= 1) return CreateSelfTransport();
+  const char* rank_s = getenv("ACX_RANK");
+  const char* fds_s = getenv("ACX_FDS");
+  if (!rank_s || !fds_s) {
+    std::fprintf(stderr,
+                 "tpu-acx: ACX_SIZE=%d but ACX_RANK/ACX_FDS unset "
+                 "(run under acxrun)\n",
+                 size);
+    exit(13);
+  }
+  std::vector<int> fds;
+  const char* s = fds_s;
+  while (*s) {
+    fds.push_back(atoi(s));
+    const char* c = strchr(s, ',');
+    if (!c) break;
+    s = c + 1;
+  }
+  if (static_cast<int>(fds.size()) != size) {
+    std::fprintf(stderr, "tpu-acx: ACX_FDS has %zu entries, want %d\n",
+                 fds.size(), size);
+    exit(13);
+  }
+  return CreateSocketTransport(atoi(rank_s), size, fds);
+}
+
+}  // namespace acx

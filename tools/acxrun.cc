@@ -1,0 +1,132 @@
+// acxrun — tpu-acx process launcher.
+//
+// Plays the role `mpiexec -np N` plays for the reference (reference
+// README.md:99-103): spawns N ranks of a program on this host with a fully
+// connected mesh of AF_UNIX socketpairs, which SocketTransport
+// (src/net/socket_transport.cc) picks up via ACX_RANK / ACX_SIZE / ACX_FDS.
+//
+// Usage: acxrun -np N [-timeout SECONDS] prog [args...]
+//
+// Exit status: 0 iff every rank exited 0. If any rank exits nonzero or a
+// timeout fires, the remaining ranks are killed (matching mpiexec behavior
+// on MPI_Abort).
+
+#include <errno.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <string>
+#include <vector>
+
+static void usage() {
+  fprintf(stderr, "usage: acxrun -np N [-timeout SEC] prog [args...]\n");
+  exit(2);
+}
+
+int main(int argc, char** argv) {
+  int np = -1;
+  int timeout_s = 120;
+  int argi = 1;
+  while (argi < argc && argv[argi][0] == '-') {
+    if (!strcmp(argv[argi], "-np") && argi + 1 < argc) {
+      np = atoi(argv[argi + 1]);
+      argi += 2;
+    } else if (!strcmp(argv[argi], "-timeout") && argi + 1 < argc) {
+      timeout_s = atoi(argv[argi + 1]);
+      argi += 2;
+    } else {
+      usage();
+    }
+  }
+  if (np < 1 || argi >= argc) usage();
+
+  // fd_of[i][j] = fd rank i uses to talk to rank j.
+  std::vector<std::vector<int>> fd_of(np, std::vector<int>(np, -1));
+  for (int i = 0; i < np; i++) {
+    for (int j = i + 1; j < np; j++) {
+      int sv[2];
+      if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
+        perror("acxrun: socketpair");
+        return 2;
+      }
+      fd_of[i][j] = sv[0];
+      fd_of[j][i] = sv[1];
+    }
+  }
+
+  std::vector<pid_t> pids(np);
+  for (int r = 0; r < np; r++) {
+    pid_t pid = fork();
+    if (pid < 0) {
+      perror("acxrun: fork");
+      return 2;
+    }
+    if (pid == 0) {
+      // Child, rank r: keep only this rank's fds, close the rest.
+      std::string fds;
+      for (int j = 0; j < np; j++) {
+        if (j) fds += ',';
+        fds += std::to_string(fd_of[r][j]);
+      }
+      for (int i = 0; i < np; i++) {
+        if (i == r) continue;
+        for (int j = 0; j < np; j++) {
+          if (fd_of[i][j] >= 0 && i != r && j != r) close(fd_of[i][j]);
+        }
+      }
+      setenv("ACX_RANK", std::to_string(r).c_str(), 1);
+      setenv("ACX_SIZE", std::to_string(np).c_str(), 1);
+      setenv("ACX_FDS", fds.c_str(), 1);
+      execvp(argv[argi], &argv[argi]);
+      fprintf(stderr, "acxrun: exec %s failed: %s\n", argv[argi],
+              strerror(errno));
+      _exit(127);
+    }
+    pids[r] = pid;
+  }
+
+  // Parent: close every fd, then reap with a timeout.
+  for (int i = 0; i < np; i++)
+    for (int j = 0; j < np; j++)
+      if (fd_of[i][j] >= 0) close(fd_of[i][j]);
+
+  // SIGALRM must interrupt wait() (no SA_RESTART) rather than kill us.
+  struct sigaction sa {};
+  sa.sa_handler = [](int) {};
+  sigaction(SIGALRM, &sa, nullptr);
+  alarm(timeout_s);
+  int worst = 0;
+  int live = np;
+  while (live > 0) {
+    int st = 0;
+    pid_t pid = wait(&st);
+    if (pid < 0) {
+      if (errno == EINTR) {
+        fprintf(stderr, "acxrun: timeout after %ds, killing ranks\n",
+                timeout_s);
+        for (int r = 0; r < np; r++) kill(pids[r], SIGKILL);
+        worst = worst ? worst : 124;
+        timeout_s = 5;
+        alarm(5);
+        continue;
+      }
+      break;
+    }
+    live--;
+    int code = WIFEXITED(st) ? WEXITSTATUS(st)
+                             : 128 + (WIFSIGNALED(st) ? WTERMSIG(st) : 0);
+    if (code != 0) {
+      if (!worst) worst = code;
+      // One rank failed: take the job down like mpiexec does on MPI_Abort.
+      for (int r = 0; r < np; r++)
+        if (pids[r] != pid) kill(pids[r], SIGTERM);
+    }
+  }
+  return worst;
+}
