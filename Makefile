@@ -10,7 +10,7 @@
 
 CXX      ?= g++
 CXXFLAGS ?= -O2 -g -Wall -Wextra -std=c++17 -fPIC -pthread
-INCLUDES  = -Iinclude
+INCLUDES  = -Iinclude -Iinclude/compat
 LDFLAGS   = -pthread
 
 ifeq ($(ACX_DEBUG), 1)
@@ -78,11 +78,11 @@ itest: $(ITEST_BINS)
 
 $(BUILD)/itests/%: itests/%.c $(STATICLIB)
 	@mkdir -p $(BUILD)/itests
-	$(CXX) -x c++ $(CXXFLAGS) $(INCLUDES) -Iinclude/compat $< $(STATICLIB) -o $@ $(LDFLAGS)
+	$(CXX) $(CXXFLAGS) $(INCLUDES) -x c++ $< -x none $(STATICLIB) -o $@ $(LDFLAGS)
 
 $(BUILD)/itests/%: itests/%.cc $(STATICLIB)
 	@mkdir -p $(BUILD)/itests
-	$(CXX) $(CXXFLAGS) $(INCLUDES) -Iinclude/compat $< $(STATICLIB) -o $@ $(LDFLAGS)
+	$(CXX) $(CXXFLAGS) $(INCLUDES) $< $(STATICLIB) -o $@ $(LDFLAGS)
 
 # --- reference-test source compatibility ---
 # Compiles NVIDIA/mpi-acx's own C test programs UNCHANGED from
@@ -100,7 +100,7 @@ reftests: $(REF_BINS) tools
 
 $(BUILD)/reftests/%: $(REF_TEST_DIR)/%.c $(STATICLIB)
 	@mkdir -p $(BUILD)/reftests
-	$(CXX) -x c++ $(CXXFLAGS) -Wno-unused-parameter $(INCLUDES) -Iinclude/compat $< $(STATICLIB) -o $@ $(LDFLAGS)
+	$(CXX) $(CXXFLAGS) -Wno-unused-parameter $(INCLUDES) -x c++ $< -x none $(STATICLIB) -o $@ $(LDFLAGS)
 
 # --- run everything ---
 check: ctest itest tools

@@ -2,6 +2,8 @@
 // Pure host code, no devices needed (SURVEY.md §4: "add a unit layer around
 // the slot table/state machine"). Plain asserts; exits nonzero on failure.
 #include <cassert>
+#include <map>
+#include <memory>
 #include <cstdio>
 #include <cstring>
 #include <thread>
@@ -37,18 +39,43 @@ struct FakeTicket : Ticket {
   }
 };
 
+// A fake wire shared by a matched Psend/Precv channel pair, so the sender's
+// Pready is observed through the *receiver's* channel — real topology, per
+// ADVICE r1 (the old fake pointed both slots at one channel).
+struct FakeWire {
+  std::vector<std::atomic<bool>> landed;
+  explicit FakeWire(int parts) : landed(parts) {}
+  void Reset() {
+    for (auto& w : landed) w.store(false, std::memory_order_relaxed);
+  }
+};
+
 struct FakeChan : PartitionedChan {
-  std::vector<std::atomic<bool>> wire;
-  explicit FakeChan(int parts) : wire(parts) {
+  std::shared_ptr<FakeWire> wire;
+  FakeChan(std::shared_ptr<FakeWire> w, int parts, bool send)
+      : wire(std::move(w)) {
     partitions = parts;
-    StartRound();
+    is_send = send;
   }
-  void Pready(int p) override { wire[p].store(true, std::memory_order_release); }
-  bool Parrived(int p) override { return wire[p].load(std::memory_order_acquire); }
+  void Pready(int p) override {
+    CHECK(is_send);
+    wire->landed[p].store(true, std::memory_order_release);
+  }
+  bool Parrived(int p) override {
+    CHECK(!is_send);
+    return wire->landed[p].load(std::memory_order_acquire);
+  }
   void StartRound() override {
-    for (auto& w : wire) w.store(false, std::memory_order_relaxed);
+    // The send side opens the round (clears the wire), mirroring how the
+    // socket transport's recv side posts fresh tickets.
+    if (is_send) wire->Reset();
   }
-  void FinishRound(Status*) override {}
+  void FinishRound(Status* st) override {
+    if (!is_send)
+      for (int p = 0; p < partitions; p++)
+        CHECK(wire->landed[p].load(std::memory_order_acquire));
+    if (st) *st = Status{0, 0, 0, part_bytes * partitions};
+  }
 };
 
 struct FakeTransport : Transport {
@@ -73,16 +100,23 @@ struct FakeTransport : Transport {
     st.bytes = bytes;
     return new FakeTicket(&sends_done, st);
   }
-  PartitionedChan* PsendInit(const void*, int parts, size_t pb, int, int,
+  // Psend/Precv pairs with the same tag share one wire (loopback matching).
+  std::map<int, std::shared_ptr<FakeWire>> wires;
+  std::shared_ptr<FakeWire> WireFor(int tag, int parts) {
+    auto it = wires.find(tag);
+    if (it == wires.end())
+      it = wires.emplace(tag, std::make_shared<FakeWire>(parts)).first;
+    return it->second;
+  }
+  PartitionedChan* PsendInit(const void*, int parts, size_t pb, int, int tag,
                              int) override {
-    auto* c = new FakeChan(parts);
+    auto* c = new FakeChan(WireFor(tag, parts), parts, /*send=*/true);
     c->part_bytes = pb;
-    c->is_send = true;
     return c;
   }
-  PartitionedChan* PrecvInit(void*, int parts, size_t pb, int, int,
+  PartitionedChan* PrecvInit(void*, int parts, size_t pb, int, int tag,
                              int) override {
-    auto* c = new FakeChan(parts);
+    auto* c = new FakeChan(WireFor(tag, parts), parts, /*send=*/false);
     c->part_bytes = pb;
     return c;
   }
@@ -187,6 +221,10 @@ void test_cleanup_never_leaks() {
 }
 
 void test_partitioned_lifecycle() {
+  // Real topology: sender marks through send_chan, proxy observes arrival
+  // through recv_chan (shared wire underneath), and the COMPLETED->RESERVED
+  // restart path runs THREE full rounds (reference runs 10 iterations,
+  // ring-partitioned.cu:101-127).
   FlagTable t(64);
   FakeTransport tr;
   Proxy proxy(&t, &tr);
@@ -195,8 +233,6 @@ void test_partitioned_lifecycle() {
   const int P = 10;
   PartitionedChan* send_chan = tr.PsendInit(nullptr, P, 4, 0, 0, 0);
   PartitionedChan* recv_chan = tr.PrecvInit(nullptr, P, 4, 0, 0, 0);
-  // Wire the fake: sends land on the recv side's wire.
-  // (Same FakeChan instance semantics: use send_chan as the shared wire.)
   std::vector<int> send_slots(P), recv_slots(P);
   for (int p = 0; p < P; p++) {
     int s = t.Allocate();
@@ -207,25 +243,34 @@ void test_partitioned_lifecycle() {
 
     int r = t.Allocate();
     t.op(r).kind = OpKind::kParrived;
-    t.op(r).chan = send_chan;  // poll the same wire the sender writes
+    t.op(r).chan = recv_chan;  // the receiver polls its OWN channel
     t.op(r).partition = p;
     recv_slots[p] = r;
   }
-  (void)recv_chan;
-  // Start: recv partitions -> ISSUED (proxy now polls them).
-  for (int p = 0; p < P; p++) t.Store(recv_slots[p], kIssued);
-  // Device marks partitions ready out of order:
-  for (int p = P - 1; p >= 0; p--) t.Store(send_slots[p], kPending);
-  proxy.Kick();
-  for (int p = 0; p < P; p++) {
-    SpinUntil(t, send_slots[p], kCompleted);
-    SpinUntil(t, recv_slots[p], kCompleted);
+
+  for (int round = 0; round < 3; round++) {
+    // MPIX_Start: open the round; recv partitions -> ISSUED.
+    send_chan->StartRound();
+    recv_chan->StartRound();
+    for (int p = 0; p < P; p++) t.Store(recv_slots[p], kIssued);
+    // Device marks partitions ready out of order:
+    for (int p = P - 1; p >= 0; p--) t.Store(send_slots[p], kPending);
+    proxy.Kick();
+    for (int p = 0; p < P; p++) {
+      SpinUntil(t, send_slots[p], kCompleted);
+      SpinUntil(t, recv_slots[p], kCompleted);
+    }
+    // Host Waitall: per-partition reset to RESERVED, then close the round.
+    for (int p = 0; p < P; p++) {
+      t.Store(send_slots[p], kReserved);
+      t.Store(recv_slots[p], kReserved);
+    }
+    Status st;
+    recv_chan->FinishRound(&st);
+    CHECK(st.bytes == 4u * P);
+    send_chan->FinishRound(nullptr);
   }
-  // Host Waitall: reset everything to RESERVED for the next round.
-  for (int p = 0; p < P; p++) {
-    t.Store(send_slots[p], kReserved);
-    t.Store(recv_slots[p], kReserved);
-  }
+
   for (int p = 0; p < P; p++) {
     t.Free(send_slots[p]);
     t.Free(recv_slots[p]);
@@ -233,7 +278,7 @@ void test_partitioned_lifecycle() {
   proxy.Stop();
   delete send_chan;
   delete recv_chan;
-  std::printf("  partitioned lifecycle: ok\n");
+  std::printf("  partitioned lifecycle (3 rounds, two channels): ok\n");
 }
 
 void test_proxy_idle_is_cheap() {

@@ -1,0 +1,72 @@
+// tpu-acx: internal state shared by the MPIX API, the MPI shim, and the
+// queue shim (counterpart of reference mpi-acx-internal.h:212-268, redesigned
+// around the atomic FlagTable + Transport + Proxy stack).
+#pragma once
+
+#include <cstdint>
+
+#include "acx/proxy.h"
+#include "acx/state.h"
+#include "acx/transport.h"
+
+namespace acx {
+
+// Request kinds, tagged with magics so MPIX_Pready/Parrived can accept both
+// an MPIX_Request* and an MPIX_Prequest handle through one void* parameter
+// (see include/mpi-acx.h note; reference disambiguates by __host__ vs
+// __device__ overload instead, mpi-acx.h:96-104).
+constexpr uint32_t kReqMagic = 0xACF00001u;
+constexpr uint32_t kPreqMagic = 0xACF00002u;
+
+enum class ReqKind : int32_t { kBasic = 0, kPsend = 1, kPrecv = 2 };
+
+// Public request object. malloc'd (Op::owner contract, acx/state.h).
+struct MpixRequest {
+  uint32_t magic = kReqMagic;
+  ReqKind kind = ReqKind::kBasic;
+  // basic (enqueued send/recv): the one flag slot.
+  int flag_idx = -1;
+  // partitioned: the channel plus one slot per partition.
+  PartitionedChan* chan = nullptr;
+  int partitions = 0;
+  int* part_idx = nullptr;  // malloc'd array[partitions] of slot indices
+  bool started = false;
+  // Graph-owned ops re-fire per launch and are reclaimed by the graph's
+  // cleanup set, not by waits (reference SENDRECV vs SENDRECV_GRAPH kinds,
+  // mpi-acx-internal.h:191-194).
+  bool graph_owned = false;
+};
+
+// Device-mirror view of a partitioned request: everything a "kernel" needs
+// to signal/poll partitions (reference MPIACX_Prequest,
+// mpi-acx-internal.h:229-232). On TPU the true device mirror is the Python
+// layer's flag buffer; this host struct serves host-queue kernels and the
+// ctypes bindings.
+struct MpixPrequest {
+  uint32_t magic = kPreqMagic;
+  ReqKind kind = ReqKind::kPsend;
+  int partitions = 0;
+  int* part_idx = nullptr;  // borrowed from the owning MpixRequest
+  PartitionedChan* chan = nullptr;
+};
+
+// Process-global API state (reference mpiacx_state, init.cpp:49).
+struct ApiState {
+  Transport* transport = nullptr;
+  FlagTable* table = nullptr;
+  Proxy* proxy = nullptr;
+  bool mpi_inited = false;
+  bool mpi_finalized = false;
+  bool mpix_inited = false;
+};
+
+ApiState& GS();
+
+// Creates the transport from the environment if it does not exist yet
+// (called by both MPI_Init_thread and MPIX_Init, in either order).
+void EnsureTransport();
+
+// Element size for a compat MPI_Datatype id (include/compat/mpi.h).
+size_t DatatypeSize(int datatype);
+
+}  // namespace acx

@@ -1,0 +1,132 @@
+// tpu-acx: host execution-queue runtime (streams + graphs).
+//
+// TPU-native counterpart of CUDA streams and CUDA graphs as the reference
+// uses them (SURVEY.md §7.1 mapping): on TPU there are no stream memOps, so
+// "the device reached this point in its queue" is modeled by an in-order
+// host execution queue — the same role PJRT stream-ordered host callbacks
+// play around XLA executables. A Graph is a staged DAG of nodes that can be
+// instantiated once and relaunched many times, matching the reference's
+// re-fire semantics (mpi-acx-internal.h:176-189): ops embedded in a graph
+// fire on every launch, and resources tied to the graph are reclaimed when
+// the last of {graph, executables} is destroyed (the cudaUserObject pattern,
+// reference sendrecv.cu:106-127).
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace acx {
+
+class Graph;
+
+// Refcounted cleanup set shared by a Graph and every GraphExec instantiated
+// from it; hooks run when the last holder is destroyed.
+struct CleanupSet {
+  std::vector<std::function<void()>> hooks;
+  ~CleanupSet() {
+    for (auto& h : hooks) h();
+  }
+};
+
+// In-order host execution queue. Work items run exactly in enqueue order on
+// a dedicated worker thread; Sync() blocks until the queue has fully
+// drained. A stream can be switched into capture mode, in which case
+// enqueued items are *recorded* into a Graph instead of executed — the
+// stream-capture construction mode of reference sendrecv.cu:74-80,174-184.
+class Stream {
+ public:
+  Stream();
+  ~Stream();
+
+  // Run fn on the worker thread after all previously enqueued work. In
+  // capture mode, records fn as a graph node (chained after the previous
+  // capture tail) instead.
+  void Enqueue(std::function<void()> fn);
+
+  void Sync();
+
+  void BeginCapture();
+  // Ends capture and returns the recorded graph (caller owns).
+  Graph* EndCapture();
+  bool capturing() const { return capture_ != nullptr; }
+  Graph* capture_graph() { return capture_; }
+
+  // The process-wide default stream ("stream 0").
+  static Stream* Default();
+
+ private:
+  void Run();
+
+  std::thread worker_;
+  mutable std::mutex mu_;
+  std::condition_variable cv_;       // worker wakeup
+  std::condition_variable done_cv_;  // Sync wakeup
+  std::deque<std::function<void()>> q_;
+  bool busy_ = false;
+  bool exit_ = false;
+
+  Graph* capture_ = nullptr;
+  void* capture_tail_ = nullptr;  // GraphNode* of the last captured node
+};
+
+struct GraphNode {
+  std::function<void()> fn;
+  std::vector<GraphNode*> deps;
+};
+
+// A DAG of host work items. Nodes are added with explicit dependencies
+// (explicit-construction mode, reference ring-all-graph-construction.c:81-84)
+// or recorded by stream capture. Instantiate() topologically orders the
+// nodes into a GraphExec; Launch enqueues them, in order, every time.
+class Graph {
+ public:
+  Graph();
+  ~Graph();
+
+  GraphNode* AddNode(std::function<void()> fn,
+                     const std::vector<GraphNode*>& deps = {});
+  // Child-graph composition: splices child's nodes into this graph with
+  // `deps` as predecessors of child's roots; returns a node representing
+  // the child's tail (for further dependencies). The child graph remains
+  // owned by the caller; its cleanup set is joined to ours.
+  GraphNode* AddChildGraph(Graph* child, const std::vector<GraphNode*>& deps);
+
+  // Register a hook to run when the last of {this graph, its executables}
+  // dies (cudaUserObject equivalent).
+  void AddCleanup(std::function<void()> hook);
+
+  const std::vector<std::unique_ptr<GraphNode>>& nodes() const {
+    return nodes_;
+  }
+  std::shared_ptr<CleanupSet> cleanup() { return cleanup_; }
+
+ private:
+  friend class GraphExec;
+  std::vector<std::unique_ptr<GraphNode>> nodes_;
+  std::shared_ptr<CleanupSet> cleanup_;
+  // Cleanup sets of composed child graphs, kept alive by this graph.
+  std::vector<std::shared_ptr<CleanupSet>> child_cleanups_;
+};
+
+// An instantiated, relaunchable snapshot of a Graph (cudaGraphExec_t
+// equivalent). Holds copies of the node closures in topological order, so
+// the Graph itself may be destroyed while the exec lives on.
+class GraphExec {
+ public:
+  explicit GraphExec(Graph* g);
+
+  // Enqueue one full execution of the graph onto `s` (re-fires every node).
+  void Launch(Stream* s);
+
+ private:
+  std::vector<std::function<void()>> seq_;  // topo order
+  std::vector<std::shared_ptr<CleanupSet>> cleanups_;
+};
+
+}  // namespace acx

@@ -1,0 +1,6 @@
+/* tpu-acx compat: cuda.h alias — the driver-API surface the reference header
+ * includes (reference mpi-acx.h:35). Everything lives in cuda_runtime.h. */
+#ifndef ACX_COMPAT_CUDA_H
+#define ACX_COMPAT_CUDA_H
+#include "cuda_runtime.h"
+#endif

@@ -1,0 +1,89 @@
+/* tpu-acx compat: the slice of the MPI interface the MPI-ACX surface and its
+ * test programs consume (reference test/src: Init_thread, Comm_rank/size,
+ * Allreduce(MAX), Abort, Finalize, MPI_Status fields). Backed by the tpu-acx
+ * SocketTransport (src/net/socket_transport.cc) instead of an MPI library —
+ * the reference's L0 data plane (SURVEY.md §1) reimplemented natively.
+ *
+ * This is a compatibility shim, not an MPI implementation: exactly the
+ * surface below is supported, and communicators other than MPI_COMM_WORLD
+ * are not.
+ */
+#ifndef ACX_COMPAT_MPI_H
+#define ACX_COMPAT_MPI_H
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef int MPI_Comm;
+#define MPI_COMM_WORLD ((MPI_Comm)0)
+
+typedef int MPI_Datatype;
+#define MPI_CHAR     ((MPI_Datatype)1)
+#define MPI_BYTE     ((MPI_Datatype)2)
+#define MPI_INT      ((MPI_Datatype)3)
+#define MPI_FLOAT    ((MPI_Datatype)4)
+#define MPI_DOUBLE   ((MPI_Datatype)5)
+#define MPI_INT64_T  ((MPI_Datatype)6)
+
+typedef int MPI_Op;
+#define MPI_MAX ((MPI_Op)0)
+#define MPI_MIN ((MPI_Op)1)
+#define MPI_SUM ((MPI_Op)2)
+
+typedef int MPI_Info;
+#define MPI_INFO_NULL ((MPI_Info)0)
+
+typedef long long MPI_Count;
+
+#define MPI_SUCCESS 0
+#define MPI_ERR_OTHER 15
+
+#define MPI_THREAD_SINGLE     0
+#define MPI_THREAD_FUNNELED   1
+#define MPI_THREAD_SERIALIZED 2
+#define MPI_THREAD_MULTIPLE   3
+
+typedef struct MPI_Status {
+    int MPI_SOURCE;
+    int MPI_TAG;
+    int MPI_ERROR;
+    size_t acx_bytes; /* internal: received byte count */
+} MPI_Status;
+
+#define MPI_STATUS_IGNORE   ((MPI_Status *)0)
+#define MPI_STATUSES_IGNORE ((MPI_Status *)0)
+
+#define MPI_IN_PLACE ((void *)-1)
+
+int MPI_Init(int *argc, char ***argv);
+int MPI_Init_thread(int *argc, char ***argv, int required, int *provided);
+int MPI_Initialized(int *flag);
+int MPI_Finalize(void);
+int MPI_Finalized(int *flag);
+int MPI_Query_thread(int *provided);
+int MPI_Abort(MPI_Comm comm, int errorcode);
+
+int MPI_Comm_rank(MPI_Comm comm, int *rank);
+int MPI_Comm_size(MPI_Comm comm, int *size);
+
+int MPI_Type_size(MPI_Datatype datatype, int *size);
+
+int MPI_Barrier(MPI_Comm comm);
+/* int32 elements only (what the tests and runtime need). */
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype datatype, MPI_Op op, MPI_Comm comm);
+
+/* Blocking point-to-point, used by simple consumers of the shim. */
+int MPI_Send(const void *buf, int count, MPI_Datatype datatype, int dest,
+             int tag, MPI_Comm comm);
+int MPI_Recv(void *buf, int count, MPI_Datatype datatype, int source, int tag,
+             MPI_Comm comm, MPI_Status *status);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* ACX_COMPAT_MPI_H */

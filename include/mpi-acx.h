@@ -1,0 +1,89 @@
+/* tpu-acx public C API — source-compatible with NVIDIA/mpi-acx's
+ * include/mpi-acx.h:42-104 (same 17 functions, same signatures) so the
+ * reference's test programs build unchanged against the compat headers in
+ * include/compat/.
+ *
+ * TPU-native notes:
+ *  - MPIX_QUEUE_CUDA_STREAM / MPIX_QUEUE_CUDA_GRAPH keep their reference
+ *    names (and get MPIX_QUEUE_XLA_* aliases): the queue is an acx::Stream
+ *    (in-order host execution queue = PJRT-stream stand-in) or acx::Graph
+ *    (staged relaunchable program = jitted-executable stand-in).
+ *  - MPIX_Pready / MPIX_Parrived are declared unconditionally: there is no
+ *    __CUDACC__ host/device split on TPU. The device-side equivalents are
+ *    Pallas flag kernels exposed from the Python layer (mpi_acx_tpu.ops);
+ *    these C entry points serve host code and host-queue "kernels".
+ */
+#ifndef MPI_ACX_H
+#define MPI_ACX_H
+
+#include <mpi.h>
+#include <cuda_runtime.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void * MPIX_Request;
+typedef void * MPIX_Prequest;
+
+#define MPIX_REQUEST_NULL  NULL
+#define MPIX_PREQUEST_NULL NULL
+
+int MPIX_Init(void);
+int MPIX_Finalize(void);
+
+/* ENQUEUED OPERATIONS (reference mpi-acx.h:51-65) ***************************/
+
+enum {
+    MPIX_QUEUE_CUDA_STREAM,
+    MPIX_QUEUE_CUDA_GRAPH
+};
+/* TPU-native names for the same queue kinds. */
+#define MPIX_QUEUE_XLA_STREAM MPIX_QUEUE_CUDA_STREAM
+#define MPIX_QUEUE_XLA_GRAPH  MPIX_QUEUE_CUDA_GRAPH
+
+int MPIX_Isend_enqueue(const void *buf, int count, MPI_Datatype datatype, int dest,
+                       int tag, MPI_Comm comm, MPIX_Request *request, int qtype, void *queue);
+
+int MPIX_Irecv_enqueue(void *buf, int count, MPI_Datatype datatype, int source,
+                       int tag, MPI_Comm comm, MPIX_Request *request, int qtype, void *queue);
+
+int MPIX_Wait_enqueue(MPIX_Request *req, MPI_Status *status, int qtype, void *queue);
+int MPIX_Waitall_enqueue(int count, MPIX_Request *reqs, MPI_Status *statuses, int qtype, void *queue);
+
+/* PARTITIONED OPERATIONS (reference mpi-acx.h:67-78) ************************/
+
+int MPIX_Psend_init(const void *buf, int partitions, MPI_Count count,
+                    MPI_Datatype datatype, int dest, int tag, MPI_Comm comm,
+                    MPI_Info info, MPIX_Request *request);
+
+int MPIX_Precv_init(void *buf, int partitions, MPI_Count count,
+                    MPI_Datatype datatype, int source, int tag, MPI_Comm comm,
+                    MPI_Info info, MPIX_Request *request);
+
+int MPIX_Prequest_create(MPIX_Request request, MPIX_Prequest *prequest);
+int MPIX_Prequest_free(MPIX_Prequest *request);
+
+/* HELPERS (reference mpi-acx.h:80-88) ***************************************/
+
+int MPIX_Start(MPIX_Request *request);
+int MPIX_Startall(int count, MPIX_Request *request);
+
+int MPIX_Wait(MPIX_Request *req, MPI_Status *status);
+int MPIX_Waitall(int count, MPIX_Request *reqs, MPI_Status *statuses);
+
+int MPIX_Request_free(MPIX_Request *request);
+
+/* PARTITION SIGNALING (reference mpi-acx.h:96-104, minus the __CUDACC__
+ * guard — see header comment). `request` accepts either an MPIX_Request*
+ * (host style) or an MPIX_Prequest handle (device-mirror style); the
+ * implementation disambiguates. */
+
+int MPIX_Pready(int partition, void *request);
+int MPIX_Parrived(void *request, int partition, int *flag);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MPI_ACX_H */

@@ -1,0 +1,68 @@
+/* tpu-acx integration test: exchange through "device" allocations with
+ * host-side MPIX_Waitall. Coverage parity with reference
+ * test/src/ring-all-device.c (cudaMalloc buffers + host Waitall to avoid
+ * blocking the queue; rationale in its comments at :93-101). On the tpu-acx
+ * host plane, device allocations are host memory staged by the shim
+ * (include/compat/cuda_runtime.h); on-TPU arrays belong to the JAX layer. */
+#include <stdio.h>
+#include <mpi.h>
+#include <mpi-acx.h>
+
+#define N 256
+
+int main(int argc, char **argv) {
+    int provided, rank, size, errs = 0;
+
+    MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided);
+    if (provided < MPI_THREAD_MULTIPLE) MPI_Abort(MPI_COMM_WORLD, 1);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    if (MPIX_Init()) MPI_Abort(MPI_COMM_WORLD, 2);
+
+    const int right = (rank + 1) % size;
+    const int left = (rank + size - 1) % size;
+
+    int host_send[N], host_recv[N];
+    int *dev_send = NULL, *dev_recv = NULL;
+    if (cudaMalloc((void **)&dev_send, sizeof host_send) != cudaSuccess ||
+        cudaMalloc((void **)&dev_recv, sizeof host_recv) != cudaSuccess)
+        MPI_Abort(MPI_COMM_WORLD, 2);
+
+    for (int i = 0; i < N; i++) {
+        host_send[i] = rank * N + i;
+        host_recv[i] = -1;
+    }
+    cudaMemcpy(dev_send, host_send, sizeof host_send, cudaMemcpyHostToDevice);
+    cudaMemcpy(dev_recv, host_recv, sizeof host_recv, cudaMemcpyHostToDevice);
+
+    MPIX_Request req[2];
+    cudaStream_t stream = 0;
+
+    MPIX_Isend_enqueue(dev_send, N, MPI_INT, right, 4, MPI_COMM_WORLD,
+                       &req[0], MPIX_QUEUE_XLA_STREAM, &stream);
+    MPIX_Irecv_enqueue(dev_recv, N, MPI_INT, left, 4, MPI_COMM_WORLD,
+                       &req[1], MPIX_QUEUE_XLA_STREAM, &stream);
+
+    /* Host-side waits: do not block the execution queue (the deadlock class
+     * reference ring-all-device.c documents). */
+    MPIX_Waitall(2, req, MPI_STATUSES_IGNORE);
+
+    cudaMemcpy(host_recv, dev_recv, sizeof host_recv, cudaMemcpyDeviceToHost);
+    for (int i = 0; i < N; i++) {
+        if (host_recv[i] != left * N + i) {
+            if (errs < 3)
+                printf("[%d] elem %d: got %d, want %d\n", rank, i,
+                       host_recv[i], left * N + i);
+            errs++;
+        }
+    }
+
+    cudaFree(dev_send);
+    cudaFree(dev_recv);
+
+    MPI_Allreduce(MPI_IN_PLACE, &errs, 1, MPI_INT, MPI_MAX, MPI_COMM_WORLD);
+    MPIX_Finalize();
+    MPI_Finalize();
+    if (rank == 0 && errs == 0) printf("ring-all-device: OK\n");
+    return errs != 0;
+}

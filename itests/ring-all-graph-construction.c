@@ -1,0 +1,68 @@
+/* tpu-acx integration test: explicit graph construction + composition.
+ *
+ * Coverage parity with reference test/src/ring-all-graph-construction.c:
+ * 74-107 — MPIX_QUEUE_XLA_GRAPH hands back single-op graphs which the app
+ * composes with child-graph nodes and dependency edges, instantiates once,
+ * and relaunches; the component graphs are destroyed while the exec lives
+ * (refcounted cleanup must keep slots alive). */
+#include <stdio.h>
+#include <mpi.h>
+#include <mpi-acx.h>
+
+int main(int argc, char **argv) {
+    int provided, rank, size, errs = 0;
+
+    MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided);
+    if (provided < MPI_THREAD_MULTIPLE) MPI_Abort(MPI_COMM_WORLD, 1);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    if (MPIX_Init()) MPI_Abort(MPI_COMM_WORLD, 2);
+
+    const int right = (rank + 1) % size;
+    const int left = (rank + size - 1) % size;
+    int send_val = rank + 1, recv_val = -1;
+    MPIX_Request req[2];
+    cudaGraph_t send_graph, recv_graph, wait_graph, graph;
+    cudaGraphNode_t send_node, recv_node, wait_node;
+
+    MPIX_Isend_enqueue(&send_val, 1, MPI_INT, right, 6, MPI_COMM_WORLD,
+                       &req[0], MPIX_QUEUE_XLA_GRAPH, &send_graph);
+    MPIX_Irecv_enqueue(&recv_val, 1, MPI_INT, left, 6, MPI_COMM_WORLD,
+                       &req[1], MPIX_QUEUE_XLA_GRAPH, &recv_graph);
+    MPIX_Waitall_enqueue(2, req, MPI_STATUSES_IGNORE, MPIX_QUEUE_XLA_GRAPH,
+                         &wait_graph);
+
+    if (cudaGraphCreate(&graph, 0) != cudaSuccess) MPI_Abort(MPI_COMM_WORLD, 2);
+    cudaGraphAddChildGraphNode(&send_node, graph, NULL, 0, send_graph);
+    cudaGraphAddChildGraphNode(&recv_node, graph, &send_node, 1, recv_graph);
+    cudaGraphAddChildGraphNode(&wait_node, graph, &recv_node, 1, wait_graph);
+
+    cudaGraphExec_t exec;
+    if (cudaGraphInstantiate(&exec, graph, NULL, NULL, 0) != cudaSuccess)
+        MPI_Abort(MPI_COMM_WORLD, 2);
+
+    for (int i = 0; i < size; i++) {
+        cudaGraphLaunch(exec, 0);
+        cudaMemcpyAsync(&send_val, &recv_val, sizeof(int),
+                        cudaMemcpyHostToHost, 0);
+    }
+    cudaStreamSynchronize(0);
+
+    cudaGraphExecDestroy(exec);
+    cudaGraphDestroy(graph);
+    cudaGraphDestroy(send_graph);
+    cudaGraphDestroy(recv_graph);
+    cudaGraphDestroy(wait_graph);
+
+    if (recv_val != rank + 1) {
+        printf("[%d] got %d after full circulation, want %d\n", rank,
+               recv_val, rank + 1);
+        errs++;
+    }
+
+    MPI_Allreduce(MPI_IN_PLACE, &errs, 1, MPI_INT, MPI_MAX, MPI_COMM_WORLD);
+    MPIX_Finalize();
+    MPI_Finalize();
+    if (rank == 0 && errs == 0) printf("ring-all-graph-construction: OK\n");
+    return errs != 0;
+}

@@ -1,0 +1,70 @@
+/* tpu-acx integration test: capture → instantiate → relaunch (re-fire).
+ *
+ * Coverage parity with reference test/src/ring-all-graph.c:74-101: capture
+ * an enqueued exchange into a graph, relaunch it world_size times with a
+ * send<-recv copy between launches, and expect each rank's value to travel
+ * the whole ring back to it. Exercises per-launch re-firing of graph-owned
+ * ops and cleanup tied to graph/exec lifetime. */
+#include <stdio.h>
+#include <mpi.h>
+#include <mpi-acx.h>
+
+int main(int argc, char **argv) {
+    int provided, rank, size, errs = 0;
+
+    MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided);
+    if (provided < MPI_THREAD_MULTIPLE) MPI_Abort(MPI_COMM_WORLD, 1);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    if (MPIX_Init()) MPI_Abort(MPI_COMM_WORLD, 2);
+
+    const int right = (rank + 1) % size;
+    const int left = (rank + size - 1) % size;
+    int send_val = rank + 1, recv_val = -1;
+    MPIX_Request req[2];
+    cudaStream_t stream;
+    cudaGraph_t graph;
+
+    if (cudaStreamCreate(&stream) != cudaSuccess) MPI_Abort(MPI_COMM_WORLD, 2);
+    if (cudaStreamBeginCapture(stream, cudaStreamCaptureModeGlobal) !=
+        cudaSuccess)
+        MPI_Abort(MPI_COMM_WORLD, 2);
+
+    MPIX_Isend_enqueue(&send_val, 1, MPI_INT, right, 5, MPI_COMM_WORLD,
+                       &req[0], MPIX_QUEUE_XLA_STREAM, &stream);
+    MPIX_Irecv_enqueue(&recv_val, 1, MPI_INT, left, 5, MPI_COMM_WORLD,
+                       &req[1], MPIX_QUEUE_XLA_STREAM, &stream);
+    MPIX_Waitall_enqueue(2, req, MPI_STATUSES_IGNORE, MPIX_QUEUE_XLA_STREAM,
+                         &stream);
+
+    if (cudaStreamEndCapture(stream, &graph) != cudaSuccess)
+        MPI_Abort(MPI_COMM_WORLD, 2);
+
+    cudaGraphExec_t exec;
+    if (cudaGraphInstantiate(&exec, graph, NULL, NULL, 0) != cudaSuccess)
+        MPI_Abort(MPI_COMM_WORLD, 2);
+
+    /* Circulate: after `size` launches my own value is back. */
+    for (int i = 0; i < size; i++) {
+        cudaGraphLaunch(exec, stream);
+        cudaMemcpyAsync(&send_val, &recv_val, sizeof(int),
+                        cudaMemcpyHostToHost, stream);
+    }
+    cudaStreamSynchronize(stream);
+
+    cudaGraphExecDestroy(exec);
+    cudaGraphDestroy(graph);
+    cudaStreamDestroy(stream);
+
+    if (recv_val != rank + 1) {
+        printf("[%d] got %d after full circulation, want %d\n", rank,
+               recv_val, rank + 1);
+        errs++;
+    }
+
+    MPI_Allreduce(MPI_IN_PLACE, &errs, 1, MPI_INT, MPI_MAX, MPI_COMM_WORLD);
+    MPIX_Finalize();
+    MPI_Finalize();
+    if (rank == 0 && errs == 0) printf("ring-all-graph: OK\n");
+    return errs != 0;
+}

@@ -1,0 +1,47 @@
+/* tpu-acx integration test: batched on-queue wait (MPIX_Waitall_enqueue).
+ * Coverage parity with reference test/src/ring-all.c:72-90. */
+#include <stdio.h>
+#include <mpi.h>
+#include <mpi-acx.h>
+
+int main(int argc, char **argv) {
+    int provided, rank, size, errs = 0;
+
+    MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided);
+    if (provided < MPI_THREAD_MULTIPLE) MPI_Abort(MPI_COMM_WORLD, 1);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    if (MPIX_Init()) MPI_Abort(MPI_COMM_WORLD, 2);
+
+    const int right = (rank + 1) % size;
+    const int left = (rank + size - 1) % size;
+    int send_val = rank + 100, recv_val = -1;
+    MPIX_Request req[2];
+    MPI_Status statuses[2];
+    cudaStream_t stream = 0;
+
+    MPIX_Isend_enqueue(&send_val, 1, MPI_INT, right, 3, MPI_COMM_WORLD,
+                       &req[0], MPIX_QUEUE_XLA_STREAM, &stream);
+    MPIX_Irecv_enqueue(&recv_val, 1, MPI_INT, left, 3, MPI_COMM_WORLD,
+                       &req[1], MPIX_QUEUE_XLA_STREAM, &stream);
+    MPIX_Waitall_enqueue(2, req, statuses, MPIX_QUEUE_XLA_STREAM, &stream);
+
+    if (cudaStreamSynchronize(stream) != cudaSuccess)
+        MPI_Abort(MPI_COMM_WORLD, 2);
+
+    if (recv_val != left + 100) {
+        printf("[%d] got %d, want %d\n", rank, recv_val, left + 100);
+        errs++;
+    }
+    if (statuses[1].MPI_SOURCE != left || statuses[1].MPI_TAG != 3) {
+        printf("[%d] bad recv status (%d,%d)\n", rank, statuses[1].MPI_SOURCE,
+               statuses[1].MPI_TAG);
+        errs++;
+    }
+
+    MPI_Allreduce(MPI_IN_PLACE, &errs, 1, MPI_INT, MPI_MAX, MPI_COMM_WORLD);
+    MPIX_Finalize();
+    MPI_Finalize();
+    if (rank == 0 && errs == 0) printf("ring-all: OK\n");
+    return errs != 0;
+}

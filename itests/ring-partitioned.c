@@ -1,0 +1,94 @@
+/* tpu-acx integration test: kernel-style partitioned communication.
+ *
+ * Coverage parity with reference test/src/ring-partitioned.cu:91-127 —
+ * persistent Psend/Precv channels restarted across 10 iterations with 10
+ * partitions, partitions marked ready from queue-ordered "kernels" through
+ * the MPIX_Prequest device-mirror handle (out of order!), and arrival
+ * polled by a *separate* queue work item (the reference's separate
+ * mark_ready / wait_until_arrived kernels — its README:152-159 deadlock
+ * rule). On TPU the kernels are Pallas flag ops from the Python layer; here
+ * they are host-queue functions via cudaLaunchHostFunc. */
+#include <stdio.h>
+#include <mpi.h>
+#include <mpi-acx.h>
+
+#define PARTS 10
+#define ITERS 10
+
+static MPIX_Prequest g_preq_send, g_preq_recv;
+
+/* "mark_ready kernel": flag every partition ready, highest index first. */
+static void mark_ready(void *unused) {
+    (void)unused;
+    for (int p = PARTS - 1; p >= 0; p--) MPIX_Pready(p, g_preq_send);
+}
+
+/* "wait_until_arrived kernel": poll each partition until it lands. */
+static void wait_until_arrived(void *unused) {
+    (void)unused;
+    for (int p = 0; p < PARTS; p++) {
+        int flag = 0;
+        while (!flag) MPIX_Parrived(g_preq_recv, p, &flag);
+    }
+}
+
+int main(int argc, char **argv) {
+    int provided, rank, size, errs = 0;
+
+    MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided);
+    if (provided < MPI_THREAD_MULTIPLE) MPI_Abort(MPI_COMM_WORLD, 1);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    if (MPIX_Init()) MPI_Abort(MPI_COMM_WORLD, 2);
+
+    const int right = (rank + 1) % size;
+    const int left = (rank + size - 1) % size;
+
+    int send_buf[PARTS], recv_buf[PARTS];
+    MPIX_Request req[2];
+    MPI_Status status[2];
+
+    MPIX_Psend_init(send_buf, PARTS, 1, MPI_INT, right, 0, MPI_COMM_WORLD,
+                    MPI_INFO_NULL, &req[0]);
+    MPIX_Precv_init(recv_buf, PARTS, 1, MPI_INT, left, 0, MPI_COMM_WORLD,
+                    MPI_INFO_NULL, &req[1]);
+    MPIX_Prequest_create(req[0], &g_preq_send);
+    MPIX_Prequest_create(req[1], &g_preq_recv);
+
+    for (int iter = 0; iter < ITERS; iter++) {
+        for (int p = 0; p < PARTS; p++) {
+            send_buf[p] = rank * 1000 + p * 10 + iter;
+            recv_buf[p] = -1;
+        }
+
+        MPIX_Startall(2, req);
+
+        cudaLaunchHostFunc(0, mark_ready, NULL);
+        cudaLaunchHostFunc(0, wait_until_arrived, NULL);
+        if (cudaStreamSynchronize(0) != cudaSuccess)
+            MPI_Abort(MPI_COMM_WORLD, 2);
+
+        MPIX_Waitall(2, req, status);
+
+        for (int p = 0; p < PARTS; p++) {
+            const int want = left * 1000 + p * 10 + iter;
+            if (recv_buf[p] != want) {
+                if (errs < 3)
+                    printf("[%d] iter %d part %d: got %d, want %d\n", rank,
+                           iter, p, recv_buf[p], want);
+                errs++;
+            }
+        }
+    }
+
+    MPIX_Prequest_free(&g_preq_send);
+    MPIX_Prequest_free(&g_preq_recv);
+    MPIX_Request_free(&req[0]);
+    MPIX_Request_free(&req[1]);
+
+    MPI_Allreduce(MPI_IN_PLACE, &errs, 1, MPI_INT, MPI_MAX, MPI_COMM_WORLD);
+    MPIX_Finalize();
+    MPI_Finalize();
+    if (rank == 0 && errs == 0) printf("ring-partitioned: OK\n");
+    return errs != 0;
+}

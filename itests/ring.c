@@ -1,0 +1,88 @@
+/* tpu-acx integration test: stream-enqueued ring exchange.
+ *
+ * Coverage parity with reference test/src/ring.c:74-142 — enqueued
+ * Isend/Irecv with (a) on-queue waits + queue sync and (b) host waits, full
+ * MPI_Status field validation both times — written for the tpu-acx host
+ * execution queue. Run under `acxrun -np N`.
+ */
+#include <stdio.h>
+#include <mpi.h>
+#include <mpi-acx.h>
+
+static int check_status(int rank, const MPI_Status *st, int want_src,
+                        int want_tag) {
+    int errs = 0;
+    if (st->MPI_SOURCE != want_src) {
+        printf("[%d] bad status source %d, want %d\n", rank, st->MPI_SOURCE,
+               want_src);
+        errs++;
+    }
+    if (st->MPI_TAG != want_tag) {
+        printf("[%d] bad status tag %d, want %d\n", rank, st->MPI_TAG,
+               want_tag);
+        errs++;
+    }
+    if (st->MPI_ERROR != MPI_SUCCESS) {
+        printf("[%d] bad status error %d\n", rank, st->MPI_ERROR);
+        errs++;
+    }
+    return errs;
+}
+
+int main(int argc, char **argv) {
+    int provided, rank, size, errs = 0;
+
+    MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided);
+    if (provided < MPI_THREAD_MULTIPLE) MPI_Abort(MPI_COMM_WORLD, 1);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+    if (MPIX_Init()) MPI_Abort(MPI_COMM_WORLD, 2);
+
+    const int right = (rank + 1) % size;
+    const int left = (rank + size - 1) % size;
+    int send_val = rank * 7 + 1;
+    int recv_val;
+    MPIX_Request req[2];
+    MPI_Status status;
+    cudaStream_t stream = 0; /* default queue */
+
+    /* Phase 1: waits on the queue, then sync. */
+    recv_val = -1;
+    MPIX_Isend_enqueue(&send_val, 1, MPI_INT, right, 0, MPI_COMM_WORLD,
+                       &req[0], MPIX_QUEUE_XLA_STREAM, &stream);
+    MPIX_Irecv_enqueue(&recv_val, 1, MPI_INT, left, 0, MPI_COMM_WORLD,
+                       &req[1], MPIX_QUEUE_XLA_STREAM, &stream);
+    MPIX_Wait_enqueue(&req[0], MPI_STATUS_IGNORE, MPIX_QUEUE_XLA_STREAM,
+                      &stream);
+    MPIX_Wait_enqueue(&req[1], &status, MPIX_QUEUE_XLA_STREAM, &stream);
+    if (cudaStreamSynchronize(stream) != cudaSuccess)
+        MPI_Abort(MPI_COMM_WORLD, 2);
+
+    if (recv_val != left * 7 + 1) {
+        printf("[%d] phase1: got %d, want %d\n", rank, recv_val, left * 7 + 1);
+        errs++;
+    }
+    errs += check_status(rank, &status, left, 0);
+
+    /* Phase 2: enqueue triggers, wait on the host. */
+    recv_val = -1;
+    MPIX_Isend_enqueue(&send_val, 1, MPI_INT, right, 1, MPI_COMM_WORLD,
+                       &req[0], MPIX_QUEUE_XLA_STREAM, &stream);
+    MPIX_Irecv_enqueue(&recv_val, 1, MPI_INT, left, 1, MPI_COMM_WORLD,
+                       &req[1], MPIX_QUEUE_XLA_STREAM, &stream);
+    MPIX_Wait(&req[0], MPI_STATUS_IGNORE);
+    MPIX_Wait(&req[1], &status);
+
+    if (recv_val != left * 7 + 1) {
+        printf("[%d] phase2: got %d, want %d\n", rank, recv_val, left * 7 + 1);
+        errs++;
+    }
+    errs += check_status(rank, &status, left, 1);
+
+    MPI_Allreduce(MPI_IN_PLACE, &errs, 1, MPI_INT, MPI_MAX, MPI_COMM_WORLD);
+    MPIX_Finalize();
+    MPI_Finalize();
+    if (rank == 0 && errs == 0) printf("ring: OK\n");
+    return errs != 0;
+}

@@ -1,0 +1,535 @@
+// tpu-acx: the public MPIX_* API (all 17 entry points of reference
+// include/mpi-acx.h:48-104), implemented over the atomic FlagTable + Proxy +
+// Transport + Stream/Graph runtime.
+//
+// Layer map (SURVEY.md §1): this file is L3+L4 — the counterpart of
+// reference src/sendrecv.cu (enqueued ops, waits, request lifecycle),
+// src/partitioned.cu (partitioned init/start/signaling) and the
+// MPIX_Init/Finalize halves of src/init.cpp. Deliberate redesigns:
+//   * Graph waits observe COMPLETED (reference's graph-path MPIX_Wait_enqueue
+//     waits for PENDING — the latent bug at sendrecv.cu:411 — fixed here by
+//     construction; Waitall at :548 already did it right).
+//   * Graph-owned ops re-fire on every launch; their slot + request are
+//     reclaimed through the graph's refcounted cleanup set (the
+//     cudaUserObject pattern, sendrecv.cu:106-127) via the proxy's
+//     first-class CLEANUP state, so nothing leaks if the graph never ran.
+//   * No completion mutex: COMPLETED is published with release ordering and
+//     consumers arbitrate COMPLETED->CLEANUP by CAS (reference needed
+//     mpiacx_op_completion_mutex, init.cpp:119-141).
+
+#include <sched.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include "acx/api_internal.h"
+#include "acx/debug.h"
+#include "acx/net.h"
+#include "acx/runtime.h"
+#include "mpi-acx.h"
+
+namespace acx {
+namespace {
+
+constexpr int kErr = 1;
+
+// Spin with yield until the slot reaches `want` (host- and node-side waits).
+void SpinUntil(FlagTable* t, int idx, int32_t want) {
+  while (t->Load(idx) != want) sched_yield();
+}
+
+void CopyStatus(const Status& s, MPI_Status* st) {
+  if (st == MPI_STATUS_IGNORE) return;
+  st->MPI_SOURCE = s.source;
+  st->MPI_TAG = s.tag;
+  st->MPI_ERROR = s.error;
+  st->acx_bytes = s.bytes;
+}
+
+Stream* StreamFromQueue(void* queue) {
+  // queue is a cudaStream_t* (reference sendrecv.cu dereferences the same
+  // way); NULL handle = default stream.
+  void* h = *static_cast<void**>(queue);
+  return h == nullptr ? Stream::Default() : static_cast<Stream*>(h);
+}
+
+// Resolve the void* of MPIX_Pready/Parrived into a request or prequest
+// (see include/mpi-acx.h: host passes MPIX_Request*, device-mirror style
+// passes the MPIX_Prequest handle itself).
+struct Resolved {
+  MpixRequest* req = nullptr;
+  MpixPrequest* preq = nullptr;
+};
+
+Resolved ResolveHandle(void* r) {
+  Resolved out;
+  if (r == nullptr) return out;
+  uint32_t m;
+  std::memcpy(&m, r, sizeof m);
+  void* h = r;
+  if (m != kReqMagic && m != kPreqMagic) {
+    h = *static_cast<void**>(r);
+    if (h == nullptr) return out;
+    std::memcpy(&m, h, sizeof m);
+  }
+  if (m == kReqMagic) out.req = static_cast<MpixRequest*>(h);
+  if (m == kPreqMagic) out.preq = static_cast<MpixPrequest*>(h);
+  return out;
+}
+
+// Register the graph-lifetime reclaim hook for a graph-owned op: when the
+// last of {graph, execs} dies, push the slot to CLEANUP (spinning out any
+// in-flight transfer first) and let the proxy free ticket + request.
+void ArmGraphCleanup(Graph* g, int idx) {
+  FlagTable* table = GS().table;
+  Proxy* proxy = GS().proxy;
+  g->AddCleanup([table, proxy, idx] {
+    int32_t f = table->Load(idx);
+    while (f == kPending || f == kIssued) {
+      sched_yield();
+      f = table->Load(idx);
+    }
+    // RESERVED (never launched) or COMPLETED: either way, reclaim.
+    table->Store(idx, kCleanup);
+    proxy->Kick();
+  });
+}
+
+int EnqueueSendRecv(bool is_send, void* buf, int count, MPI_Datatype datatype,
+                    int peer, int tag, MPI_Comm comm, MPIX_Request* request,
+                    int qtype, void* queue) {
+  ApiState& g = GS();
+  if (!g.mpix_inited || request == nullptr || queue == nullptr) return kErr;
+  // Error paths hand back MPIX_REQUEST_NULL so a caller that ignores the
+  // return code fails loudly on its next MPIX call instead of dereferencing
+  // an uninitialized handle.
+  *request = MPIX_REQUEST_NULL;
+
+  const int idx = g.table->Allocate();
+  if (idx < 0) {
+    std::fprintf(stderr, "tpu-acx: flag table exhausted (ACX_NFLAGS=%zu)\n",
+                 g.table->size());
+    return kErr;
+  }
+  Op& op = g.table->op(idx);
+  op.kind = is_send ? OpKind::kIsend : OpKind::kIrecv;
+  if (is_send)
+    op.sbuf = buf;
+  else
+    op.rbuf = buf;
+  op.bytes = DatatypeSize(datatype) * static_cast<size_t>(count);
+  op.peer = peer;
+  op.tag = tag;
+  op.ctx = comm;
+
+  auto* req = static_cast<MpixRequest*>(std::calloc(1, sizeof(MpixRequest)));
+  req->magic = kReqMagic;
+  req->kind = ReqKind::kBasic;
+  req->flag_idx = idx;
+  op.owner = req;  // proxy frees it at CLEANUP (malloc contract, state.h)
+
+  FlagTable* table = g.table;
+  Proxy* proxy = g.proxy;
+  // The trigger: "the queue reached this point". First firing moves
+  // RESERVED->PENDING; graph relaunches re-fire COMPLETED->PENDING
+  // (reference state doc, mpi-acx-internal.h:176-189).
+  auto trigger = [table, proxy, idx] {
+    table->Store(idx, kPending);
+    proxy->Kick();
+  };
+
+  if (qtype == MPIX_QUEUE_CUDA_STREAM) {
+    Stream* s = StreamFromQueue(queue);
+    req->graph_owned = s->capturing();
+    s->Enqueue(trigger);  // records a node instead when capturing
+    if (req->graph_owned) ArmGraphCleanup(s->capture_graph(), idx);
+  } else if (qtype == MPIX_QUEUE_CUDA_GRAPH) {
+    // Explicit-construction mode: hand back a single-node graph the app
+    // composes (reference sendrecv.cu:186-208).
+    auto* gr = new Graph();
+    gr->AddNode(trigger);
+    req->graph_owned = true;
+    ArmGraphCleanup(gr, idx);
+    *static_cast<void**>(queue) = gr;
+  } else {
+    table->Free(idx);
+    std::free(req);
+    return kErr;
+  }
+  *request = req;
+  return MPI_SUCCESS;
+}
+
+// The wait work item: spin to COMPLETED, deliver status, and for
+// stream-owned ops advance to CLEANUP (graph-owned ops only observe, so the
+// op can re-fire on the next launch).
+std::function<void()> MakeWaiter(int idx, MPI_Status* status,
+                                 bool graph_owned) {
+  FlagTable* table = GS().table;
+  Proxy* proxy = GS().proxy;
+  return [table, proxy, idx, status, graph_owned] {
+    SpinUntil(table, idx, kCompleted);
+    CopyStatus(table->op(idx).status, status);
+    if (!graph_owned) {
+      table->Store(idx, kCleanup);
+      proxy->Kick();
+    }
+  };
+}
+
+int EnqueueWait(MPIX_Request* reqp, MPI_Status* status, int qtype,
+                void* queue, Graph* shared_graph) {
+  ApiState& g = GS();
+  if (!g.mpix_inited || reqp == nullptr) return kErr;
+  auto* req = static_cast<MpixRequest*>(*reqp);
+  if (req == nullptr || req->kind != ReqKind::kBasic) return kErr;
+  const int idx = req->flag_idx;
+  const bool graph_owned = req->graph_owned;
+
+  if (qtype == MPIX_QUEUE_CUDA_STREAM) {
+    Stream* s = StreamFromQueue(queue);
+    if (!s->capturing() && !graph_owned &&
+        g.table->Load(idx) == kCompleted) {
+      // Fast path (reference try_complete_wait_op, sendrecv.cu:82-104):
+      // already complete — consume inline, no queue hop.
+      CopyStatus(g.table->op(idx).status, status);
+      g.table->Store(idx, kCleanup);
+      g.proxy->Kick();
+      *reqp = MPIX_REQUEST_NULL;
+      return MPI_SUCCESS;
+    }
+    s->Enqueue(MakeWaiter(idx, status, graph_owned));
+  } else if (qtype == MPIX_QUEUE_CUDA_GRAPH) {
+    // Graph wait observes COMPLETED — deliberately NOT the reference's
+    // buggy PENDING wait (sendrecv.cu:411).
+    Graph* gr = shared_graph;
+    if (gr == nullptr) {
+      gr = new Graph();
+      *static_cast<void**>(queue) = gr;
+    }
+    gr->AddNode(MakeWaiter(idx, status, /*graph_owned=*/true));
+  } else {
+    return kErr;
+  }
+  *reqp = MPIX_REQUEST_NULL;
+  return MPI_SUCCESS;
+}
+
+int HostWaitBasic(MpixRequest* req, MPI_Status* status) {
+  ApiState& g = GS();
+  const int idx = req->flag_idx;
+  if (req->graph_owned) {
+    std::fprintf(stderr,
+                 "tpu-acx: host MPIX_Wait on a graph-owned op is not "
+                 "supported (reference README limitation)\n");
+    return kErr;
+  }
+  SpinUntil(g.table, idx, kCompleted);
+  CopyStatus(g.table->op(idx).status, status);
+  g.table->Store(idx, kCleanup);  // proxy frees request + ticket + slot
+  g.proxy->Kick();
+  return MPI_SUCCESS;
+}
+
+// Host wait on a partitioned request: per-partition COMPLETED->RESERVED
+// reset for restart, then close the transport round (reference
+// sendrecv.cu:607-632).
+int HostWaitPartitioned(MpixRequest* req, MPI_Status* status) {
+  ApiState& g = GS();
+  for (int p = 0; p < req->partitions; p++) {
+    SpinUntil(g.table, req->part_idx[p], kCompleted);
+    g.table->Store(req->part_idx[p], kReserved);
+  }
+  Status st;
+  req->chan->FinishRound(&st);
+  CopyStatus(st, status);
+  req->started = false;
+  return MPI_SUCCESS;
+}
+
+int PartitionedInit(bool is_send, void* buf, int partitions, MPI_Count count,
+                    MPI_Datatype datatype, int peer, int tag, MPI_Comm comm,
+                    MPIX_Request* request) {
+  ApiState& g = GS();
+  if (!g.mpix_inited || request == nullptr || partitions <= 0) return kErr;
+  *request = MPIX_REQUEST_NULL;  // see EnqueueSendRecv
+  const size_t part_bytes =
+      DatatypeSize(datatype) * static_cast<size_t>(count);
+
+  PartitionedChan* chan =
+      is_send ? g.transport->PsendInit(buf, partitions, part_bytes, peer, tag,
+                                       comm)
+              : g.transport->PrecvInit(buf, partitions, part_bytes, peer, tag,
+                                       comm);
+
+  auto* req = static_cast<MpixRequest*>(std::calloc(1, sizeof(MpixRequest)));
+  req->magic = kReqMagic;
+  req->kind = is_send ? ReqKind::kPsend : ReqKind::kPrecv;
+  req->chan = chan;
+  req->partitions = partitions;
+  req->part_idx =
+      static_cast<int*>(std::malloc(sizeof(int) * partitions));
+  // One flag slot per partition (reference partitioned.cu:61-68,105-112).
+  for (int p = 0; p < partitions; p++) {
+    const int idx = g.table->Allocate();
+    if (idx < 0) {
+      for (int q = 0; q < p; q++) g.table->Free(req->part_idx[q]);
+      std::free(req->part_idx);
+      std::free(req);
+      delete chan;
+      return kErr;
+    }
+    Op& op = g.table->op(idx);
+    op.kind = is_send ? OpKind::kPready : OpKind::kParrived;
+    op.chan = chan;
+    op.partition = p;
+    req->part_idx[p] = idx;
+  }
+  *request = req;
+  return MPI_SUCCESS;
+}
+
+}  // namespace
+}  // namespace acx
+
+using namespace acx;
+
+extern "C" {
+
+int MPIX_Init(void) {
+  ApiState& g = GS();
+  if (g.mpix_inited) return kErr;
+  EnsureTransport();
+  // Table size from env; both the tpu-acx and the reference spelling work
+  // (reference MPIACX_NFLAGS, init.cpp:205-216; default 4096,
+  // mpi-acx-internal.h:141).
+  size_t nflags = 4096;
+  const char* e = std::getenv("ACX_NFLAGS");
+  if (e == nullptr) e = std::getenv("MPIACX_NFLAGS");
+  if (e != nullptr) {
+    long v = std::atol(e);
+    if (v <= 0) {
+      std::fprintf(stderr, "tpu-acx: invalid ACX_NFLAGS '%s'\n", e);
+      return kErr;
+    }
+    nflags = static_cast<size_t>(v);
+  }
+  g.table = new FlagTable(nflags);
+  g.proxy = new Proxy(g.table, g.transport);
+  g.proxy->Start();
+  g.mpix_inited = true;
+  ACX_DLOG("MPIX_Init: rank %d/%d, %zu flag slots", g.transport->rank(),
+           g.transport->size(), nflags);
+  return MPI_SUCCESS;
+}
+
+int MPIX_Finalize(void) {
+  ApiState& g = GS();
+  if (!g.mpix_inited) return kErr;
+  // Leaked-slot diagnostics (reference init.cpp:262-266).
+  size_t leaked = 0;
+  for (size_t i = 0; i < g.table->size(); i++) {
+    const int32_t f = g.table->Load(i);
+    if (f != kAvailable && f != kCleanup) {
+      if (leaked < 8)
+        std::fprintf(stderr, "tpu-acx: finalize: slot %zu leaked in state %s\n",
+                     i, FlagName(f));
+      leaked++;
+    }
+  }
+  if (leaked > 0)
+    std::fprintf(stderr, "tpu-acx: finalize: %zu leaked slot(s)\n", leaked);
+  Proxy::Stats st = g.proxy->stats();
+  ACX_DLOG("MPIX_Finalize: sweeps=%llu issued=%llu completed=%llu reclaimed=%llu",
+           (unsigned long long)st.sweeps, (unsigned long long)st.ops_issued,
+           (unsigned long long)st.ops_completed,
+           (unsigned long long)st.slots_reclaimed);
+  g.proxy->Stop();
+  delete g.proxy;
+  g.proxy = nullptr;
+  delete g.table;
+  g.table = nullptr;
+  g.mpix_inited = false;
+  return MPI_SUCCESS;
+}
+
+int MPIX_Isend_enqueue(const void* buf, int count, MPI_Datatype datatype,
+                       int dest, int tag, MPI_Comm comm, MPIX_Request* request,
+                       int qtype, void* queue) {
+  return EnqueueSendRecv(true, const_cast<void*>(buf), count, datatype, dest,
+                         tag, comm, request, qtype, queue);
+}
+
+int MPIX_Irecv_enqueue(void* buf, int count, MPI_Datatype datatype, int source,
+                       int tag, MPI_Comm comm, MPIX_Request* request,
+                       int qtype, void* queue) {
+  return EnqueueSendRecv(false, buf, count, datatype, source, tag, comm,
+                         request, qtype, queue);
+}
+
+int MPIX_Wait_enqueue(MPIX_Request* req, MPI_Status* status, int qtype,
+                      void* queue) {
+  return EnqueueWait(req, status, qtype, queue, nullptr);
+}
+
+int MPIX_Waitall_enqueue(int count, MPIX_Request* reqs, MPI_Status* statuses,
+                         int qtype, void* queue) {
+  // One node/work-item per request; for the graph flavor all waits share a
+  // single returned graph (reference returns one graph from
+  // Waitall_enqueue too, sendrecv.cu:544-566).
+  Graph* shared = nullptr;
+  if (qtype == MPIX_QUEUE_CUDA_GRAPH) {
+    shared = new Graph();
+    *static_cast<void**>(queue) = shared;
+  }
+  for (int i = 0; i < count; i++) {
+    MPI_Status* st =
+        statuses == MPI_STATUSES_IGNORE ? MPI_STATUS_IGNORE : &statuses[i];
+    int rc = EnqueueWait(&reqs[i], st, qtype, queue, shared);
+    if (rc != MPI_SUCCESS) return rc;
+  }
+  return MPI_SUCCESS;
+}
+
+int MPIX_Psend_init(const void* buf, int partitions, MPI_Count count,
+                    MPI_Datatype datatype, int dest, int tag, MPI_Comm comm,
+                    MPI_Info, MPIX_Request* request) {
+  return PartitionedInit(true, const_cast<void*>(buf), partitions, count,
+                         datatype, dest, tag, comm, request);
+}
+
+int MPIX_Precv_init(void* buf, int partitions, MPI_Count count,
+                    MPI_Datatype datatype, int source, int tag, MPI_Comm comm,
+                    MPI_Info, MPIX_Request* request) {
+  return PartitionedInit(false, buf, partitions, count, datatype, source, tag,
+                         comm, request);
+}
+
+int MPIX_Prequest_create(MPIX_Request request, MPIX_Prequest* prequest) {
+  auto* req = static_cast<MpixRequest*>(request);
+  if (prequest == nullptr) return kErr;
+  *prequest = MPIX_PREQUEST_NULL;
+  if (req == nullptr || req->magic != kReqMagic ||
+      req->kind == ReqKind::kBasic)
+    return kErr;
+  auto* preq =
+      static_cast<MpixPrequest*>(std::calloc(1, sizeof(MpixPrequest)));
+  preq->magic = kPreqMagic;
+  preq->kind = req->kind;
+  preq->partitions = req->partitions;
+  preq->part_idx = req->part_idx;  // borrowed
+  preq->chan = req->chan;
+  *prequest = preq;
+  return MPI_SUCCESS;
+}
+
+int MPIX_Prequest_free(MPIX_Prequest* request) {
+  if (request == nullptr || *request == nullptr) return kErr;
+  std::free(*request);
+  *request = MPIX_PREQUEST_NULL;
+  return MPI_SUCCESS;
+}
+
+int MPIX_Start(MPIX_Request* request) {
+  ApiState& g = GS();
+  auto* req = static_cast<MpixRequest*>(*request);
+  if (req == nullptr || req->kind == ReqKind::kBasic || req->started)
+    return kErr;
+  req->chan->StartRound();
+  if (req->kind == ReqKind::kPrecv) {
+    // Receive partitions go straight to ISSUED so the proxy polls arrival
+    // (reference partitioned.cu:133-136); send partitions stay RESERVED
+    // until Pready.
+    for (int p = 0; p < req->partitions; p++)
+      g.table->Store(req->part_idx[p], kIssued);
+    g.proxy->Kick();
+  }
+  req->started = true;
+  return MPI_SUCCESS;
+}
+
+int MPIX_Startall(int count, MPIX_Request* request) {
+  for (int i = 0; i < count; i++) {
+    int rc = MPIX_Start(&request[i]);
+    if (rc != MPI_SUCCESS) return rc;
+  }
+  return MPI_SUCCESS;
+}
+
+int MPIX_Wait(MPIX_Request* req, MPI_Status* status) {
+  ApiState& g = GS();
+  if (!g.mpix_inited || req == nullptr) return kErr;
+  auto* r = static_cast<MpixRequest*>(*req);
+  if (r == nullptr) return kErr;
+  int rc = r->kind == ReqKind::kBasic ? HostWaitBasic(r, status)
+                                      : HostWaitPartitioned(r, status);
+  if (rc == MPI_SUCCESS && r->kind == ReqKind::kBasic)
+    *req = MPIX_REQUEST_NULL;  // partitioned requests persist across rounds
+  return rc;
+}
+
+int MPIX_Waitall(int count, MPIX_Request* reqs, MPI_Status* statuses) {
+  for (int i = 0; i < count; i++) {
+    MPI_Status* st =
+        statuses == MPI_STATUSES_IGNORE ? MPI_STATUS_IGNORE : &statuses[i];
+    int rc = MPIX_Wait(&reqs[i], st);
+    if (rc != MPI_SUCCESS) return rc;
+  }
+  return MPI_SUCCESS;
+}
+
+int MPIX_Request_free(MPIX_Request* request) {
+  // Partitioned-only, like the reference (sendrecv.cu:654-682): basic
+  // requests are consumed by their wait.
+  ApiState& g = GS();
+  auto* req = static_cast<MpixRequest*>(*request);
+  if (req == nullptr || req->kind == ReqKind::kBasic) return kErr;
+  for (int p = 0; p < req->partitions; p++) g.table->Free(req->part_idx[p]);
+  delete req->chan;
+  std::free(req->part_idx);
+  std::free(req);
+  *request = MPIX_REQUEST_NULL;
+  return MPI_SUCCESS;
+}
+
+int MPIX_Pready(int partition, void* request) {
+  ApiState& g = GS();
+  Resolved h = ResolveHandle(request);
+  int* part_idx = nullptr;
+  int partitions = 0;
+  if (h.req != nullptr && h.req->kind == ReqKind::kPsend) {
+    part_idx = h.req->part_idx;
+    partitions = h.req->partitions;
+  } else if (h.preq != nullptr && h.preq->kind == ReqKind::kPsend) {
+    part_idx = h.preq->part_idx;
+    partitions = h.preq->partitions;
+  } else {
+    return kErr;
+  }
+  if (partition < 0 || partition >= partitions) return kErr;
+  g.table->Store(part_idx[partition], kPending);
+  g.proxy->Kick();
+  return MPI_SUCCESS;
+}
+
+int MPIX_Parrived(void* request, int partition, int* flag) {
+  ApiState& g = GS();
+  Resolved h = ResolveHandle(request);
+  int* part_idx = nullptr;
+  int partitions = 0;
+  if (h.req != nullptr && h.req->kind == ReqKind::kPrecv) {
+    part_idx = h.req->part_idx;
+    partitions = h.req->partitions;
+  } else if (h.preq != nullptr && h.preq->kind == ReqKind::kPrecv) {
+    part_idx = h.preq->part_idx;
+    partitions = h.preq->partitions;
+  } else {
+    return kErr;
+  }
+  if (partition < 0 || partition >= partitions || flag == nullptr) return kErr;
+  *flag = g.table->Load(part_idx[partition]) == kCompleted ? 1 : 0;
+  return MPI_SUCCESS;
+}
+
+}  // extern "C"

@@ -54,6 +54,9 @@ bool Proxy::Sweep() {
           case OpKind::kIsend:
             ACX_DLOG("slot %zu: isend %zuB -> peer %d tag %d", i, op.bytes,
                      op.peer, op.tag);
+            // Graph re-fire: a relaunch moves COMPLETED->PENDING with the
+            // previous launch's ticket still attached; reclaim it first.
+            delete op.ticket;
             op.ticket = transport_->Isend(op.sbuf, op.bytes, op.peer, op.tag,
                                           op.ctx);
             table_->Store(i, kIssued);
@@ -63,6 +66,7 @@ bool Proxy::Sweep() {
           case OpKind::kIrecv:
             ACX_DLOG("slot %zu: irecv %zuB <- peer %d tag %d", i, op.bytes,
                      op.peer, op.tag);
+            delete op.ticket;
             op.ticket = transport_->Irecv(op.rbuf, op.bytes, op.peer, op.tag,
                                           op.ctx);
             table_->Store(i, kIssued);

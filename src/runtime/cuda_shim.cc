@@ -1,0 +1,131 @@
+// tpu-acx: CUDA runtime compat shim over the host execution-queue runtime.
+//
+// Maps the cuda* names the reference's tests call (include/compat/
+// cuda_runtime.h) onto acx::Stream / acx::Graph / acx::GraphExec
+// (include/acx/runtime.h). "Device" memory is host memory on this path —
+// on-TPU buffers belong to the Python/JAX layer, and the host-plane tests
+// exchange host buffers exactly like reference test/src/ring.c does.
+
+#include <cstdlib>
+#include <cstring>
+
+#include "acx/runtime.h"
+#include "compat/cuda_runtime.h"
+
+namespace {
+
+inline acx::Stream* S(cudaStream_t s) {
+  return s == nullptr ? acx::Stream::Default()
+                      : reinterpret_cast<acx::Stream*>(s);
+}
+inline acx::Graph* G(cudaGraph_t g) { return reinterpret_cast<acx::Graph*>(g); }
+
+}  // namespace
+
+extern "C" {
+
+const char* cudaGetErrorName(cudaError_t err) {
+  return err == cudaSuccess ? "cudaSuccess" : "acxError";
+}
+
+int cudaGetDeviceCount(int* count) {
+  // One logical device per rank on the host plane (the proxy path). TPU
+  // chip enumeration is the Python layer's job.
+  *count = 1;
+  return cudaSuccess;
+}
+
+int cudaSetDevice(int) { return cudaSuccess; }
+
+int cudaStreamCreate(cudaStream_t* stream) {
+  *stream = reinterpret_cast<cudaStream_t>(new acx::Stream());
+  return cudaSuccess;
+}
+
+int cudaStreamDestroy(cudaStream_t stream) {
+  if (stream != nullptr) delete reinterpret_cast<acx::Stream*>(stream);
+  return cudaSuccess;
+}
+
+int cudaStreamSynchronize(cudaStream_t stream) {
+  S(stream)->Sync();
+  return cudaSuccess;
+}
+
+int cudaStreamBeginCapture(cudaStream_t stream, enum cudaStreamCaptureMode) {
+  S(stream)->BeginCapture();
+  return cudaSuccess;
+}
+
+int cudaStreamEndCapture(cudaStream_t stream, cudaGraph_t* graph) {
+  *graph = reinterpret_cast<cudaGraph_t>(S(stream)->EndCapture());
+  return cudaSuccess;
+}
+
+int cudaGraphCreate(cudaGraph_t* graph, unsigned int) {
+  *graph = reinterpret_cast<cudaGraph_t>(new acx::Graph());
+  return cudaSuccess;
+}
+
+int cudaGraphDestroy(cudaGraph_t graph) {
+  delete G(graph);
+  return cudaSuccess;
+}
+
+int cudaGraphAddChildGraphNode(cudaGraphNode_t* node, cudaGraph_t graph,
+                               const cudaGraphNode_t* deps, size_t ndeps,
+                               cudaGraph_t child) {
+  std::vector<acx::GraphNode*> d;
+  for (size_t i = 0; i < ndeps; i++)
+    d.push_back(reinterpret_cast<acx::GraphNode*>(deps[i]));
+  acx::GraphNode* tail = G(graph)->AddChildGraph(G(child), d);
+  if (node) *node = reinterpret_cast<cudaGraphNode_t>(tail);
+  return cudaSuccess;
+}
+
+int cudaGraphInstantiate(cudaGraphExec_t* exec, cudaGraph_t graph,
+                         cudaGraphNode_t* error_node, char* log,
+                         size_t log_size) {
+  if (error_node) *error_node = nullptr;
+  if (log && log_size) log[0] = '\0';
+  *exec = reinterpret_cast<cudaGraphExec_t>(new acx::GraphExec(G(graph)));
+  return cudaSuccess;
+}
+
+int cudaGraphLaunch(cudaGraphExec_t exec, cudaStream_t stream) {
+  reinterpret_cast<acx::GraphExec*>(exec)->Launch(S(stream));
+  return cudaSuccess;
+}
+
+int cudaGraphExecDestroy(cudaGraphExec_t exec) {
+  delete reinterpret_cast<acx::GraphExec*>(exec);
+  return cudaSuccess;
+}
+
+int cudaMemcpy(void* dst, const void* src, size_t count, enum cudaMemcpyKind) {
+  std::memcpy(dst, src, count);
+  return cudaSuccess;
+}
+
+int cudaMemcpyAsync(void* dst, const void* src, size_t count,
+                    enum cudaMemcpyKind, cudaStream_t stream) {
+  S(stream)->Enqueue([dst, src, count] { std::memcpy(dst, src, count); });
+  return cudaSuccess;
+}
+
+int cudaMalloc(void** ptr, size_t size) {
+  *ptr = std::malloc(size);
+  return *ptr != nullptr || size == 0 ? cudaSuccess : cudaErrorInvalidValue;
+}
+
+int cudaFree(void* ptr) {
+  std::free(ptr);
+  return cudaSuccess;
+}
+
+int cudaLaunchHostFunc(cudaStream_t stream, cudaHostFn_t fn, void* userData) {
+  S(stream)->Enqueue([fn, userData] { fn(userData); });
+  return cudaSuccess;
+}
+
+}  // extern "C"

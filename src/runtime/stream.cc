@@ -1,0 +1,170 @@
+// tpu-acx: stream/graph runtime implementation. See include/acx/runtime.h.
+
+#include "acx/runtime.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <unordered_map>
+
+namespace acx {
+
+// ---- Stream -------------------------------------------------------------
+
+Stream::Stream() {
+  worker_ = std::thread([this] { Run(); });
+}
+
+Stream::~Stream() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    exit_ = true;
+  }
+  cv_.notify_all();
+  if (worker_.joinable()) worker_.join();
+}
+
+void Stream::Run() {
+  std::unique_lock<std::mutex> lk(mu_);
+  for (;;) {
+    cv_.wait(lk, [&] { return exit_ || !q_.empty(); });
+    if (exit_ && q_.empty()) return;
+    auto fn = std::move(q_.front());
+    q_.pop_front();
+    busy_ = true;
+    lk.unlock();
+    fn();
+    lk.lock();
+    busy_ = false;
+    if (q_.empty()) done_cv_.notify_all();
+  }
+}
+
+void Stream::Enqueue(std::function<void()> fn) {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (capture_ != nullptr) {
+      // Record instead of execute: chain after the capture tail so replay
+      // preserves enqueue order.
+      std::vector<GraphNode*> deps;
+      if (capture_tail_ != nullptr)
+        deps.push_back(static_cast<GraphNode*>(capture_tail_));
+      capture_tail_ = capture_->AddNode(std::move(fn), deps);
+      return;
+    }
+    q_.push_back(std::move(fn));
+  }
+  cv_.notify_all();
+}
+
+void Stream::Sync() {
+  std::unique_lock<std::mutex> lk(mu_);
+  done_cv_.wait(lk, [&] { return q_.empty() && !busy_; });
+}
+
+void Stream::BeginCapture() {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (capture_ != nullptr) {
+    std::fprintf(stderr, "tpu-acx: nested stream capture not supported\n");
+    std::abort();
+  }
+  capture_ = new Graph();
+  capture_tail_ = nullptr;
+}
+
+Graph* Stream::EndCapture() {
+  std::lock_guard<std::mutex> lk(mu_);
+  Graph* g = capture_;
+  capture_ = nullptr;
+  capture_tail_ = nullptr;
+  return g;
+}
+
+Stream* Stream::Default() {
+  // Leaked intentionally: the default stream lives for the process, like
+  // CUDA's stream 0.
+  static Stream* s = new Stream();
+  return s;
+}
+
+// ---- Graph --------------------------------------------------------------
+
+Graph::Graph() : cleanup_(std::make_shared<CleanupSet>()) {}
+
+Graph::~Graph() = default;
+
+GraphNode* Graph::AddNode(std::function<void()> fn,
+                          const std::vector<GraphNode*>& deps) {
+  nodes_.push_back(std::make_unique<GraphNode>());
+  GraphNode* n = nodes_.back().get();
+  n->fn = std::move(fn);
+  n->deps = deps;
+  return n;
+}
+
+GraphNode* Graph::AddChildGraph(Graph* child,
+                                const std::vector<GraphNode*>& deps) {
+  // Copy the child's node closures in, remapping intra-child dependencies;
+  // child roots additionally depend on `deps`.
+  std::unordered_map<const GraphNode*, GraphNode*> remap;
+  GraphNode* tail = nullptr;
+  for (const auto& cn : child->nodes_) {
+    std::vector<GraphNode*> nd;
+    for (GraphNode* d : cn->deps) {
+      auto it = remap.find(d);
+      if (it != remap.end()) nd.push_back(it->second);
+    }
+    if (cn->deps.empty()) nd.insert(nd.end(), deps.begin(), deps.end());
+    GraphNode* nn = AddNode(cn->fn, nd);
+    remap[cn.get()] = nn;
+    tail = nn;
+  }
+  child_cleanups_.push_back(child->cleanup_);
+  return tail;
+}
+
+void Graph::AddCleanup(std::function<void()> hook) {
+  cleanup_->hooks.push_back(std::move(hook));
+}
+
+// ---- GraphExec ----------------------------------------------------------
+
+GraphExec::GraphExec(Graph* g) {
+  // Kahn topological sort, stable w.r.t. insertion order so capture replays
+  // in enqueue order.
+  const auto& nodes = g->nodes();
+  std::unordered_map<const GraphNode*, size_t> indeg;
+  for (const auto& n : nodes) indeg[n.get()] = n->deps.size();
+  std::vector<const GraphNode*> ready, order;
+  order.reserve(nodes.size());
+  for (const auto& n : nodes)
+    if (n->deps.empty()) ready.push_back(n.get());
+  size_t cursor = 0;
+  while (cursor < ready.size()) {
+    const GraphNode* n = ready[cursor++];
+    order.push_back(n);
+    for (const auto& m : nodes) {
+      if (std::find(m->deps.begin(), m->deps.end(), n) != m->deps.end()) {
+        if (--indeg[m.get()] == 0) ready.push_back(m.get());
+      }
+    }
+  }
+  if (order.size() != nodes.size()) {
+    std::fprintf(stderr, "tpu-acx: graph has a dependency cycle\n");
+    std::abort();
+  }
+  for (const GraphNode* n : order) seq_.push_back(n->fn);
+  cleanups_.push_back(g->cleanup());
+  for (auto& c : g->child_cleanups_) cleanups_.push_back(c);
+}
+
+void GraphExec::Launch(Stream* s) {
+  // Hold the cleanup sets for the duration of this launch so resources
+  // outlive in-flight work even if the exec is destroyed immediately after.
+  auto keep = cleanups_;
+  for (auto& fn : seq_) {
+    s->Enqueue([fn, keep] { fn(); });
+  }
+}
+
+}  // namespace acx

@@ -1,0 +1,162 @@
+// tpu-acx: MPI compat shim over SocketTransport.
+//
+// Implements the MPI slice in include/compat/mpi.h so programs written
+// against MPI-ACX (including the reference's own tests) run on the tpu-acx
+// data plane with no MPI library present. Matches the role reference
+// init.cpp:164-181 assumes of its MPI (THREAD_MULTIPLE, world comm).
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <memory>
+#include <sched.h>
+
+#include "acx/api_internal.h"
+#include "acx/net.h"
+#include "compat/mpi.h"
+
+namespace acx {
+
+ApiState& GS() {
+  static ApiState s;
+  return s;
+}
+
+void EnsureTransport() {
+  ApiState& g = GS();
+  if (g.transport == nullptr) g.transport = CreateTransportFromEnv();
+}
+
+size_t DatatypeSize(int datatype) {
+  switch (datatype) {
+    case MPI_CHAR:
+    case MPI_BYTE: return 1;
+    case MPI_INT:
+    case MPI_FLOAT: return 4;
+    case MPI_DOUBLE:
+    case MPI_INT64_T: return 8;
+    default:
+      std::fprintf(stderr, "tpu-acx: unknown datatype %d\n", datatype);
+      std::exit(13);
+  }
+}
+
+}  // namespace acx
+
+using acx::GS;
+
+extern "C" {
+
+int MPI_Init_thread(int*, char***, int required, int* provided) {
+  (void)required;
+  acx::EnsureTransport();
+  GS().mpi_inited = true;
+  if (provided) *provided = MPI_THREAD_MULTIPLE;
+  return MPI_SUCCESS;
+}
+
+int MPI_Init(int* argc, char*** argv) {
+  int provided;
+  return MPI_Init_thread(argc, argv, MPI_THREAD_SINGLE, &provided);
+}
+
+int MPI_Initialized(int* flag) {
+  *flag = GS().mpi_inited ? 1 : 0;
+  return MPI_SUCCESS;
+}
+
+int MPI_Finalize(void) {
+  acx::ApiState& g = GS();
+  if (g.transport != nullptr) {
+    g.transport->Barrier(0);
+    // The transport is deleted only if MPIX_Finalize already ran (it owns
+    // nothing else at this point); otherwise leave it for process exit.
+    if (!g.mpix_inited) {
+      delete g.transport;
+      g.transport = nullptr;
+    }
+  }
+  g.mpi_inited = false;
+  g.mpi_finalized = true;
+  return MPI_SUCCESS;
+}
+
+int MPI_Finalized(int* flag) {
+  *flag = GS().mpi_finalized ? 1 : 0;
+  return MPI_SUCCESS;
+}
+
+int MPI_Query_thread(int* provided) {
+  *provided = MPI_THREAD_MULTIPLE;
+  return MPI_SUCCESS;
+}
+
+int MPI_Abort(MPI_Comm, int errorcode) {
+  if (GS().transport != nullptr) GS().transport->Abort(errorcode);
+  std::exit(errorcode);
+}
+
+int MPI_Comm_rank(MPI_Comm, int* rank) {
+  acx::EnsureTransport();
+  *rank = GS().transport->rank();
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_size(MPI_Comm, int* size) {
+  acx::EnsureTransport();
+  *size = GS().transport->size();
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_size(MPI_Datatype datatype, int* size) {
+  *size = static_cast<int>(acx::DatatypeSize(datatype));
+  return MPI_SUCCESS;
+}
+
+int MPI_Barrier(MPI_Comm comm) {
+  acx::EnsureTransport();
+  GS().transport->Barrier(comm);
+  return MPI_SUCCESS;
+}
+
+int MPI_Allreduce(const void* sendbuf, void* recvbuf, int count,
+                  MPI_Datatype datatype, MPI_Op op, MPI_Comm comm) {
+  acx::EnsureTransport();
+  if (datatype != MPI_INT) {
+    std::fprintf(stderr, "tpu-acx MPI shim: Allreduce supports MPI_INT only\n");
+    return MPI_ERR_OTHER;
+  }
+  if (sendbuf != MPI_IN_PLACE)
+    std::memcpy(recvbuf, sendbuf, sizeof(int32_t) * count);
+  GS().transport->AllreduceInt(static_cast<int32_t*>(recvbuf), count, op,
+                               comm);
+  return MPI_SUCCESS;
+}
+
+int MPI_Send(const void* buf, int count, MPI_Datatype datatype, int dest,
+             int tag, MPI_Comm comm) {
+  acx::EnsureTransport();
+  std::unique_ptr<acx::Ticket> t(GS().transport->Isend(
+      buf, acx::DatatypeSize(datatype) * count, dest, tag, comm));
+  acx::Status st;
+  while (!t->Test(&st)) sched_yield();
+  return MPI_SUCCESS;
+}
+
+int MPI_Recv(void* buf, int count, MPI_Datatype datatype, int source, int tag,
+             MPI_Comm comm, MPI_Status* status) {
+  acx::EnsureTransport();
+  std::unique_ptr<acx::Ticket> t(GS().transport->Irecv(
+      buf, acx::DatatypeSize(datatype) * count, source, tag, comm));
+  acx::Status st;
+  while (!t->Test(&st)) sched_yield();
+  if (status != MPI_STATUS_IGNORE) {
+    status->MPI_SOURCE = st.source;
+    status->MPI_TAG = st.tag;
+    status->MPI_ERROR = st.error;
+    status->acx_bytes = st.bytes;
+  }
+  return MPI_SUCCESS;
+}
+
+}  // extern "C"
