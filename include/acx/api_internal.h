@@ -62,6 +62,17 @@ struct ApiState {
 
 ApiState& GS();
 
+// acx::Status -> compat MPI_Status (shared by the MPIX API and MPI shim).
+// Declared as a template so this header needn't include compat/mpi.h.
+template <typename MpiStatusT>
+void CopyStatus(const Status& s, MpiStatusT* st) {
+  if (st == nullptr) return;  // MPI_STATUS_IGNORE
+  st->MPI_SOURCE = s.source;
+  st->MPI_TAG = s.tag;
+  st->MPI_ERROR = s.error;
+  st->acx_bytes = s.bytes;
+}
+
 // Creates the transport from the environment if it does not exist yet
 // (called by both MPI_Init_thread and MPIX_Init, in either order).
 void EnsureTransport();

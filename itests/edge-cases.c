@@ -1,0 +1,74 @@
+/* tpu-acx integration test: edge cases beyond the reference's coverage.
+ *
+ * 1. MPIX_Wait on an inactive (never-started / already-waited) persistent
+ *    partitioned request returns immediately (MPI persistent semantics).
+ * 2. Ops enqueued BEFORE stream capture whose waits are recorded DURING
+ *    capture: the captured wait must observe-only, and relaunching the
+ *    graph must not consume the slot twice (r2 code-review regression).
+ */
+#include <stdio.h>
+#include <mpi.h>
+#include <mpi-acx.h>
+
+int main(int argc, char **argv) {
+    int provided, rank, size, errs = 0;
+
+    MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    if (MPIX_Init()) MPI_Abort(MPI_COMM_WORLD, 2);
+
+    const int right = (rank + 1) % size;
+    const int left = (rank + size - 1) % size;
+
+    /* 1: wait-on-inactive returns at once (would deadlock if broken). */
+    int pbuf[4];
+    MPIX_Request preq;
+    MPI_Status pst;
+    MPIX_Psend_init(pbuf, 4, 1, MPI_INT, right, 8, MPI_COMM_WORLD,
+                    MPI_INFO_NULL, &preq);
+    if (MPIX_Wait(&preq, &pst) != MPI_SUCCESS) errs++;   /* never started */
+    MPIX_Request_free(&preq);
+
+    /* 2: pre-capture enqueue + captured waitall, relaunched twice. */
+    int send_val = rank + 1, recv_val = -1;
+    MPIX_Request req[2];
+    cudaStream_t stream;
+    cudaStreamCreate(&stream);
+
+    MPIX_Isend_enqueue(&send_val, 1, MPI_INT, right, 9, MPI_COMM_WORLD,
+                       &req[0], MPIX_QUEUE_XLA_STREAM, &stream);
+    MPIX_Irecv_enqueue(&recv_val, 1, MPI_INT, left, 9, MPI_COMM_WORLD,
+                       &req[1], MPIX_QUEUE_XLA_STREAM, &stream);
+
+    cudaStreamBeginCapture(stream, cudaStreamCaptureModeGlobal);
+    MPIX_Waitall_enqueue(2, req, MPI_STATUSES_IGNORE, MPIX_QUEUE_XLA_STREAM,
+                         &stream);
+    cudaGraph_t graph;
+    cudaStreamEndCapture(stream, &graph);
+    cudaGraphExec_t exec;
+    cudaGraphInstantiate(&exec, graph, NULL, NULL, 0);
+
+    /* First launch completes the pre-capture ops... */
+    cudaGraphLaunch(exec, stream);
+    cudaStreamSynchronize(stream);
+    if (recv_val != left + 1) {
+        printf("[%d] capture-wait: got %d want %d\n", rank, recv_val,
+               left + 1);
+        errs++;
+    }
+    /* ...second launch re-runs the observe-only waits: must return
+     * instantly (slot still COMPLETED), not hang or consume a fresh slot. */
+    cudaGraphLaunch(exec, stream);
+    cudaStreamSynchronize(stream);
+
+    cudaGraphExecDestroy(exec);
+    cudaGraphDestroy(graph);
+    cudaStreamDestroy(stream);
+
+    MPI_Allreduce(MPI_IN_PLACE, &errs, 1, MPI_INT, MPI_MAX, MPI_COMM_WORLD);
+    MPIX_Finalize();
+    MPI_Finalize();
+    if (rank == 0 && errs == 0) printf("edge-cases: OK\n");
+    return errs != 0;
+}

@@ -1,0 +1,22 @@
+"""mpi_acx_tpu — a TPU-native accelerator-triggered communication framework.
+
+A ground-up rebuild of the capabilities of NVIDIA/mpi-acx (stream/graph-
+triggered MPI point-to-point and kernel-triggered partitioned communication;
+reference README.md:1-7) designed for TPU:
+
+* The **host plane** is the native C++ runtime in ``src/``: an atomic
+  flag-slot table, a progress (proxy) thread, a socket data plane, a host
+  execution-queue runtime, and the 17-function ``MPIX_*`` C API — reachable
+  from Python through :mod:`mpi_acx_tpu.runtime` (ctypes).
+* The **ICI plane** is pure JAX/XLA: collectives over a
+  ``jax.sharding.Mesh`` (:mod:`mpi_acx_tpu.parallel`), partitioned
+  (pipelined, per-partition-ready) exchanges, ring attention for sequence
+  parallelism, and a collective-permute microbatch pipeline — the idiomatic
+  TPU forms of the reference's enqueued and partitioned primitives
+  (SURVEY.md §7.1 mapping table).
+* :mod:`mpi_acx_tpu.models` provides transformer model families wired for
+  dp/tp/pp/sp/ep execution on top of those primitives.
+"""
+
+from mpi_acx_tpu import parallel  # noqa: F401
+from mpi_acx_tpu.version import __version__  # noqa: F401

@@ -1,0 +1,33 @@
+"""TPU data plane: mesh-based collectives, partitioned exchange, ring
+attention, and the microbatch pipeline.
+
+This package is the ICI half of the framework (the native C++ runtime in
+``src/`` is the host half): the reference's CUDA/MPI primitives re-expressed
+as JAX/XLA collectives over a ``jax.sharding.Mesh``, per the SURVEY.md §7.1
+mapping table. Everything here is jit-compatible, static-shaped, and runs
+identically on a real TPU slice and on a virtual CPU mesh.
+"""
+
+from mpi_acx_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    mesh_from_devices,
+)
+from mpi_acx_tpu.parallel.collective import (  # noqa: F401
+    ring_shift,
+    neighbor_exchange,
+    halo_exchange_1d,
+    halo_exchange_2d,
+    all_to_all_seq,
+)
+from mpi_acx_tpu.parallel.partitioned import (  # noqa: F401
+    partitioned_ring_exchange,
+    partitioned_pipeline,
+)
+from mpi_acx_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    blockwise_attention_reference,
+)
+from mpi_acx_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_forward,
+    pipeline_loss,
+)

@@ -1,0 +1,44 @@
+"""Mesh construction helpers.
+
+The reference's process topology is `mpiexec -np N` ranks (SURVEY.md §4);
+the TPU-native topology is a named device mesh over which pjit/shard_map
+place collectives on ICI. These helpers build the standard meshes the rest
+of the package expects.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def mesh_from_devices(axis_sizes: Mapping[str, int],
+                      devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Builds a Mesh with the given axis names/sizes from available devices.
+
+    Axis order follows dict order; the product must equal the device count
+    used. Example: ``mesh_from_devices({"dp": 2, "tp": 4})`` on 8 devices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    names = tuple(axis_sizes.keys())
+    sizes = tuple(axis_sizes.values())
+    n = int(np.prod(sizes))
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices for mesh {dict(axis_sizes)}, "
+                         f"have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    """Default mesh for n devices: a 1D "x" axis (ring).
+
+    The ring is the canonical topology for the reference's tests (every
+    test/src program is a ring exchange) and maps directly onto an ICI ring.
+    """
+    devices = jax.devices() if n_devices is None else jax.devices()[:n_devices]
+    return Mesh(np.asarray(devices), ("x",))

@@ -1,0 +1,101 @@
+"""Pipeline parallelism: microbatch exchange over a 'pp' mesh axis.
+
+The reference positions partitioned P2P as the substrate for
+pipeline-parallel microbatch exchange (SURVEY.md §2 "Parallelism
+strategies"; BASELINE.json configs[3,4]). This module is that application,
+TPU-native: a GPipe-style schedule where each pipeline stage is one slice
+of the mesh's 'pp' axis, activations travel stage->stage+1 by
+collective-permute on ICI, and the whole schedule is a single
+``lax.scan`` inside ``shard_map`` — one compiled program, no host in the
+loop. Autodiff through the scan gives the backward pipeline (reverse
+permutes) for free.
+
+Schedule: T = n_micro + n_stages - 1 ticks; stage s computes microbatch m
+at tick t = s + m (the classic GPipe timetable; bubbles are masked
+compute).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_forward(
+    stage_fn: Callable,
+    stage_params,
+    xs: jax.Array,
+    axis_name: str,
+) -> jax.Array:
+    """Runs xs ([n_micro, micro_batch, ...], replicated) through the
+    pipeline; returns the last stage's outputs [n_micro, micro_batch, ...]
+    (replicated via psum).
+
+    Per-shard function: call inside shard_map with `stage_params` sharded
+    P(axis_name) on a stacked leading stage axis (shard_map hands each
+    device its own stage's slice, leading dim 1 — squeezed here).
+
+    stage_fn(params, x) -> y with y.shape == x.shape (inter-stage
+    activations must be shape-stable so the wire format is fixed).
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = xs.shape[0]
+    ticks = n_micro + n_stages - 1
+
+    params = jax.tree.map(lambda p: p[0], stage_params)  # drop stage axis
+
+    # stage s -> s+1 (no wraparound: stage 0 receives zeros = bubble).
+    fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def tick(carry, t):
+        from_left = carry
+        m = jnp.clip(t, 0, n_micro - 1)
+        first_in = lax.dynamic_index_in_dim(xs, m, 0, keepdims=False)
+        x = jnp.where(stage == 0, first_in, from_left)
+        y = stage_fn(params, x)
+        send = lax.ppermute(y, axis_name, perm=fwd_perm)
+        return send, y
+
+    # Carry is device-varying (each stage holds a different activation).
+    init = lax.pcast(jnp.zeros_like(xs[0]), axis_name, to="varying")
+    _, ys = lax.scan(tick, init, jnp.arange(ticks))
+
+    # The last stage's valid outputs live at ticks [n_stages-1, ticks).
+    tail = lax.dynamic_slice_in_dim(ys, n_stages - 1, n_micro, 0)
+    contrib = jnp.where(stage == n_stages - 1, tail, jnp.zeros_like(tail))
+    return lax.psum(contrib, axis_name)
+
+
+def pipeline_loss(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params,
+    xs: jax.Array,
+    targets: jax.Array,
+    axis_name: str,
+) -> jax.Array:
+    """Mean loss over microbatches through the pipeline (differentiable;
+    jax.grad of this per-shard function yields the 1F1B-equivalent backward
+    schedule as the scan's transpose)."""
+    ys = pipeline_forward(stage_fn, stage_params, xs, axis_name)
+    return loss_fn(ys, targets)
+
+
+def run_pipeline(mesh, stage_fn, stacked_params, xs, axis_name: str = "pp"):
+    """Array-level convenience: stacked_params' leading axis = stage."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    f = shard_map(
+        functools.partial(pipeline_forward, stage_fn, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return f(stacked_params, xs)

@@ -1,0 +1,123 @@
+"""Ring attention: exact attention over a sequence sharded across devices.
+
+The long-context capability of the framework (first-class per the build
+goals): each device holds a sequence block of Q, K, V; K/V blocks rotate
+around the ring (collective-permute over ICI) while each device
+accumulates its Q-block's attention over every K/V block using the
+numerically stable running-max/log-sum-exp merge (flash-attention style).
+After `n` steps every Q block has attended to the full sequence, with peak
+memory O(seq/n) and the K/V transfer of step k overlapping the attention
+compute of step k-1 — the same produce/transmit overlap the reference's
+partitioned primitive provides on the host plane (SURVEY.md §5.7 maps
+partitioned comm to exactly this pipelined exchange).
+
+Causal masking uses static block indices (device index is static under
+shard_map with a full ring permutation), so XLA sees static control flow.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _ring_perm(n: int, shift: int = 1):
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def _block_attend(q, k, v, mask):
+    """One Q-block x K-block attention: returns (unnorm_out, row_max,
+    row_sumexp) for LSE merging. Shapes: q [Sq, H, D], k/v [Sk, H, D]."""
+    d = q.shape[-1]
+    # [H, Sq, Sk]
+    logits = jnp.einsum("qhd,khd->hqk", q, k) / jnp.sqrt(d).astype(q.dtype)
+    logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    m = jnp.max(logits, axis=-1)                      # [H, Sq]
+    p = jnp.exp(logits - m[..., None])
+    p = jnp.where(mask, p, 0.0)                       # kill fully-masked rows
+    l = jnp.sum(p, axis=-1)                           # [H, Sq]
+    o = jnp.einsum("hqk,khd->qhd", p, v)              # unnormalized
+    return o, m, l
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
+                   causal: bool = True) -> jax.Array:
+    """Exact (optionally causal) attention with K/V rotating on the ring.
+
+    Per-shard shapes: q, k, v = [seq_shard, heads, head_dim]; the global
+    sequence is the concatenation of shards in mesh order. Returns the
+    attention output for the local Q block, [seq_shard, heads, head_dim].
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    sq = q.shape[0]
+    h = q.shape[1]
+
+    neg = jnp.finfo(jnp.float32).min
+    # Accumulators are device-varying from step 0 (they mix in rotated K/V);
+    # mark them so the scan carry type is stable under shard_map's vma check.
+    o0 = lax.pcast(jnp.zeros(q.shape, jnp.float32), axis_name, to="varying")
+    m0 = lax.pcast(jnp.full((h, sq), neg, jnp.float32), axis_name,
+                   to="varying")
+    l0 = lax.pcast(jnp.zeros((h, sq), jnp.float32), axis_name, to="varying")
+
+    q32 = q.astype(jnp.float32)
+
+    def step(carry, t):
+        o_acc, m_acc, l_acc, kk, vv = carry
+        # K/V block currently held arrived from `t` ring steps back.
+        src = (my - t) % n
+        if causal:
+            qpos = my * sq + jnp.arange(sq)[:, None]          # [Sq, 1]
+            kpos = src * sq + jnp.arange(kk.shape[0])[None, :]  # [1, Sk]
+            mask = (kpos <= qpos)[None]                        # [1, Sq, Sk]
+        else:
+            mask = jnp.ones((1, sq, kk.shape[0]), bool)
+        o, m, l = _block_attend(q32, kk.astype(jnp.float32),
+                                vv.astype(jnp.float32), mask)
+        # LSE merge of (o_acc, m_acc, l_acc) with the new block.
+        m_new = jnp.maximum(m_acc, m)
+        a = jnp.exp(m_acc - m_new)      # rescale old accumulator
+        b = jnp.exp(m - m_new)          # rescale new block
+        l_new = l_acc * a + l * b
+        o_new = (o_acc * a.transpose(1, 0)[:, :, None]
+                 + o * b.transpose(1, 0)[:, :, None])
+        # Rotate K/V to the right neighbor for the next step; XLA overlaps
+        # this transfer with the next iteration's compute.
+        kk = lax.ppermute(kk, axis_name, perm=_ring_perm(n, 1))
+        vv = lax.ppermute(vv, axis_name, perm=_ring_perm(n, 1))
+        return (o_new, m_new, l_new, kk, vv), None
+
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+    # Normalize; fully-masked rows (none in causal self-attention) guard.
+    denom = jnp.maximum(l, 1e-20).transpose(1, 0)[:, :, None]
+    return (o / denom).astype(q.dtype)
+
+
+def blockwise_attention_reference(q, k, v, causal=True):
+    """Single-device reference attention (for tests): [S, H, D] inputs."""
+    d = q.shape[-1]
+    logits = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / jnp.sqrt(d)
+    if causal:
+        s = q.shape[0]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask[None], logits, jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, axis_name: str = "x",
+                           causal: bool = True):
+    """Array-level wrapper: q/k/v sharded on the sequence (leading) axis."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(axis_name)
+    f = shard_map(
+        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return f(q, k, v)

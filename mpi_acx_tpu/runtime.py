@@ -1,0 +1,212 @@
+"""ctypes bindings to the native tpu-acx runtime (build/libtpuacx.so).
+
+Python face of the host plane: MPIX_Init/Finalize, enqueued sends/recvs on
+the host execution queue, host waits, partitioned channels, and proxy
+statistics. The C surface is the same 17-function API the C tests use
+(include/mpi-acx.h; parity with reference include/mpi-acx.h:48-104), so
+behavior is identical across languages.
+
+Multi-process usage mirrors the C side: run under ``build/acxrun -np N
+python my_script.py`` and the transport picks up ACX_RANK/ACX_SIZE/ACX_FDS.
+Single-process usage gets the loopback transport (rank 0 of 1).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB_PATH = os.path.join(_REPO_ROOT, "build", "libtpuacx.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build_lib() -> None:
+    subprocess.run(["make", "-C", _REPO_ROOT, "lib", "tools"], check=True,
+                   capture_output=True)
+
+
+def lib() -> ctypes.CDLL:
+    """Load (building if necessary) the native runtime library."""
+    global _lib
+    if _lib is None:
+        if not os.path.exists(_LIB_PATH):
+            _build_lib()
+        _lib = ctypes.CDLL(_LIB_PATH)
+        _lib.MPIX_Init.restype = ctypes.c_int
+        _lib.MPIX_Finalize.restype = ctypes.c_int
+        _lib.acx_proxy_stats.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+    return _lib
+
+
+def acxrun_path() -> str:
+    p = os.path.join(_REPO_ROOT, "build", "acxrun")
+    if not os.path.exists(p):
+        _build_lib()
+    return p
+
+
+class Status(ctypes.Structure):
+    """Mirror of the compat MPI_Status (include/compat/mpi.h)."""
+
+    _fields_ = [
+        ("MPI_SOURCE", ctypes.c_int),
+        ("MPI_TAG", ctypes.c_int),
+        ("MPI_ERROR", ctypes.c_int),
+        ("acx_bytes", ctypes.c_size_t),
+    ]
+
+
+_DTYPE_TO_MPI = {
+    np.dtype(np.int8): 1,     # MPI_CHAR
+    np.dtype(np.uint8): 2,    # MPI_BYTE
+    np.dtype(np.int32): 3,    # MPI_INT
+    np.dtype(np.float32): 4,  # MPI_FLOAT
+    np.dtype(np.float64): 5,  # MPI_DOUBLE
+    np.dtype(np.int64): 6,    # MPI_INT64_T
+}
+
+QUEUE_STREAM = 0
+QUEUE_GRAPH = 1
+
+
+class Runtime:
+    """One process's handle on the native runtime.
+
+    Wraps MPI_Init_thread + MPIX_Init and exposes enqueued/partitioned
+    operations on numpy buffers. Buffers must stay alive until their op
+    completes (same rule as the C API).
+    """
+
+    def __init__(self) -> None:
+        L = lib()
+        provided = ctypes.c_int(0)
+        L.MPI_Init_thread(None, None, 3, ctypes.byref(provided))
+        if L.MPIX_Init() != 0:
+            raise RuntimeError("MPIX_Init failed")
+        self._lib = L
+        rank = ctypes.c_int(0)
+        size = ctypes.c_int(0)
+        L.MPI_Comm_rank(0, ctypes.byref(rank))
+        L.MPI_Comm_size(0, ctypes.byref(size))
+        self.rank = rank.value
+        self.size = size.value
+        self._open = True
+
+    # -- enqueued ops (default queue) --------------------------------------
+
+    def isend_enqueue(self, buf: np.ndarray, dest: int, tag: int = 0):
+        """MPIX_Isend_enqueue on the default host queue; returns a request."""
+        req = ctypes.c_void_p(None)
+        stream = ctypes.c_void_p(None)  # NULL handle = default queue
+        mpitype = _DTYPE_TO_MPI[buf.dtype]
+        rc = self._lib.MPIX_Isend_enqueue(
+            buf.ctypes.data_as(ctypes.c_void_p), buf.size, mpitype, dest, tag,
+            0, ctypes.byref(req), QUEUE_STREAM, ctypes.byref(stream))
+        if rc != 0:
+            raise RuntimeError("MPIX_Isend_enqueue failed")
+        return req
+
+    def irecv_enqueue(self, buf: np.ndarray, source: int, tag: int = 0):
+        req = ctypes.c_void_p(None)
+        stream = ctypes.c_void_p(None)
+        mpitype = _DTYPE_TO_MPI[buf.dtype]
+        rc = self._lib.MPIX_Irecv_enqueue(
+            buf.ctypes.data_as(ctypes.c_void_p), buf.size, mpitype, source,
+            tag, 0, ctypes.byref(req), QUEUE_STREAM, ctypes.byref(stream))
+        if rc != 0:
+            raise RuntimeError("MPIX_Irecv_enqueue failed")
+        return req
+
+    def wait(self, req) -> Status:
+        st = Status()
+        rc = self._lib.MPIX_Wait(ctypes.byref(req), ctypes.byref(st))
+        if rc != 0:
+            raise RuntimeError("MPIX_Wait failed")
+        return st
+
+    def stream_sync(self) -> None:
+        self._lib.cudaStreamSynchronize(None)
+
+    # -- partitioned ops ----------------------------------------------------
+
+    def psend_init(self, buf: np.ndarray, partitions: int, dest: int,
+                   tag: int = 0):
+        assert buf.size % partitions == 0
+        req = ctypes.c_void_p(None)
+        mpitype = _DTYPE_TO_MPI[buf.dtype]
+        rc = self._lib.MPIX_Psend_init(
+            buf.ctypes.data_as(ctypes.c_void_p), partitions,
+            ctypes.c_longlong(buf.size // partitions), mpitype, dest, tag, 0,
+            0, ctypes.byref(req))
+        if rc != 0:
+            raise RuntimeError("MPIX_Psend_init failed")
+        return req
+
+    def precv_init(self, buf: np.ndarray, partitions: int, source: int,
+                   tag: int = 0):
+        assert buf.size % partitions == 0
+        req = ctypes.c_void_p(None)
+        mpitype = _DTYPE_TO_MPI[buf.dtype]
+        rc = self._lib.MPIX_Precv_init(
+            buf.ctypes.data_as(ctypes.c_void_p), partitions,
+            ctypes.c_longlong(buf.size // partitions), mpitype, source, tag,
+            0, 0, ctypes.byref(req))
+        if rc != 0:
+            raise RuntimeError("MPIX_Precv_init failed")
+        return req
+
+    def start(self, req) -> None:
+        if self._lib.MPIX_Start(ctypes.byref(req)) != 0:
+            raise RuntimeError("MPIX_Start failed")
+
+    def pready(self, partition: int, req) -> None:
+        if self._lib.MPIX_Pready(partition, ctypes.byref(req)) != 0:
+            raise RuntimeError("MPIX_Pready failed")
+
+    def parrived(self, req, partition: int) -> bool:
+        flag = ctypes.c_int(0)
+        if self._lib.MPIX_Parrived(ctypes.byref(req), partition,
+                                   ctypes.byref(flag)) != 0:
+            raise RuntimeError("MPIX_Parrived failed")
+        return bool(flag.value)
+
+    def wait_partitioned(self, req) -> Status:
+        return self.wait(req)
+
+    def request_free(self, req) -> None:
+        if self._lib.MPIX_Request_free(ctypes.byref(req)) != 0:
+            raise RuntimeError("MPIX_Request_free failed")
+
+    # -- collectives / lifecycle -------------------------------------------
+
+    def barrier(self) -> None:
+        self._lib.MPI_Barrier(0)
+
+    def allreduce_max(self, value: int) -> int:
+        buf = np.array([value], dtype=np.int32)
+        inplace = ctypes.c_void_p(-1 & (2**64 - 1))  # MPI_IN_PLACE
+        self._lib.MPI_Allreduce(inplace, buf.ctypes.data_as(ctypes.c_void_p),
+                                1, 3, 0, 0)
+        return int(buf[0])
+
+    def proxy_stats(self) -> dict:
+        out = (ctypes.c_uint64 * 4)()
+        self._lib.acx_proxy_stats(out)
+        return {
+            "sweeps": out[0],
+            "ops_issued": out[1],
+            "ops_completed": out[2],
+            "slots_reclaimed": out[3],
+        }
+
+    def finalize(self) -> None:
+        if self._open:
+            self._lib.MPIX_Finalize()
+            self._lib.MPI_Finalize()
+            self._open = False

@@ -1,0 +1,40 @@
+// tpu-acx: small C exports beyond the MPIX surface, for the Python ctypes
+// bindings (mpi_acx_tpu/runtime.py) — observability the reference lacks
+// (SURVEY.md §5.5).
+
+#include <cstdint>
+
+#include "acx/api_internal.h"
+
+extern "C" {
+
+// Fills out[4] = {sweeps, ops_issued, ops_completed, slots_reclaimed}.
+void acx_proxy_stats(uint64_t* out) {
+  acx::ApiState& g = acx::GS();
+  if (g.proxy == nullptr) {
+    out[0] = out[1] = out[2] = out[3] = 0;
+    return;
+  }
+  acx::Proxy::Stats s = g.proxy->stats();
+  out[0] = s.sweeps;
+  out[1] = s.ops_issued;
+  out[2] = s.ops_completed;
+  out[3] = s.slots_reclaimed;
+}
+
+int acx_rank(void) {
+  acx::EnsureTransport();
+  return acx::GS().transport->rank();
+}
+
+int acx_size(void) {
+  acx::EnsureTransport();
+  return acx::GS().transport->size();
+}
+
+uint64_t acx_nflags(void) {
+  acx::ApiState& g = acx::GS();
+  return g.table == nullptr ? 0 : g.table->size();
+}
+
+}  // extern "C"

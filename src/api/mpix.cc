@@ -39,14 +39,6 @@ void SpinUntil(FlagTable* t, int idx, int32_t want) {
   while (t->Load(idx) != want) sched_yield();
 }
 
-void CopyStatus(const Status& s, MPI_Status* st) {
-  if (st == MPI_STATUS_IGNORE) return;
-  st->MPI_SOURCE = s.source;
-  st->MPI_TAG = s.tag;
-  st->MPI_ERROR = s.error;
-  st->acx_bytes = s.bytes;
-}
-
 Stream* StreamFromQueue(void* queue) {
   // queue is a cudaStream_t* (reference sendrecv.cu dereferences the same
   // way); NULL handle = default stream.
@@ -81,18 +73,22 @@ Resolved ResolveHandle(void* r) {
 // Register the graph-lifetime reclaim hook for a graph-owned op: when the
 // last of {graph, execs} dies, push the slot to CLEANUP (spinning out any
 // in-flight transfer first) and let the proxy free ticket + request.
+// The hook re-reads the global state at run time: if MPIX_Finalize already
+// tore the table down (graphs may legally outlive finalize), there is
+// nothing left to reclaim and the hook is a no-op.
 void ArmGraphCleanup(Graph* g, int idx) {
-  FlagTable* table = GS().table;
-  Proxy* proxy = GS().proxy;
-  g->AddCleanup([table, proxy, idx] {
-    int32_t f = table->Load(idx);
-    while (f == kPending || f == kIssued) {
+  FlagTable* expect_table = GS().table;
+  g->AddCleanup([expect_table, idx] {
+    ApiState& g2 = GS();
+    if (g2.table == nullptr || g2.table != expect_table) return;
+    int32_t f = g2.table->Load(idx);
+    while ((f == kPending || f == kIssued) && g2.proxy != nullptr) {
       sched_yield();
-      f = table->Load(idx);
+      f = g2.table->Load(idx);
     }
     // RESERVED (never launched) or COMPLETED: either way, reclaim.
-    table->Store(idx, kCleanup);
-    proxy->Kick();
+    g2.table->Store(idx, kCleanup);
+    if (g2.proxy != nullptr) g2.proxy->Kick();
   });
 }
 
@@ -199,7 +195,14 @@ int EnqueueWait(MPIX_Request* reqp, MPI_Status* status, int qtype,
       *reqp = MPIX_REQUEST_NULL;
       return MPI_SUCCESS;
     }
-    s->Enqueue(MakeWaiter(idx, status, graph_owned));
+    // A wait recorded while capturing becomes a graph node and must only
+    // OBSERVE completion (a cleanup-consuming node would free the slot on
+    // the first launch and hang every relaunch). If the op itself was
+    // enqueued pre-capture, the capture graph also takes over reclaim.
+    const bool wait_in_graph = graph_owned || s->capturing();
+    if (s->capturing() && !graph_owned)
+      ArmGraphCleanup(s->capture_graph(), idx);
+    s->Enqueue(MakeWaiter(idx, status, wait_in_graph));
   } else if (qtype == MPIX_QUEUE_CUDA_GRAPH) {
     // Graph wait observes COMPLETED — deliberately NOT the reference's
     // buggy PENDING wait (sendrecv.cu:411).
@@ -237,6 +240,12 @@ int HostWaitBasic(MpixRequest* req, MPI_Status* status) {
 // sendrecv.cu:607-632).
 int HostWaitPartitioned(MpixRequest* req, MPI_Status* status) {
   ApiState& g = GS();
+  if (!req->started) {
+    // Wait on an inactive persistent request returns immediately with an
+    // empty status (MPI persistent-request semantics).
+    CopyStatus(Status{}, status);
+    return MPI_SUCCESS;
+  }
   for (int p = 0; p < req->partitions; p++) {
     SpinUntil(g.table, req->part_idx[p], kCompleted);
     g.table->Store(req->part_idx[p], kReserved);
@@ -462,9 +471,11 @@ int MPIX_Wait(MPIX_Request* req, MPI_Status* status) {
   if (!g.mpix_inited || req == nullptr) return kErr;
   auto* r = static_cast<MpixRequest*>(*req);
   if (r == nullptr) return kErr;
-  int rc = r->kind == ReqKind::kBasic ? HostWaitBasic(r, status)
-                                      : HostWaitPartitioned(r, status);
-  if (rc == MPI_SUCCESS && r->kind == ReqKind::kBasic)
+  // Cache the kind: HostWaitBasic hands the request to the proxy for
+  // freeing, so r must not be dereferenced after it returns.
+  const bool basic = r->kind == ReqKind::kBasic;
+  int rc = basic ? HostWaitBasic(r, status) : HostWaitPartitioned(r, status);
+  if (rc == MPI_SUCCESS && basic)
     *req = MPIX_REQUEST_NULL;  // partitioned requests persist across rounds
   return rc;
 }

@@ -150,12 +150,7 @@ int MPI_Recv(void* buf, int count, MPI_Datatype datatype, int source, int tag,
       buf, acx::DatatypeSize(datatype) * count, source, tag, comm));
   acx::Status st;
   while (!t->Test(&st)) sched_yield();
-  if (status != MPI_STATUS_IGNORE) {
-    status->MPI_SOURCE = st.source;
-    status->MPI_TAG = st.tag;
-    status->MPI_ERROR = st.error;
-    status->acx_bytes = st.bytes;
-  }
+  acx::CopyStatus(st, status);
   return MPI_SUCCESS;
 }
 

@@ -1,0 +1,20 @@
+"""Test configuration: virtual 8-device CPU mesh.
+
+Multi-chip behavior (sharding, collectives, pipeline) is validated on a
+virtual CPU mesh (XLA host devices); the same code paths run unmodified on
+a real TPU slice. The environment pins JAX_PLATFORMS=axon for the real
+chip, so we must force cpu via jax.config (which wins over env)."""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)

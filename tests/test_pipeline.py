@@ -1,0 +1,101 @@
+"""GPipe-style pipeline over the 'pp' axis: forward parity with the
+sequential stack, and gradient flow through the schedule."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from mpi_acx_tpu.parallel import make_mesh
+from mpi_acx_tpu.parallel.pipeline import pipeline_forward, pipeline_loss
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import numpy as onp
+    devs = jax.devices()[:4]
+    from jax.sharding import Mesh
+    return Mesh(onp.asarray(devs), ("pp",))
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _stack_params(key, n_stages, d):
+    ks = jax.random.split(key, n_stages)
+    w = jnp.stack([jax.random.normal(k, (d, d)) * 0.3 for k in ks])
+    b = jnp.zeros((n_stages, d))
+    return {"w": w, "b": b}
+
+
+def test_pipeline_matches_sequential(mesh):
+    d, n_micro, mb = 8, 6, 3
+    params = _stack_params(jax.random.key(0), 4, d)
+    xs = jax.random.normal(jax.random.key(1), (n_micro, mb, d))
+
+    f = shard_map(
+        functools.partial(pipeline_forward, _stage_fn, axis_name="pp"),
+        mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+        check_vma=False)
+    got = np.asarray(f(params, xs))
+
+    want = np.asarray(xs)
+    for s in range(4):
+        p = {"w": params["w"][s], "b": params["b"][s]}
+        want = np.asarray(_stage_fn(p, want))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_gradients_flow_to_all_stages(mesh):
+    d, n_micro, mb = 4, 4, 2
+    params = _stack_params(jax.random.key(2), 4, d)
+    xs = jax.random.normal(jax.random.key(3), (n_micro, mb, d))
+    tgt = jax.random.normal(jax.random.key(4), (n_micro, mb, d))
+
+    def loss(params):
+        f = shard_map(
+            functools.partial(
+                pipeline_loss, _stage_fn,
+                lambda y, t: jnp.mean((y - t) ** 2), axis_name="pp"),
+            mesh=mesh, in_specs=(P("pp"), P(), P()), out_specs=P(),
+            check_vma=False)
+        return f(params, xs, tgt)
+
+    g = jax.grad(loss)(params)
+    # Every stage's weights must receive nonzero gradient (backward
+    # pipeline reached them all through the ppermute transposes).
+    gw = np.asarray(g["w"])
+    for s in range(4):
+        assert np.abs(gw[s]).max() > 1e-8, f"stage {s} got no gradient"
+
+
+def test_pipeline_jit_and_loss_decreases(mesh):
+    d, n_micro, mb = 4, 4, 2
+    params = _stack_params(jax.random.key(5), 4, d)
+    xs = jax.random.normal(jax.random.key(6), (n_micro, mb, d))
+    tgt = jnp.zeros((n_micro, mb, d))
+
+    @jax.jit
+    def step(params):
+        def loss(p):
+            f = shard_map(
+                functools.partial(
+                    pipeline_loss, _stage_fn,
+                    lambda y, t: jnp.mean((y - t) ** 2), axis_name="pp"),
+                mesh=mesh, in_specs=(P("pp"), P(), P()), out_specs=P(),
+                check_vma=False)
+            return f(p, xs, tgt)
+
+        l, g = jax.value_and_grad(loss)(params)
+        new = jax.tree.map(lambda p, gg: p - 0.5 * gg, params, g)
+        return l, new
+
+    l0, params = step(params)
+    for _ in range(5):
+        l1, params = step(params)
+    assert float(l1) < float(l0)
