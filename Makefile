@@ -9,7 +9,7 @@
 #   ACX_DEBUG=1      compile in debug logging (reference: -DDEBUG)
 
 CXX      ?= g++
-CXXFLAGS ?= -O2 -g -Wall -Wextra -std=c++17 -fPIC -pthread
+CXXFLAGS ?= -O2 -g -Wall -Wextra -std=c++17 -fPIC -pthread -MMD -MP
 INCLUDES  = -Iinclude -Iinclude/compat
 LDFLAGS   = -pthread
 
@@ -53,9 +53,9 @@ TOOL_BINS := $(TOOL_SRCS:tools/%.cc=$(BUILD)/%)
 
 tools: $(TOOL_BINS)
 
-$(BUILD)/%: tools/%.cc
+$(BUILD)/%: tools/%.cc $(STATICLIB)
 	@mkdir -p $(BUILD)
-	$(CXX) $(CXXFLAGS) $(INCLUDES) $< -o $@ $(LDFLAGS)
+	$(CXX) $(CXXFLAGS) $(INCLUDES) $< $(STATICLIB) -o $@ $(LDFLAGS)
 
 # --- unit tests (single process, fake transport) ---
 CTEST_SRCS := $(wildcard ctests/*.cc)
@@ -107,6 +107,9 @@ check: ctest itest tools
 	@for t in $(CTEST_BINS); do echo "== $$t"; $$t || exit 1; done
 	@for t in $(ITEST_BINS); do echo "== acxrun -np 2 $$t"; $(BUILD)/acxrun -np 2 $$t || exit 1; done
 	@echo "ALL NATIVE TESTS PASSED"
+
+# Header dependency tracking (-MMD): a header edit rebuilds its users.
+-include $(LIB_OBJS:.o=.d)
 
 clean:
 	rm -rf $(BUILD)

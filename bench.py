@@ -1,0 +1,88 @@
+"""tpu-acx benchmark — prints ONE JSON line for the driver.
+
+Primary metric: enqueued Isend/Irecv ping-pong p50 latency (µs) through the
+full native stack (host execution queue -> flag table -> proxy -> socket
+wire), 2 processes under acxrun — BASELINE.md metric #2. Also reports
+partitioned-exchange bandwidth (host plane) and, when a TPU chip is
+present, flagship-model forward throughput on the MXU.
+
+The reference (NVIDIA/mpi-acx) publishes no numbers (SURVEY.md §6);
+BASELINE.md records our own round-2 measurements as the baseline, so
+vs_baseline tracks regression/improvement across rounds.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+# Round-2 baseline measurements (this machine, recorded in BASELINE.md).
+BASELINE_P50_US = 26.6
+BASELINE_PART_BW_GBPS = 1.12
+
+
+def native_bench():
+    subprocess.run(["make", "-C", REPO, "lib", "tools"], check=True,
+                   capture_output=True)
+    r = subprocess.run(
+        [os.path.join(REPO, "build", "acxrun"), "-np", "2", "-timeout",
+         "300", os.path.join(REPO, "build", "bench_pingpong")],
+        capture_output=True, text=True, timeout=400)
+    m = re.search(r"pingpong_p50_us=([\d.]+).*part_bw_gbps=([\d.]+)",
+                  r.stdout)
+    if not m:
+        raise RuntimeError(f"bench_pingpong failed: {r.stdout} {r.stderr}")
+    return float(m.group(1)), float(m.group(2))
+
+
+def tpu_bench():
+    """Flagship GPT-2 125M forward throughput (tokens/s) on the local
+    accelerator; None if JAX has no usable device."""
+    try:
+        import jax
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "graft_entry", os.path.join(REPO, "__graft_entry__.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        fn, (params, tokens) = mod.entry()
+        step = jax.jit(fn)
+        step(params, tokens).block_until_ready()       # compile + warm
+        n = 10
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = step(params, tokens)
+        out.block_until_ready()
+        dt = time.perf_counter() - t0
+        toks = tokens.size * n / dt
+        return round(toks, 1), str(jax.devices()[0].platform)
+    except Exception as e:  # no TPU / compile issue: report without it
+        print(f"bench: tpu path skipped: {e}", file=sys.stderr)
+        return None, None
+
+
+def main():
+    p50, bw = native_bench()
+    toks, platform = tpu_bench()
+    out = {
+        "metric": "enqueued_pingpong_p50_latency",
+        "value": p50,
+        "unit": "us",
+        # Latency: lower is better -> ratio >= 1 means at/above baseline.
+        "vs_baseline": round(BASELINE_P50_US / p50, 3),
+        "partitioned_bw_gbps": bw,
+        "partitioned_bw_vs_baseline": round(bw / BASELINE_PART_BW_GBPS, 3),
+    }
+    if toks is not None:
+        out["gpt2_fwd_tokens_per_s"] = toks
+        out["device"] = platform
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

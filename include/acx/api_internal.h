@@ -4,6 +4,7 @@
 #pragma once
 
 #include <cstdint>
+#include <mutex>
 
 #include "acx/proxy.h"
 #include "acx/state.h"
@@ -58,6 +59,9 @@ struct ApiState {
   bool mpi_inited = false;
   bool mpi_finalized = false;
   bool mpix_inited = false;
+  // Serializes MPIX_Finalize's teardown against graph cleanup hooks (which
+  // may run on arbitrary threads when a graph/exec is destroyed).
+  std::mutex lifecycle_mu;
 };
 
 ApiState& GS();

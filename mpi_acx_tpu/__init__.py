@@ -18,5 +18,15 @@ reference README.md:1-7) designed for TPU:
   dp/tp/pp/sp/ep execution on top of those primitives.
 """
 
-from mpi_acx_tpu import parallel  # noqa: F401
 from mpi_acx_tpu.version import __version__  # noqa: F401
+
+
+def __getattr__(name):
+    # Lazy subpackage imports: the host-plane path (`runtime`, pure
+    # ctypes/numpy) must not pay for — or depend on — the JAX stack, which
+    # matters when acxrun spawns N Python ranks.
+    if name in ("parallel", "models", "runtime", "train"):
+        import importlib
+
+        return importlib.import_module(f"mpi_acx_tpu.{name}")
+    raise AttributeError(f"module 'mpi_acx_tpu' has no attribute '{name}'")

@@ -1,0 +1,23 @@
+"""Model families built on the parallel substrate.
+
+The reference ships no models (SURVEY.md §0: "it is not a training
+framework") — but its driver-defined target configs are model workloads
+(BASELINE.json configs[3,4]: GPT-2 125M and Llama-style pipeline
+exchanges). These are those workloads, TPU-native: MXU-shaped matmuls in
+bfloat16, static shapes, and parallelism expressed through the
+mpi_acx_tpu.parallel primitives.
+"""
+
+from mpi_acx_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    gpt2_small,
+    tiny_config,
+    init_params,
+    forward,
+    loss_fn,
+)
+from mpi_acx_tpu.models.moe import (  # noqa: F401
+    MoeConfig,
+    init_moe_params,
+    moe_layer,
+)

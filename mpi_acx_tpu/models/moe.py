@@ -1,0 +1,94 @@
+"""Mixture-of-experts layer with expert parallelism over a mesh axis.
+
+Top-1 (switch-style) routing with a static capacity factor: dispatch and
+combine are einsums against a one-hot dispatch tensor, so the whole layer
+is static-shaped for XLA. Expert parallelism shards the expert dimension
+over a mesh axis inside shard_map: tokens travel to their expert's device
+through ``lax.all_to_all`` (the EP collective), are transformed by the
+local experts, and return the same way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    d_model: int = 128
+    d_ff: int = 256
+    n_experts: int = 8
+    capacity_factor: float = 2.0
+
+
+def init_moe_params(key: jax.Array, cfg: MoeConfig) -> Dict[str, Any]:
+    k1, k2, k3 = jax.random.split(key, 3)
+    E, d, ff = cfg.n_experts, cfg.d_model, cfg.d_ff
+    return {
+        "gate": jax.random.normal(k1, (d, E), jnp.float32) * 0.02,
+        "w1": jax.random.normal(k2, (E, d, ff), jnp.float32) * 0.02,
+        "w2": jax.random.normal(k3, (E, ff, d), jnp.float32) * 0.02,
+    }
+
+
+def _dispatch_tensors(gates: jax.Array, capacity: int):
+    """gates [T, E] -> (dispatch [T, E, C] one-hot, combine [T, E, C])."""
+    T, E = gates.shape
+    expert = jnp.argmax(gates, axis=-1)                       # [T]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)     # [T, E]
+    # Position of each token within its expert's queue.
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot         # [T, E]
+    keep = pos < capacity
+    onehot = onehot * keep
+    posc = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), capacity)  # [T, C]
+    dispatch = onehot[:, :, None] * posc[:, None, :]          # [T, E, C]
+    prob = jnp.sum(jax.nn.softmax(gates, axis=-1) * onehot, -1)  # [T]
+    combine = dispatch * prob[:, None, None]
+    return dispatch, combine
+
+
+def moe_layer(params: Dict[str, Any], x: jax.Array, cfg: MoeConfig,
+              ep_axis: str | None = None) -> jax.Array:
+    """x [T, d] -> [T, d].
+
+    With ep_axis set (inside shard_map), the expert dim of params is the
+    LOCAL slice [E/ep, d, ff] and tokens are exchanged by all_to_all:
+    dispatch [T, E_local*ep, C] -> regroup to [ep, T, E_local, C] ->
+    all_to_all over the leading axis, so each device receives every
+    device's tokens for ITS experts (BASELINE-style EP).
+    """
+    T, d = x.shape
+    gates = x.astype(jnp.float32) @ params["gate"]
+    e_local = params["w1"].shape[0]
+    if ep_axis is None:
+        E = e_local
+        cap = int(cfg.capacity_factor * T / E + 1)
+        dispatch, combine = _dispatch_tensors(gates, cap)
+        xin = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), dispatch)
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin, params["w1"]))
+        out = jnp.einsum("ecf,efd->ecd", h, params["w2"])
+        return jnp.einsum("ecd,tec->td", out, combine).astype(x.dtype)
+
+    ep = lax.axis_size(ep_axis)
+    E = e_local * ep
+    cap = int(cfg.capacity_factor * T / E + 1)
+    dispatch, combine = _dispatch_tensors(gates, cap)          # [T, E, C]
+    xin = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), dispatch)
+    # [E, C, d] -> [ep, E_local, C, d]; all_to_all swaps the ep axis with
+    # the device axis so device j holds every sender's slice for ITS
+    # experts: afterwards [ep(senders), E_local, C, d].
+    xin = xin.reshape(ep, e_local, cap, d)
+    xin = lax.all_to_all(xin, ep_axis, split_axis=0, concat_axis=0,
+                         tiled=False)
+    h = jax.nn.gelu(jnp.einsum("secd,edf->secf", xin, params["w1"]))
+    out = jnp.einsum("secf,efd->secd", h, params["w2"])
+    # Route results back to their senders.
+    out = lax.all_to_all(out, ep_axis, split_axis=0, concat_axis=0,
+                         tiled=False)
+    out = out.reshape(E, cap, d)
+    return jnp.einsum("ecd,tec->td", out, combine).astype(x.dtype)

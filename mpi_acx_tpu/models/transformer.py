@@ -1,0 +1,159 @@
+"""Decoder-only transformer (GPT-2 family), pure functional JAX.
+
+TPU-first choices:
+* parameters live in float32, compute casts to bfloat16 so every matmul
+  lands on the MXU at full rate;
+* attention/MLP shapes are [*, d_model] x [d_model, big] einsums — large,
+  batched, static — exactly what XLA tiles well;
+* no Python control flow depends on data; the layer stack is a
+  ``lax.scan`` over stacked layer parameters (single compiled layer body,
+  fast compiles at depth);
+* the head dim and FFN dim are the tensor-parallel shardable axes, and the
+  sequence axis is the ring-attention/sequence-parallel axis — the
+  distributed train step in mpi_acx_tpu.train slices these with shard_map.
+
+GPT-2 125M (BASELINE.json configs[3]) is `gpt2_small()`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 50257
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    max_seq: int = 1024
+    dtype: Any = jnp.bfloat16  # compute dtype (params stay f32)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def gpt2_small() -> TransformerConfig:
+    """GPT-2 124M: 12L / 768d / 12H / 3072ff (BASELINE.json configs[3])."""
+    return TransformerConfig()
+
+
+def tiny_config(vocab: int = 512, d_model: int = 128, n_heads: int = 4,
+                n_layers: int = 4, d_ff: int = 512,
+                max_seq: int = 128) -> TransformerConfig:
+    """Small config for tests and virtual-mesh dryruns."""
+    return TransformerConfig(vocab=vocab, d_model=d_model, n_heads=n_heads,
+                             n_layers=n_layers, d_ff=d_ff, max_seq=max_seq)
+
+
+Params = Dict[str, Any]
+
+
+def init_params(key: jax.Array, cfg: TransformerConfig) -> Params:
+    """Stacked-layer parameter pytree: every per-layer tensor has a leading
+    [n_layers] axis (scanned in forward; sliceable into pipeline stages)."""
+    k = jax.random.split(key, 8)
+    L, d, ff = cfg.n_layers, cfg.d_model, cfg.d_ff
+    s = 0.02
+
+    def nrm(key, *shape, scale=s):
+        return (jax.random.normal(key, shape, jnp.float32) * scale)
+
+    return {
+        "embed": nrm(k[0], cfg.vocab, d),
+        "pos": nrm(k[1], cfg.max_seq, d),
+        "layers": {
+            "ln1_g": jnp.ones((L, d)), "ln1_b": jnp.zeros((L, d)),
+            "wqkv": nrm(k[2], L, d, 3 * d),
+            "wo": nrm(k[3], L, d, d, scale=s / jnp.sqrt(2 * L).item()),
+            "ln2_g": jnp.ones((L, d)), "ln2_b": jnp.zeros((L, d)),
+            "w1": nrm(k[4], L, d, ff), "b1": jnp.zeros((L, ff)),
+            "w2": nrm(k[5], L, ff, d, scale=s / jnp.sqrt(2 * L).item()),
+            "b2": jnp.zeros((L, d)),
+        },
+        "lnf_g": jnp.ones((d,)), "lnf_b": jnp.zeros((d,)),
+    }
+
+
+def layernorm(x, g, b, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mu) * lax.rsqrt(var + eps) * g + b).astype(x.dtype)
+
+
+def _causal_attention(q, k, v):
+    """q,k,v: [B, S, H, Dh] -> [B, S, H, Dh], causal, f32 softmax."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    logits = logits / jnp.sqrt(d)
+    s = q.shape[1]
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def block(cfg: TransformerConfig, lp: Params, x: jax.Array) -> jax.Array:
+    """One transformer block; x [B, S, d] in compute dtype."""
+    B, S, d = x.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+
+    h = layernorm(x, lp["ln1_g"], lp["ln1_b"])
+    qkv = h @ lp["wqkv"].astype(x.dtype)                      # [B,S,3d]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, Dh)
+    k = k.reshape(B, S, H, Dh)
+    v = v.reshape(B, S, H, Dh)
+    o = _causal_attention(q, k, v).reshape(B, S, d)
+    x = x + o @ lp["wo"].astype(x.dtype)
+
+    h = layernorm(x, lp["ln2_g"], lp["ln2_b"])
+    y = jax.nn.gelu(h @ lp["w1"].astype(x.dtype) + lp["b1"].astype(x.dtype))
+    return x + y @ lp["w2"].astype(x.dtype) + lp["b2"].astype(x.dtype)
+
+
+def forward(params: Params, cfg: TransformerConfig,
+            tokens: jax.Array) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab] (f32)."""
+    B, S = tokens.shape
+    x = (params["embed"][tokens] + params["pos"][:S]).astype(cfg.dtype)
+
+    def body(x, lp):
+        return block(cfg, lp, x), None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    x = layernorm(x, params["lnf_g"], params["lnf_b"])
+    # Tied unembedding (GPT-2 style).
+    return (x.astype(jnp.float32) @ params["embed"].T)
+
+
+def loss_fn(params: Params, cfg: TransformerConfig, tokens: jax.Array,
+            targets: jax.Array) -> jax.Array:
+    """Mean next-token cross-entropy."""
+    logits = forward(params, cfg, tokens)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def stage_slice(params: Params, n_stages: int) -> Params:
+    """Reshape stacked layers [L, ...] -> [n_stages, L/n_stages, ...] so a
+    shard_map P('pp') spec hands each pipeline stage its own layer block."""
+    L = params["layers"]["ln1_g"].shape[0]
+    assert L % n_stages == 0, (L, n_stages)
+    per = L // n_stages
+
+    def rs(p):
+        return p.reshape((n_stages, per) + p.shape[1:])
+
+    out = dict(params)
+    out["layers"] = jax.tree.map(rs, params["layers"])
+    return out

@@ -66,12 +66,13 @@ def halo_exchange_1d(x: jax.Array, axis_name: str, halo: int) -> jax.Array:
 
 def halo_exchange_2d(x: jax.Array, row_axis: str, col_axis: str,
                      halo: int) -> jax.Array:
-    """2D 5-point-stencil halo exchange (periodic) over a 2D mesh
-    (BASELINE.json configs[2]): rows then columns; corners are not needed
-    for a 5-point stencil.
+    """2D halo exchange (periodic) over a 2D mesh (BASELINE.json
+    configs[2]): rows first, then columns of the already-padded block — so
+    edge halos carry the 4 axis neighbors and corner cells carry the
+    DIAGONAL neighbors' corners (sufficient for 9-point as well as 5-point
+    stencils).
 
-    `x` is the local [H, W] block; returns [H+2h, W+2h] with halo rows/cols
-    filled (corner regions zero).
+    `x` is the local [H, W] block; returns [H+2h, W+2h].
     """
     x = halo_exchange_1d(x, row_axis, halo)                # pad rows
     left = x[:, :halo]

@@ -23,9 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-
-def _ring_perm(n: int, shift: int = 1):
-    return [(i, (i + shift) % n) for i in range(n)]
+from mpi_acx_tpu.parallel.collective import _ring_perm
 
 
 def _block_attend(q, k, v, mask):

@@ -1,0 +1,177 @@
+"""Distributed training step: dp x pp x tp/sp in one shard_map program.
+
+The flagship composition of the framework's primitives (the counterpart of
+the reference's driver configs, BASELINE.json configs[3,4]):
+
+* **pp** — pipeline stages over the 'pp' mesh axis; microbatch activations
+  travel stage->stage by collective permute
+  (mpi_acx_tpu.parallel.pipeline).
+* **tp + sp** — inside each stage, attention runs sequence-parallel over
+  the 'tp' axis with ring attention (K/V rotating on ICI), and the MLP
+  runs tensor-parallel with the FFN dim sharded over 'tp' and one psum.
+* **dp** — the microbatch dim is sharded over 'dp'; gradients are averaged
+  with one pmean.
+
+Everything is a single jitted SPMD program: XLA sees the mesh, the
+collectives, and the scan — no host in the loop.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mpi_acx_tpu.models import transformer as tfm
+from mpi_acx_tpu.parallel.pipeline import pipeline_forward
+from mpi_acx_tpu.parallel.ring_attention import ring_attention
+
+
+def _block_sp_tp(cfg: tfm.TransformerConfig, lp: Dict[str, Any],
+                 h: jax.Array, tp_axis: str) -> jax.Array:
+    """Transformer block, sequence-parallel attention + tensor-parallel MLP.
+
+    h: [mb, S, d] replicated over tp. lp's w1/b1/w2 are the LOCAL tp slices
+    (shard_map hands us [d, ff/tp] etc.); wqkv/wo are replicated.
+    """
+    tpn = lax.axis_size(tp_axis)
+    ti = lax.axis_index(tp_axis)
+    mb, S, d = h.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+    blk = S // tpn
+
+    # --- attention: shard the SEQUENCE over tp; ring-attend K/V blocks ---
+    hn = tfm.layernorm(h, lp["ln1_g"], lp["ln1_b"])
+    loc = lax.dynamic_slice_in_dim(hn, ti * blk, blk, axis=1)  # [mb,blk,d]
+    qkv = loc @ lp["wqkv"].astype(h.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(mb, blk, H, Dh)
+    k = k.reshape(mb, blk, H, Dh)
+    v = v.reshape(mb, blk, H, Dh)
+    attend = jax.vmap(
+        functools.partial(ring_attention, axis_name=tp_axis, causal=True))
+    o = attend(q, k, v).reshape(mb, blk, d)
+    o = o @ lp["wo"].astype(h.dtype)
+    # Re-assemble the full sequence on every tp rank.
+    attn = lax.all_gather(o, tp_axis, axis=1, tiled=True)     # [mb, S, d]
+    h = h + attn
+
+    # --- MLP: shard the FFN dim over tp; one psum to reduce ---
+    hn = tfm.layernorm(h, lp["ln2_g"], lp["ln2_b"])
+    y = jax.nn.gelu(hn @ lp["w1"].astype(h.dtype) +
+                    lp["b1"].astype(h.dtype))                 # [mb,S,ff/tp]
+    part = y @ lp["w2"].astype(h.dtype)
+    return h + lax.psum(part, tp_axis) + lp["b2"].astype(h.dtype)
+
+
+def param_specs(stage: bool = True) -> Dict[str, Any]:
+    """PartitionSpecs for the stage-sliced parameter pytree
+    (tfm.stage_slice output): layers carry a leading 'pp' stage axis; the
+    FFN dims of w1/b1/w2 shard over 'tp'; everything else replicates."""
+    pp = "pp" if stage else None
+    return {
+        "embed": P(), "pos": P(), "lnf_g": P(), "lnf_b": P(),
+        "layers": {
+            "ln1_g": P(pp), "ln1_b": P(pp),
+            "wqkv": P(pp), "wo": P(pp),
+            "ln2_g": P(pp), "ln2_b": P(pp),
+            "w1": P(pp, None, None, "tp"), "b1": P(pp, None, "tp"),
+            "w2": P(pp, None, "tp", None), "b2": P(pp),
+        },
+    }
+
+
+def _tp_sharded(path: str) -> bool:
+    return path in ("w1", "b1", "w2")
+
+
+def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
+                    n_micro: int, lr: float = 1e-2):
+    """Builds a jitted (params, tokens, targets) -> (loss, new_params) SGD
+    step over a ('dp','pp','tp') mesh.
+
+    params must be tfm.stage_slice(init_params(...), pp_size).
+    tokens/targets: [n_micro, micro_batch, S] int32, batch over 'dp'.
+    """
+    n_stages = mesh.shape["pp"]
+
+    def per_shard(params, tokens, targets):
+        def loss_fn(params):
+            # Embed on every rank (dp-local microbatches). The pipeline
+            # consumes xs only on stage 0, so the embedding-gather cotangent
+            # path is exclusive to stage 0 by construction.
+            S = tokens.shape[-1]
+            x = (params["embed"][tokens] +
+                 params["pos"][:S]).astype(cfg.dtype)  # [M, mbl, S, d]
+
+            def stage_fn(stage_layers, h):
+                def body(h, lp):
+                    return _block_sp_tp(cfg, lp, h, "tp"), None
+                h, _ = lax.scan(body, h, stage_layers)
+                return h
+
+            ys = pipeline_forward(stage_fn, params["layers"], x, "pp")
+            ys = tfm.layernorm(ys, params["lnf_g"], params["lnf_b"])
+
+            # EXCLUSIVE loss paths: every rank scores only its own slice —
+            # its tp sequence block, and only on the last pipeline stage —
+            # and the scalar is assembled by psum. This keeps every
+            # parameter's cotangent path unique, so gradient reduction is a
+            # plain psum over the axes a leaf is replicated on (redundant
+            # loss computation would scale cotangents by the redundancy).
+            tpn = lax.axis_size("tp")
+            ti = lax.axis_index("tp")
+            si = lax.axis_index("pp")
+            blk = S // tpn
+            ys_blk = lax.dynamic_slice_in_dim(ys, ti * blk, blk, axis=2)
+            tg_blk = lax.dynamic_slice_in_dim(targets, ti * blk, blk, axis=2)
+            logits = ys_blk.astype(jnp.float32) @ params["embed"].T
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(logp, tg_blk[..., None], -1)[..., 0]
+            contrib = jnp.where(si == n_stages - 1, jnp.sum(ll), 0.0)
+            total = lax.psum(contrib, ("pp", "tp"))
+            n_tok = tokens.shape[0] * tokens.shape[1] * S
+            return -total / n_tok
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # With check_vma=False the transpose of psum is psum (replication is
+        # untracked), so the loss-assembly psum over ('pp','tp') all-reduces
+        # the per-rank unit seeds: every cotangent — and thus every gradient
+        # leaf — is uniformly scaled by pp*tp. Undo it explicitly.
+        group = lax.axis_size("pp") * lax.axis_size("tp")
+        grads = jax.tree.map(lambda g: g / group, grads)
+        loss = lax.pmean(loss, "dp")
+
+        # Gradient reduction rule: pmean over dp (mean loss over the global
+        # batch); psum over every axis the leaf is REPLICATED on ('tp' for
+        # attention/norm leaves, 'pp'+'tp' for the embedding family); no
+        # reduction over axes the leaf is sharded on.
+        def reduce(g, tp_sharded: bool, pp_sharded: bool):
+            g = lax.pmean(g, "dp")
+            if not tp_sharded:
+                g = lax.psum(g, "tp")
+            if not pp_sharded:
+                g = lax.psum(g, "pp")
+            return g
+
+        new = dict(params)
+        for k in ("embed", "pos", "lnf_g", "lnf_b"):
+            new[k] = params[k] - lr * reduce(grads[k], False, False)
+        new["layers"] = {
+            k: params["layers"][k]
+            - lr * reduce(grads["layers"][k], _tp_sharded(k), True)
+            for k in params["layers"]
+        }
+        return loss, new
+
+    specs = param_specs()
+    data_spec = P(None, "dp")
+    step = shard_map(per_shard, mesh=mesh,
+                     in_specs=(specs, data_spec, data_spec),
+                     out_specs=(P(), specs),
+                     check_vma=False)
+    return jax.jit(step), n_stages

@@ -80,6 +80,11 @@ void ArmGraphCleanup(Graph* g, int idx) {
   FlagTable* expect_table = GS().table;
   g->AddCleanup([expect_table, idx] {
     ApiState& g2 = GS();
+    // lifecycle_mu makes the liveness check and the reclaim atomic with
+    // respect to MPIX_Finalize's teardown (a concurrent finalize would
+    // otherwise free the table under us). The spin is lock-free safe: the
+    // proxy never takes this mutex, so it keeps making progress.
+    std::lock_guard<std::mutex> lk(g2.lifecycle_mu);
     if (g2.table == nullptr || g2.table != expect_table) return;
     int32_t f = g2.table->Load(idx);
     while ((f == kPending || f == kIssued) && g2.proxy != nullptr) {
@@ -335,6 +340,8 @@ int MPIX_Init(void) {
 
 int MPIX_Finalize(void) {
   ApiState& g = GS();
+  // Serialize against graph cleanup hooks (see ArmGraphCleanup).
+  std::lock_guard<std::mutex> lk(g.lifecycle_mu);
   if (!g.mpix_inited) return kErr;
   // Leaked-slot diagnostics (reference init.cpp:262-266).
   size_t leaked = 0;

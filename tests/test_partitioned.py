@@ -19,8 +19,6 @@ def mesh():
 
 
 def test_partitioned_ring_exchange_identity(mesh):
-    x = jnp.arange(8 * 12, dtype=jnp.float32).reshape(8 * 12 // 8, -1)
-    x = jnp.arange(8 * 12, dtype=jnp.float32).reshape(96 // 8 * 8, -1)
     x = jnp.arange(96, dtype=jnp.float32).reshape(96, 1)
 
     def body(shard):  # [12, 1]
