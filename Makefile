@@ -10,7 +10,7 @@
 
 CXX      ?= g++
 CXXFLAGS ?= -O2 -g -Wall -Wextra -std=c++17 -fPIC -pthread -MMD -MP
-INCLUDES  = -Iinclude -Iinclude/compat
+INCLUDES  = -Iinclude -Iinclude/compat -I.
 LDFLAGS   = -pthread
 
 ifeq ($(ACX_DEBUG), 1)
@@ -103,9 +103,12 @@ $(BUILD)/reftests/%: $(REF_TEST_DIR)/%.c $(STATICLIB)
 	$(CXX) $(CXXFLAGS) -Wno-unused-parameter $(INCLUDES) -x c++ $< -x none $(STATICLIB) -o $@ $(LDFLAGS)
 
 # --- run everything ---
+# Integration tests run on both data planes: shm (default, SPSC rings in a
+# memfd) and socket (AF_UNIX, the cross-host-shaped wire).
 check: ctest itest tools
 	@for t in $(CTEST_BINS); do echo "== $$t"; $$t || exit 1; done
-	@for t in $(ITEST_BINS); do echo "== acxrun -np 2 $$t"; $(BUILD)/acxrun -np 2 $$t || exit 1; done
+	@for t in $(ITEST_BINS); do echo "== acxrun -np 2 $$t (shm)"; $(BUILD)/acxrun -np 2 $$t || exit 1; done
+	@for t in $(ITEST_BINS); do echo "== acxrun -np 2 $$t (socket)"; $(BUILD)/acxrun -np 2 -transport socket $$t || exit 1; done
 	@echo "ALL NATIVE TESTS PASSED"
 
 # Header dependency tracking (-MMD): a header edit rebuilds its users.

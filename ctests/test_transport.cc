@@ -1,13 +1,17 @@
-// Unit tests for SocketTransport + partitioned channels + proxy integration.
+// Unit tests for StreamTransport (both wire planes) + partitioned channels
+// + proxy integration.
 //
-// Two transports live in one process, connected by a real socketpair, with
-// rank 1 driven from a second thread — the same shape the reference only
-// ever tests via two mpiexec ranks (reference test/src/ring.c), but
-// unit-testable. Covers: basic sendrecv, FIFO (src,tag,ctx) matching with
-// out-of-order tags, large (multi-MB, > socket buffer) payloads, self-send,
-// barrier, allreduce, partitioned rounds with out-of-order Pready, and the
-// full proxy-driven enqueued lifecycle over a real wire.
+// Two transports live in one process, connected by a real wire — an AF_UNIX
+// socketpair or a shared-memory ring segment — with rank 1 driven from a
+// second thread: the same shape the reference only ever tests via two
+// mpiexec ranks (reference test/src/ring.c), but unit-testable. Covers:
+// basic sendrecv, FIFO (src,tag,ctx) matching with out-of-order tags, large
+// (multi-MB, > wire buffer) payloads, truncating receives, self-send,
+// barrier, allreduce, partitioned rounds with out-of-order Pready, the full
+// proxy-driven enqueued lifecycle over a real wire, and SPSC-ring
+// wrap-around at the byte level.
 
+#include <sys/mman.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -20,6 +24,7 @@
 #include "acx/net.h"
 #include "acx/proxy.h"
 #include "acx/state.h"
+#include "src/net/link.h"
 
 #define CHECK(cond)                                                        \
   do {                                                                     \
@@ -31,15 +36,35 @@
 
 namespace {
 
+enum class Wire { kSocket, kShm };
+const char* WireName(Wire w) { return w == Wire::kSocket ? "socket" : "shm"; }
+
 struct Pair {
   std::unique_ptr<acx::Transport> t0, t1;
-  Pair() {
-    int a[2], b[2];
-    CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, a) == 0);
-    // fds vector: index = peer rank; own slot unused.
-    t0.reset(acx::CreateSocketTransport(0, 2, {-1, a[0]}));
-    t1.reset(acx::CreateSocketTransport(1, 2, {a[1], -1}));
-    (void)b;
+  void* shm = nullptr;
+  size_t shm_len = 0;
+  // Deliberately small shm rings (4 KiB) so multi-MB tests exercise ring
+  // wrap-around and flow control hard.
+  explicit Pair(Wire w = Wire::kSocket, size_t ring_bytes = 4096) {
+    if (w == Wire::kSocket) {
+      int a[2];
+      CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, a) == 0);
+      // fds vector: index = peer rank; own slot unused.
+      t0.reset(acx::CreateSocketTransport(0, 2, {-1, a[0]}));
+      t1.reset(acx::CreateSocketTransport(1, 2, {a[1], -1}));
+    } else {
+      shm_len = acx::ShmSegmentBytes(2, ring_bytes);
+      shm = mmap(nullptr, shm_len, PROT_READ | PROT_WRITE,
+                 MAP_SHARED | MAP_ANONYMOUS, -1, 0);
+      CHECK(shm != MAP_FAILED);
+      t0.reset(acx::CreateShmTransport(0, 2, shm, ring_bytes));
+      t1.reset(acx::CreateShmTransport(1, 2, shm, ring_bytes));
+    }
+  }
+  ~Pair() {
+    t0.reset();
+    t1.reset();
+    if (shm != nullptr) munmap(shm, shm_len);
   }
 };
 
@@ -47,8 +72,8 @@ void WaitDone(acx::Ticket* t, acx::Status* st) {
   while (!t->Test(st)) std::this_thread::yield();
 }
 
-void test_basic_sendrecv() {
-  Pair p;
+void test_basic_sendrecv(Wire w) {
+  Pair p(w);
   int sv = 42, rv = -1;
   std::unique_ptr<acx::Ticket> s(p.t0->Isend(&sv, sizeof sv, 1, 7, 0));
   std::unique_ptr<acx::Ticket> r(p.t1->Irecv(&rv, sizeof rv, 0, 7, 0));
@@ -58,11 +83,11 @@ void test_basic_sendrecv() {
   CHECK(rv == 42);
   CHECK(st.source == 0 && st.tag == 7 && st.error == 0 &&
         st.bytes == sizeof sv);
-  std::printf("  transport basic sendrecv: ok\n");
+  std::printf("  transport basic sendrecv (%s): ok\n", WireName(w));
 }
 
-void test_matching_out_of_order_tags() {
-  Pair p;
+void test_matching_out_of_order_tags(Wire w) {
+  Pair p(w);
   int a = 1, b = 2, ra = 0, rb = 0;
   // Send tag 5 then tag 6; recv tag 6 first. Matching is by tag, FIFO
   // within a tag.
@@ -77,11 +102,11 @@ void test_matching_out_of_order_tags() {
   CHECK(ra == 1 && st.tag == 5);
   WaitDone(s1.get(), nullptr);
   WaitDone(s2.get(), nullptr);
-  std::printf("  transport tag matching: ok\n");
+  std::printf("  transport tag matching (%s): ok\n", WireName(w));
 }
 
-void test_large_message() {
-  Pair p;
+void test_large_message(Wire w) {
+  Pair p(w);
   const size_t n = 8u << 20;  // 8 MiB, far beyond AF_UNIX buffering
   std::vector<char> src(n), dst(n, 0);
   for (size_t i = 0; i < n; i++) src[i] = static_cast<char>(i * 31 + 7);
@@ -96,7 +121,7 @@ void test_large_message() {
   WaitDone(s.get(), nullptr);
   peer.join();
   CHECK(memcmp(src.data(), dst.data(), n) == 0);
-  std::printf("  transport 8MiB message: ok\n");
+  std::printf("  transport 8MiB message (%s): ok\n", WireName(w));
 }
 
 void test_self_send() {
@@ -111,8 +136,8 @@ void test_self_send() {
   std::printf("  self transport loopback: ok\n");
 }
 
-void test_barrier_allreduce() {
-  Pair p;
+void test_barrier_allreduce(Wire w) {
+  Pair p(w);
   std::thread peer([&] {
     p.t1->Barrier(0);
     int32_t v[2] = {5, -3};
@@ -124,11 +149,11 @@ void test_barrier_allreduce() {
   p.t0->AllreduceInt(v, 2, 0, 0);
   CHECK(v[0] == 7 && v[1] == -3);
   peer.join();
-  std::printf("  barrier + allreduce(max): ok\n");
+  std::printf("  barrier + allreduce(max) (%s): ok\n", WireName(w));
 }
 
-void test_partitioned_round_trip() {
-  Pair p;
+void test_partitioned_round_trip(Wire w) {
+  Pair p(w);
   constexpr int kParts = 10;
   constexpr int kIters = 3;
   int send[kParts], recv[kParts];
@@ -152,16 +177,16 @@ void test_partitioned_round_trip() {
     CHECK(st.bytes == sizeof(int) * kParts);
     for (int i = 0; i < kParts; i++) CHECK(recv[i] == it * 100 + i);
   }
-  std::printf("  partitioned %d-part x%d rounds (out-of-order Pready): ok\n",
-              kParts, kIters);
+  std::printf("  partitioned %d-part x%d rounds (out-of-order Pready, %s): ok\n",
+              kParts, kIters, WireName(w));
 }
 
 // The full L1+L2+L0 stack over a real wire: two proxies, two flag tables,
 // enqueued isend/irecv lifecycle driven purely by flag transitions — the
 // unit-level equivalent of the reference's ring.c flow (sendrecv.cu:129-327
 // + init.cpp:55-154).
-void test_proxy_over_wire() {
-  Pair p;
+void test_proxy_over_wire(Wire w) {
+  Pair p(w);
   acx::FlagTable ft0(64), ft1(64);
   acx::Proxy px0(&ft0, p.t0.get()), px1(&ft1, p.t1.get());
   px0.Start();
@@ -205,19 +230,97 @@ void test_proxy_over_wire() {
     std::this_thread::yield();
   px0.Stop();
   px1.Stop();
-  std::printf("  proxy-driven enqueued sendrecv over wire: ok\n");
+  std::printf("  proxy-driven enqueued sendrecv over wire (%s): ok\n", WireName(w));
+}
+
+// Byte-level SPSC ring: partial writes when full, partial reads when
+// draining, and correctness across many wrap-arounds with co-prime chunk
+// sizes.
+void test_shm_ring_wraparound() {
+  constexpr size_t kCap = 64;
+  alignas(64) char slot[sizeof(acx::ShmRingHdr) + kCap] = {};
+  auto* hdr = new (slot) acx::ShmRingHdr();
+  char* data = slot + sizeof(acx::ShmRingHdr);
+
+  // Full/partial-write behavior.
+  std::vector<char> big(100, 'x');
+  CHECK(acx::ShmRingWrite(hdr, data, kCap, big.data(), big.size()) == kCap);
+  CHECK(acx::ShmRingWrite(hdr, data, kCap, big.data(), 1) == 0);  // full
+  std::vector<char> sink(100);
+  CHECK(acx::ShmRingRead(hdr, data, kCap, sink.data(), 100) == kCap);
+  CHECK(acx::ShmRingRead(hdr, data, kCap, sink.data(), 1) == 0);  // empty
+
+  // Streaming correctness across wrap-arounds: writer pushes 7-byte chunks,
+  // reader pulls 5-byte chunks, 10k bytes total.
+  const size_t total = 10000;
+  size_t wrote = 0, read = 0;
+  std::vector<char> out(total);
+  while (read < total) {
+    if (wrote < total) {
+      char chunk[7];
+      size_t n = total - wrote < 7 ? total - wrote : 7;
+      for (size_t i = 0; i < n; i++)
+        chunk[i] = static_cast<char>((wrote + i) * 13 + 5);
+      wrote += acx::ShmRingWrite(hdr, data, kCap, chunk, n);
+    }
+    read += acx::ShmRingRead(hdr, data, kCap, out.data() + read,
+                             total - read < 5 ? total - read : 5);
+  }
+  for (size_t i = 0; i < total; i++)
+    CHECK(out[i] == static_cast<char>(i * 13 + 5));
+  std::printf("  shm ring wrap-around: ok\n");
+}
+
+// A recv buffer smaller than the incoming message truncates (both the
+// direct-delivery path — recv posted first — and the unexpected path).
+void test_truncated_recv(Wire w) {
+  Pair p(w);
+  char msg[64];
+  for (size_t i = 0; i < sizeof msg; i++) msg[i] = static_cast<char>(i + 1);
+  acx::Status st;
+  {
+    // Direct path: recv posted before the message arrives.
+    char small[16] = {0};
+    std::unique_ptr<acx::Ticket> r(p.t1->Irecv(small, sizeof small, 0, 4, 0));
+    std::unique_ptr<acx::Ticket> s(p.t0->Isend(msg, sizeof msg, 1, 4, 0));
+    WaitDone(r.get(), &st);
+    WaitDone(s.get(), nullptr);
+    CHECK(st.bytes == sizeof small);
+    CHECK(memcmp(small, msg, sizeof small) == 0);
+  }
+  {
+    // Unexpected path: message arrives (and buffers) before the recv.
+    std::unique_ptr<acx::Ticket> s(p.t0->Isend(msg, sizeof msg, 1, 5, 0));
+    WaitDone(s.get(), nullptr);
+    // Drive t1's progress with an unrelated probe so the tag-5 message is
+    // drained into the unexpected queue before its recv exists.
+    int dummy;
+    std::unique_ptr<acx::Ticket> probe(
+        p.t1->Irecv(&dummy, sizeof dummy, 0, 99, 0));
+    probe->Test(nullptr);
+    char small[16] = {0};
+    std::unique_ptr<acx::Ticket> r(p.t1->Irecv(small, sizeof small, 0, 5, 0));
+    WaitDone(r.get(), &st);
+    CHECK(st.bytes == sizeof small);
+    CHECK(memcmp(small, msg, sizeof small) == 0);
+  }
+  std::printf("  truncated recv, direct + unexpected (%s): ok\n", WireName(w));
 }
 
 }  // namespace
 
 int main() {
-  test_basic_sendrecv();
-  test_matching_out_of_order_tags();
-  test_large_message();
+  test_shm_ring_wraparound();
   test_self_send();
-  test_barrier_allreduce();
-  test_partitioned_round_trip();
-  test_proxy_over_wire();
+  for (Wire w : {Wire::kSocket, Wire::kShm}) {
+    test_basic_sendrecv(w);
+    test_matching_out_of_order_tags(w);
+    test_large_message(w);
+    test_truncated_recv(w);
+    test_barrier_allreduce(w);
+    test_partitioned_round_trip(w);
+    test_proxy_over_wire(w);
+  }
   std::printf("test_transport: ALL OK\n");
   return 0;
 }

@@ -4,12 +4,17 @@
 // "Distributed communication backend"; reference src/init.cpp:66-141 posts
 // MPI_Isend/Irecv/Test through it). tpu-acx replaces that with its own
 // native backends:
-//   * SocketTransport — multi-process message passing over pre-connected
-//     AF_UNIX socketpairs set up by the `acxrun` launcher (tools/acxrun.cc),
-//     the role `mpiexec` plays for the reference. This is the host/DCN
-//     plane; on a TPU pod the equivalent wires are the DCN links between
-//     hosts, while intra-slice traffic rides ICI via XLA collectives from
-//     the Python layer (mpi_acx_tpu.parallel).
+//   * StreamTransport over socket links — multi-process message passing
+//     over pre-connected AF_UNIX socketpairs set up by the `acxrun`
+//     launcher (tools/acxrun.cc), the role `mpiexec` plays for the
+//     reference. This is the host/DCN plane shape; on a TPU pod the
+//     equivalent wires are the DCN links between hosts, while intra-slice
+//     traffic rides ICI via XLA collectives from the Python layer
+//     (mpi_acx_tpu.parallel).
+//   * StreamTransport over shm links — same-host fast path: SPSC byte
+//     rings in a memfd segment (the role MPI's shm transport plays under
+//     single-node mpiexec). Default when launched by acxrun; override with
+//     ACX_TRANSPORT=socket.
 //   * SelfTransport — size-1 loopback used by unit tests and by
 //     single-process Python sessions.
 #pragma once
@@ -22,8 +27,12 @@ namespace acx {
 
 // Builds the process's transport from the environment:
 //   ACX_RANK / ACX_SIZE  — set by acxrun
+//   ACX_SHM_FD           — memfd of the shm ring segment (preferred plane)
+//   ACX_SHM_RING_BYTES   — per-directed-pair ring capacity (default 256KiB)
 //   ACX_FDS              — comma-separated socket fds, one per peer rank,
 //                          "-1" at our own position
+//   ACX_TRANSPORT        — "socket" forces the socket plane even when
+//                          ACX_SHM_FD is present
 // Falls back to SelfTransport when ACX_SIZE is absent or 1.
 // Caller owns the result.
 Transport* CreateTransportFromEnv();
@@ -32,6 +41,13 @@ Transport* CreateTransportFromEnv();
 // stream-socket fd per peer (fds[rank] ignored). Takes ownership of the fds.
 Transport* CreateSocketTransport(int rank, int size,
                                  const std::vector<int>& fds);
+
+// Direct shm constructor (unit tests + env path): `base` is a mapping of a
+// segment laid out per ShmSegmentBytes(size, ring_bytes) (src/net/link.h),
+// shared by all ranks. With owned_len == 0 the caller owns the mapping;
+// otherwise the transport munmaps base/owned_len at teardown.
+Transport* CreateShmTransport(int rank, int size, void* base,
+                              size_t ring_bytes, size_t owned_len = 0);
 
 Transport* CreateSelfTransport();
 

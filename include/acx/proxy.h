@@ -9,7 +9,11 @@
 //   * no completion mutex: the proxy publishes op.status with a release store
 //     of COMPLETED, and consumers arbitrate COMPLETED->CLEANUP by CAS;
 //   * adaptive backoff (spin -> yield -> sleep -> idle condvar) instead of a
-//     hot O(nflags) busy spin, so a shared-core host is not starved.
+//     hot O(nflags) busy spin, so a shared-core host is not starved;
+//   * caller-driven progress: any thread blocked on a flag can drive the
+//     sweep itself via TryProgress() (the way MPI progress engines run
+//     inside MPI_Wait), so completion needs no context switch to the proxy
+//     thread — the dominant latency on shared-core hosts.
 #pragma once
 
 #include <condition_variable>
@@ -33,6 +37,11 @@ class Proxy {
   // the host, or after enqueueing work that will).
   void Kick();
 
+  // Run one sweep on the calling thread if no other thread is sweeping.
+  // Returns true if the sweep ran AND made progress. Spin-wait loops call
+  // this so the waiter completes its own op without a thread handoff.
+  bool TryProgress();
+
   // Stats (observability the reference lacks). Counters are plain atomics so
   // the hot sweep loop never takes a lock.
   struct Stats {
@@ -46,10 +55,13 @@ class Proxy {
  private:
   void Run();
   // One sweep over the table; returns true if any transition was made.
+  // Callers must hold sweep_mu_ (one sweeper at a time: the PENDING->ISSUED
+  // and CLEANUP->AVAILABLE transitions are plain stores).
   bool Sweep();
 
   FlagTable* table_;
   Transport* transport_;
+  std::mutex sweep_mu_;
   std::thread thread_;
   std::atomic<bool> exit_{false};
   std::atomic<bool> running_{false};

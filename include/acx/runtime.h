@@ -49,6 +49,12 @@ class Stream {
   // capture tail) instead.
   void Enqueue(std::function<void()> fn);
 
+  // Like Enqueue, but if the queue is empty and idle (the "device" has
+  // already reached this point), run fn inline on the calling thread — no
+  // worker-thread handoff. Only for cheap, non-blocking items (triggers);
+  // items that wait (MakeWaiter) must use Enqueue.
+  void EnqueueInstant(std::function<void()> fn);
+
   void Sync();
 
   void BeginCapture();
@@ -62,6 +68,7 @@ class Stream {
 
  private:
   void Run();
+  bool RecordIfCapturingLocked(std::function<void()>& fn);
 
   std::thread worker_;
   mutable std::mutex mu_;

@@ -128,6 +128,10 @@ class FlagTable {
   // Raw pointer to the flag word array (exposed to Python / device mirrors).
   std::atomic<int32_t>* raw() { return flags_.get(); }
 
+  // Sweep bound: every slot ever allocated lives below this (monotonic; with
+  // lowest-free-slot allocation it tracks peak concurrency, not table size).
+  size_t watermark() const { return watermark_.load(std::memory_order_acquire); }
+
   // Number of non-AVAILABLE slots; the proxy idles when zero.
   std::atomic<int64_t> active{0};
 
@@ -135,7 +139,7 @@ class FlagTable {
   size_t n_;
   std::unique_ptr<std::atomic<int32_t>[]> flags_;
   std::unique_ptr<Op[]> ops_;
-  std::atomic<uint32_t> hint_{0};
+  std::atomic<size_t> watermark_{0};
 };
 
 }  // namespace acx

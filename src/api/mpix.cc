@@ -34,9 +34,14 @@ namespace {
 
 constexpr int kErr = 1;
 
-// Spin with yield until the slot reaches `want` (host- and node-side waits).
-void SpinUntil(FlagTable* t, int idx, int32_t want) {
-  while (t->Load(idx) != want) sched_yield();
+// Spin until the slot reaches `want` (host- and node-side waits). The
+// waiting thread drives the progress engine itself (Proxy::TryProgress) so
+// completion doesn't require a context switch to the proxy thread; yield
+// only when another thread already holds the sweep.
+void SpinUntil(FlagTable* t, Proxy* proxy, int idx, int32_t want) {
+  while (t->Load(idx) != want) {
+    if (proxy == nullptr || !proxy->TryProgress()) sched_yield();
+  }
 }
 
 Stream* StreamFromQueue(void* queue) {
@@ -137,13 +142,17 @@ int EnqueueSendRecv(bool is_send, void* buf, int count, MPI_Datatype datatype,
   // (reference state doc, mpi-acx-internal.h:176-189).
   auto trigger = [table, proxy, idx] {
     table->Store(idx, kPending);
+    // Post the transfer inline if no one else is sweeping (saves the
+    // proxy-thread handoff); Kick still wakes a parked proxy to poll the
+    // ISSUED op in case no host thread ever waits on it.
+    proxy->TryProgress();
     proxy->Kick();
   };
 
   if (qtype == MPIX_QUEUE_CUDA_STREAM) {
     Stream* s = StreamFromQueue(queue);
     req->graph_owned = s->capturing();
-    s->Enqueue(trigger);  // records a node instead when capturing
+    s->EnqueueInstant(trigger);  // records a node instead when capturing
     if (req->graph_owned) ArmGraphCleanup(s->capture_graph(), idx);
   } else if (qtype == MPIX_QUEUE_CUDA_GRAPH) {
     // Explicit-construction mode: hand back a single-node graph the app
@@ -170,7 +179,7 @@ std::function<void()> MakeWaiter(int idx, MPI_Status* status,
   FlagTable* table = GS().table;
   Proxy* proxy = GS().proxy;
   return [table, proxy, idx, status, graph_owned] {
-    SpinUntil(table, idx, kCompleted);
+    SpinUntil(table, proxy, idx, kCompleted);
     CopyStatus(table->op(idx).status, status);
     if (!graph_owned) {
       table->Store(idx, kCleanup);
@@ -233,7 +242,7 @@ int HostWaitBasic(MpixRequest* req, MPI_Status* status) {
                  "supported (reference README limitation)\n");
     return kErr;
   }
-  SpinUntil(g.table, idx, kCompleted);
+  SpinUntil(g.table, g.proxy, idx, kCompleted);
   CopyStatus(g.table->op(idx).status, status);
   g.table->Store(idx, kCleanup);  // proxy frees request + ticket + slot
   g.proxy->Kick();
@@ -252,7 +261,7 @@ int HostWaitPartitioned(MpixRequest* req, MPI_Status* status) {
     return MPI_SUCCESS;
   }
   for (int p = 0; p < req->partitions; p++) {
-    SpinUntil(g.table, req->part_idx[p], kCompleted);
+    SpinUntil(g.table, g.proxy, req->part_idx[p], kCompleted);
     g.table->Store(req->part_idx[p], kReserved);
   }
   Status st;

@@ -38,14 +38,25 @@ FlagTable::~FlagTable() {
 }
 
 int FlagTable::Allocate() {
-  const uint32_t start = hint_.fetch_add(1, std::memory_order_relaxed);
-  for (size_t probe = 0; probe < n_; probe++) {
-    const size_t i = (start + probe) % n_;
+  // Lowest-free-slot allocation (not a rotating hint): keeps live slots
+  // packed at the bottom of the table so the proxy's sweep only has to walk
+  // [0, watermark) — with K concurrent ops that's a K-entry sweep instead of
+  // O(nflags), which is what makes caller-driven inline progress cheap
+  // enough to run on the enqueue path. CAS arbitrates concurrent allocators
+  // (fixes the reference's single-thread-only FIXME, triggered.cpp:40-44).
+  for (size_t i = 0; i < n_; i++) {
     int32_t expect = kAvailable;
     if (flags_[i].compare_exchange_strong(expect, kReserved,
                                           std::memory_order_acq_rel,
                                           std::memory_order_relaxed)) {
       active.fetch_add(1, std::memory_order_relaxed);
+      // Raise the sweep watermark to cover this slot (monotonic max).
+      size_t w = watermark_.load(std::memory_order_relaxed);
+      while (w < i + 1 &&
+             !watermark_.compare_exchange_weak(w, i + 1,
+                                               std::memory_order_release,
+                                               std::memory_order_relaxed)) {
+      }
       return static_cast<int>(i);
     }
   }

@@ -32,6 +32,14 @@ void Proxy::Kick() {
   idle_cv_.notify_all();
 }
 
+bool Proxy::TryProgress() {
+  std::unique_lock<std::mutex> lk(sweep_mu_, std::try_to_lock);
+  if (!lk.owns_lock()) return false;  // another thread is already sweeping
+  const bool progressed = Sweep();
+  if (progressed) sweeps_.fetch_add(1, std::memory_order_relaxed);
+  return progressed;
+}
+
 Proxy::Stats Proxy::stats() const {
   Stats s;
   s.sweeps = sweeps_.load(std::memory_order_relaxed);
@@ -44,7 +52,9 @@ Proxy::Stats Proxy::stats() const {
 bool Proxy::Sweep() {
   bool progressed = false;
   Stats local{};
-  const size_t n = table_->size();
+  // Only [0, watermark) can hold live slots (lowest-free-slot allocation);
+  // with K concurrent ops this is a K-entry walk, not O(nflags).
+  const size_t n = table_->watermark();
   for (size_t i = 0; i < n; i++) {
     const int32_t f = table_->Load(i);
     Op& op = table_->op(i);
@@ -143,7 +153,11 @@ void Proxy::Run() {
   int idle_sweeps = 0;
   while (!exit_.load(std::memory_order_acquire)) {
     const uint64_t kicks_before = kicks_.load(std::memory_order_acquire);
-    bool progressed = Sweep();
+    bool progressed;
+    {
+      std::lock_guard<std::mutex> lk(sweep_mu_);
+      progressed = Sweep();
+    }
     sweeps_.fetch_add(1, std::memory_order_relaxed);
     if (progressed) {
       idle_sweeps = 0;

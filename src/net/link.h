@@ -1,0 +1,173 @@
+// tpu-acx: byte-stream links — the wire under the stream transport.
+//
+// The transport's framing/matching engine (stream_transport logic in
+// socket_transport.cc) is wire-agnostic; a Link is one full-duplex byte
+// stream to one peer with nonblocking semantics. Two implementations:
+//   * SockLink — an AF_UNIX stream socket fd (cross-host-capable shape;
+//     the role of the reference's network MPI path).
+//   * ShmLink — a pair of single-producer/single-consumer byte rings in a
+//     shared-memory segment, one ring per direction. This is the same-host
+//     fast path, the role MPI's shm BTL plays for the reference's
+//     `mpiexec -np N` single-node runs: no syscalls on the data path, just
+//     two memcpys and acquire/release counters.
+#pragma once
+
+#include <errno.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+
+namespace acx {
+
+class Link {
+ public:
+  virtual ~Link() = default;
+  // Nonblocking; return bytes moved (0 = would block / nothing available).
+  // Fatal wire errors terminate the process (matching the abort-style error
+  // handling of the reference library, its internal.h CHECK macros).
+  virtual size_t WriteSome(const char* p, size_t n) = 0;
+  virtual size_t ReadSome(char* p, size_t n) = 0;
+};
+
+class SockLink : public Link {
+ public:
+  explicit SockLink(int fd, int rank, int peer)
+      : fd_(fd), rank_(rank), peer_(peer) {}
+  ~SockLink() override {
+    if (fd_ >= 0) close(fd_);
+  }
+
+  size_t WriteSome(const char* p, size_t n) override {
+    ssize_t r = write(fd_, p, n);
+    if (r < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+      std::fprintf(stderr, "tpu-acx[%d]: write to %d failed: %s\n", rank_,
+                   peer_, strerror(errno));
+      _exit(14);
+    }
+    return static_cast<size_t>(r);
+  }
+
+  size_t ReadSome(char* p, size_t n) override {
+    ssize_t r = read(fd_, p, n);
+    if (r < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+      std::fprintf(stderr, "tpu-acx[%d]: read from %d failed: %s\n", rank_,
+                   peer_, strerror(errno));
+      _exit(14);
+    }
+    // r == 0 (peer closed): treated as "nothing available"; any data the
+    // peer sent before exiting was already drained by earlier reads.
+    return static_cast<size_t>(r);
+  }
+
+ private:
+  int fd_;
+  int rank_, peer_;
+};
+
+// -- Shared-memory SPSC ring ------------------------------------------------
+//
+// head/tail are free-running 64-bit byte counters on separate cache lines
+// (no false sharing between the producer's and consumer's hot words).
+// Producer owns tail, consumer owns head; cross-reads use acquire so payload
+// bytes written before the release store of tail are visible to the reader.
+
+struct alignas(64) ShmRingHdr {
+  std::atomic<uint64_t> tail{0};  // bytes produced
+  char pad0[64 - sizeof(std::atomic<uint64_t>)];
+  std::atomic<uint64_t> head{0};  // bytes consumed
+  char pad1[64 - sizeof(std::atomic<uint64_t>)];
+};
+static_assert(sizeof(ShmRingHdr) == 128, "two cache lines");
+
+inline size_t ShmRingWrite(ShmRingHdr* h, char* data, size_t cap,
+                           const char* src, size_t n) {
+  const uint64_t head = h->head.load(std::memory_order_acquire);
+  const uint64_t tail = h->tail.load(std::memory_order_relaxed);
+  const size_t space = cap - static_cast<size_t>(tail - head);
+  if (n > space) n = space;
+  if (n == 0) return 0;
+  const size_t pos = static_cast<size_t>(tail % cap);
+  const size_t first = n < cap - pos ? n : cap - pos;
+  memcpy(data + pos, src, first);
+  memcpy(data, src + first, n - first);
+  h->tail.store(tail + n, std::memory_order_release);
+  return n;
+}
+
+inline size_t ShmRingRead(ShmRingHdr* h, char* data, size_t cap, char* dst,
+                          size_t n) {
+  const uint64_t tail = h->tail.load(std::memory_order_acquire);
+  const uint64_t head = h->head.load(std::memory_order_relaxed);
+  const size_t avail = static_cast<size_t>(tail - head);
+  if (n > avail) n = avail;
+  if (n == 0) return 0;
+  const size_t pos = static_cast<size_t>(head % cap);
+  const size_t first = n < cap - pos ? n : cap - pos;
+  memcpy(dst, data + pos, first);
+  memcpy(dst + first, data, n - first);
+  h->head.store(head + n, std::memory_order_release);
+  return n;
+}
+
+// Ring capacity sanitizer: a zero ring would wedge every send (WriteSome
+// forever returns 0), and a stride not a multiple of 64 would misalign the
+// alignas(64) ShmRingHdr atomics of higher slots (UB). Clamp to >= 4 KiB
+// and round up to a cache line. acxrun and the env path share this so the
+// segment the launcher sizes and the one ranks map always agree.
+inline size_t ShmSanitizeRingBytes(uint64_t v) {
+  if (v < 4096) v = 4096;
+  return static_cast<size_t>((v + 63) & ~uint64_t{63});
+}
+
+// Segment geometry: np*(np-1) directed rings, one per ordered rank pair,
+// laid out densely. Ring for (i -> j), j != i, lives at slot
+// i*(np-1) + (j<i ? j : j-1). Derived identically by acxrun (which sizes the
+// segment) and every rank (which maps it) — no metadata block needed.
+inline size_t ShmRingSlotBytes(size_t ring_bytes) {
+  return sizeof(ShmRingHdr) + ring_bytes;
+}
+inline size_t ShmSegmentBytes(int np, size_t ring_bytes) {
+  return static_cast<size_t>(np) * (np - 1) * ShmRingSlotBytes(ring_bytes);
+}
+inline char* ShmRingAt(char* base, int np, size_t ring_bytes, int src,
+                       int dst) {
+  const int slot = src * (np - 1) + (dst < src ? dst : dst - 1);
+  return base + static_cast<size_t>(slot) * ShmRingSlotBytes(ring_bytes);
+}
+
+class ShmLink : public Link {
+ public:
+  // base: mapped segment; rank -> peer is the out ring, peer -> rank the in.
+  ShmLink(char* base, int np, size_t ring_bytes, int rank, int peer)
+      : cap_(ring_bytes) {
+    char* out = ShmRingAt(base, np, ring_bytes, rank, peer);
+    char* in = ShmRingAt(base, np, ring_bytes, peer, rank);
+    out_hdr_ = reinterpret_cast<ShmRingHdr*>(out);
+    out_data_ = out + sizeof(ShmRingHdr);
+    in_hdr_ = reinterpret_cast<ShmRingHdr*>(in);
+    in_data_ = in + sizeof(ShmRingHdr);
+  }
+
+  size_t WriteSome(const char* p, size_t n) override {
+    return ShmRingWrite(out_hdr_, out_data_, cap_, p, n);
+  }
+  size_t ReadSome(char* p, size_t n) override {
+    return ShmRingRead(in_hdr_, in_data_, cap_, p, n);
+  }
+
+ private:
+  ShmRingHdr* out_hdr_;
+  char* out_data_;
+  ShmRingHdr* in_hdr_;
+  char* in_data_;
+  size_t cap_;
+};
+
+}  // namespace acx

@@ -1,4 +1,4 @@
-// tpu-acx: SocketTransport — the multi-process data plane.
+// tpu-acx: StreamTransport — the multi-process data plane.
 //
 // Plays the role the MPI library plays for the reference (SURVEY.md §2 L0;
 // reference src/init.cpp:66-141 posts MPI_Isend/Irecv/Test): nonblocking
@@ -6,13 +6,16 @@
 // channels, and the two control collectives (Barrier, AllreduceInt) the
 // runtime and compat layer need.
 //
-// Wires are AF_UNIX stream socketpairs pre-connected by `acxrun`
-// (tools/acxrun.cc), one per peer, passed down via ACX_FDS. All sockets are
-// nonblocking; Progress() flushes pending writes and drains arrivals, and is
-// driven from Ticket::Test so the proxy's sweep loop is also the transport's
-// progress engine. A single mutex serializes the proxy thread and app
-// threads — the message-rate ceiling of this backend is host-side anyway
-// (on-TPU traffic rides ICI via XLA collectives, not this path).
+// The framing/matching engine is wire-agnostic over Link (src/net/link.h):
+//   * socket plane — AF_UNIX stream socketpairs pre-connected by `acxrun`
+//     (tools/acxrun.cc), one per peer, passed down via ACX_FDS;
+//   * shm plane — SPSC byte rings in a memfd segment created by acxrun
+//     (ACX_SHM_FD), the same-host fast path (no syscalls per message).
+// Progress() flushes pending writes and drains arrivals, and is driven from
+// Ticket::Test so the proxy's sweep loop is also the transport's progress
+// engine. A single mutex serializes the proxy thread and app threads — the
+// message-rate ceiling of this backend is host-side anyway (on-TPU traffic
+// rides ICI via XLA collectives, not this path).
 
 #include "acx/net.h"
 
@@ -20,6 +23,7 @@
 #include <fcntl.h>
 #include <sched.h>
 #include <string.h>
+#include <sys/mman.h>
 #include <unistd.h>
 
 #include <cstdint>
@@ -29,6 +33,8 @@
 #include <memory>
 #include <mutex>
 #include <vector>
+
+#include "src/net/link.h"
 
 namespace acx {
 namespace {
@@ -54,9 +60,14 @@ struct WireHeader {
 };
 #pragma pack(pop)
 
+// Zero-copy send: the wire is fed straight from the user buffer (legal —
+// the caller may not touch it until the ticket completes), so large
+// messages cost exactly one memcpy into the ring / socket.
 struct SendReq {
-  std::vector<char> data;  // header + payload
-  size_t off = 0;
+  WireHeader hdr{};
+  const char* payload = nullptr;  // user buffer, borrowed until done
+  size_t bytes = 0;
+  size_t off = 0;  // progress over [header | payload]
   bool done = false;
   Status st;
 };
@@ -74,44 +85,46 @@ struct Msg {
   std::vector<char> payload;
 };
 
-// Incoming-byte-stream assembly state for one peer socket.
+// Incoming-byte-stream assembly state for one peer link. When the header
+// matches an already-posted recv, payload bytes stream directly into the
+// recv buffer (`direct`); otherwise they assemble into `payload` and queue
+// as an unexpected message.
 struct InState {
   WireHeader hdr{};
   size_t hdr_got = 0;
   std::vector<char> payload;
   size_t payload_got = 0;
+  std::shared_ptr<RecvReq> direct;
 };
 
-class SocketTransport;
+class StreamTransport;
 
 class SockTicket : public Ticket {
  public:
-  SockTicket(SocketTransport* t, std::shared_ptr<SendReq> s)
+  SockTicket(StreamTransport* t, std::shared_ptr<SendReq> s)
       : t_(t), send_(std::move(s)) {}
-  SockTicket(SocketTransport* t, std::shared_ptr<RecvReq> r)
+  SockTicket(StreamTransport* t, std::shared_ptr<RecvReq> r)
       : t_(t), recv_(std::move(r)) {}
   bool Test(Status* st) override;
 
  private:
-  SocketTransport* t_;
+  StreamTransport* t_;
   std::shared_ptr<SendReq> send_;
   std::shared_ptr<RecvReq> recv_;
 };
 
-class SocketTransport : public Transport {
+class StreamTransport : public Transport {
  public:
-  SocketTransport(int rank, int size, std::vector<int> fds)
-      : rank_(rank), size_(size), fds_(std::move(fds)), peers_(size) {
-    for (int i = 0; i < size_; i++) {
-      if (i == rank_ || fds_[i] < 0) continue;
-      const int fl = fcntl(fds_[i], F_GETFL, 0);
-      fcntl(fds_[i], F_SETFL, fl | O_NONBLOCK);
-    }
-  }
+  // links[i] is the wire to rank i (null at i == rank). shm_base/shm_len, if
+  // set, is a mapping to munmap at teardown.
+  StreamTransport(int rank, int size, std::vector<std::unique_ptr<Link>> links,
+                  void* shm_base = nullptr, size_t shm_len = 0)
+      : rank_(rank), size_(size), links_(std::move(links)), peers_(size),
+        shm_base_(shm_base), shm_len_(shm_len) {}
 
-  ~SocketTransport() override {
-    for (int i = 0; i < size_; i++)
-      if (i != rank_ && fds_[i] >= 0) close(fds_[i]);
+  ~StreamTransport() override {
+    links_.clear();
+    if (shm_base_ != nullptr) munmap(shm_base_, shm_len_);
   }
 
   int rank() const override { return rank_; }
@@ -201,6 +214,10 @@ class SocketTransport : public Transport {
 
   Ticket* IsendLocked(const void* buf, size_t bytes, int dst, int tag,
                       int ctx) {
+    if (dst != rank_ && (dst < 0 || dst >= size_ || !links_[dst])) {
+      std::fprintf(stderr, "tpu-acx[%d]: no wire to peer %d\n", rank_, dst);
+      _exit(14);
+    }
     auto s = std::make_shared<SendReq>();
     s->st = Status{rank_, tag, 0, bytes};
     if (dst == rank_) {
@@ -214,10 +231,9 @@ class SocketTransport : public Transport {
       s->done = true;
       return new SockTicket(this, s);
     }
-    WireHeader h{kMagic, tag, ctx, bytes};
-    s->data.resize(sizeof h + bytes);
-    memcpy(s->data.data(), &h, sizeof h);
-    memcpy(s->data.data() + sizeof h, buf, bytes);
+    s->hdr = WireHeader{kMagic, tag, ctx, bytes};
+    s->payload = static_cast<const char*>(buf);
+    s->bytes = bytes;
     peers_[dst].outq.push_back(s);
     FlushOutLocked(dst);
     return new SockTicket(this, s);
@@ -266,20 +282,23 @@ class SocketTransport : public Transport {
     auto& q = peers_[p].outq;
     while (!q.empty()) {
       auto& s = q.front();
-      ssize_t n = write(fds_[p], s->data.data() + s->off,
-                        s->data.size() - s->off);
-      if (n < 0) {
-        if (errno == EAGAIN || errno == EWOULDBLOCK) return;
-        std::fprintf(stderr, "tpu-acx[%d]: write to %d failed: %s\n", rank_,
-                     p, strerror(errno));
-        _exit(14);
+      while (s->off < sizeof(WireHeader)) {
+        size_t n = links_[p]->WriteSome(
+            reinterpret_cast<const char*>(&s->hdr) + s->off,
+            sizeof(WireHeader) - s->off);
+        if (n == 0) return;  // wire full
+        s->off += n;
       }
-      s->off += static_cast<size_t>(n);
-      if (s->off == s->data.size()) {
-        s->done = true;
-        s->data.clear();
-        q.pop_front();
+      const size_t total = sizeof(WireHeader) + s->bytes;
+      while (s->off < total) {
+        size_t n = links_[p]->WriteSome(
+            s->payload + (s->off - sizeof(WireHeader)), total - s->off);
+        if (n == 0) return;
+        s->off += n;
       }
+      s->done = true;
+      s->payload = nullptr;
+      q.pop_front();
     }
   }
 
@@ -287,36 +306,62 @@ class SocketTransport : public Transport {
     InState& in = peers_[p].in;
     for (;;) {
       if (in.hdr_got < sizeof(WireHeader)) {
-        ssize_t n = read(fds_[p], reinterpret_cast<char*>(&in.hdr) + in.hdr_got,
-                         sizeof(WireHeader) - in.hdr_got);
-        if (n <= 0) {
-          if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
-          if (n == 0) return;  // peer exited; pending data already drained
-          std::fprintf(stderr, "tpu-acx[%d]: read from %d failed: %s\n",
-                       rank_, p, strerror(errno));
-          _exit(14);
-        }
-        in.hdr_got += static_cast<size_t>(n);
+        size_t n =
+            links_[p]->ReadSome(reinterpret_cast<char*>(&in.hdr) + in.hdr_got,
+                                sizeof(WireHeader) - in.hdr_got);
+        if (n == 0) return;
+        in.hdr_got += n;
         if (in.hdr_got < sizeof(WireHeader)) return;
         if (in.hdr.magic != kMagic) {
           std::fprintf(stderr, "tpu-acx[%d]: bad wire magic from %d\n", rank_,
                        p);
           _exit(14);
         }
-        in.payload.resize(in.hdr.bytes);
         in.payload_got = 0;
+        // Direct delivery: if a matching recv is already posted, stream the
+        // payload straight into its buffer (one memcpy off the wire). Only
+        // unexpected messages pay the assembly-buffer copy.
+        auto& posted = peers_[p].posted;
+        for (auto it = posted.begin(); it != posted.end(); ++it) {
+          if ((*it)->tag == in.hdr.tag && (*it)->ctx == in.hdr.ctx) {
+            in.direct = *it;
+            posted.erase(it);
+            break;
+          }
+        }
+        if (in.direct == nullptr) in.payload.resize(in.hdr.bytes);
+      }
+      if (in.direct != nullptr) {
+        RecvReq* r = in.direct.get();
+        const size_t deliver =
+            r->bytes < in.hdr.bytes ? r->bytes : in.hdr.bytes;
+        while (in.payload_got < deliver) {
+          size_t n = links_[p]->ReadSome(
+              static_cast<char*>(r->buf) + in.payload_got,
+              deliver - in.payload_got);
+          if (n == 0) return;
+          in.payload_got += n;
+        }
+        // Oversized tail (recv buffer smaller than message): drain + drop.
+        while (in.payload_got < in.hdr.bytes) {
+          char scratch[4096];
+          size_t want = in.hdr.bytes - in.payload_got;
+          if (want > sizeof scratch) want = sizeof scratch;
+          size_t n = links_[p]->ReadSome(scratch, want);
+          if (n == 0) return;
+          in.payload_got += n;
+        }
+        r->st = Status{p, in.hdr.tag, 0, deliver};
+        r->done = true;
+        in.direct.reset();
+        in.hdr_got = 0;
+        continue;
       }
       while (in.payload_got < in.payload.size()) {
-        ssize_t n = read(fds_[p], in.payload.data() + in.payload_got,
-                         in.payload.size() - in.payload_got);
-        if (n <= 0) {
-          if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
-          if (n == 0) return;
-          std::fprintf(stderr, "tpu-acx[%d]: read from %d failed: %s\n",
-                       rank_, p, strerror(errno));
-          _exit(14);
-        }
-        in.payload_got += static_cast<size_t>(n);
+        size_t n = links_[p]->ReadSome(in.payload.data() + in.payload_got,
+                                       in.payload.size() - in.payload_got);
+        if (n == 0) return;
+        in.payload_got += n;
       }
       Msg m;
       m.tag = in.hdr.tag;
@@ -330,7 +375,7 @@ class SocketTransport : public Transport {
 
   void ProgressLocked() {
     for (int p = 0; p < size_; p++) {
-      if (p == rank_) continue;
+      if (p == rank_ || !links_[p]) continue;  // no wire (malformed env)
       FlushOutLocked(p);
       DrainInLocked(p);
     }
@@ -349,9 +394,11 @@ class SocketTransport : public Transport {
   }
 
   int rank_, size_;
-  std::vector<int> fds_;
+  std::vector<std::unique_ptr<Link>> links_;
   std::vector<Peer> peers_;
   std::mutex mu_;
+  void* shm_base_;
+  size_t shm_len_;
 };
 
 bool SockTicket::Test(Status* st) { return t_->TestReq(send_, recv_, st); }
@@ -372,7 +419,7 @@ bool SockTicket::Test(Status* st) { return t_->TestReq(send_, recv_, st); }
 
 class SockPsendChan : public PartitionedChan {
  public:
-  SockPsendChan(SocketTransport* t, const void* buf, int parts, size_t pb,
+  SockPsendChan(StreamTransport* t, const void* buf, int parts, size_t pb,
                 int dst, int tag, int ctx)
       : t_(t), buf_(static_cast<const char*>(buf)), dst_(dst), tag_(tag),
         ctx_(ctx) {
@@ -400,7 +447,7 @@ class SockPsendChan : public PartitionedChan {
   }
 
  private:
-  SocketTransport* t_;
+  StreamTransport* t_;
   const char* buf_;
   int dst_, tag_, ctx_;
   std::vector<std::unique_ptr<Ticket>> inflight_;
@@ -408,7 +455,7 @@ class SockPsendChan : public PartitionedChan {
 
 class SockPrecvChan : public PartitionedChan {
  public:
-  SockPrecvChan(SocketTransport* t, void* buf, int parts, size_t pb, int src,
+  SockPrecvChan(StreamTransport* t, void* buf, int parts, size_t pb, int src,
                 int tag, int ctx)
       : t_(t), buf_(static_cast<char*>(buf)), src_(src), tag_(tag), ctx_(ctx),
         tickets_(parts), done_(parts, false) {
@@ -445,20 +492,20 @@ class SockPrecvChan : public PartitionedChan {
   }
 
  private:
-  SocketTransport* t_;
+  StreamTransport* t_;
   char* buf_;
   int src_, tag_, ctx_;
   std::vector<std::unique_ptr<Ticket>> tickets_;
   std::vector<bool> done_;
 };
 
-PartitionedChan* SocketTransport::PsendInit(const void* buf, int partitions,
+PartitionedChan* StreamTransport::PsendInit(const void* buf, int partitions,
                                             size_t part_bytes, int dst,
                                             int tag, int ctx) {
   return new SockPsendChan(this, buf, partitions, part_bytes, dst, tag, ctx);
 }
 
-PartitionedChan* SocketTransport::PrecvInit(void* buf, int partitions,
+PartitionedChan* StreamTransport::PrecvInit(void* buf, int partitions,
                                             size_t part_bytes, int src,
                                             int tag, int ctx) {
   return new SockPrecvChan(this, buf, partitions, part_bytes, src, tag, ctx);
@@ -468,13 +515,33 @@ PartitionedChan* SocketTransport::PrecvInit(void* buf, int partitions,
 
 Transport* CreateSocketTransport(int rank, int size,
                                  const std::vector<int>& fds) {
-  return new SocketTransport(rank, size, fds);
+  std::vector<std::unique_ptr<Link>> links(size);
+  for (int i = 0; i < size; i++) {
+    if (i == rank || fds[i] < 0) continue;
+    const int fl = fcntl(fds[i], F_GETFL, 0);
+    fcntl(fds[i], F_SETFL, fl | O_NONBLOCK);
+    links[i] = std::make_unique<SockLink>(fds[i], rank, i);
+  }
+  return new StreamTransport(rank, size, std::move(links));
+}
+
+Transport* CreateShmTransport(int rank, int size, void* base,
+                              size_t ring_bytes, size_t owned_len) {
+  std::vector<std::unique_ptr<Link>> links(size);
+  for (int i = 0; i < size; i++) {
+    if (i == rank) continue;
+    links[i] = std::make_unique<ShmLink>(static_cast<char*>(base), size,
+                                         ring_bytes, rank, i);
+  }
+  return new StreamTransport(rank, size, std::move(links),
+                             owned_len != 0 ? base : nullptr, owned_len);
 }
 
 Transport* CreateSelfTransport() {
-  // A SocketTransport of size 1 is pure loopback: every send routes through
-  // DeliverLocked and never touches a socket.
-  return new SocketTransport(0, 1, {-1});
+  // A size-1 StreamTransport is pure loopback: every send routes through
+  // DeliverLocked and never touches a wire.
+  std::vector<std::unique_ptr<Link>> links(1);
+  return new StreamTransport(0, 1, std::move(links));
 }
 
 Transport* CreateTransportFromEnv() {
@@ -482,11 +549,46 @@ Transport* CreateTransportFromEnv() {
   const int size = size_s ? atoi(size_s) : 1;
   if (size <= 1) return CreateSelfTransport();
   const char* rank_s = getenv("ACX_RANK");
-  const char* fds_s = getenv("ACX_FDS");
-  if (!rank_s || !fds_s) {
+  if (!rank_s) {
     std::fprintf(stderr,
-                 "tpu-acx: ACX_SIZE=%d but ACX_RANK/ACX_FDS unset "
-                 "(run under acxrun)\n",
+                 "tpu-acx: ACX_SIZE=%d but ACX_RANK unset (run under acxrun)\n",
+                 size);
+    exit(13);
+  }
+  const int rank = atoi(rank_s);
+
+  // Same-host fast path: the memfd segment acxrun created, unless the user
+  // forces the socket plane with ACX_TRANSPORT=socket.
+  const char* want = getenv("ACX_TRANSPORT");
+  const char* shm_fd_s = getenv("ACX_SHM_FD");
+  if (want != nullptr && strcmp(want, "shm") == 0 && shm_fd_s == nullptr) {
+    // shm requested by name but no segment exists: fail loudly rather than
+    // silently running (and benchmarking) the socket plane.
+    std::fprintf(stderr,
+                 "tpu-acx: ACX_TRANSPORT=shm but no ACX_SHM_FD (launcher "
+                 "could not create the shm segment?)\n");
+    exit(13);
+  }
+  if (shm_fd_s != nullptr && (want == nullptr || strcmp(want, "socket") != 0)) {
+    const int fd = atoi(shm_fd_s);
+    const char* ring_s = getenv("ACX_SHM_RING_BYTES");
+    const size_t ring_bytes = ShmSanitizeRingBytes(
+        ring_s ? strtoull(ring_s, nullptr, 10) : (1u << 18));
+    const size_t len = ShmSegmentBytes(size, ring_bytes);
+    void* base = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    if (base == MAP_FAILED) {
+      std::fprintf(stderr, "tpu-acx: mmap of ACX_SHM_FD failed: %s\n",
+                   strerror(errno));
+      exit(13);
+    }
+    close(fd);
+    return CreateShmTransport(rank, size, base, ring_bytes, len);
+  }
+
+  const char* fds_s = getenv("ACX_FDS");
+  if (!fds_s) {
+    std::fprintf(stderr,
+                 "tpu-acx: ACX_SIZE=%d but ACX_FDS unset (run under acxrun)\n",
                  size);
     exit(13);
   }
@@ -503,7 +605,7 @@ Transport* CreateTransportFromEnv() {
                  fds.size(), size);
     exit(13);
   }
-  return CreateSocketTransport(atoi(rank_s), size, fds);
+  return CreateSocketTransport(rank, size, fds);
 }
 
 }  // namespace acx

@@ -27,7 +27,10 @@ Stream::~Stream() {
 void Stream::Run() {
   std::unique_lock<std::mutex> lk(mu_);
   for (;;) {
-    cv_.wait(lk, [&] { return exit_ || !q_.empty(); });
+    // !busy_ matters: an EnqueueInstant may be running an item inline with
+    // the lock released; popping the next item before it finishes would
+    // break in-order execution.
+    cv_.wait(lk, [&] { return (exit_ || !q_.empty()) && !busy_; });
     if (exit_ && q_.empty()) return;
     auto fn = std::move(q_.front());
     q_.pop_front();
@@ -40,21 +43,57 @@ void Stream::Run() {
   }
 }
 
+// Capture mode: record fn as a graph node chained after the capture tail so
+// replay preserves enqueue order. Returns false when not capturing. Caller
+// holds mu_.
+bool Stream::RecordIfCapturingLocked(std::function<void()>& fn) {
+  if (capture_ == nullptr) return false;
+  std::vector<GraphNode*> deps;
+  if (capture_tail_ != nullptr)
+    deps.push_back(static_cast<GraphNode*>(capture_tail_));
+  capture_tail_ = capture_->AddNode(std::move(fn), deps);
+  return true;
+}
+
 void Stream::Enqueue(std::function<void()> fn) {
   {
     std::lock_guard<std::mutex> lk(mu_);
-    if (capture_ != nullptr) {
-      // Record instead of execute: chain after the capture tail so replay
-      // preserves enqueue order.
-      std::vector<GraphNode*> deps;
-      if (capture_tail_ != nullptr)
-        deps.push_back(static_cast<GraphNode*>(capture_tail_));
-      capture_tail_ = capture_->AddNode(std::move(fn), deps);
-      return;
-    }
+    if (RecordIfCapturingLocked(fn)) return;
     q_.push_back(std::move(fn));
   }
   cv_.notify_all();
+}
+
+void Stream::EnqueueInstant(std::function<void()> fn) {
+  std::unique_lock<std::mutex> lk(mu_);
+  if (RecordIfCapturingLocked(fn)) return;
+  if (!q_.empty() || busy_) {
+    q_.push_back(std::move(fn));
+    lk.unlock();
+    cv_.notify_all();
+    return;
+  }
+  // Queue idle: an in-order queue has already "reached" this point, so the
+  // item may run right here — saves the worker-thread context switch (the
+  // dominant enqueue cost on shared-core hosts). Run it as the worker
+  // would: busy_ held, lock released (fn may drive transport progress, so
+  // it must not hold mu_ and stall concurrent Enqueue/Sync). busy_ keeps
+  // the worker and other EnqueueInstant callers ordered behind us.
+  busy_ = true;
+  lk.unlock();
+  fn();
+  lk.lock();
+  busy_ = false;
+  // Wake the worker only if it has something to act on (items queued while
+  // fn ran, or a pending exit) — an unconditional notify would futex-wake
+  // the idle worker on every inline op, costing a context switch on
+  // shared-core hosts. Sync waiters are gated on busy_ too, so tell them
+  // when the stream drains.
+  const bool wake_worker = !q_.empty() || exit_;
+  const bool drained = q_.empty();
+  lk.unlock();
+  if (wake_worker) cv_.notify_all();
+  if (drained) done_cv_.notify_all();
 }
 
 void Stream::Sync() {

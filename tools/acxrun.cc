@@ -1,11 +1,15 @@
 // acxrun — tpu-acx process launcher.
 //
 // Plays the role `mpiexec -np N` plays for the reference (reference
-// README.md:99-103): spawns N ranks of a program on this host with a fully
-// connected mesh of AF_UNIX socketpairs, which SocketTransport
-// (src/net/socket_transport.cc) picks up via ACX_RANK / ACX_SIZE / ACX_FDS.
+// README.md:99-103): spawns N ranks of a program on this host with two
+// pre-wired data planes the transport picks from at init:
+//   * a shared-memory segment (memfd) of SPSC rings, the same-host fast
+//     path (ACX_SHM_FD / ACX_SHM_RING_BYTES), and
+//   * a fully connected mesh of AF_UNIX socketpairs (ACX_FDS).
+// Ranks default to shm; `-transport socket` (or env ACX_TRANSPORT=socket)
+// selects the socket plane.
 //
-// Usage: acxrun -np N [-timeout SECONDS] prog [args...]
+// Usage: acxrun -np N [-timeout SECONDS] [-transport shm|socket] prog [args...]
 //
 // Exit status: 0 iff every rank exited 0. If any rank exits nonzero or a
 // timeout fires, the remaining ranks are killed (matching mpiexec behavior
@@ -16,6 +20,7 @@
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/types.h>
 #include <sys/wait.h>
@@ -24,14 +29,19 @@
 #include <string>
 #include <vector>
 
+#include "src/net/link.h"
+
 static void usage() {
-  fprintf(stderr, "usage: acxrun -np N [-timeout SEC] prog [args...]\n");
+  fprintf(stderr,
+          "usage: acxrun -np N [-timeout SEC] [-transport shm|socket] "
+          "prog [args...]\n");
   exit(2);
 }
 
 int main(int argc, char** argv) {
   int np = -1;
   int timeout_s = 120;
+  const char* transport = nullptr;  // nullptr = leave env as-is (default shm)
   int argi = 1;
   while (argi < argc && argv[argi][0] == '-') {
     if (!strcmp(argv[argi], "-np") && argi + 1 < argc) {
@@ -40,11 +50,44 @@ int main(int argc, char** argv) {
     } else if (!strcmp(argv[argi], "-timeout") && argi + 1 < argc) {
       timeout_s = atoi(argv[argi + 1]);
       argi += 2;
+    } else if (!strcmp(argv[argi], "-transport") && argi + 1 < argc) {
+      transport = argv[argi + 1];
+      argi += 2;
     } else {
       usage();
     }
   }
   if (np < 1 || argi >= argc) usage();
+  if (transport != nullptr && strcmp(transport, "shm") != 0 &&
+      strcmp(transport, "socket") != 0) {
+    fprintf(stderr, "acxrun: unknown -transport '%s' (want shm or socket)\n",
+            transport);
+    return 2;
+  }
+
+  // Shared-memory plane: one memfd of np*(np-1) directed rings. The fd is
+  // inherited across fork+exec (no MFD_CLOEXEC); each rank mmaps it.
+  const char* ring_env = getenv("ACX_SHM_RING_BYTES");
+  const size_t ring_bytes = acx::ShmSanitizeRingBytes(
+      ring_env ? strtoull(ring_env, nullptr, 10) : (1u << 18));
+  int shm_fd = -1;
+  if (np > 1) {
+    shm_fd = memfd_create("acx-shm", 0);
+    if (shm_fd < 0) {
+      perror("acxrun: memfd_create (shm plane disabled)");
+    } else if (ftruncate(shm_fd,
+                         (off_t)acx::ShmSegmentBytes(np, ring_bytes)) != 0) {
+      perror("acxrun: ftruncate (shm plane disabled)");
+      close(shm_fd);
+      shm_fd = -1;
+    }
+    if (shm_fd < 0 && transport != nullptr && strcmp(transport, "shm") == 0) {
+      // shm was requested by name: fail loudly rather than silently
+      // benchmarking the socket plane.
+      fprintf(stderr, "acxrun: -transport shm requested but unavailable\n");
+      return 2;
+    }
+  }
 
   // fd_of[i][j] = fd rank i uses to talk to rank j.
   std::vector<std::vector<int>> fd_of(np, std::vector<int>(np, -1));
@@ -83,6 +126,11 @@ int main(int argc, char** argv) {
       setenv("ACX_RANK", std::to_string(r).c_str(), 1);
       setenv("ACX_SIZE", std::to_string(np).c_str(), 1);
       setenv("ACX_FDS", fds.c_str(), 1);
+      if (shm_fd >= 0) {
+        setenv("ACX_SHM_FD", std::to_string(shm_fd).c_str(), 1);
+        setenv("ACX_SHM_RING_BYTES", std::to_string(ring_bytes).c_str(), 1);
+      }
+      if (transport != nullptr) setenv("ACX_TRANSPORT", transport, 1);
       execvp(argv[argi], &argv[argi]);
       fprintf(stderr, "acxrun: exec %s failed: %s\n", argv[argi],
               strerror(errno));
@@ -95,6 +143,7 @@ int main(int argc, char** argv) {
   for (int i = 0; i < np; i++)
     for (int j = 0; j < np; j++)
       if (fd_of[i][j] >= 0) close(fd_of[i][j]);
+  if (shm_fd >= 0) close(shm_fd);
 
   // SIGALRM must interrupt wait() (no SA_RESTART) rather than kill us.
   struct sigaction sa {};
