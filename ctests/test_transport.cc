@@ -303,6 +303,12 @@ void test_truncated_recv(Wire w) {
     WaitDone(r.get(), &st);
     CHECK(st.bytes == sizeof small);
     CHECK(memcmp(small, msg, sizeof small) == 0);
+    // Satisfy the probe before `dummy` leaves scope — a posted RecvReq
+    // holds the buffer pointer for as long as it stays unmatched.
+    int one = 1;
+    std::unique_ptr<acx::Ticket> ps(p.t0->Isend(&one, sizeof one, 1, 99, 0));
+    WaitDone(probe.get(), nullptr);
+    WaitDone(ps.get(), nullptr);
   }
   std::printf("  truncated recv, direct + unexpected (%s): ok\n", WireName(w));
 }

@@ -1,0 +1,32 @@
+"""Pallas TPU kernels — the device-side half of the framework.
+
+The reference exposes two `__device__` functions a CUDA kernel can call
+mid-execution: ``MPIX_Pready`` (store PENDING into a host-mapped flag word,
+reference partitioned.cu:200-212) and ``MPIX_Parrived`` (poll a flag word
+for COMPLETED, partitioned.cu:215-231). On TPU the analogue is a Pallas
+kernel operating on an in-HBM flag buffer: :mod:`mpi_acx_tpu.ops.flags`
+provides ``pready`` / ``parrived`` / fused produce-and-signal kernels with
+identical state-machine semantics (state values shared with the native
+runtime, include/acx/state.h).
+
+:mod:`mpi_acx_tpu.ops.attention` provides the blockwise-causal flash
+attention kernel used by the model families — the MXU hot op.
+"""
+
+from mpi_acx_tpu.ops.flags import (  # noqa: F401
+    AVAILABLE,
+    RESERVED,
+    PENDING,
+    ISSUED,
+    COMPLETED,
+    CLEANUP,
+    pready,
+    pready_many,
+    parrived,
+    parrived_all,
+    produce_and_pready,
+)
+from mpi_acx_tpu.ops.attention import (  # noqa: F401
+    flash_attention,
+    attention_reference,
+)

@@ -1,0 +1,141 @@
+"""Blockwise-causal flash attention as a Pallas TPU kernel.
+
+The MXU hot op of every model family in :mod:`mpi_acx_tpu.models`. Online
+softmax over key/value blocks (never materializes the [S, S] score matrix),
+f32 accumulators, bf16-friendly matmuls with ``preferred_element_type`` so
+both dots land on the MXU at full rate. Causal blocks above the diagonal
+are skipped entirely (the inner loop's trip count is ``i + 1``), so the
+kernel does ~half the FLOPs of the dense-mask reference implementation and
+O(S) memory instead of O(S^2).
+
+This is also the single-chip building block of
+:func:`mpi_acx_tpu.parallel.ring_attention.ring_attention`: ring attention
+rotates K/V shards around the mesh while each step runs exactly this
+blockwise inner kernel on the resident shard.
+
+Runs compiled on TPU; falls back to Pallas interpret mode elsewhere (the
+CPU test mesh), same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128
+_NEG_INF = -1e30
+
+
+def attention_reference(q, k, v, causal: bool = True):
+    """Dense-mask reference attention, [B, S, H, D] layout; f32 softmax.
+    Ground truth for the kernel's numerics tests."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    logits = logits / jnp.sqrt(d)
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool))
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, scale,
+                  causal):
+    """One (batch, head, q-block) program: online softmax over k blocks.
+
+    Dots run in the input dtype with f32 accumulation; for f32 inputs the
+    MXU is asked for HIGHEST precision (its default f32 path is bf16-pass
+    multiplication, ~1e-2 absolute error — measured on v5e)."""
+    i = pl.program_id(2)
+    q = q_ref[0, 0]                                      # [BQ, D], input dtype
+    prec = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    if causal:
+        n_kv = i + 1                                     # skip above diagonal
+    else:
+        n_kv = pl.num_programs(2) * block_q // block_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec) * scale                      # [BQ, BK] f32
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = corr * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = corr * acc + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128):
+    """Flash attention, [B, S, H, D] in / [B, S, H, D] out.
+
+    D is zero-padded to the 128-lane width (padding contributes nothing to
+    the logits and is sliced off the output). S must divide by the block
+    sizes (clamped to S for short sequences).
+    """
+    B, S, H, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    assert S % block_q == 0 and S % block_k == 0, (S, block_q, block_k)
+    scale = 1.0 / (D ** 0.5)
+
+    def to_bhsd(x):
+        x = jnp.transpose(x, (0, 2, 1, 3))               # [B, H, S, D]
+        if D < _LANE:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, _LANE - D)))
+        return x
+
+    qt, kt, vt = to_bhsd(q), to_bhsd(k), to_bhsd(v)
+    dp = qt.shape[-1]
+
+    kernel = functools.partial(_flash_kernel, block_q=block_q,
+                               block_k=block_k, scale=scale, causal=causal)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, H, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, dp), lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S, dp), lambda b, h, i: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S, dp), lambda b, h, i: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, dp),
+                               lambda b, h, i: (b, h, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, dp), q.dtype),
+        interpret=jax.default_backend() != "tpu",
+    )(qt, kt, vt)
+
+    out = out[..., :D]                                   # drop lane padding
+    return jnp.transpose(out, (0, 2, 1, 3))              # [B, S, H, D]

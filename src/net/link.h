@@ -126,6 +126,12 @@ inline size_t ShmSanitizeRingBytes(uint64_t v) {
   return static_cast<size_t>((v + 63) & ~uint64_t{63});
 }
 
+// Default per-direction ring capacity when ACX_SHM_RING_BYTES is unset.
+// Segment length is derived from ring size with no metadata block, so the
+// launcher (which sizes the memfd) and every rank (which maps it) must use
+// the same default — keep this the single definition.
+inline constexpr size_t kShmDefaultRingBytes = 1u << 18;
+
 // Segment geometry: np*(np-1) directed rings, one per ordered rank pair,
 // laid out densely. Ring for (i -> j), j != i, lives at slot
 // i*(np-1) + (j<i ? j : j-1). Derived identically by acxrun (which sizes the

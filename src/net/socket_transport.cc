@@ -240,6 +240,12 @@ class StreamTransport : public Transport {
   }
 
   Ticket* IrecvLocked(void* buf, size_t bytes, int src, int tag, int ctx) {
+    // Same loud failure as IsendLocked: a recv from a wireless peer would
+    // otherwise sit in `posted` forever (ProgressLocked skips null links).
+    if (src != rank_ && (src < 0 || src >= size_ || !links_[src])) {
+      std::fprintf(stderr, "tpu-acx[%d]: no wire to peer %d\n", rank_, src);
+      _exit(14);
+    }
     auto r = std::make_shared<RecvReq>();
     r->buf = buf;
     r->bytes = bytes;
@@ -573,7 +579,7 @@ Transport* CreateTransportFromEnv() {
     const int fd = atoi(shm_fd_s);
     const char* ring_s = getenv("ACX_SHM_RING_BYTES");
     const size_t ring_bytes = ShmSanitizeRingBytes(
-        ring_s ? strtoull(ring_s, nullptr, 10) : (1u << 18));
+        ring_s ? strtoull(ring_s, nullptr, 10) : kShmDefaultRingBytes);
     const size_t len = ShmSegmentBytes(size, ring_bytes);
     void* base = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
     if (base == MAP_FAILED) {

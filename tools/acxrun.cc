@@ -69,7 +69,7 @@ int main(int argc, char** argv) {
   // inherited across fork+exec (no MFD_CLOEXEC); each rank mmaps it.
   const char* ring_env = getenv("ACX_SHM_RING_BYTES");
   const size_t ring_bytes = acx::ShmSanitizeRingBytes(
-      ring_env ? strtoull(ring_env, nullptr, 10) : (1u << 18));
+      ring_env ? strtoull(ring_env, nullptr, 10) : acx::kShmDefaultRingBytes);
   int shm_fd = -1;
   if (np > 1) {
     shm_fd = memfd_create("acx-shm", 0);
