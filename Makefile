@@ -109,6 +109,9 @@ check: ctest itest tools
 	@for t in $(CTEST_BINS); do echo "== $$t"; $$t || exit 1; done
 	@for t in $(ITEST_BINS); do echo "== acxrun -np 2 $$t (shm)"; $(BUILD)/acxrun -np 2 $$t || exit 1; done
 	@for t in $(ITEST_BINS); do echo "== acxrun -np 2 $$t (socket)"; $(BUILD)/acxrun -np 2 -transport socket $$t || exit 1; done
+	@for t in $(ITEST_BINS); do echo "== acxrun -np 2 $$t (rendezvous-all)"; ACX_RV_THRESHOLD=1 $(BUILD)/acxrun -np 2 $$t || exit 1; done
+	@for t in $(ITEST_BINS); do echo "== acxrun -np 2 $$t (rendezvous-nack)"; ACX_RV_THRESHOLD=1 ACX_RV_FORCE_FALLBACK=1 $(BUILD)/acxrun -np 2 $$t || exit 1; done
+	@for t in $(ITEST_BINS); do echo "== acxrun -np 2 $$t (rendezvous-socket)"; ACX_RV_THRESHOLD=1 $(BUILD)/acxrun -np 2 -transport socket $$t || exit 1; done
 	@echo "ALL NATIVE TESTS PASSED"
 
 # Header dependency tracking (-MMD): a header edit rebuilds its users.

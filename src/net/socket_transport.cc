@@ -24,7 +24,12 @@
 #include <sched.h>
 #include <string.h>
 #include <sys/mman.h>
+#include <sys/prctl.h>
+#include <sys/uio.h>
 #include <unistd.h>
+
+#include <climits>
+#include <unordered_map>
 
 #include <cstdint>
 #include <cstdio>
@@ -40,11 +45,21 @@ namespace acx {
 namespace {
 
 constexpr uint32_t kMagic = 0xAC0C0101u;
+// Rendezvous frames (large-message single-copy path, same host only):
+// an RTS frame advertises {addr, seq, pid} of the sender's buffer; the
+// receiver pulls the payload with one process_vm_readv straight into the
+// destination (the copy-through-the-ring path costs two copies) and acks.
+// A nack (ok=0, e.g. pvread denied by a hardened kernel) makes the sender
+// re-send the payload as a normal copy frame on a private (seq, ctx) key.
+constexpr uint32_t kMagicRts = 0xAC0C0102u;
+constexpr uint32_t kMagicAck = 0xAC0C0103u;
 
 // Internal context ids. User contexts are >= 0; the control plane and the
 // partitioned layer get their own namespaces so they can never match user
 // point-to-point traffic.
 constexpr int kCtrlCtx = -2;
+constexpr int kRvDataCtx = -3;  // rendezvous-fallback payload frames
+constexpr size_t kRvDefaultThreshold = 256u << 10;
 inline int PartCtx(int ctx) { return -1000 - ctx; }
 // Partition p of a tag-tagged partitioned channel travels as its own
 // message; 4096 partitions per channel (the reference's whole slot table is
@@ -58,6 +73,15 @@ struct WireHeader {
   int32_t ctx;
   uint64_t bytes;
 };
+struct RvDesc {  // RTS wire payload
+  uint64_t addr;
+  uint32_t seq;
+  int32_t pid;
+};
+struct RvAck {  // ACK wire payload
+  uint32_t seq;
+  int32_t ok;
+};
 #pragma pack(pop)
 
 // Zero-copy send: the wire is fed straight from the user buffer (legal —
@@ -66,9 +90,13 @@ struct WireHeader {
 struct SendReq {
   WireHeader hdr{};
   const char* payload = nullptr;  // user buffer, borrowed until done
-  size_t bytes = 0;
-  size_t off = 0;  // progress over [header | payload]
+  size_t bytes = 0;               // user message length (== hdr.bytes)
+  const char* wire_payload = nullptr;  // what actually goes on the wire
+  size_t wire_bytes = 0;               // (== payload/bytes except RTS/ACK)
+  size_t off = 0;  // progress over [header | wire payload]
+  bool rv = false;  // rendezvous: wire completion != user completion
   bool done = false;
+  char desc[16];  // storage for RTS/ACK wire payloads
   Status st;
 };
 
@@ -76,6 +104,9 @@ struct RecvReq {
   void* buf = nullptr;
   size_t bytes = 0;
   int src = -1, tag = 0, ctx = 0;
+  // Rendezvous fallback rewrites the matching key to (seq, kRvDataCtx);
+  // report_tag preserves the user-visible tag for the Status.
+  int report_tag = INT_MIN;
   bool done = false;
   Status st;
 };
@@ -83,6 +114,9 @@ struct RecvReq {
 struct Msg {
   int tag = 0, ctx = 0;
   std::vector<char> payload;
+  bool rv = false;  // unexpected RTS: payload empty, fields below valid
+  RvDesc rv_desc{};
+  uint64_t rv_bytes = 0;  // full message length advertised by the RTS
 };
 
 // Incoming-byte-stream assembly state for one peer link. When the header
@@ -120,7 +154,23 @@ class StreamTransport : public Transport {
   StreamTransport(int rank, int size, std::vector<std::unique_ptr<Link>> links,
                   void* shm_base = nullptr, size_t shm_len = 0)
       : rank_(rank), size_(size), links_(std::move(links)), peers_(size),
-        shm_base_(shm_base), shm_len_(shm_len) {}
+        shm_base_(shm_base), shm_len_(shm_len) {
+    const char* e = getenv("ACX_RV_THRESHOLD");
+    if (e != nullptr) {
+      const unsigned long long v = strtoull(e, nullptr, 10);
+      rv_threshold_ = v == 0 ? SIZE_MAX : static_cast<size_t>(v);
+    }
+    // Test hook: pretend every pvread fails so the nack/copy-fallback
+    // path (the behavior on ptrace-hardened kernels) gets exercised.
+    const char* ff = getenv("ACX_RV_FORCE_FALLBACK");
+    rv_force_fallback_ = ff != nullptr && atoi(ff) != 0;
+#ifdef PR_SET_PTRACER
+    // Let sibling ranks process_vm_readv our send buffers even under
+    // Yama ptrace_scope=1 (no-op where Yama is absent; nack path covers
+    // kernels where this still isn't enough).
+    if (size_ > 1) prctl(PR_SET_PTRACER, PR_SET_PTRACER_ANY, 0, 0, 0);
+#endif
+  }
 
   ~StreamTransport() override {
     links_.clear();
@@ -231,9 +281,25 @@ class StreamTransport : public Transport {
       s->done = true;
       return new SockTicket(this, s);
     }
-    s->hdr = WireHeader{kMagic, tag, ctx, bytes};
     s->payload = static_cast<const char*>(buf);
     s->bytes = bytes;
+    if (bytes >= rv_threshold_) {
+      // Rendezvous: put a 16-byte RTS on the wire instead of the payload;
+      // completion comes from the receiver's ACK (HandleAckLocked).
+      const uint32_t seq = rv_next_seq_++;
+      s->hdr = WireHeader{kMagicRts, tag, ctx, bytes};
+      RvDesc d{reinterpret_cast<uint64_t>(buf), seq, getpid()};
+      static_assert(sizeof d <= sizeof s->desc, "desc too small");
+      memcpy(s->desc, &d, sizeof d);
+      s->wire_payload = s->desc;
+      s->wire_bytes = sizeof d;
+      s->rv = true;
+      rv_pending_[seq] = s;
+    } else {
+      s->hdr = WireHeader{kMagic, tag, ctx, bytes};
+      s->wire_payload = s->payload;
+      s->wire_bytes = bytes;
+    }
     peers_[dst].outq.push_back(s);
     FlushOutLocked(dst);
     return new SockTicket(this, s);
@@ -256,7 +322,11 @@ class StreamTransport : public Transport {
     auto& q = peers_[src].arrived;
     for (auto it = q.begin(); it != q.end(); ++it) {
       if (it->tag == tag && it->ctx == ctx) {
-        CompleteRecv(r.get(), src, *it);
+        if (it->rv) {
+          CompleteRvLocked(src, r, it->tag, it->rv_bytes, it->rv_desc);
+        } else {
+          CompleteRecv(r.get(), src, *it);
+        }
         q.erase(it);
         return new SockTicket(this, r);
       }
@@ -268,16 +338,85 @@ class StreamTransport : public Transport {
   static void CompleteRecv(RecvReq* r, int src, const Msg& m) {
     const size_t n = m.payload.size() < r->bytes ? m.payload.size() : r->bytes;
     memcpy(r->buf, m.payload.data(), n);
-    r->st = Status{src, m.tag, 0, n};
+    r->st = Status{src, r->report_tag != INT_MIN ? r->report_tag : m.tag, 0, n};
     r->done = true;
+  }
+
+  // Pull an RTS-advertised payload straight out of the sender's address
+  // space (one copy), then ack. On pvread failure, nack and repost the recv
+  // on the private fallback key the sender will use for the copy re-send.
+  void CompleteRvLocked(int src, const std::shared_ptr<RecvReq>& r, int tag,
+                        uint64_t full_bytes, const RvDesc& d) {
+    const size_t deliver = r->bytes < full_bytes ? r->bytes : full_bytes;
+    size_t got = 0;
+    if (!rv_force_fallback_) {
+      // Loop: one process_vm_readv call moves at most MAX_RW_COUNT
+      // (~2 GiB), so giant messages take several calls.
+      while (got < deliver) {
+        struct iovec liov{static_cast<char*>(r->buf) + got, deliver - got};
+        struct iovec riov{reinterpret_cast<void*>(d.addr + got),
+                          deliver - got};
+        const ssize_t n = process_vm_readv(d.pid, &liov, 1, &riov, 1, 0);
+        if (n <= 0) break;
+        got += static_cast<size_t>(n);
+      }
+    }
+    const bool ok = !rv_force_fallback_ && got == deliver;
+    if (ok) {
+      r->st = Status{src, tag, 0, deliver};
+      r->done = true;
+    } else {
+      r->report_tag = tag;
+      r->tag = static_cast<int>(d.seq & 0x7fffffff);
+      r->ctx = kRvDataCtx;
+      peers_[src].posted.push_back(r);
+    }
+    SendAckLocked(src, d.seq, ok);
+  }
+
+  void SendAckLocked(int dst, uint32_t seq, bool ok) {
+    auto s = std::make_shared<SendReq>();
+    s->hdr = WireHeader{kMagicAck, 0, 0, 0};
+    RvAck a{seq, ok ? 1 : 0};
+    memcpy(s->desc, &a, sizeof a);
+    s->wire_payload = s->desc;
+    s->wire_bytes = sizeof a;
+    peers_[dst].outq.push_back(std::move(s));
+    FlushOutLocked(dst);
+  }
+
+  void HandleAckLocked(int src, const RvAck& a) {
+    auto it = rv_pending_.find(a.seq);
+    if (it == rv_pending_.end()) return;  // duplicate/stale ack
+    std::shared_ptr<SendReq> s = it->second;
+    rv_pending_.erase(it);
+    if (a.ok) {
+      s->done = true;
+      return;
+    }
+    // Receiver couldn't pvread: re-send as a normal copy frame on the
+    // fallback key it just posted.
+    s->rv = false;
+    s->hdr = WireHeader{kMagic, static_cast<int>(a.seq & 0x7fffffff),
+                        kRvDataCtx, s->bytes};
+    s->wire_payload = s->payload;
+    s->wire_bytes = s->bytes;
+    s->off = 0;
+    peers_[src].outq.push_back(std::move(s));
+    FlushOutLocked(src);
   }
 
   void DeliverLocked(int src, Msg&& m) {
     auto& posted = peers_[src].posted;
     for (auto it = posted.begin(); it != posted.end(); ++it) {
       if ((*it)->tag == m.tag && (*it)->ctx == m.ctx) {
-        CompleteRecv(it->get(), src, m);
+        std::shared_ptr<RecvReq> r = *it;
         posted.erase(it);
+        if (m.rv) {
+          CompleteRvLocked(src, r, m.tag, m.rv_bytes, m.rv_desc);
+        } else {
+          CompleteRecv(r.get(), src, m);
+        }
         return;
       }
     }
@@ -295,15 +434,19 @@ class StreamTransport : public Transport {
         if (n == 0) return;  // wire full
         s->off += n;
       }
-      const size_t total = sizeof(WireHeader) + s->bytes;
+      const size_t total = sizeof(WireHeader) + s->wire_bytes;
       while (s->off < total) {
         size_t n = links_[p]->WriteSome(
-            s->payload + (s->off - sizeof(WireHeader)), total - s->off);
+            s->wire_payload + (s->off - sizeof(WireHeader)), total - s->off);
         if (n == 0) return;
         s->off += n;
       }
-      s->done = true;
-      s->payload = nullptr;
+      if (!s->rv) {
+        // Rendezvous sends stay pending (and keep borrowing the user
+        // buffer) until the receiver's ACK arrives.
+        s->done = true;
+        s->payload = nullptr;
+      }
       q.pop_front();
     }
   }
@@ -318,24 +461,31 @@ class StreamTransport : public Transport {
         if (n == 0) return;
         in.hdr_got += n;
         if (in.hdr_got < sizeof(WireHeader)) return;
-        if (in.hdr.magic != kMagic) {
+        in.payload_got = 0;
+        if (in.hdr.magic == kMagicRts) {
+          in.direct.reset();
+          in.payload.resize(sizeof(RvDesc));
+        } else if (in.hdr.magic == kMagicAck) {
+          in.direct.reset();
+          in.payload.resize(sizeof(RvAck));
+        } else if (in.hdr.magic == kMagic) {
+          // Direct delivery: if a matching recv is already posted, stream
+          // the payload straight into its buffer (one memcpy off the wire).
+          // Only unexpected messages pay the assembly-buffer copy.
+          auto& posted = peers_[p].posted;
+          for (auto it = posted.begin(); it != posted.end(); ++it) {
+            if ((*it)->tag == in.hdr.tag && (*it)->ctx == in.hdr.ctx) {
+              in.direct = *it;
+              posted.erase(it);
+              break;
+            }
+          }
+          if (in.direct == nullptr) in.payload.resize(in.hdr.bytes);
+        } else {
           std::fprintf(stderr, "tpu-acx[%d]: bad wire magic from %d\n", rank_,
                        p);
           _exit(14);
         }
-        in.payload_got = 0;
-        // Direct delivery: if a matching recv is already posted, stream the
-        // payload straight into its buffer (one memcpy off the wire). Only
-        // unexpected messages pay the assembly-buffer copy.
-        auto& posted = peers_[p].posted;
-        for (auto it = posted.begin(); it != posted.end(); ++it) {
-          if ((*it)->tag == in.hdr.tag && (*it)->ctx == in.hdr.ctx) {
-            in.direct = *it;
-            posted.erase(it);
-            break;
-          }
-        }
-        if (in.direct == nullptr) in.payload.resize(in.hdr.bytes);
       }
       if (in.direct != nullptr) {
         RecvReq* r = in.direct.get();
@@ -357,7 +507,9 @@ class StreamTransport : public Transport {
           if (n == 0) return;
           in.payload_got += n;
         }
-        r->st = Status{p, in.hdr.tag, 0, deliver};
+        r->st = Status{
+            p, r->report_tag != INT_MIN ? r->report_tag : in.hdr.tag, 0,
+            deliver};
         r->done = true;
         in.direct.reset();
         in.hdr_got = 0;
@@ -369,13 +521,31 @@ class StreamTransport : public Transport {
         if (n == 0) return;
         in.payload_got += n;
       }
-      Msg m;
-      m.tag = in.hdr.tag;
-      m.ctx = in.hdr.ctx;
-      m.payload = std::move(in.payload);
-      in.payload.clear();
-      in.hdr_got = 0;
-      DeliverLocked(p, std::move(m));
+      if (in.hdr.magic == kMagicRts) {
+        Msg m;
+        m.tag = in.hdr.tag;
+        m.ctx = in.hdr.ctx;
+        m.rv = true;
+        memcpy(&m.rv_desc, in.payload.data(), sizeof m.rv_desc);
+        m.rv_bytes = in.hdr.bytes;
+        in.payload.clear();
+        in.hdr_got = 0;
+        DeliverLocked(p, std::move(m));
+      } else if (in.hdr.magic == kMagicAck) {
+        RvAck a;
+        memcpy(&a, in.payload.data(), sizeof a);
+        in.payload.clear();
+        in.hdr_got = 0;
+        HandleAckLocked(p, a);
+      } else {
+        Msg m;
+        m.tag = in.hdr.tag;
+        m.ctx = in.hdr.ctx;
+        m.payload = std::move(in.payload);
+        in.payload.clear();
+        in.hdr_got = 0;
+        DeliverLocked(p, std::move(m));
+      }
     }
   }
 
@@ -405,6 +575,10 @@ class StreamTransport : public Transport {
   std::mutex mu_;
   void* shm_base_;
   size_t shm_len_;
+  size_t rv_threshold_ = kRvDefaultThreshold;
+  bool rv_force_fallback_ = false;
+  uint32_t rv_next_seq_ = 1;
+  std::unordered_map<uint32_t, std::shared_ptr<SendReq>> rv_pending_;
 };
 
 bool SockTicket::Test(Status* st) { return t_->TestReq(send_, recv_, st); }

@@ -82,19 +82,23 @@ int main(int argc, char** argv) {
       MPIX_Precv_init(pbuf.data(), parts, (MPI_Count)(total / parts),
                       MPI_BYTE, peer, 7, MPI_COMM_WORLD, MPI_INFO_NULL,
                       &preq);
-    const int rounds = 20;
-    MPI_Barrier(MPI_COMM_WORLD);
-    auto t0 = Clock::now();
-    for (int r = 0; r < rounds; r++) {
-      MPIX_Start(&preq);
-      if (rank == 0) {
-        for (int p = parts - 1; p >= 0; p--) MPIX_Pready(p, &preq);
+    // Best of 3 sets x 20 rounds: the first set absorbs cold page faults
+    // on the shm rings and destination buffer; report steady-state BW.
+    const int rounds = 20, sets = 3;
+    for (int set = 0; set < sets; set++) {
+      MPI_Barrier(MPI_COMM_WORLD);
+      auto t0 = Clock::now();
+      for (int r = 0; r < rounds; r++) {
+        MPIX_Start(&preq);
+        if (rank == 0) {
+          for (int p = parts - 1; p >= 0; p--) MPIX_Pready(p, &preq);
+        }
+        MPIX_Wait(&preq, MPI_STATUS_IGNORE);
       }
-      MPIX_Wait(&preq, MPI_STATUS_IGNORE);
+      MPI_Barrier(MPI_COMM_WORLD);
+      double secs = us_since(t0) / 1e6;
+      gbps = std::max(gbps, (double)total * rounds / secs / 1e9);
     }
-    MPI_Barrier(MPI_COMM_WORLD);
-    double secs = us_since(t0) / 1e6;
-    gbps = (double)total * rounds / secs / 1e9;
     MPIX_Request_free(&preq);
   }
 
