@@ -15,6 +15,11 @@ from mpi_acx_tpu.models.transformer import (  # noqa: F401
     init_params,
     forward,
     loss_fn,
+    init_kv_cache,
+    prefill,
+    decode_step,
+    generate,
+    cast_params,
 )
 from mpi_acx_tpu.models.moe import (  # noqa: F401
     MoeConfig,

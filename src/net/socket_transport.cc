@@ -167,8 +167,10 @@ class StreamTransport : public Transport {
 #ifdef PR_SET_PTRACER
     // Let sibling ranks process_vm_readv our send buffers even under
     // Yama ptrace_scope=1 (no-op where Yama is absent; nack path covers
-    // kernels where this still isn't enough).
-    if (size_ > 1) prctl(PR_SET_PTRACER, PR_SET_PTRACER_ANY, 0, 0, 0);
+    // kernels where this still isn't enough). Skipped when the rendezvous
+    // path is disabled so ACX_RV_THRESHOLD=0 keeps ptrace hardening intact.
+    if (size_ > 1 && rv_threshold_ != SIZE_MAX)
+      prctl(PR_SET_PTRACER, PR_SET_PTRACER_ANY, 0, 0, 0);
 #endif
   }
 
