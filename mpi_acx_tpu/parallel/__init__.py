@@ -31,3 +31,4 @@ from mpi_acx_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_forward,
     pipeline_loss,
 )
+from mpi_acx_tpu.parallel import multihost  # noqa: F401

@@ -1,0 +1,127 @@
+"""Multi-host (multi-process) distributed runtime.
+
+The reference scales across hosts with `mpiexec -np N` + MPI as the wire
+(SURVEY.md §4: multi-node is "tested" the way any MPI program is — by the
+launcher). The TPU-native equivalent is JAX's distributed runtime: one
+process per host, `jax.distributed.initialize` for rank bootstrap (the
+`MPI_Init` analogue), a global device mesh whose inner axes ride ICI and
+whose outer axis rides DCN, and `multihost_utils` for host-local <->
+global array movement. This module packages that recipe behind an
+acxrun-style env-var interface so the same worker code runs single-host
+(no-op initialize) or multi-host (ACX_COORDINATOR/ACX_NPROCS/ACX_PROC_ID).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Bootstrap the distributed runtime (MPIX_Init's process-level half).
+
+    Arguments fall back to ACX_COORDINATOR / ACX_NPROCS / ACX_PROC_ID, so
+    a launcher exports three env vars and workers call ``initialize()``
+    bare. Single-process (no coordinator configured) is a no-op, letting
+    the same worker script run standalone. Idempotent.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "ACX_COORDINATOR")
+    if coordinator_address is None:
+        return  # single-process mode
+    if num_processes is None:
+        num_processes = int(os.environ.get("ACX_NPROCS", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("ACX_PROC_ID", "0"))
+    # Multi-process CPU (the test topology) needs a cross-process
+    # collectives backend; gloo is the in-tree one. Harmless if the
+    # platform is TPU (ICI collectives don't use it).
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def global_mesh(axis_sizes: Mapping[str, int]) -> Mesh:
+    """Mesh over ALL processes' devices with named axes (dict order =
+    major-to-minor). Put the cross-host axis FIRST so consecutive devices
+    (same host, ICI-connected) land in the innermost axes — collectives
+    over inner axes then ride ICI, the outer axis rides DCN.
+
+    Example (2 hosts x 4 chips): ``global_mesh({"dcn": 2, "ici": 4})``;
+    dp-over-hosts + tp-within-host: ``global_mesh({"dp": 2, "tp": 4})``.
+    """
+    from mpi_acx_tpu.parallel.mesh import mesh_from_devices
+    devices = jax.devices()
+    n = int(np.prod(tuple(axis_sizes.values())))
+    if n != len(devices):
+        raise ValueError(f"mesh {dict(axis_sizes)} needs {n} devices, the "
+                         f"job has {len(devices)}")
+    return mesh_from_devices(axis_sizes, devices)
+
+
+def hybrid_mesh(ici_axes: Mapping[str, int],
+                dcn_axis: str = "dcn") -> Mesh:
+    """ICI x DCN mesh: one outer axis spanning processes (DCN), the given
+    inner axes within each process's devices (ICI). The standard layout
+    for data-parallel-across-hosts, model-parallel-within-host."""
+    n_proc = jax.process_count()
+    local = len(jax.local_devices())
+    sizes = tuple(ici_axes.values())
+    if int(np.prod(sizes)) != local:
+        raise ValueError(f"ici axes {dict(ici_axes)} need {np.prod(sizes)} "
+                         f"local devices, have {local}")
+    return global_mesh({dcn_axis: n_proc, **ici_axes})
+
+
+def host_local_to_global(x, mesh: Mesh, pspec: P):
+    """Assemble per-process shards into one global jax.Array (the moral
+    inverse of scattering an MPI-rank-local buffer)."""
+    from jax.experimental import multihost_utils
+    return multihost_utils.host_local_array_to_global_array(x, mesh, pspec)
+
+
+def global_to_host_local(x, mesh: Mesh, pspec: P):
+    from jax.experimental import multihost_utils
+    return multihost_utils.global_array_to_host_local_array(x, mesh, pspec)
+
+
+def broadcast_from_host0(x):
+    """Replicate host 0's pytree to every process (param init pattern:
+    init once, broadcast, avoid divergent RNG)."""
+    from jax.experimental import multihost_utils
+    return multihost_utils.broadcast_one_to_all(x)
+
+
+def sync(name: str = "acx_sync") -> None:
+    """Cross-process barrier (MPI_Barrier analogue)."""
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)
+
+
+def replicated(mesh: Mesh):
+    """Sharding for fully-replicated values on the mesh."""
+    return NamedSharding(mesh, P())

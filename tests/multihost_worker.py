@@ -1,0 +1,59 @@
+"""Worker for tests/test_multihost.py: one distributed process.
+
+Launched (2x) by the test with ACX_COORDINATOR/ACX_NPROCS/ACX_PROC_ID set.
+Exercises: initialize() bootstrap, hybrid ICI x DCN mesh, host-local ->
+global assembly, a cross-process jitted reduction, broadcast_from_host0,
+and the barrier. Prints MH_OK <sum> on success.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_num_cpu_devices", 4)
+
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from mpi_acx_tpu.parallel import multihost as mh  # noqa: E402
+
+
+def main():
+    mh.initialize()  # from ACX_* env
+    assert mh.process_count() == 2, mh.process_count()
+    pid = mh.process_index()
+    assert len(jax.local_devices()) == 4
+    assert len(jax.devices()) == 8
+
+    mesh = mh.hybrid_mesh({"ici": 4})
+    assert mesh.shape == {"dcn": 2, "ici": 4}
+
+    # Each process contributes a host-local shard; the global sum must see
+    # both (0+1+2+3) + (10+11+12+13) = 52.
+    x_local = np.arange(4.0) + 10 * pid
+    x = mh.host_local_to_global(x_local, mesh, P("dcn"))
+    assert x.shape == (8,)
+    f = jax.jit(lambda x: x.sum(),
+                out_shardings=NamedSharding(mesh, P()))
+    s = float(jax.device_get(f(x)))
+    assert s == 52.0, s
+
+    # broadcast: host 0's value lands everywhere.
+    v = mh.broadcast_from_host0(np.asarray([41.0 + (1 if pid == 0 else 99)]))
+    assert float(v[0]) == 42.0, v
+
+    # global -> host-local round trip returns this process's shard.
+    back = mh.global_to_host_local(x, mesh, P("dcn"))
+    np.testing.assert_allclose(np.asarray(back), x_local)
+
+    mh.sync("done")
+    print(f"MH_OK {s}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
