@@ -25,7 +25,7 @@ def __getattr__(name):
     # Lazy subpackage imports: the host-plane path (`runtime`, pure
     # ctypes/numpy) must not pay for — or depend on — the JAX stack, which
     # matters when acxrun spawns N Python ranks.
-    if name in ("parallel", "models", "runtime", "train"):
+    if name in ("parallel", "models", "runtime", "train", "checkpoint"):
         import importlib
 
         return importlib.import_module(f"mpi_acx_tpu.{name}")

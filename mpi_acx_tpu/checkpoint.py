@@ -1,0 +1,95 @@
+"""Checkpoint/resume for training state.
+
+The reference has no checkpointing (SURVEY.md §5.4: "none"); this is part
+of the framework surface a training stack needs. Orbax-backed: async-safe
+atomic step directories, sharded-array aware (each host writes only its
+shards of a global array — the multihost story composes with
+parallel/multihost.py), retention policy, and exact-resume semantics
+(restored state is bit-identical, so a resumed run reproduces the
+original trajectory step for step).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+class Checkpointer:
+    """Step-indexed checkpoint directory with retention.
+
+    >>> ckpt = Checkpointer("/tmp/run1", max_to_keep=3)
+    >>> ckpt.save(step, {"params": params, "opt": opt_state})
+    >>> state = ckpt.restore(like={"params": params0, "opt": opt0})
+
+    ``like`` supplies the pytree structure, dtypes, and shardings for
+    restore — restored arrays land exactly where ``like``'s live, so for
+    a distributed run pass state already placed on the mesh (the
+    initialized-and-sharded state a fresh worker builds anyway). With no
+    ``step``, restores the latest.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, step: int, state: Any, wait: bool = True) -> None:
+        """Write ``state`` (any pytree of arrays/scalars) for ``step``.
+        wait=False lets orbax finish the write in the background
+        (call wait_until_finished() or close() before exiting)."""
+        import orbax.checkpoint as ocp
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def restore(self, step: Optional[int] = None,
+                like: Optional[Any] = None) -> Any:
+        """Read a step (default: latest). ``like`` gives the target
+        structure/shardings; without it, leaves come back as jax.Arrays
+        on the default device with the saved dtypes (fine for inspection;
+        distributed restores should always pass ``like``)."""
+        import orbax.checkpoint as ocp
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self._dir}")
+        if like is not None:
+            target = jax.tree.map(_abstractify, like)
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(target))
+        return self._mgr.restore(step)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def _abstractify(x):
+    """Target entry for StandardRestore: keep jax.Arrays as abstract
+    shape/dtype/sharding descriptors, leave scalars and numpy as-is."""
+    if isinstance(x, jax.Array):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+    return x

@@ -41,9 +41,23 @@ def initialize(coordinator_address: Optional[str] = None,
     if coordinator_address is None:
         return  # single-process mode
     if num_processes is None:
-        num_processes = int(os.environ.get("ACX_NPROCS", "1"))
+        # ACX_NPROCS/ACX_PROC_ID primary; fall back to the native
+        # launcher's ACX_SIZE/ACX_RANK so a worker under acxrun only
+        # needs ACX_COORDINATOR exported on top.
+        e = os.environ.get("ACX_NPROCS") or os.environ.get("ACX_SIZE")
+        if e is None:
+            raise ValueError(
+                "ACX_COORDINATOR is set but the process count isn't: "
+                "export ACX_NPROCS (or run under acxrun, which sets "
+                "ACX_SIZE) — defaulting to 1 would silently split the job")
+        num_processes = int(e)
     if process_id is None:
-        process_id = int(os.environ.get("ACX_PROC_ID", "0"))
+        e = os.environ.get("ACX_PROC_ID") or os.environ.get("ACX_RANK")
+        if e is None:
+            raise ValueError(
+                "ACX_COORDINATOR is set but the process id isn't: export "
+                "ACX_PROC_ID (or run under acxrun, which sets ACX_RANK)")
+        process_id = int(e)
     # Multi-process CPU (the test topology) needs a cross-process
     # collectives backend; gloo is the in-tree one. Harmless if the
     # platform is TPU (ICI collectives don't use it).

@@ -37,10 +37,15 @@ def _run_workers(port):
             procs.append(subprocess.Popen(
                 [sys.executable, WORKER], env=env,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
-        outs = []
-        for p in procs:
-            out, err = p.communicate(timeout=280)
-            outs.append((p.returncode, out, err))
+        # Drain both pipes concurrently: sequential communicate() deadlocks
+        # if the not-yet-drained worker fills its pipe buffer mid-collective.
+        import concurrent.futures as cf
+        with cf.ThreadPoolExecutor(len(procs)) as ex:
+            futs = [ex.submit(p.communicate, timeout=280) for p in procs]
+            outs = []
+            for p, f in zip(procs, futs):
+                out, err = f.result(timeout=290)
+                outs.append((p.returncode, out, err))
         return outs
     finally:
         for p in procs:
