@@ -1,0 +1,37 @@
+// tpu-acx: op-lifecycle tracing (SURVEY.md §5.1 — the reference's only
+// story is printf-with-DDEBUG, mpi-acx-internal.h:129-139).
+//
+// Run-time gated, always compiled: ACX_TRACE=<path> records one timestamped
+// event per op state transition (enqueue, trigger, issue, complete,
+// reclaim, ...) into an in-memory ring and writes
+// "<path>.rank<r>.trace.json" at MPIX_Finalize in Chrome trace-event
+// format — load it in chrome://tracing or Perfetto; each slot renders as
+// its own track. Disabled (the default) it costs one predictable branch
+// per call site. ACX_TRACE_CAP caps the ring (default 65536 events;
+// overflow drops new events and reports the drop count in the file).
+
+#pragma once
+
+#include <cstdint>
+
+namespace acx {
+namespace trace {
+
+// True iff ACX_TRACE is set (checked once).
+bool Enabled();
+
+// Record event `name` (STATIC string only — the pointer is stored) for a
+// slot (or -1 for process-scope events).
+void Emit(const char* name, int64_t slot);
+
+// Write the ring to ACX_TRACE.rank<rank>.trace.json and clear it.
+void Flush(int rank);
+
+}  // namespace trace
+}  // namespace acx
+
+#define ACX_TRACE_EVENT(name, slot)                       \
+  do {                                                    \
+    if (::acx::trace::Enabled())                          \
+      ::acx::trace::Emit((name), (int64_t)(slot));        \
+  } while (0)

@@ -25,6 +25,7 @@
 
 #include "acx/api_internal.h"
 #include "acx/debug.h"
+#include "acx/trace.h"
 #include "acx/net.h"
 #include "acx/runtime.h"
 #include "mpi-acx.h"
@@ -142,6 +143,7 @@ int EnqueueSendRecv(bool is_send, void* buf, int count, MPI_Datatype datatype,
   // (reference state doc, mpi-acx-internal.h:176-189).
   auto trigger = [table, proxy, idx] {
     table->Store(idx, kPending);
+    ACX_TRACE_EVENT("trigger_fired", idx);
     // Post the transfer inline if no one else is sweeping (saves the
     // proxy-thread handoff); Kick still wakes a parked proxy to poll the
     // ISSUED op in case no host thread ever waits on it.
@@ -167,6 +169,7 @@ int EnqueueSendRecv(bool is_send, void* buf, int count, MPI_Datatype datatype,
     std::free(req);
     return kErr;
   }
+  ACX_TRACE_EVENT(is_send ? "isend_enqueue" : "irecv_enqueue", idx);
   *request = req;
   return MPI_SUCCESS;
 }
@@ -309,6 +312,10 @@ int PartitionedInit(bool is_send, void* buf, int partitions, MPI_Count count,
     op.partition = p;
     req->part_idx[p] = idx;
   }
+  if (trace::Enabled()) {
+    for (int p = 0; p < partitions; p++)
+      trace::Emit(is_send ? "psend_slot" : "precv_slot", req->part_idx[p]);
+  }
   *request = req;
   return MPI_SUCCESS;
 }
@@ -371,6 +378,9 @@ int MPIX_Finalize(void) {
            (unsigned long long)st.ops_completed,
            (unsigned long long)st.slots_reclaimed);
   g.proxy->Stop();
+  // After Stop: the proxy thread's tail events (final completions and
+  // slot reclaims) are in the ring before the file is written.
+  trace::Flush(g.transport->rank());
   delete g.proxy;
   g.proxy = nullptr;
   delete g.table;
@@ -536,6 +546,7 @@ int MPIX_Pready(int partition, void* request) {
   }
   if (partition < 0 || partition >= partitions) return kErr;
   g.table->Store(part_idx[partition], kPending);
+  ACX_TRACE_EVENT("pready_marked", part_idx[partition]);
   g.proxy->Kick();
   return MPI_SUCCESS;
 }

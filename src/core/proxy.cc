@@ -5,6 +5,7 @@
 #include <cstdlib>
 
 #include "acx/debug.h"
+#include "acx/trace.h"
 
 namespace acx {
 
@@ -70,6 +71,7 @@ bool Proxy::Sweep() {
             op.ticket = transport_->Isend(op.sbuf, op.bytes, op.peer, op.tag,
                                           op.ctx);
             table_->Store(i, kIssued);
+            ACX_TRACE_EVENT("isend_issued", i);
             local.ops_issued++;
             progressed = true;
             break;
@@ -80,6 +82,7 @@ bool Proxy::Sweep() {
             op.ticket = transport_->Irecv(op.rbuf, op.bytes, op.peer, op.tag,
                                           op.ctx);
             table_->Store(i, kIssued);
+            ACX_TRACE_EVENT("irecv_issued", i);
             local.ops_issued++;
             progressed = true;
             break;
@@ -88,6 +91,7 @@ bool Proxy::Sweep() {
             // flag write): push it to the wire and complete the slot.
             op.chan->Pready(op.partition);
             table_->Store(i, kCompleted);
+            ACX_TRACE_EVENT("pready_wire", i);
             local.ops_completed++;
             progressed = true;
             break;
@@ -108,6 +112,7 @@ bool Proxy::Sweep() {
             // reference needed a mutex here; see its init.cpp:119-141).
             if (op.ticket != nullptr && op.ticket->Test(&op.status)) {
               table_->Store(i, kCompleted);
+              ACX_TRACE_EVENT("op_completed", i);
               local.ops_completed++;
               progressed = true;
             }
@@ -116,6 +121,7 @@ bool Proxy::Sweep() {
           case OpKind::kParrived: {
             if (op.chan->Parrived(op.partition)) {
               table_->Store(i, kCompleted);
+              ACX_TRACE_EVENT("parrived", i);
               local.ops_completed++;
               progressed = true;
             }
@@ -132,6 +138,7 @@ bool Proxy::Sweep() {
         std::free(op.owner);
         op.owner = nullptr;
         table_->Free(static_cast<int>(i));
+        ACX_TRACE_EVENT("slot_reclaimed", i);
         local.slots_reclaimed++;
         progressed = true;
         break;

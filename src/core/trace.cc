@@ -1,0 +1,109 @@
+#include "acx/trace.h"
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace acx {
+namespace trace {
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Event {
+  uint64_t ts_us;
+  const char* name;
+  int64_t slot;
+};
+
+struct Ring {
+  std::mutex mu;
+  std::vector<Event> events;
+  size_t cap = 65536;
+  uint64_t dropped = 0;
+  Clock::time_point t0 = Clock::now();
+};
+
+Ring& ring() {
+  static Ring* r = [] {
+    Ring* r = new Ring;
+    const char* c = std::getenv("ACX_TRACE_CAP");
+    if (c != nullptr) {
+      const unsigned long long v = strtoull(c, nullptr, 10);
+      if (v > 0) r->cap = static_cast<size_t>(v);
+    }
+    r->events.reserve(r->cap < 4096 ? r->cap : 4096);
+    return r;
+  }();
+  return *r;
+}
+
+const char* path() {
+  static const char* p = std::getenv("ACX_TRACE");
+  return p;
+}
+
+}  // namespace
+
+bool Enabled() {
+  static const bool on = path() != nullptr && path()[0] != '\0';
+  return on;
+}
+
+void Emit(const char* name, int64_t slot) {
+  Ring& r = ring();
+  // Timestamp under the lock: emitters race (app, trigger, proxy, and
+  // waiter threads), and the file must be time-ordered.
+  std::lock_guard<std::mutex> lk(r.mu);
+  if (r.events.size() >= r.cap) {
+    r.dropped++;
+    return;
+  }
+  const uint64_t ts = static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::microseconds>(Clock::now() -
+                                                            r.t0)
+          .count());
+  r.events.push_back(Event{ts, name, slot});
+}
+
+void Flush(int rank) {
+  if (!Enabled()) return;
+  Ring& r = ring();
+  std::vector<Event> events;
+  uint64_t dropped;
+  {
+    std::lock_guard<std::mutex> lk(r.mu);
+    events.swap(r.events);
+    dropped = r.dropped;
+    r.dropped = 0;
+  }
+  std::string fn = std::string(path()) + ".rank" + std::to_string(rank) +
+                   ".trace.json";
+  FILE* f = std::fopen(fn.c_str(), "w");
+  if (f == nullptr) {
+    std::fprintf(stderr, "tpu-acx: ACX_TRACE: cannot write %s\n", fn.c_str());
+    return;
+  }
+  // Chrome trace-event JSON: instant events, one tid per slot so each
+  // op slot gets its own track in the viewer.
+  std::fprintf(f, "{\"traceEvents\":[\n");
+  for (size_t i = 0; i < events.size(); i++) {
+    const Event& e = events[i];
+    std::fprintf(f,
+                 "{\"name\":\"%s\",\"ph\":\"i\",\"s\":\"t\",\"ts\":%llu,"
+                 "\"pid\":%d,\"tid\":%lld}%s\n",
+                 e.name, (unsigned long long)e.ts_us, rank,
+                 (long long)e.slot, i + 1 < events.size() ? "," : "");
+  }
+  std::fprintf(f, "],\"displayTimeUnit\":\"ms\",\"otherData\":{"
+                  "\"dropped\":%llu,\"events\":%zu}}\n",
+               (unsigned long long)dropped, events.size());
+  std::fclose(f);
+}
+
+}  // namespace trace
+}  // namespace acx

@@ -35,3 +35,48 @@ def test_reference_tests_build_and_pass_unchanged():
     r = _make("reftests")
     assert r.returncode == 0, r.stdout + r.stderr
     assert "ALL REFERENCE TESTS PASSED" in r.stdout
+
+
+def test_op_trace(tmp_path):
+    """ACX_TRACE records the op lifecycle as valid Chrome trace JSON:
+    enqueue -> trigger -> issue -> complete -> reclaim, time-ordered,
+    one file per rank."""
+    import json
+    _make("itest", "tools")
+    env = dict(os.environ)
+    env["ACX_TRACE"] = str(tmp_path / "tr")
+    r = subprocess.run(
+        [os.path.join(REPO, "build", "acxrun"), "-np", "2", "-timeout",
+         "120", os.path.join(REPO, "build", "itests", "ring")],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    for rank in (0, 1):
+        f = tmp_path / f"tr.rank{rank}.trace.json"
+        d = json.loads(f.read_text())
+        names = {e["name"] for e in d["traceEvents"]}
+        assert {"isend_enqueue", "irecv_enqueue", "trigger_fired",
+                "isend_issued", "irecv_issued", "op_completed",
+                "slot_reclaimed"} <= names, names
+        ts = [e["ts"] for e in d["traceEvents"]]
+        assert ts == sorted(ts)
+        assert d["otherData"]["dropped"] == 0
+
+
+def test_op_trace_partitioned(tmp_path):
+    """Partitioned lifecycle events (psend/precv slots, pready, parrived)
+    land in the trace."""
+    import json
+    _make("itest", "tools")
+    env = dict(os.environ)
+    env["ACX_TRACE"] = str(tmp_path / "tr")
+    r = subprocess.run(
+        [os.path.join(REPO, "build", "acxrun"), "-np", "2", "-timeout",
+         "120", os.path.join(REPO, "build", "itests", "ring-partitioned")],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    seen = set()
+    for rank in (0, 1):
+        d = json.loads((tmp_path / f"tr.rank{rank}.trace.json").read_text())
+        seen |= {e["name"] for e in d["traceEvents"]}
+    assert {"psend_slot", "precv_slot", "pready_marked", "pready_wire",
+            "parrived"} <= seen, seen
