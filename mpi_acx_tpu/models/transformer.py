@@ -111,11 +111,10 @@ def _attend(cfg: TransformerConfig, q, k, v):
     """Causal attention with the per-shape kernel choice (flash vs dense);
     [B, S, H, Dh] -> [B, S, d]."""
     B, S = q.shape[:2]
-    use_flash = cfg.use_flash
-    if use_flash is None:
-        use_flash = (jax.default_backend() == "tpu" and S >= 1024
-                     and S % 128 == 0)
-    if use_flash:
+    if cfg.use_flash is None:
+        from mpi_acx_tpu.ops.attention import auto_attention
+        o = auto_attention(q, k, v)
+    elif cfg.use_flash:
         from mpi_acx_tpu.ops.attention import flash_attention
         o = flash_attention(q, k, v)
     else:

@@ -43,6 +43,18 @@ def attention_reference(q, k, v, causal: bool = True):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+def auto_attention(q, k, v, causal: bool = True):
+    """[B, S, H, D] attention with the kernel picked per shape: the Pallas
+    flash kernel past its measured v5e crossover (S >= 1024; dense wins
+    below — grid overhead), dense elsewhere. THE single definition of the
+    flash/dense policy — the model layer and the sequence-parallel
+    strategies all route through here."""
+    S = q.shape[1]
+    if jax.default_backend() == "tpu" and S >= 1024 and S % 128 == 0:
+        return flash_attention(q, k, v, causal=causal)
+    return attention_reference(q, k, v, causal=causal)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, scale,
                   causal):
     """One (batch, head, q-block) program: online softmax over k blocks.

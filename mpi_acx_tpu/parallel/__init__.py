@@ -31,4 +31,8 @@ from mpi_acx_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_forward,
     pipeline_loss,
 )
+from mpi_acx_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_attention,
+    ulysses_attention_sharded,
+)
 from mpi_acx_tpu.parallel import multihost  # noqa: F401

@@ -1,0 +1,74 @@
+"""Ulysses (all-to-all) sequence parallelism.
+
+The second canonical long-context strategy next to ring attention
+(parallel/ring_attention.py). Ring attention keeps the sequence sharded
+and rotates K/V around the mesh — n ppermute steps, O(S/n) peak memory.
+Ulysses instead **re-shards**: one all-to-all turns the sequence-sharded
+layout into a head-sharded layout, each device runs full-sequence
+attention for its H/n heads with any single-device kernel (the Pallas
+flash kernel rides along for free), and a second all-to-all restores
+sequence sharding. Two collectives total, so it wins when attention
+FLOPs dominate and H >= mesh size; ring wins when S is extreme and
+memory is the constraint. Both ride ICI.
+
+The primitive underneath is collective.all_to_all_seq — a single
+lax.all_to_all per direction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mpi_acx_tpu.parallel.collective import all_to_all_seq
+
+
+def _default_local_attn(q, k, v, causal: bool):
+    """Full-sequence attention for the local heads, [S, H_loc, D];
+    flash/dense choice delegated to the shared policy."""
+    from mpi_acx_tpu.ops.attention import auto_attention
+    return auto_attention(q[None], k[None], v[None], causal=causal)[0]
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str, causal: bool = True,
+                      attn_fn: Optional[Callable] = None) -> jax.Array:
+    """Exact attention under Ulysses sequence parallelism.
+
+    Per-shard shapes: q, k, v = [seq_shard, heads, head_dim]; the global
+    sequence is the shard concatenation in mesh order. heads must divide
+    by the axis size. Returns the local Q block's output, same shape.
+
+    attn_fn(q, k, v, causal) runs on [S_global, heads/n, head_dim]; the
+    default picks flash/dense like the model layer.
+    """
+    n = lax.axis_size(axis_name)
+    sq, H, D = q.shape
+    assert H % n == 0, f"heads {H} not divisible by axis size {n}"
+    if attn_fn is None:
+        attn_fn = _default_local_attn
+
+    # seq-sharded -> head-sharded: scatter heads, gather sequence. q/k/v
+    # stack into ONE all-to-all so the reshard is a single ICI collective.
+    x = jnp.stack([q, k, v])                       # [3, sq, H, D]
+    xh = all_to_all_seq(x, axis_name, split_axis=2, concat_axis=1)
+    qh, kh, vh = xh[0], xh[1], xh[2]               # [S_global, H/n, D]
+    oh = attn_fn(qh, kh, vh, causal)
+    # head-sharded -> seq-sharded.
+    return all_to_all_seq(oh, axis_name, split_axis=0, concat_axis=1)
+
+
+def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "x",
+                              causal: bool = True):
+    """Array-level wrapper: q/k/v sharded on the sequence (leading) axis."""
+    spec = P(axis_name)
+    f = shard_map(
+        functools.partial(ulysses_attention, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return f(q, k, v)
