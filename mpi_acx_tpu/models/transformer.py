@@ -95,16 +95,6 @@ def layernorm(x, g, b, eps=1e-5):
     return ((x32 - mu) * lax.rsqrt(var + eps) * g + b).astype(x.dtype)
 
 
-def _causal_attention(q, k, v):
-    """q,k,v: [B, S, H, Dh] -> [B, S, H, Dh], causal, f32 softmax."""
-    d = q.shape[-1]
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-    logits = logits / jnp.sqrt(d)
-    s = q.shape[1]
-    mask = jnp.tril(jnp.ones((s, s), bool))
-    logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
-    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
 def _attend(cfg: TransformerConfig, q, k, v):
@@ -118,7 +108,8 @@ def _attend(cfg: TransformerConfig, q, k, v):
         from mpi_acx_tpu.ops.attention import flash_attention
         o = flash_attention(q, k, v)
     else:
-        o = _causal_attention(q, k, v)
+        from mpi_acx_tpu.ops.attention import attention_reference
+        o = attention_reference(q, k, v)
     return o.reshape(B, S, cfg.d_model)
 
 

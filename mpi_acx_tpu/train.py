@@ -89,10 +89,12 @@ def _tp_sharded(path: str) -> bool:
     return path in ("w1", "b1", "w2")
 
 
-def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
-                    n_micro: int, lr: float = 1e-2):
-    """Builds a jitted (params, tokens, targets) -> (loss, new_params) SGD
-    step over a ('dp','pp','tp') mesh.
+def make_loss_and_grads(cfg: tfm.TransformerConfig, mesh: Mesh,
+                        n_micro: int):
+    """Builds a jitted (params, tokens, targets) -> (loss, grads) over a
+    ('dp','pp','tp') mesh — the shard_map core every optimizer shares.
+    Returned grads carry the same shardings as params, so any elementwise
+    optimizer applied outside stays correctly sharded by propagation.
 
     params must be tfm.stage_slice(init_params(...), pp_size).
     tokens/targets: [n_micro, micro_batch, S] int32, batch over 'dp'.
@@ -158,20 +160,59 @@ def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
                 g = lax.psum(g, "pp")
             return g
 
-        new = dict(params)
+        out = dict(grads)
         for k in ("embed", "pos", "lnf_g", "lnf_b"):
-            new[k] = params[k] - lr * reduce(grads[k], False, False)
-        new["layers"] = {
-            k: params["layers"][k]
-            - lr * reduce(grads["layers"][k], _tp_sharded(k), True)
-            for k in params["layers"]
+            out[k] = reduce(grads[k], False, False)
+        out["layers"] = {
+            k: reduce(grads["layers"][k], _tp_sharded(k), True)
+            for k in grads["layers"]
         }
-        return loss, new
+        return loss, out
 
     specs = param_specs()
     data_spec = P(None, "dp")
-    step = shard_map(per_shard, mesh=mesh,
-                     in_specs=(specs, data_spec, data_spec),
-                     out_specs=(P(), specs),
-                     check_vma=False)
-    return jax.jit(step), n_stages
+    fn = shard_map(per_shard, mesh=mesh,
+                   in_specs=(specs, data_spec, data_spec),
+                   out_specs=(P(), specs),
+                   check_vma=False)
+    return jax.jit(fn), n_stages
+
+
+def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
+                    n_micro: int, lr: float = 1e-2):
+    """Jitted (params, tokens, targets) -> (loss, new_params) SGD step
+    (stateless optimizer; for stateful ones use make_train_step_optax)."""
+    grad_fn, n_stages = make_loss_and_grads(cfg, mesh, n_micro)
+
+    @jax.jit
+    def step(params, tokens, targets):
+        loss, grads = grad_fn(params, tokens, targets)
+        new = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return loss, new
+
+    return step, n_stages
+
+
+def make_train_step_optax(cfg: tfm.TransformerConfig, mesh: Mesh,
+                          n_micro: int, optimizer):
+    """Distributed train step with any optax GradientTransformation.
+
+    Returns (step, n_stages): step(params, opt_state, tokens, targets) ->
+    (loss, new_params, new_opt_state). Initialize opt_state with
+    ``optimizer.init(params)`` — its leaves mirror the parameter tree, so
+    XLA's sharding propagation keeps optimizer moments sharded exactly
+    like their parameters (pp-staged, tp-split FFN slices included), and
+    the whole state checkpoints through mpi_acx_tpu.checkpoint.
+    """
+    import optax
+
+    grad_fn, n_stages = make_loss_and_grads(cfg, mesh, n_micro)
+
+    @jax.jit
+    def step(params, opt_state, tokens, targets):
+        loss, grads = grad_fn(params, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return loss, params, opt_state
+
+    return step, n_stages

@@ -70,3 +70,82 @@ def test_distributed_training_converges(setup):
     for _ in range(8):
         l1, staged = step(staged, tokens, targets)
     assert float(l1) < float(l0)
+
+
+def test_optax_adamw_matches_sequential(setup):
+    """Distributed AdamW (grads from the shard_map core, update applied by
+    optax outside) == single-device AdamW on the same math. One step:
+    Adam's g/sqrt(v) normalization turns the first update into ~lr*sign(g),
+    so tiny f32 reduction-order differences bound the tolerance at
+    O(2*lr) on near-zero-gradient params — any sharding/transpose bug is
+    orders of magnitude larger."""
+    import optax
+    from mpi_acx_tpu.train import make_train_step_optax
+
+    cfg, mesh, params, tokens, targets = setup
+    lr = 1e-3
+    opt = optax.adamw(lr, weight_decay=0.01)
+
+    step, n_stages = make_train_step_optax(cfg, mesh, n_micro=3,
+                                           optimizer=opt)
+    staged = tfm.stage_slice(params, n_stages)
+    dloss, dp, _ = step(staged, opt.init(staged), tokens, targets)
+
+    # sequential reference on the same staged tree
+    M, mb, S = tokens.shape
+    flat_t, flat_y = tokens.reshape(M * mb, S), targets.reshape(M * mb, S)
+
+    def seq_loss(p):
+        flat = dict(p)
+        flat["layers"] = jax.tree.map(
+            lambda x: x.reshape((-1,) + x.shape[2:]), p["layers"])
+        return tfm.loss_fn(flat, cfg, flat_t, flat_y)
+
+    sloss, g = jax.value_and_grad(seq_loss)(staged)
+    upd, _ = opt.update(g, opt.init(staged), staged)
+    sp = optax.apply_updates(staged, upd)
+
+    np.testing.assert_allclose(float(dloss), float(sloss), rtol=2e-4)
+    for a, b in zip(jax.tree.leaves(dp), jax.tree.leaves(sp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3 * lr, rtol=1e-2)
+
+
+def test_optax_adamw_converges(setup):
+    import optax
+    from mpi_acx_tpu.train import make_train_step_optax
+
+    cfg, mesh, params, tokens, targets = setup
+    opt = optax.adamw(3e-3)
+    step, n_stages = make_train_step_optax(cfg, mesh, n_micro=3,
+                                           optimizer=opt)
+    p = tfm.stage_slice(params, n_stages)
+    s = opt.init(p)
+    l0, p, s = step(p, s, tokens, targets)
+    for _ in range(6):
+        l1, p, s = step(p, s, tokens, targets)
+    assert float(l1) < float(l0)
+
+
+def test_optax_state_checkpoints(setup, tmp_path):
+    """Optimizer moments checkpoint and restore for an exact resume."""
+    import optax
+    from mpi_acx_tpu.checkpoint import Checkpointer
+    from mpi_acx_tpu.train import make_train_step_optax
+
+    cfg, mesh, params, tokens, targets = setup
+    opt = optax.adamw(1e-3)
+    step, n_stages = make_train_step_optax(cfg, mesh, n_micro=3,
+                                           optimizer=opt)
+    p = tfm.stage_slice(params, n_stages)
+    s = opt.init(p)
+    for _ in range(2):
+        _, p, s = step(p, s, tokens, targets)
+    with Checkpointer(str(tmp_path / "run")) as ck:
+        ck.save(2, {"params": p, "opt": s})
+        la, pa, _ = step(p, s, tokens, targets)
+        st = ck.restore(like={"params": p, "opt": s})
+    lb, pb, _ = step(st["params"], st["opt"], tokens, targets)
+    assert float(la) == float(lb)
+    for a, b in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
