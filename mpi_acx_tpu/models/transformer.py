@@ -95,8 +95,6 @@ def layernorm(x, g, b, eps=1e-5):
     return ((x32 - mu) * lax.rsqrt(var + eps) * g + b).astype(x.dtype)
 
 
-
-
 def _attend(cfg: TransformerConfig, q, k, v):
     """Causal attention with the per-shape kernel choice (flash vs dense);
     [B, S, H, Dh] -> [B, S, d]."""

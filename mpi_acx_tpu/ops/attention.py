@@ -8,6 +8,16 @@ are skipped entirely (the inner loop's trip count is ``i + 1``), so the
 kernel does ~half the FLOPs of the dense-mask reference implementation and
 O(S) memory instead of O(S^2).
 
+Differentiable: a ``jax.custom_vjp`` backward recomputes everything
+blockwise from (q, k, v, o) in pure JAX — one streaming pass rebuilds the
+row logsumexp, a second applies the standard flash-backward formulas
+(dS = P * (dP - rowsum(dO*O))) — O(S * block_k) peak memory, so training
+(e.g. make_train_step on long sequences) differentiates straight through
+the Pallas call. (The lse is recomputed rather than emitted by the kernel
+because multi-output pallas_call hangs the axon remote-compile path; the
+extra QK sweep costs ~1/5 of the backward's FLOPs and keeps the
+inference forward at zero overhead.)
+
 This is also the single-chip building block of
 :func:`mpi_acx_tpu.parallel.ring_attention.ring_attention`: ring attention
 rotates K/V shards around the mesh while each step runs exactly this
@@ -55,8 +65,8 @@ def auto_attention(q, k, v, causal: bool = True):
     return attention_reference(q, k, v, causal=causal)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, scale,
-                  causal):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
+                  scale, causal):
     """One (batch, head, q-block) program: online softmax over k blocks.
 
     Causal masking is only evaluated on the blocks that straddle the
@@ -115,37 +125,25 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, scale,
     o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
-                    block_k: int = 512):
-    """Flash attention, [B, S, H, D] in / [B, S, H, D] out.
-
-    D rides the lane dimension as-is (Mosaic handles sub-128 lane widths;
-    padding to 128 would double both FLOPs and HBM traffic for the common
-    D=64). Block sizes shrink to the largest divisor of S when S isn't a
-    multiple of the requested block (S itself must divide by 128, or be
-    smaller than 128 entirely).
-    """
-    B, S, H, D = q.shape
-
+def _fit_blocks(S, block_q, block_k):
     def fit(block):
         b = min(block, S)
         while b > 128 and S % b:
             b -= 128
         return b
 
-    block_q, block_k = fit(block_q), fit(block_k)
-    assert S % block_q == 0 and S % block_k == 0, (S, block_q, block_k)
+    bq, bk = fit(block_q), fit(block_k)
+    assert S % bq == 0 and S % bk == 0, (S, bq, bk)
+    return bq, bk
+
+
+def _flash_fwd_impl(qt, kt, vt, causal, block_q, block_k):
+    """Raw pallas call on [B, H, S, D] operands -> o [B, H, S, D]."""
+    B, H, S, D = qt.shape
     scale = 1.0 / (D ** 0.5)
-
-    def to_bhsd(x):
-        return jnp.transpose(x, (0, 2, 1, 3))            # [B, H, S, D]
-
-    qt, kt, vt = to_bhsd(q), to_bhsd(k), to_bhsd(v)
-
     kernel = functools.partial(_flash_kernel, block_q=block_q,
                                block_k=block_k, scale=scale, causal=causal)
-    out = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         grid=(B, H, S // block_q),
         in_specs=[
@@ -159,8 +157,131 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
         out_specs=pl.BlockSpec((1, 1, block_q, D),
                                lambda b, h, i: (b, h, i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), qt.dtype),
         interpret=jax.default_backend() != "tpu",
     )(qt, kt, vt)
 
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(qt, kt, vt, causal, block_q, block_k):
+    return _flash_fwd_impl(qt, kt, vt, causal, block_q, block_k)
+
+
+def _flash_vjp_fwd(qt, kt, vt, causal, block_q, block_k):
+    o = _flash_fwd_impl(qt, kt, vt, causal, block_q, block_k)
+    return o, (qt, kt, vt, o)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, res, do):
+    """Blockwise flash backward in pure JAX ([B, H, S, D] operands).
+
+    Outer scan over q blocks; for each, an inner fori_loop over exactly
+    the k blocks at-or-below the diagonal (causal skips the rest, like the
+    forward kernel) first rebuilds that q block's row logsumexp, then
+    applies the standard flash-backward formulas:
+      dV_j += P_j^T dO;  dP_j = dO V_j^T;  D = rowsum(dO * O)
+      dS_j = P_j * (dP_j - D) * scale;  dQ += dS_j K_j;  dK_j += dS_j^T Q
+    Peak extra memory is [B, H, block_q, block_k] per step.
+    """
+    qt, kt, vt, o = res
+    B, H, S, Dh = qt.shape
+    scale = 1.0 / (Dh ** 0.5)
+    k32 = kt.astype(jnp.float32)
+    v32 = vt.astype(jnp.float32)
+    do32 = do.astype(jnp.float32)
+    Drow = jnp.sum(do32 * o.astype(jnp.float32), axis=-1)      # [B,H,S]
+
+    def qblock(carry, i):
+        dk_acc, dv_acc = carry
+        q0 = i * block_q
+        qb = jax.lax.dynamic_slice_in_dim(
+            qt, q0, block_q, axis=2).astype(jnp.float32)       # [B,H,bq,D]
+        dob = jax.lax.dynamic_slice_in_dim(do32, q0, block_q, axis=2)
+        Db = jax.lax.dynamic_slice_in_dim(Drow, q0, block_q, axis=2)
+        rows = q0 + jnp.arange(block_q)[:, None]               # [bq, 1]
+        if causal:
+            # k blocks [0, n_kv) contain at least one unmasked column for
+            # this q block (same bound as the forward kernel's n_diag).
+            n_kv = (q0 + block_q + block_k - 1) // block_k
+        else:
+            n_kv = S // block_k
+
+        def logits(j):
+            kb = jax.lax.dynamic_slice_in_dim(k32, j * block_k, block_k,
+                                              axis=2)
+            s = jnp.einsum("bhqd,bhkd->bhqk", qb, kb) * scale
+            if causal:
+                cols = j * block_k + jnp.arange(block_k)[None, :]
+                s = jnp.where((rows >= cols)[None, None], s, _NEG_INF)
+            return s, kb
+
+        def lse_step(j, carry):
+            m, l = carry
+            s, _ = logits(j)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            l = l * jnp.exp(m - m_new) + jnp.sum(
+                jnp.exp(s - m_new[..., None]), axis=-1)
+            return m_new, l
+
+        m0 = jnp.full((B, H, block_q), _NEG_INF, jnp.float32)
+        m, l = jax.lax.fori_loop(0, n_kv, lse_step,
+                                 (m0, jnp.zeros_like(m0)))
+        lse_b = m + jnp.log(l)                                 # [B,H,bq]
+
+        def grad_step(j, carry):
+            dq_b, dk_acc, dv_acc = carry
+            s, kb = logits(j)
+            p = jnp.exp(s - lse_b[..., None])                  # [B,H,bq,bk]
+            vb = jax.lax.dynamic_slice_in_dim(v32, j * block_k, block_k,
+                                              axis=2)
+            dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, dob)
+            dp = jnp.einsum("bhqd,bhkd->bhqk", dob, vb)
+            ds = p * (dp - Db[..., None]) * scale
+            dq_b = dq_b + jnp.einsum("bhqk,bhkd->bhqd", ds, kb)
+            dk_j = jnp.einsum("bhqk,bhqd->bhkd", ds, qb)
+
+            def acc(a, upd, j=j):
+                cur = jax.lax.dynamic_slice_in_dim(a, j * block_k, block_k,
+                                                   axis=2)
+                return jax.lax.dynamic_update_slice_in_dim(
+                    a, cur + upd, j * block_k, axis=2)
+
+            return dq_b, acc(dk_acc, dk_j), acc(dv_acc, dv_j)
+
+        dq_b0 = jnp.zeros((B, H, block_q, Dh), jnp.float32)
+        dq_b, dk_acc, dv_acc = jax.lax.fori_loop(
+            0, n_kv, grad_step, (dq_b0, dk_acc, dv_acc))
+        return (dk_acc, dv_acc), dq_b
+
+    zeros = jnp.zeros((B, H, S, Dh), jnp.float32)
+    (dk, dv), dq_blocks = jax.lax.scan(qblock, (zeros, zeros),
+                                       jnp.arange(S // block_q))
+    # [n_q, B, H, bq, D] -> [B, H, S, D]
+    dq = jnp.moveaxis(dq_blocks, 0, 2).reshape(B, H, S, Dh)
+    return dq.astype(qt.dtype), dk.astype(kt.dtype), dv.astype(vt.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
+                    block_k: int = 512):
+    """Flash attention, [B, S, H, D] in / [B, S, H, D] out. Differentiable
+    (custom VJP; see module docstring).
+
+    D rides the lane dimension as-is (Mosaic handles sub-128 lane widths;
+    padding to 128 would double both FLOPs and HBM traffic for the common
+    D=64). Block sizes shrink to the largest divisor of S when S isn't a
+    multiple of the requested block (S itself must divide by 128, or be
+    smaller than 128 entirely).
+    """
+    B, S, H, D = q.shape
+    block_q, block_k = _fit_blocks(S, block_q, block_k)
+
+    def to_bhsd(x):
+        return jnp.transpose(x, (0, 2, 1, 3))            # [B, H, S, D]
+
+    out = _flash(to_bhsd(q), to_bhsd(k), to_bhsd(v), causal, block_q,
+                 block_k)
     return jnp.transpose(out, (0, 2, 1, 3))              # [B, S, H, D]

@@ -48,6 +48,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     default picks flash/dense like the model layer.
     """
     n = lax.axis_size(axis_name)
+    assert q.ndim == 3, f"expected [seq_shard, heads, head_dim], got {q.shape}"
     H = q.shape[1]
     assert H % n == 0, f"heads {H} not divisible by axis size {n}"
     if attn_fn is None:
