@@ -90,7 +90,8 @@ $(BUILD)/itests/%: itests/%.cc $(STATICLIB)
 # mpi-acx.h) and runs them under acxrun. This is the north-star check:
 # "test/ builds unchanged". (ring-partitioned.cu needs nvcc and is covered by
 # our itests/ring-partitioned port instead.)
-REF_TEST_DIR ?= /root/reference/test/src
+REF          ?= /root/reference
+REF_TEST_DIR ?= $(REF)/test/src
 REF_TESTS := ring ring-all ring-all-device ring-all-graph ring-all-graph-construction
 REF_BINS  := $(REF_TESTS:%=$(BUILD)/reftests/%)
 
@@ -119,3 +120,19 @@ check: ctest itest tools
 
 clean:
 	rm -rf $(BUILD)
+
+# --- ThreadSanitizer build + run (race detection the reference lacks,
+# SURVEY.md §5.2). Rebuilds everything into build-tsan/ and runs the unit
+# suite plus the multi-process integration tests under TSAN.
+.PHONY: tsan
+tsan:
+	@$(MAKE) --no-print-directory BUILD=build-tsan \
+	  CXXFLAGS="$(CXXFLAGS) -O1 -fsanitize=thread" \
+	  LDFLAGS="-pthread -fsanitize=thread" \
+	  ctest itest tools
+	@for t in $(CTEST_BINS:$(BUILD)/%=build-tsan/%); do \
+	  echo "== tsan $$t"; TSAN_OPTIONS=halt_on_error=1 $$t || exit 1; done
+	@for t in $(ITEST_BINS:$(BUILD)/%=build-tsan/%); do \
+	  echo "== tsan acxrun -np 2 $$t"; \
+	  TSAN_OPTIONS=halt_on_error=1 build-tsan/acxrun -np 2 -timeout 600 $$t || exit 1; done
+	@echo "TSAN CLEAN"

@@ -1,0 +1,90 @@
+/* Multi-threaded enqueue stress: T app threads per rank concurrently
+ * allocate slots, enqueue isend/irecv pairs, and host-wait, while the
+ * proxy progresses.  The reference's slot allocator is explicitly
+ * single-thread-only (its triggered.cpp FIXME); ours claims lock-free
+ * thread safety — this program, run under `make check` (all transport
+ * matrix rows) and `make tsan`, is the proof.
+ *
+ * Each (rank, thread, round) uses payload = rank*1e6 + thread*1e3 + round
+ * on tag = thread*ROUNDS + round, so any cross-thread matching confusion
+ * is caught by value.
+ */
+#include <mpi.h>
+#include <mpi-acx.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#define THREADS 4
+#define ROUNDS 32
+
+static int g_rank, g_peer;
+static int g_errs[THREADS];
+
+static void* worker(void* arg) {
+  int tid = (int)(long)arg;
+  cudaStream_t s0 = 0;
+  for (int r = 0; r < ROUNDS; r++) {
+    int tag = tid * ROUNDS + r;
+    int sendv = g_rank * 1000000 + tid * 1000 + r;
+    int recvv = -1;
+    MPIX_Request req[2];
+    if (MPIX_Isend_enqueue(&sendv, 1, MPI_INT, g_peer, tag, MPI_COMM_WORLD,
+                           &req[0], MPIX_QUEUE_XLA_STREAM, &s0) ||
+        MPIX_Irecv_enqueue(&recvv, 1, MPI_INT, g_peer, tag, MPI_COMM_WORLD,
+                           &req[1], MPIX_QUEUE_XLA_STREAM, &s0)) {
+      /* Fail loudly: a silent return would leave the peer's matching
+       * thread blocked in MPIX_Wait until the launcher timeout masks
+       * the real error. */
+      fprintf(stderr, "rank %d tid %d round %d: enqueue failed\n", g_rank,
+              tid, r);
+      MPI_Abort(MPI_COMM_WORLD, 3);
+    }
+    MPI_Status st;
+    MPIX_Wait(&req[1], &st);
+    MPIX_Wait(&req[0], MPI_STATUS_IGNORE);
+    int want = g_peer * 1000000 + tid * 1000 + r;
+    if (recvv != want) {
+      fprintf(stderr, "rank %d tid %d round %d: got %d want %d\n", g_rank,
+              tid, r, recvv, want);
+      g_errs[tid]++;
+    }
+    if (st.MPI_TAG != tag || st.MPI_SOURCE != g_peer) {
+      fprintf(stderr, "rank %d tid %d: bad status tag=%d src=%d\n", g_rank,
+              tid, st.MPI_TAG, st.MPI_SOURCE);
+      g_errs[tid]++;
+    }
+  }
+  return NULL;
+}
+
+int main(int argc, char** argv) {
+  int provided, size;
+  MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided);
+  MPI_Comm_rank(MPI_COMM_WORLD, &g_rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  if (size != 2) {
+    if (g_rank == 0) fprintf(stderr, "concurrent-stress needs -np 2\n");
+    MPI_Abort(MPI_COMM_WORLD, 2);
+  }
+  g_peer = 1 - g_rank;
+  if (MPIX_Init()) MPI_Abort(MPI_COMM_WORLD, 2);
+
+  pthread_t th[THREADS];
+  for (long t = 0; t < THREADS; t++)
+    pthread_create(&th[t], NULL, worker, (void*)t);
+  int errs = 0;
+  for (int t = 0; t < THREADS; t++) {
+    pthread_join(th[t], NULL);
+    errs += g_errs[t];
+  }
+
+  int total = errs;
+  MPI_Allreduce(&errs, &total, 1, MPI_INT, MPI_MAX, MPI_COMM_WORLD);
+  if (g_rank == 0)
+    printf(total == 0 ? "concurrent-stress: OK\n"
+                      : "concurrent-stress: FAIL\n");
+  MPIX_Finalize();
+  MPI_Finalize();
+  return total != 0;
+}
