@@ -26,3 +26,4 @@ from mpi_acx_tpu.models.moe import (  # noqa: F401
     init_moe_params,
     moe_layer,
 )
+from mpi_acx_tpu.models import llama  # noqa: F401  (namespaced: llama.forward, ...)

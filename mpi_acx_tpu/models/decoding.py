@@ -1,0 +1,41 @@
+"""Shared greedy-decode scaffold for the model families.
+
+Both transformer.generate and llama.generate are this loop closed over
+their own prefill/decode_step; keeping the scaffold in one place keeps
+the max_seq position-clamp guard and the scan wiring from drifting.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def greedy_generate(prefill_fn: Callable, decode_fn: Callable,
+                    prompt, n_new: int, max_seq: int,
+                    max_len: Optional[int] = None):
+    """prompt [B, S] -> [B, S + n_new] by greedy argmax.
+
+    prefill_fn(tokens, max_len, last_only) -> (logits [B, *, vocab], cache)
+    decode_fn(cache, token [B]) -> (logits [B, vocab], cache)
+    """
+    B, S = prompt.shape
+    if max_len is None:
+        max_len = S + n_new
+    assert S + n_new <= max_len, (S, n_new, max_len)
+    # The position table/rope ceiling is hard: past it, position lookups
+    # clamp silently and every token reuses the last row.
+    assert S + n_new <= max_seq, (S, n_new, max_seq)
+    logits, cache = prefill_fn(prompt, max_len, True)
+    first = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+
+    def step(carry, _):
+        cache, tok = carry
+        logits, cache = decode_fn(cache, tok)
+        nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+        return (cache, nxt), tok
+
+    (_, _), toks = lax.scan(step, (cache, first), None, length=n_new)
+    return jnp.concatenate([prompt, jnp.moveaxis(toks, 0, 1)], axis=1)

@@ -1,0 +1,274 @@
+"""Llama-family decoder (RMSNorm, RoPE, SwiGLU, grouped-query attention),
+pure functional JAX.
+
+Second model family next to the GPT-2 transformer (models/transformer.py);
+the workload behind BASELINE.json configs[4] ("Llama-3-8B activation/grad
+pipeline exchange"). Same TPU-first construction: stacked-layer params
+scanned with ``lax.scan`` (stage-sliceable for pipeline parallelism with
+:func:`mpi_acx_tpu.models.transformer.stage_slice`-style reshapes), bf16
+compute with f32 norms/softmax, static shapes, and the shared flash/dense
+attention policy (GQA K/V heads are broadcast to query heads before the
+kernel — the cache still stores only ``n_kv_heads``, which is GQA's
+inference memory win).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 128256
+    d_model: int = 4096
+    n_heads: int = 32
+    n_kv_heads: int = 8          # GQA: queries share K/V head groups
+    n_layers: int = 32
+    d_ff: int = 14336            # SwiGLU hidden
+    max_seq: int = 8192
+    rope_theta: float = 500000.0
+    dtype: Any = jnp.bfloat16
+    use_flash: Optional[bool] = None  # None = shared auto policy
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def llama3_8b() -> LlamaConfig:
+    """Llama-3-8B geometry (BASELINE.json configs[4])."""
+    return LlamaConfig()
+
+
+def tiny_llama(vocab: int = 256, d_model: int = 64, n_heads: int = 4,
+               n_kv_heads: int = 2, n_layers: int = 2, d_ff: int = 128,
+               max_seq: int = 64) -> LlamaConfig:
+    """Small config for tests and virtual-mesh dryruns."""
+    return LlamaConfig(vocab=vocab, d_model=d_model, n_heads=n_heads,
+                       n_kv_heads=n_kv_heads, n_layers=n_layers, d_ff=d_ff,
+                       max_seq=max_seq, rope_theta=10000.0)
+
+
+Params = Dict[str, Any]
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
+    """Stacked-layer parameter pytree ([n_layers] leading axis per leaf)."""
+    k = jax.random.split(key, 8)
+    L, d, ff = cfg.n_layers, cfg.d_model, cfg.d_ff
+    dh, hq, hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    s = 0.02
+
+    def nrm(key, *shape, scale=s):
+        return jax.random.normal(key, shape, jnp.float32) * scale
+
+    return {
+        "embed": nrm(k[0], cfg.vocab, d),
+        "layers": {
+            "attn_norm": jnp.ones((L, d)),
+            "wq": nrm(k[1], L, d, hq * dh),
+            "wk": nrm(k[2], L, d, hkv * dh),
+            "wv": nrm(k[3], L, d, hkv * dh),
+            "wo": nrm(k[4], L, hq * dh, d, scale=s / (2 * L) ** 0.5),
+            "mlp_norm": jnp.ones((L, d)),
+            "w_gate": nrm(k[5], L, d, ff),
+            "w_up": nrm(k[6], L, d, ff),
+            "w_down": nrm(k[7], L, ff, d, scale=s / (2 * L) ** 0.5),
+        },
+        "final_norm": jnp.ones((d,)),
+        # Untied output head (Llama style).
+        "unembed": nrm(jax.random.fold_in(key, 99), cfg.vocab, d),
+    }
+
+
+def rmsnorm(x, g, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    rms = lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms * g).astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding; x [..., S, H, D], positions [S] (or [..., S])."""
+    D = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, D // 2, dtype=jnp.float32) / (D // 2))
+    ang = positions[..., None].astype(jnp.float32) * freqs       # [..., S, D/2]
+    cos = jnp.cos(ang)[..., None, :]                             # [..., S, 1, D/2]
+    sin = jnp.sin(ang)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, Hkv, D] -> [B, S, Hkv*n_rep, D]: broadcast K/V head groups
+    to the query heads (GQA -> MHA view for the attention kernel)."""
+    if n_rep == 1:
+        return x
+    B, S, H, D = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :],
+                            (B, S, H, n_rep, D)).reshape(B, S, H * n_rep, D)
+
+
+def _qkv(cfg: LlamaConfig, lp: Params, x: jax.Array, positions: jax.Array):
+    B, S, _ = x.shape
+    h = rmsnorm(x, lp["attn_norm"])
+    q = (h @ lp["wq"].astype(x.dtype)).reshape(B, S, cfg.n_heads,
+                                               cfg.head_dim)
+    k = (h @ lp["wk"].astype(x.dtype)).reshape(B, S, cfg.n_kv_heads,
+                                               cfg.head_dim)
+    v = (h @ lp["wv"].astype(x.dtype)).reshape(B, S, cfg.n_kv_heads,
+                                               cfg.head_dim)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _attend(cfg: LlamaConfig, q, k, v):
+    """Post-RoPE attention with K/V broadcast to query heads; the kernel
+    choice delegates to the shared flash/dense policy."""
+    from mpi_acx_tpu.ops.attention import (attention_reference,
+                                           auto_attention, flash_attention)
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    k, v = _repeat_kv(k, n_rep), _repeat_kv(v, n_rep)
+    if cfg.use_flash is None:
+        o = auto_attention(q, k, v)
+    elif cfg.use_flash:
+        o = flash_attention(q, k, v)
+    else:
+        o = attention_reference(q, k, v)
+    B, S = q.shape[:2]
+    return o.reshape(B, S, cfg.n_heads * cfg.head_dim)
+
+
+def _mlp(cfg: LlamaConfig, lp: Params, x: jax.Array):
+    h = rmsnorm(x, lp["mlp_norm"])
+    gate = jax.nn.silu(h @ lp["w_gate"].astype(x.dtype))
+    up = h @ lp["w_up"].astype(x.dtype)
+    return x + (gate * up) @ lp["w_down"].astype(x.dtype)
+
+
+def block(cfg: LlamaConfig, lp: Params, x: jax.Array,
+          positions: jax.Array) -> jax.Array:
+    q, k, v = _qkv(cfg, lp, x, positions)
+    x = x + _attend(cfg, q, k, v) @ lp["wo"].astype(x.dtype)
+    return _mlp(cfg, lp, x)
+
+
+def forward(params: Params, cfg: LlamaConfig,
+            tokens: jax.Array) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab] f32."""
+    B, S = tokens.shape
+    assert S <= cfg.max_seq, (S, cfg.max_seq)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    positions = jnp.arange(S)
+
+    def body(x, lp):
+        return block(cfg, lp, x, positions), None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    x = rmsnorm(x, params["final_norm"])
+    return jnp.einsum("bsd,vd->bsv", x, params["unembed"].astype(x.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def loss_fn(params: Params, cfg: LlamaConfig, tokens: jax.Array,
+            targets: jax.Array) -> jax.Array:
+    logits = forward(params, cfg, tokens)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+# -- KV-cache decode (GQA: the cache stores only n_kv_heads) ---------------
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int):
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def prefill(params: Params, cfg: LlamaConfig, tokens: jax.Array,
+            max_len: int, last_only: bool = False):
+    """Prompt pass filling a fresh KV cache (layout: init_kv_cache)."""
+    B, S = tokens.shape
+    assert S <= max_len and S <= cfg.max_seq, (S, max_len, cfg.max_seq)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    positions = jnp.arange(S)
+
+    def body(x, lp):
+        q, k, v = _qkv(cfg, lp, x, positions)
+        x = x + _attend(cfg, q, k, v) @ lp["wo"].astype(x.dtype)
+        x = _mlp(cfg, lp, x)
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, params["layers"])
+    x = rmsnorm(x, params["final_norm"])
+    if last_only:
+        x = x[:, -1:]
+    logits = jnp.einsum("bsd,vd->bsv", x, params["unembed"].astype(x.dtype),
+                        preferred_element_type=jnp.float32)
+    cache = init_kv_cache(cfg, B, max_len)
+    cache["k"] = lax.dynamic_update_slice(cache["k"], ks, (0,) * 5)
+    cache["v"] = lax.dynamic_update_slice(cache["v"], vs, (0,) * 5)
+    cache["pos"] = jnp.asarray(S, jnp.int32)
+    return logits, cache
+
+
+def decode_step(params: Params, cfg: LlamaConfig, cache,
+                token: jax.Array):
+    """One autoregressive step; token [B] -> (logits [B, vocab] f32,
+    updated cache). Fixed shapes: jit once per generation."""
+    B = token.shape[0]
+    pos = cache["pos"]
+    max_len = cache["k"].shape[2]
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    x = params["embed"][token][:, None, :].astype(cfg.dtype)
+    positions = jnp.full((1,), pos)
+
+    def body(x, layer):
+        lp, kc, vc = layer
+        q, k, v = _qkv(cfg, lp, x, positions)            # k,v [B,1,Hkv,D]
+        kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
+        vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
+        # Grouped attention straight against the un-repeated cache: query
+        # head g*n_rep + r reads K/V group g — no [B, L, Hq, D]
+        # materialization, preserving GQA's cache-bandwidth win.
+        qg = q.reshape(B, 1, cfg.n_kv_heads, n_rep, cfg.head_dim)
+        logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, kc).astype(jnp.float32)
+        logits = logits / jnp.sqrt(cfg.head_dim)
+        mask = jnp.arange(max_len) <= pos
+        logits = jnp.where(mask[None, None, None, None], logits,
+                           jnp.finfo(jnp.float32).min)
+        p = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bgrqk,bkgd->bqgrd", p, vc).reshape(
+            B, 1, cfg.n_heads * cfg.head_dim)
+        x = x + o @ lp["wo"].astype(x.dtype)
+        x = _mlp(cfg, lp, x)
+        return x, (kc, vc)
+
+    x, (ks, vs) = lax.scan(body, x, (params["layers"], cache["k"],
+                                     cache["v"]))
+    x = rmsnorm(x, params["final_norm"])
+    logits = jnp.einsum("bsd,vd->bsv", x, params["unembed"].astype(x.dtype),
+                        preferred_element_type=jnp.float32)[:, 0]
+    return logits, {"k": ks, "v": vs, "pos": pos + 1}
+
+
+def generate(params: Params, cfg: LlamaConfig, prompt: jax.Array,
+             n_new: int, max_len: Optional[int] = None) -> jax.Array:
+    """Greedy decode: prompt [B, S] -> [B, S + n_new]."""
+    from mpi_acx_tpu.models.decoding import greedy_generate
+    return greedy_generate(
+        lambda t, ml, lo: prefill(params, cfg, t, ml, last_only=lo),
+        lambda c, t: decode_step(params, cfg, c, t),
+        prompt, n_new, cfg.max_seq, max_len)

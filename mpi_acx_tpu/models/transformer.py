@@ -258,25 +258,11 @@ def generate(params: Params, cfg: TransformerConfig, prompt: jax.Array,
              n_new: int, max_len: Optional[int] = None) -> jax.Array:
     """Greedy decode: prompt [B, S] -> [B, S + n_new] (jit-compatible;
     the decode loop is a lax.scan of n_new fixed-shape steps)."""
-    B, S = prompt.shape
-    if max_len is None:
-        max_len = S + n_new
-    assert S + n_new <= max_len, (S, n_new, max_len)
-    # The position table is the hard ceiling: past it, the pos gather
-    # clamps silently and every token reuses the last row.
-    assert S + n_new <= cfg.max_seq, (S, n_new, cfg.max_seq)
-    logits, cache = prefill(params, cfg, prompt, max_len, last_only=True)
-    first = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
-
-    def step(carry, _):
-        cache, tok = carry
-        logits, cache = decode_step(params, cfg, cache, tok)
-        nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
-        return (cache, nxt), tok
-
-    (_, last), toks = lax.scan(step, (cache, first), None, length=n_new)
-    out = jnp.moveaxis(toks, 0, 1)                     # [B, n_new]
-    return jnp.concatenate([prompt, out], axis=1)
+    from mpi_acx_tpu.models.decoding import greedy_generate
+    return greedy_generate(
+        lambda t, ml, lo: prefill(params, cfg, t, ml, last_only=lo),
+        lambda c, t: decode_step(params, cfg, c, t),
+        prompt, n_new, cfg.max_seq, max_len)
 
 
 def stage_slice(params: Params, n_stages: int) -> Params:
