@@ -3,11 +3,23 @@
 Primary metric: enqueued Isend/Irecv ping-pong p50 latency (µs) through the
 full native stack (host execution queue -> flag table -> proxy -> socket
 wire), 2 processes under acxrun — BASELINE.md metric #2. Also reports
-partitioned-exchange bandwidth (host plane) and, when a TPU chip is
-present, flagship-model forward throughput on the MXU.
+partitioned-exchange bandwidth (host plane) and flagship-model forward
+throughput + MFU on the TPU chip.
+
+The TPU measurement runs in a SUBPROCESS with retries: the chip arrives
+via the axon tunnel and its PJRT init can fail or hang transiently
+(round 2 lost all TPU evidence to exactly that). A hung child is killed
+by timeout and retried; after the last attempt the failure is reported
+LOUDLY as a "tpu_error" field in the JSON line instead of being dropped.
+
+`python bench.py --full` additionally re-measures the secondary
+BASELINE.md rows (flash-attention speedup @ S=4096, KV-cache decode
+tok/s) and regression-checks all starred/TPU rows against BASELINE.md
+with a 10% tolerance, writing BENCH_FULL.json and exiting nonzero on any
+regression.
 
 The reference (NVIDIA/mpi-acx) publishes no numbers (SURVEY.md §6);
-BASELINE.md records our own round-2 measurements as the baseline, so
+BASELINE.md records our own measurements as the baseline, so
 vs_baseline tracks regression/improvement across rounds.
 """
 
@@ -24,6 +36,13 @@ sys.path.insert(0, REPO)
 # Round-2 baseline measurements (this machine, recorded in BASELINE.md).
 BASELINE_P50_US = 26.6
 BASELINE_PART_BW_GBPS = 1.12
+BASELINE_GPT2_FWD_TOKS = 221_900.0
+BASELINE_FLASH_SPEEDUP_4096 = 5.3
+BASELINE_DECODE_TOKS = 4_700.0
+
+# v5e bf16 peak: 197 TFLOP/s per chip (public spec).
+V5E_BF16_PEAK_FLOPS = 197e12
+GPT2_SMALL_PARAMS = 124e6
 
 
 def native_bench():
@@ -40,9 +59,34 @@ def native_bench():
     return float(m.group(1)), float(m.group(2))
 
 
-def tpu_bench():
-    """Flagship GPT-2 125M forward throughput (tokens/s) on the local
-    accelerator; None if JAX has no usable device.
+def _run_tpu_child(mode: str, attempts: int = 3, timeout: int = 420):
+    if attempts < 1:
+        return None, "skipped (previous TPU child exhausted its retries)"
+    """Run `bench.py --tpu-child-<mode>` in a fresh process, retrying on
+    failure/hang. Returns (parsed dict | None, last_error | None)."""
+    last = None
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 f"--tpu-child-{mode}"],
+                capture_output=True, text=True, timeout=timeout)
+            for line in r.stdout.splitlines():
+                if line.startswith("{"):
+                    return json.loads(line), None
+            last = (f"rc={r.returncode} no JSON in output; "
+                    f"stderr tail: {r.stderr[-300:]}")
+        except subprocess.TimeoutExpired:
+            last = f"timeout after {timeout}s (attempt {i + 1})"
+        except Exception as e:  # noqa: BLE001 — report, don't crash bench
+            last = f"{type(e).__name__}: {e}"
+        if i + 1 < attempts:
+            time.sleep(10 * (i + 1))   # tunnel hiccups are transient
+    return None, last
+
+
+def tpu_child_fwd():
+    """Child process: flagship GPT-2 125M forward throughput (tokens/s).
 
     The repetition loop runs ON DEVICE (lax.scan of REPS forwards with an
     iteration-dependent input so XLA can't hoist the body) and the result
@@ -50,45 +94,92 @@ def tpu_bench():
     round-trip (tens of ms through the axon tunnel), not the TPU — this
     methodology reports device throughput, which is what a deployment
     without the tunnel gets."""
-    try:
-        import jax
-        import jax.numpy as jnp
-        import importlib.util
-        spec = importlib.util.spec_from_file_location(
-            "graft_entry", os.path.join(REPO, "__graft_entry__.py"))
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        fn, (params, tokens) = mod.entry()
-        reps = 50
-        vocab = int(tokens.max()) + 1
+    import jax
+    import jax.numpy as jnp
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", os.path.join(REPO, "__graft_entry__.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fn, (params, tokens) = mod.entry()
+    reps = 50
+    vocab = int(tokens.max()) + 1
 
-        @jax.jit
-        def loop(params, tokens):
-            def body(carry, i):
-                acc, t = carry
-                ti = (t + i) % vocab
-                return (acc + fn(params, ti).sum(), t), None
-            (acc, _), _ = jax.lax.scan(
-                body, (jnp.zeros((), jnp.float32), tokens),
-                jnp.arange(reps))
-            return acc
+    @jax.jit
+    def loop(params, tokens):
+        def body(carry, i):
+            acc, t = carry
+            ti = (t + i) % vocab
+            return (acc + fn(params, ti).sum(), t), None
+        (acc, _), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), tokens),
+            jnp.arange(reps))
+        return acc
 
-        float(loop(params, tokens))                    # compile + warm
+    float(loop(params, tokens))                    # compile + warm
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(loop(params, tokens))                # device_get = sync
+        best = min(best, (time.perf_counter() - t0) / reps)
+    toks = tokens.size / best
+    # Forward-pass MFU: ~2 FLOPs per parameter per token on the matmuls.
+    mfu = toks * 2 * GPT2_SMALL_PARAMS / V5E_BF16_PEAK_FLOPS
+    print(json.dumps({
+        "gpt2_fwd_tokens_per_s": round(toks, 1),
+        "gpt2_fwd_mfu": round(mfu, 4),
+        "device": str(jax.devices()[0].platform),
+    }))
+
+
+def tpu_child_full():
+    """Child process: secondary BASELINE.md rows — flash-attention speedup
+    vs dense at S=4096 (GPT-2 heads) and KV-cache greedy decode tok/s."""
+    import jax
+    import jax.numpy as jnp
+    from mpi_acx_tpu.ops.attention import attention_reference, flash_attention
+    from mpi_acx_tpu.models import transformer as tfm
+
+    def timeit(f, *a, reps=10):
+        jax.block_until_ready(f(*a))               # compile + warm
         best = 1e9
         for _ in range(3):
             t0 = time.perf_counter()
-            float(loop(params, tokens))                # device_get = sync
+            for _ in range(reps):
+                out = f(*a)
+            jax.block_until_ready(out)
             best = min(best, (time.perf_counter() - t0) / reps)
-        toks = tokens.size / best
-        return round(toks, 1), str(jax.devices()[0].platform)
-    except Exception as e:  # no TPU / compile issue: report without it
-        print(f"bench: tpu path skipped: {e}", file=sys.stderr)
-        return None, None
+        return best
+
+    # Flash vs dense, GPT-2 head geometry, S=4096.
+    B, S, H, D = 1, 4096, 12, 64
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+               for kk in ks)
+    dense = jax.jit(attention_reference)
+    t_dense = timeit(dense, q, k, v)
+    t_flash = timeit(flash_attention, q, k, v)
+    speedup = t_dense / t_flash
+
+    # KV-cache greedy decode, B=8, bf16 weights.
+    cfg = tfm.gpt2_small()
+    params = tfm.cast_params(
+        tfm.init_params(jax.random.key(0), cfg), jnp.bfloat16)
+    B, S_p, n_new = 8, 32, 64
+    prompt = jax.random.randint(jax.random.key(1), (B, S_p), 0, cfg.vocab)
+    gen = jax.jit(lambda p, t: tfm.generate(p, cfg, t, n_new, max_len=256))
+    decode_toks = B * n_new / timeit(gen, params, prompt, reps=1)
+    print(json.dumps({
+        "flash_speedup_s4096": round(speedup, 2),
+        "flash_ms": round(t_flash * 1e3, 3),
+        "dense_ms": round(t_dense * 1e3, 3),
+        "decode_tokens_per_s": round(decode_toks, 1),
+        "device": str(jax.devices()[0].platform),
+    }))
 
 
-def main():
+def main(full: bool = False):
     p50, bw = native_bench()
-    toks, platform = tpu_bench()
     out = {
         "metric": "enqueued_pingpong_p50_latency",
         "value": p50,
@@ -98,11 +189,66 @@ def main():
         "partitioned_bw_gbps": bw,
         "partitioned_bw_vs_baseline": round(bw / BASELINE_PART_BW_GBPS, 3),
     }
-    if toks is not None:
-        out["gpt2_fwd_tokens_per_s"] = toks
-        out["device"] = platform
+    # Provisional line FIRST: if a driver timeout kills us mid-TPU-retry,
+    # the native metrics still reach the artifact (the driver parses the
+    # last JSON line, so a completed run supersedes this one).
+    provisional = dict(out)
+    provisional["tpu_error"] = "provisional line: TPU measurement pending"
+    print(json.dumps(provisional), flush=True)
+
+    fwd, err = _run_tpu_child("fwd")
+    if fwd is not None:
+        out.update(fwd)
+        out["gpt2_fwd_vs_baseline"] = round(
+            fwd["gpt2_fwd_tokens_per_s"] / BASELINE_GPT2_FWD_TOKS, 3)
+    else:
+        out["tpu_error"] = err     # LOUD: never silently drop the metric
+
+    checks = []
+    if full:
+        # Don't burn another 3x600s if the tunnel just proved dead.
+        sec, err2 = _run_tpu_child(
+            "full", attempts=3 if fwd is not None else 1, timeout=600)
+        if sec is not None:
+            out.update(sec)
+        else:
+            out["tpu_full_error"] = err2
+        # Regression gate: all five starred/TPU BASELINE.md rows, 10%.
+        def gate(name, value, baseline, higher_is_better=True):
+            if value is None:
+                checks.append({"metric": name, "ok": False,
+                               "reason": "not measured"})
+                return
+            if higher_is_better:
+                ok = value >= baseline * 0.9
+            else:                      # latency: at most 10% above baseline
+                ok = value <= baseline * 1.1
+            checks.append({"metric": name, "value": value,
+                           "baseline": baseline,
+                           "ratio": round(value / baseline, 3), "ok": ok})
+
+        gate("pingpong_p50_us", p50, BASELINE_P50_US, higher_is_better=False)
+        gate("partitioned_bw_gbps", bw, BASELINE_PART_BW_GBPS)
+        gate("gpt2_fwd_tokens_per_s",
+             (fwd or {}).get("gpt2_fwd_tokens_per_s"), BASELINE_GPT2_FWD_TOKS)
+        gate("flash_speedup_s4096",
+             (sec or {}).get("flash_speedup_s4096"),
+             BASELINE_FLASH_SPEEDUP_4096)
+        gate("decode_tokens_per_s",
+             (sec or {}).get("decode_tokens_per_s"), BASELINE_DECODE_TOKS)
+        out["regressions"] = [c["metric"] for c in checks if not c["ok"]]
+        with open(os.path.join(REPO, "BENCH_FULL.json"), "w") as f:
+            json.dump({"checks": checks, "result": out}, f, indent=1)
+
     print(json.dumps(out))
+    if full and any(not c["ok"] for c in checks):
+        sys.exit(1)
 
 
 if __name__ == "__main__":
-    main()
+    if "--tpu-child-fwd" in sys.argv:
+        tpu_child_fwd()
+    elif "--tpu-child-full" in sys.argv:
+        tpu_child_full()
+    else:
+        main(full="--full" in sys.argv)
