@@ -27,6 +27,9 @@ from mpi_acx_tpu.ops.flags import (  # noqa: F401
     produce_and_pready,
 )
 from mpi_acx_tpu.ops.attention import (  # noqa: F401
-    flash_attention,
     attention_reference,
+    auto_attention,
+    flash_attention,
+    flash_attention_lse,
+    select_attention,
 )

@@ -13,15 +13,18 @@ blockwise from (q, k, v, o) in pure JAX — one streaming pass rebuilds the
 row logsumexp, a second applies the standard flash-backward formulas
 (dS = P * (dP - rowsum(dO*O))) — O(S * block_k) peak memory, so training
 (e.g. make_train_step on long sequences) differentiates straight through
-the Pallas call. (The lse is recomputed rather than emitted by the kernel
-because multi-output pallas_call hangs the axon remote-compile path; the
-extra QK sweep costs ~1/5 of the backward's FLOPs and keeps the
-inference forward at zero overhead.)
+the Pallas call. (For plain :func:`flash_attention` the lse is recomputed
+rather than emitted because multi-output pallas_call hangs the axon
+remote-compile path; the extra QK sweep costs ~1/5 of the backward's
+FLOPs and keeps the inference forward at zero overhead.)
 
-This is also the single-chip building block of
-:func:`mpi_acx_tpu.parallel.ring_attention.ring_attention`: ring attention
-rotates K/V shards around the mesh while each step runs exactly this
-blockwise inner kernel on the resident shard.
+:func:`flash_attention_lse` is the variant that DOES emit the row
+logsumexp — packed into one extra lane column of a single output, so the
+single-output constraint holds — and its backward reuses the emitted lse
+and folds the lse cotangent into dS. It is the single-chip building block
+of :func:`mpi_acx_tpu.parallel.ring_attention.ring_attention`: ring
+attention rotates K/V shards around the mesh while each step runs exactly
+this kernel on the resident shard and merges blocks by logaddexp.
 
 Runs compiled on TPU; falls back to Pallas interpret mode elsewhere (the
 CPU test mesh), same code path.
@@ -65,15 +68,31 @@ def auto_attention(q, k, v, causal: bool = True):
     return attention_reference(q, k, v, causal=causal)
 
 
+def select_attention(use_flash):
+    """THE single flash/dense dispatch for a ``use_flash`` config field
+    (both model families route here so the policy can't drift):
+    ``None`` -> per-shape auto policy, ``True`` -> Pallas flash kernel,
+    ``False`` -> dense reference. All returned callables take
+    ``(q, k, v, causal=True)`` on [B, S, H, D]."""
+    if use_flash is None:
+        return auto_attention
+    return flash_attention if use_flash else attention_reference
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
-                  scale, causal):
+                  scale, causal, emit_lse=False):
     """One (batch, head, q-block) program: online softmax over k blocks.
 
     Causal masking is only evaluated on the blocks that straddle the
     diagonal; the (majority) fully-below-diagonal blocks run the unmasked
     fast loop. Dots run in the input dtype with f32 accumulation; for f32
     inputs the MXU is asked for HIGHEST precision (its default f32 path is
-    bf16-pass multiplication, ~1e-2 absolute error — measured on v5e)."""
+    bf16-pass multiplication, ~1e-2 absolute error — measured on v5e).
+
+    With ``emit_lse`` the out block is f32 [block_q, D+1]: the normalized
+    output in lanes [0, D) and the row logsumexp in lane D. Packing into
+    ONE output keeps the kernel single-output (multi-output pallas_call
+    hangs the axon remote-compile path; see module docstring)."""
     i = pl.program_id(2)
     prec = (jax.lax.Precision.HIGHEST if q_ref.dtype == jnp.float32
             else jax.lax.Precision.DEFAULT)
@@ -122,7 +141,25 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
         n_kv = k_ref.shape[2] // block_k
         m, l, acc = jax.lax.fori_loop(
             0, n_kv, lambda j, c: step(j, c, masked=False), (m0, l0, acc0))
-    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    if emit_lse:
+        lse = m + jnp.log(l)                             # [BQ, 1] f32
+        o_ref[0, 0] = jnp.concatenate([acc / l, lse], axis=-1).astype(
+            o_ref.dtype)
+    else:
+        o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+def _out_struct(shape, dtype, *operands):
+    """ShapeDtypeStruct for a pallas_call output, carrying the union of the
+    operands' varying-mesh-axes so the kernel can run inside a shard_map
+    with check_vma=True (e.g. as ring attention's block primitive)."""
+    try:
+        vma = frozenset().union(*(jax.typeof(x).vma for x in operands))
+    except Exception:
+        vma = frozenset()
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _fit_blocks(S, block_q, block_k):
@@ -157,7 +194,7 @@ def _flash_fwd_impl(qt, kt, vt, causal, block_q, block_k):
         out_specs=pl.BlockSpec((1, 1, block_q, D),
                                lambda b, h, i: (b, h, i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, H, S, D), qt.dtype),
+        out_shape=_out_struct((B, H, S, D), qt.dtype, qt, kt, vt),
         interpret=jax.default_backend() != "tpu",
     )(qt, kt, vt)
 
@@ -172,19 +209,22 @@ def _flash_vjp_fwd(qt, kt, vt, causal, block_q, block_k):
     return o, (qt, kt, vt, o)
 
 
-def _flash_vjp_bwd(causal, block_q, block_k, res, do):
+def _flash_bwd_blockwise(qt, kt, vt, o, do, causal, block_q, block_k,
+                         lse=None, dlse=None):
     """Blockwise flash backward in pure JAX ([B, H, S, D] operands).
 
     Outer scan over q blocks; for each, an inner fori_loop over exactly
     the k blocks at-or-below the diagonal (causal skips the rest, like the
-    forward kernel) first rebuilds that q block's row logsumexp, then
-    applies the standard flash-backward formulas:
+    forward kernel) first rebuilds that q block's row logsumexp (skipped
+    when the forward emitted ``lse`` [B, H, S]), then applies the standard
+    flash-backward formulas:
       dV_j += P_j^T dO;  dP_j = dO V_j^T;  D = rowsum(dO * O)
-      dS_j = P_j * (dP_j - D) * scale;  dQ += dS_j K_j;  dK_j += dS_j^T Q
-    Peak extra memory is [B, H, block_q, block_k] per step.
+      dS_j = P_j * (dP_j - D + dLSE) * scale;  dQ += dS_j K_j;  dK_j += dS_j^T Q
+    (the dLSE term is the cotangent of an emitted lse output: d lse_i /
+    d s_ij = P_ij). Peak extra memory is [B, H, block_q, block_k] per step.
     """
-    qt, kt, vt, o = res
     B, H, S, Dh = qt.shape
+    Sk = kt.shape[2]
     scale = 1.0 / (Dh ** 0.5)
     k32 = kt.astype(jnp.float32)
     v32 = vt.astype(jnp.float32)
@@ -204,7 +244,7 @@ def _flash_vjp_bwd(causal, block_q, block_k, res, do):
             # this q block (same bound as the forward kernel's n_diag).
             n_kv = (q0 + block_q + block_k - 1) // block_k
         else:
-            n_kv = S // block_k
+            n_kv = Sk // block_k
 
         def logits(j):
             kb = jax.lax.dynamic_slice_in_dim(k32, j * block_k, block_k,
@@ -215,18 +255,27 @@ def _flash_vjp_bwd(causal, block_q, block_k, res, do):
                 s = jnp.where((rows >= cols)[None, None], s, _NEG_INF)
             return s, kb
 
-        def lse_step(j, carry):
-            m, l = carry
-            s, _ = logits(j)
-            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-            l = l * jnp.exp(m - m_new) + jnp.sum(
-                jnp.exp(s - m_new[..., None]), axis=-1)
-            return m_new, l
+        if lse is not None:
+            lse_b = jax.lax.dynamic_slice_in_dim(lse, q0, block_q, axis=2)
+        else:
+            def lse_step(j, carry):
+                m, l = carry
+                s, _ = logits(j)
+                m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+                l = l * jnp.exp(m - m_new) + jnp.sum(
+                    jnp.exp(s - m_new[..., None]), axis=-1)
+                return m_new, l
 
-        m0 = jnp.full((B, H, block_q), _NEG_INF, jnp.float32)
-        m, l = jax.lax.fori_loop(0, n_kv, lse_step,
-                                 (m0, jnp.zeros_like(m0)))
-        lse_b = m + jnp.log(l)                                 # [B,H,bq]
+            m0 = jnp.full((B, H, block_q), _NEG_INF, jnp.float32)
+            m, l = jax.lax.fori_loop(0, n_kv, lse_step,
+                                     (m0, jnp.zeros_like(m0)))
+            lse_b = m + jnp.log(l)                             # [B,H,bq]
+
+        rowterm = Db[..., None]
+        if dlse is not None:
+            dlse_b = jax.lax.dynamic_slice_in_dim(
+                dlse.astype(jnp.float32), q0, block_q, axis=2)
+            rowterm = rowterm - dlse_b[..., None]
 
         def grad_step(j, carry):
             dq_b, dk_acc, dv_acc = carry
@@ -236,7 +285,7 @@ def _flash_vjp_bwd(causal, block_q, block_k, res, do):
                                               axis=2)
             dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, dob)
             dp = jnp.einsum("bhqd,bhkd->bhqk", dob, vb)
-            ds = p * (dp - Db[..., None]) * scale
+            ds = p * (dp - rowterm) * scale
             dq_b = dq_b + jnp.einsum("bhqk,bhkd->bhqd", ds, kb)
             dk_j = jnp.einsum("bhqk,bhqd->bhkd", ds, qb)
 
@@ -253,7 +302,7 @@ def _flash_vjp_bwd(causal, block_q, block_k, res, do):
             0, n_kv, grad_step, (dq_b0, dk_acc, dv_acc))
         return (dk_acc, dv_acc), dq_b
 
-    zeros = jnp.zeros((B, H, S, Dh), jnp.float32)
+    zeros = jnp.zeros((B, H, Sk, Dh), jnp.float32)
     (dk, dv), dq_blocks = jax.lax.scan(qblock, (zeros, zeros),
                                        jnp.arange(S // block_q))
     # [n_q, B, H, bq, D] -> [B, H, S, D]
@@ -261,7 +310,67 @@ def _flash_vjp_bwd(causal, block_q, block_k, res, do):
     return dq.astype(qt.dtype), dk.astype(kt.dtype), dv.astype(vt.dtype)
 
 
+def _flash_vjp_bwd(causal, block_q, block_k, res, do):
+    qt, kt, vt, o = res
+    return _flash_bwd_blockwise(qt, kt, vt, o, do, causal, block_q, block_k)
+
+
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# -- LSE-emitting variant (the building block of ring attention) -----------
+
+
+def _flash_lse_fwd_impl(qt, kt, vt, causal, block_q, block_k):
+    """Packed pallas call on [B, H, Sq, D] / [B, H, Sk, D] operands ->
+    f32 [B, H, Sq, D+1] (normalized output ‖ row logsumexp). Sk may differ
+    from Sq in the non-causal case (ring/cross blocks). Single output on
+    purpose — see _flash_kernel."""
+    B, H, S, D = qt.shape
+    Sk = kt.shape[2]
+    assert not causal or S == Sk, (S, Sk)
+    scale = 1.0 / (D ** 0.5)
+    kernel = functools.partial(_flash_kernel, block_q=block_q,
+                               block_k=block_k, scale=scale, causal=causal,
+                               emit_lse=True)
+    packed = pl.pallas_call(
+        kernel,
+        grid=(B, H, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, Sk, D), lambda b, h, i: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, Sk, D), lambda b, h, i: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D + 1),
+                               lambda b, h, i: (b, h, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=_out_struct((B, H, S, D + 1), jnp.float32, qt, kt, vt),
+        interpret=jax.default_backend() != "tpu",
+    )(qt, kt, vt)
+    return packed[..., :D].astype(qt.dtype), packed[..., D]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_lse(qt, kt, vt, causal, block_q, block_k):
+    return _flash_lse_fwd_impl(qt, kt, vt, causal, block_q, block_k)
+
+
+def _flash_lse_vjp_fwd(qt, kt, vt, causal, block_q, block_k):
+    o, lse = _flash_lse_fwd_impl(qt, kt, vt, causal, block_q, block_k)
+    return (o, lse), (qt, kt, vt, o, lse)
+
+
+def _flash_lse_vjp_bwd(causal, block_q, block_k, res, cts):
+    do, dlse = cts
+    qt, kt, vt, o, lse = res
+    return _flash_bwd_blockwise(qt, kt, vt, o, do, causal, block_q, block_k,
+                                lse=lse, dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
@@ -273,8 +382,9 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
     D rides the lane dimension as-is (Mosaic handles sub-128 lane widths;
     padding to 128 would double both FLOPs and HBM traffic for the common
     D=64). Block sizes shrink to the largest divisor of S when S isn't a
-    multiple of the requested block (S itself must divide by 128, or be
-    smaller than 128 entirely).
+    multiple of the requested block. On a real TPU, S must be a multiple
+    of 128 (Mosaic tiling; ``auto_attention`` guards this) — interpret
+    mode (any non-TPU backend) accepts any S that divides by 8.
     """
     B, S, H, D = q.shape
     block_q, block_k = _fit_blocks(S, block_q, block_k)
@@ -285,3 +395,31 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
     out = _flash(to_bhsd(q), to_bhsd(k), to_bhsd(v), causal, block_q,
                  block_k)
     return jnp.transpose(out, (0, 2, 1, 3))              # [B, S, H, D]
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def flash_attention_lse(q, k, v, causal: bool = True, block_q: int = 512,
+                        block_k: int = 512):
+    """Flash attention that also returns the row logsumexp — the merge
+    state sequence-parallel strategies need. [B, S, H, D] in; returns
+    ``(o [B, S, H, D], lse [B, H, S] f32)`` where ``lse[b,h,s] =
+    log sum_k exp(q·k/sqrt(D))`` over the visible keys. Two partial
+    results merge exactly:
+      ``lse = logaddexp(lse1, lse2); o = o1*exp(lse1-lse) + o2*exp(lse2-lse)``
+    Differentiable in both outputs (custom VJP; the backward reuses the
+    emitted lse instead of recomputing it, and folds the lse cotangent
+    into dS — see _flash_bwd_blockwise). Same shape rules as
+    :func:`flash_attention`, except K/V sequence length may differ from
+    Q's in the non-causal case (ring/cross attention blocks).
+    """
+    B, S, H, D = q.shape
+    Sk = k.shape[1]
+    block_q, _ = _fit_blocks(S, block_q, block_k)
+    _, block_k = _fit_blocks(Sk, block_q, block_k)
+
+    def to_bhsd(x):
+        return jnp.transpose(x, (0, 2, 1, 3))            # [B, H, S, D]
+
+    o, lse = _flash_lse(to_bhsd(q), to_bhsd(k), to_bhsd(v), causal,
+                        block_q, block_k)
+    return jnp.transpose(o, (0, 2, 1, 3)), lse           # [B, S, H, D]

@@ -25,6 +25,8 @@ from mpi_acx_tpu.parallel.partitioned import (  # noqa: F401
 )
 from mpi_acx_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
+    ring_attention_batched,
+    ring_attention_sharded,
     blockwise_attention_reference,
 )
 from mpi_acx_tpu.parallel.pipeline import (  # noqa: F401

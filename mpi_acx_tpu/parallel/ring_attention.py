@@ -4,15 +4,22 @@ The long-context capability of the framework (first-class per the build
 goals): each device holds a sequence block of Q, K, V; K/V blocks rotate
 around the ring (collective-permute over ICI) while each device
 accumulates its Q-block's attention over every K/V block using the
-numerically stable running-max/log-sum-exp merge (flash-attention style).
-After `n` steps every Q block has attended to the full sequence, with peak
-memory O(seq/n) and the K/V transfer of step k overlapping the attention
-compute of step k-1 — the same produce/transmit overlap the reference's
+numerically stable logsumexp merge (flash-attention style). After `n`
+steps every Q block has attended to the full sequence, with peak memory
+O(seq/n) and the K/V transfer of step k overlapping the attention compute
+of step k-1 — the same produce/transmit overlap the reference's
 partitioned primitive provides on the host plane (SURVEY.md §5.7 maps
 partitioned comm to exactly this pipelined exchange).
 
-Causal masking uses static block indices (device index is static under
-shard_map with a full ring permutation), so XLA sees static control flow.
+Each ring step's block-pair attention runs the Pallas flash kernel
+(:func:`mpi_acx_tpu.ops.attention.flash_attention_lse`) when profitable —
+the kernel returns (normalized output, row logsumexp), exactly the merge
+state the ring needs, so the sequence-parallel path keeps the single-chip
+flash advantage. A K/V block is, per the causal structure, entirely
+visible (source block before this device's block: unmasked flash call),
+entirely masked (source after: skipped — no FLOPs at all), or diagonal
+(the standard causal flash call); the three cases dispatch by
+``lax.switch`` on the rotating source index.
 """
 
 from __future__ import annotations
@@ -25,74 +32,139 @@ from jax import lax
 
 from mpi_acx_tpu.parallel.collective import _ring_perm
 
+_NEG = float(jnp.finfo(jnp.float32).min)
 
-def _block_attend(q, k, v, mask):
-    """One Q-block x K-block attention: returns (unnorm_out, row_max,
-    row_sumexp) for LSE merging. Shapes: q [Sq, H, D], k/v [Sk, H, D]."""
-    d = q.shape[-1]
-    # [H, Sq, Sk]
-    logits = jnp.einsum("qhd,khd->hqk", q, k) / jnp.sqrt(d).astype(q.dtype)
-    logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
-    m = jnp.max(logits, axis=-1)                      # [H, Sq]
+
+def _dense_block(q32, kk, vv, mask):
+    """One Q-block x K-block dense attention: returns (normalized_out
+    [mb, Sq, H, D] f32, lse [mb, H, Sq] f32). Fully-masked rows get
+    lse = finfo.min (an additive identity for the logaddexp merge)."""
+    d = q32.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q32, kk.astype(jnp.float32))
+    logits = logits / jnp.sqrt(d)
+    logits = jnp.where(mask, logits, _NEG)
+    m = jnp.max(logits, axis=-1)                      # [mb, H, Sq]
     p = jnp.exp(logits - m[..., None])
     p = jnp.where(mask, p, 0.0)                       # kill fully-masked rows
-    l = jnp.sum(p, axis=-1)                           # [H, Sq]
-    o = jnp.einsum("hqk,khd->qhd", p, v)              # unnormalized
-    return o, m, l
+    l = jnp.sum(p, axis=-1)                           # [mb, H, Sq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, vv.astype(jnp.float32))
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-37)), _NEG)
+    o = o / jnp.moveaxis(jnp.maximum(l, 1e-37), 1, 2)[..., None]
+    return o, lse
 
 
-def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
-                   causal: bool = True) -> jax.Array:
+def ring_attention_batched(q: jax.Array, k: jax.Array, v: jax.Array,
+                           axis_name: str, causal: bool = True,
+                           use_flash: bool | None = None,
+                           kv_repeat: int = 1) -> jax.Array:
     """Exact (optionally causal) attention with K/V rotating on the ring.
 
-    Per-shard shapes: q, k, v = [seq_shard, heads, head_dim]; the global
-    sequence is the concatenation of shards in mesh order. Returns the
-    attention output for the local Q block, [seq_shard, heads, head_dim].
+    Per-shard shapes: q = [mb, seq_shard, heads, head_dim]; k, v =
+    [mb, seq_shard, heads/kv_repeat, head_dim]; the global sequence is the
+    concatenation of shards in mesh order. Returns the attention output
+    for the local Q block, same shape as q.
+
+    kv_repeat > 1 is grouped-query attention: the ring rotates the
+    UN-expanded K/V heads (kv_repeat x less ICI traffic per ppermute —
+    the bandwidth GQA exists to save) and each block broadcasts them to
+    the query heads locally, where XLA fuses the broadcast into the dots.
+
+    use_flash: None -> auto (Pallas kernel on TPU for shards past the
+    measured crossover), True/False -> force. The dense and flash paths
+    produce identical math; both yield (normalized block output, lse) and
+    merge with logaddexp, so switching kernels never changes numerics
+    beyond float roundoff.
     """
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
-    sq = q.shape[0]
-    h = q.shape[1]
+    mb, sq, h, dh = q.shape
+    assert k.shape[2] * kv_repeat == h, (k.shape, h, kv_repeat)
+    if use_flash is None:
+        use_flash = (jax.default_backend() == "tpu" and sq >= 1024
+                     and sq % 128 == 0)
 
-    neg = jnp.finfo(jnp.float32).min
+    def expand(x):
+        # kv-head g serves query heads [g*kv_repeat, (g+1)*kv_repeat) —
+        # the same layout as the model families' _repeat_kv.
+        if kv_repeat == 1:
+            return x
+        hkv = x.shape[2]
+        return jnp.broadcast_to(
+            x[:, :, :, None, :],
+            (mb, x.shape[1], hkv, kv_repeat, dh)).reshape(
+                mb, x.shape[1], h, dh)
+
+    if use_flash:
+        from mpi_acx_tpu.ops.attention import flash_attention_lse
+
+        def full_fn(q_, kk, vv):
+            o, lse = flash_attention_lse(q_, expand(kk), expand(vv),
+                                         causal=False)
+            return o.astype(jnp.float32), lse
+
+        def diag_fn(q_, kk, vv):
+            o, lse = flash_attention_lse(q_, expand(kk), expand(vv),
+                                         causal=True)
+            return o.astype(jnp.float32), lse
+
+        def skip_fn(q_, kk, vv):
+            return (jnp.zeros((mb, sq, h, dh), jnp.float32),
+                    jnp.full((mb, h, sq), _NEG, jnp.float32))
+
+        def block_fn(q_, kk, vv, src):
+            if not causal:
+                return full_fn(q_, kk, vv)
+            idx = jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
+            return lax.switch(idx, (full_fn, diag_fn, skip_fn), q_, kk, vv)
+
+        q_in = q
+    else:
+        def block_fn(q_, kk, vv, src):
+            if causal:
+                qpos = my * sq + jnp.arange(sq)[:, None]            # [Sq,1]
+                kpos = src * sq + jnp.arange(kk.shape[1])[None, :]  # [1,Sk]
+                mask = (kpos <= qpos)[None, None]              # [1,1,Sq,Sk]
+            else:
+                mask = jnp.ones((1, 1, sq, kk.shape[1]), bool)
+            return _dense_block(q_, expand(kk), expand(vv), mask)
+
+        q_in = q.astype(jnp.float32)
+
     # Accumulators are device-varying from step 0 (they mix in rotated K/V);
     # mark them so the scan carry type is stable under shard_map's vma check.
     o0 = lax.pcast(jnp.zeros(q.shape, jnp.float32), axis_name, to="varying")
-    m0 = lax.pcast(jnp.full((h, sq), neg, jnp.float32), axis_name,
-                   to="varying")
-    l0 = lax.pcast(jnp.zeros((h, sq), jnp.float32), axis_name, to="varying")
-
-    q32 = q.astype(jnp.float32)
+    lse0 = lax.pcast(jnp.full((mb, h, sq), _NEG, jnp.float32), axis_name,
+                     to="varying")
 
     def step(carry, t):
-        o_acc, m_acc, l_acc, kk, vv = carry
+        o_acc, lse_acc, kk, vv = carry
         # K/V block currently held arrived from `t` ring steps back.
         src = (my - t) % n
-        if causal:
-            qpos = my * sq + jnp.arange(sq)[:, None]          # [Sq, 1]
-            kpos = src * sq + jnp.arange(kk.shape[0])[None, :]  # [1, Sk]
-            mask = (kpos <= qpos)[None]                        # [1, Sq, Sk]
-        else:
-            mask = jnp.ones((1, sq, kk.shape[0]), bool)
-        o, m, l = _block_attend(q32, kk.astype(jnp.float32),
-                                vv.astype(jnp.float32), mask)
-        # LSE merge of (o_acc, m_acc, l_acc) with the new block.
-        m_new = jnp.maximum(m_acc, m)
-        a = jnp.exp(m_acc - m_new)      # rescale old accumulator
-        b = jnp.exp(m - m_new)          # rescale new block
-        l_new = l_acc * a + l * b
-        o_new = (o_acc * a.transpose(1, 0)[:, :, None]
-                 + o * b.transpose(1, 0)[:, :, None])
+        o_b, lse_b = block_fn(q_in, kk, vv, src)
+        # logaddexp merge. finfo.min sentinels stay finite, so the weights
+        # are well-defined with no NaN guard: a finfo.min-vs-finfo.min
+        # merge gives weight 1 on a zero block output.
+        lse_new = jnp.logaddexp(lse_acc, lse_b)
+        wa = jnp.exp(lse_acc - lse_new)                   # [mb, H, Sq]
+        wb = jnp.exp(lse_b - lse_new)
+        o_new = (o_acc * jnp.moveaxis(wa, 1, 2)[..., None]
+                 + o_b * jnp.moveaxis(wb, 1, 2)[..., None])
         # Rotate K/V to the right neighbor for the next step; XLA overlaps
         # this transfer with the next iteration's compute.
         kk = lax.ppermute(kk, axis_name, perm=_ring_perm(n, 1))
         vv = lax.ppermute(vv, axis_name, perm=_ring_perm(n, 1))
-        return (o_new, m_new, l_new, kk, vv), None
+        return (o_new, lse_new, kk, vv), None
 
-    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
-    # Normalize; fully-masked rows (none in causal self-attention) guard.
-    denom = jnp.maximum(l, 1e-20).transpose(1, 0)[:, :, None]
-    return (o / denom).astype(q.dtype)
+    (o, _, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(n))
+    return o.astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
+                   causal: bool = True,
+                   use_flash: bool | None = None) -> jax.Array:
+    """3-D per-shard form: q, k, v = [seq_shard, heads, head_dim]."""
+    return ring_attention_batched(q[None], k[None], v[None], axis_name,
+                                  causal=causal, use_flash=use_flash)[0]
 
 
 def blockwise_attention_reference(q, k, v, causal=True):
@@ -109,13 +181,22 @@ def blockwise_attention_reference(q, k, v, causal=True):
 
 
 def ring_attention_sharded(q, k, v, mesh, axis_name: str = "x",
-                           causal: bool = True):
+                           causal: bool = True,
+                           use_flash: bool | None = None):
     """Array-level wrapper: q/k/v sharded on the sequence (leading) axis."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(axis_name)
+    # check_vma=False: the Pallas interpreter (CPU path) can't yet mix
+    # varying and non-varying operands inside its internal dynamic_slice
+    # ("Primitive dynamic_slice requires varying manual axes to match ...
+    # as a temporary workaround pass check_vma=False"); the distributed
+    # train step (train.py) runs the same per-shard function with
+    # check_vma=False as well.
     f = shard_map(
-        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        functools.partial(ring_attention, axis_name=axis_name, causal=causal,
+                          use_flash=use_flash),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
     return f(q, k, v)

@@ -25,20 +25,27 @@ def _qkv(key, s, h, d):
     return q, k, v
 
 
+@pytest.mark.parametrize("use_flash", [False, True])
 @pytest.mark.parametrize("causal", [False, True])
-def test_ring_attention_matches_reference(mesh, causal):
+def test_ring_attention_matches_reference(mesh, causal, use_flash):
+    # use_flash=True exercises the Pallas flash_attention_lse block path
+    # (interpret mode on the CPU mesh) including the lax.switch dispatch
+    # over full/diagonal/skipped K/V blocks.
     q, k, v = _qkv(jax.random.key(0), s=64, h=4, d=16)
-    got = ring_attention_sharded(q, k, v, mesh, causal=causal)
+    got = ring_attention_sharded(q, k, v, mesh, causal=causal,
+                                 use_flash=use_flash)
     want = blockwise_attention_reference(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-5, rtol=2e-5)
 
 
-def test_ring_attention_grads_match(mesh):
+@pytest.mark.parametrize("use_flash", [False, True])
+def test_ring_attention_grads_match(mesh, use_flash):
     q, k, v = _qkv(jax.random.key(1), s=32, h=2, d=8)
 
     def loss_ring(q, k, v):
-        return jnp.sum(ring_attention_sharded(q, k, v, mesh, causal=True) ** 2)
+        return jnp.sum(ring_attention_sharded(q, k, v, mesh, causal=True,
+                                              use_flash=use_flash) ** 2)
 
     def loss_ref(q, k, v):
         return jnp.sum(blockwise_attention_reference(q, k, v, causal=True) ** 2)
