@@ -132,16 +132,10 @@ def _qkv(cfg: LlamaConfig, lp: Params, x: jax.Array, positions: jax.Array):
 def _attend(cfg: LlamaConfig, q, k, v):
     """Post-RoPE attention with K/V broadcast to query heads; the kernel
     choice delegates to the shared flash/dense policy."""
-    from mpi_acx_tpu.ops.attention import (attention_reference,
-                                           auto_attention, flash_attention)
+    from mpi_acx_tpu.ops.attention import select_attention
     n_rep = cfg.n_heads // cfg.n_kv_heads
     k, v = _repeat_kv(k, n_rep), _repeat_kv(v, n_rep)
-    if cfg.use_flash is None:
-        o = auto_attention(q, k, v)
-    elif cfg.use_flash:
-        o = flash_attention(q, k, v)
-    else:
-        o = attention_reference(q, k, v)
+    o = select_attention(cfg.use_flash)(q, k, v)
     B, S = q.shape[:2]
     return o.reshape(B, S, cfg.n_heads * cfg.head_dim)
 

@@ -99,15 +99,8 @@ def _attend(cfg: TransformerConfig, q, k, v):
     """Causal attention with the per-shape kernel choice (flash vs dense);
     [B, S, H, Dh] -> [B, S, d]."""
     B, S = q.shape[:2]
-    if cfg.use_flash is None:
-        from mpi_acx_tpu.ops.attention import auto_attention
-        o = auto_attention(q, k, v)
-    elif cfg.use_flash:
-        from mpi_acx_tpu.ops.attention import flash_attention
-        o = flash_attention(q, k, v)
-    else:
-        from mpi_acx_tpu.ops.attention import attention_reference
-        o = attention_reference(q, k, v)
+    from mpi_acx_tpu.ops.attention import select_attention
+    o = select_attention(cfg.use_flash)(q, k, v)
     return o.reshape(B, S, cfg.d_model)
 
 
@@ -267,8 +260,10 @@ def generate(params: Params, cfg: TransformerConfig, prompt: jax.Array,
 
 def stage_slice(params: Params, n_stages: int) -> Params:
     """Reshape stacked layers [L, ...] -> [n_stages, L/n_stages, ...] so a
-    shard_map P('pp') spec hands each pipeline stage its own layer block."""
-    L = params["layers"]["ln1_g"].shape[0]
+    shard_map P('pp') spec hands each pipeline stage its own layer block.
+    Works on any family's params dict with a stacked 'layers' subtree
+    (GPT-2 and Llama both)."""
+    L = jax.tree.leaves(params["layers"])[0].shape[0]
     assert L % n_stages == 0, (L, n_stages)
     per = L // n_stages
 

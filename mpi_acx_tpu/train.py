@@ -28,7 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from mpi_acx_tpu.models import transformer as tfm
 from mpi_acx_tpu.parallel.pipeline import pipeline_forward
-from mpi_acx_tpu.parallel.ring_attention import ring_attention
+from mpi_acx_tpu.parallel.ring_attention import ring_attention_batched
 
 
 def _block_sp_tp(cfg: tfm.TransformerConfig, lp: Dict[str, Any],
@@ -52,9 +52,8 @@ def _block_sp_tp(cfg: tfm.TransformerConfig, lp: Dict[str, Any],
     q = q.reshape(mb, blk, H, Dh)
     k = k.reshape(mb, blk, H, Dh)
     v = v.reshape(mb, blk, H, Dh)
-    attend = jax.vmap(
-        functools.partial(ring_attention, axis_name=tp_axis, causal=True))
-    o = attend(q, k, v).reshape(mb, blk, d)
+    o = ring_attention_batched(q, k, v, tp_axis, causal=True,
+                               use_flash=cfg.use_flash).reshape(mb, blk, d)
     o = o @ lp["wo"].astype(h.dtype)
     # Re-assemble the full sequence on every tp rank.
     attn = lax.all_gather(o, tp_axis, axis=1, tiled=True)     # [mb, S, d]
@@ -68,8 +67,53 @@ def _block_sp_tp(cfg: tfm.TransformerConfig, lp: Dict[str, Any],
     return h + lax.psum(part, tp_axis) + lp["b2"].astype(h.dtype)
 
 
+def _llama_block_sp_tp(cfg, lp: Dict[str, Any], h: jax.Array,
+                       tp_axis: str) -> jax.Array:
+    """Llama block (RMSNorm + RoPE + GQA + SwiGLU), sequence-parallel
+    attention + tensor-parallel MLP — the Llama-family counterpart of
+    :func:`_block_sp_tp` (BASELINE.json configs[4]).
+
+    h: [mb, S, d] replicated over tp. lp's w_gate/w_up/w_down are the
+    LOCAL tp slices of the SwiGLU FFN; attention weights are replicated.
+    RoPE uses each rank's GLOBAL positions (ti*blk + arange), so the
+    sharded rotation matches the single-device computation exactly.
+    """
+    from mpi_acx_tpu.models import llama as lm
+
+    tpn = lax.axis_size(tp_axis)
+    ti = lax.axis_index(tp_axis)
+    mb, S, d = h.shape
+    Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    blk = S // tpn
+
+    # --- attention: shard the SEQUENCE over tp; ring-attend K/V blocks ---
+    hn = lm.rmsnorm(h, lp["attn_norm"])
+    loc = lax.dynamic_slice_in_dim(hn, ti * blk, blk, axis=1)  # [mb,blk,d]
+    q = (loc @ lp["wq"].astype(h.dtype)).reshape(mb, blk, Hq, Dh)
+    k = (loc @ lp["wk"].astype(h.dtype)).reshape(mb, blk, Hkv, Dh)
+    v = (loc @ lp["wv"].astype(h.dtype)).reshape(mb, blk, Hkv, Dh)
+    positions = ti * blk + jnp.arange(blk)
+    q = lm.rope(q, positions, cfg.rope_theta)
+    k = lm.rope(k, positions, cfg.rope_theta)
+    # K/V stay at Hkv heads: the ring rotates the un-expanded GQA heads
+    # (Hq/Hkv x less ICI traffic) and broadcasts per block.
+    o = ring_attention_batched(q, k, v, tp_axis, causal=True,
+                               use_flash=cfg.use_flash,
+                               kv_repeat=Hq // Hkv)
+    o = o.reshape(mb, blk, Hq * Dh) @ lp["wo"].astype(h.dtype)
+    attn = lax.all_gather(o, tp_axis, axis=1, tiled=True)     # [mb, S, d]
+    h = h + attn
+
+    # --- SwiGLU MLP: shard the FFN dim over tp; one psum to reduce ---
+    hn = lm.rmsnorm(h, lp["mlp_norm"])
+    gate = jax.nn.silu(hn @ lp["w_gate"].astype(h.dtype))     # [mb,S,ff/tp]
+    up = hn @ lp["w_up"].astype(h.dtype)
+    part = (gate * up) @ lp["w_down"].astype(h.dtype)
+    return h + lax.psum(part, tp_axis)
+
+
 def param_specs(stage: bool = True) -> Dict[str, Any]:
-    """PartitionSpecs for the stage-sliced parameter pytree
+    """PartitionSpecs for the stage-sliced GPT-2 parameter pytree
     (tfm.stage_slice output): layers carry a leading 'pp' stage axis; the
     FFN dims of w1/b1/w2 shard over 'tp'; everything else replicates."""
     pp = "pp" if stage else None
@@ -85,21 +129,72 @@ def param_specs(stage: bool = True) -> Dict[str, Any]:
     }
 
 
-def _tp_sharded(path: str) -> bool:
-    return path in ("w1", "b1", "w2")
+def llama_param_specs(stage: bool = True) -> Dict[str, Any]:
+    """PartitionSpecs for the stage-sliced Llama parameter pytree: the
+    SwiGLU FFN dims shard over 'tp'; attention/norms replicate per stage."""
+    pp = "pp" if stage else None
+    return {
+        "embed": P(), "final_norm": P(), "unembed": P(),
+        "layers": {
+            "attn_norm": P(pp), "wq": P(pp), "wk": P(pp), "wv": P(pp),
+            "wo": P(pp), "mlp_norm": P(pp),
+            "w_gate": P(pp, None, None, "tp"),
+            "w_up": P(pp, None, None, "tp"),
+            "w_down": P(pp, None, "tp", None),
+        },
+    }
 
 
-def make_loss_and_grads(cfg: tfm.TransformerConfig, mesh: Mesh,
-                        n_micro: int):
+class _Family:
+    """Model-family adapter: everything make_loss_and_grads needs to run a
+    family through the dp x pp x tp/sp composition."""
+
+    def __init__(self, block, embed, final, head, specs, tp_sharded):
+        self.block = block           # (cfg, lp, h, tp_axis) -> h
+        self.embed = embed           # (params, cfg, tokens) -> x [...,S,d]
+        self.final = final           # (params, ys) -> ys
+        self.head = head             # (params) -> [vocab, d] logits matrix
+        self.specs = specs           # () -> PartitionSpec tree
+        self.tp_sharded = tp_sharded  # layer-leaf name -> bool
+
+
+def _family(cfg) -> _Family:
+    from mpi_acx_tpu.models.llama import LlamaConfig, rmsnorm
+
+    if isinstance(cfg, LlamaConfig):
+        return _Family(
+            block=_llama_block_sp_tp,
+            embed=lambda p, c, t: p["embed"][t].astype(c.dtype),
+            final=lambda p, ys: rmsnorm(ys, p["final_norm"]),
+            head=lambda p: p["unembed"],
+            specs=llama_param_specs,
+            tp_sharded=lambda k: k in ("w_gate", "w_up", "w_down"),
+        )
+    return _Family(
+        block=_block_sp_tp,
+        embed=lambda p, c, t: (p["embed"][t] +
+                               p["pos"][:t.shape[-1]]).astype(c.dtype),
+        final=lambda p, ys: tfm.layernorm(ys, p["lnf_g"], p["lnf_b"]),
+        head=lambda p: p["embed"],
+        specs=param_specs,
+        tp_sharded=lambda k: k in ("w1", "b1", "w2"),
+    )
+
+
+def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int):
     """Builds a jitted (params, tokens, targets) -> (loss, grads) over a
     ('dp','pp','tp') mesh — the shard_map core every optimizer shares.
     Returned grads carry the same shardings as params, so any elementwise
     optimizer applied outside stays correctly sharded by propagation.
 
-    params must be tfm.stage_slice(init_params(...), pp_size).
-    tokens/targets: [n_micro, micro_batch, S] int32, batch over 'dp'.
+    cfg selects the model family (tfm.TransformerConfig or
+    llama.LlamaConfig — both run the same composition through their
+    _Family adapter). params must be tfm.stage_slice(init_params(...),
+    pp_size). tokens/targets: [n_micro, micro_batch, S] int32, batch over
+    'dp'.
     """
     n_stages = mesh.shape["pp"]
+    fam = _family(cfg)
 
     def per_shard(params, tokens, targets):
         def loss_fn(params):
@@ -107,17 +202,16 @@ def make_loss_and_grads(cfg: tfm.TransformerConfig, mesh: Mesh,
             # consumes xs only on stage 0, so the embedding-gather cotangent
             # path is exclusive to stage 0 by construction.
             S = tokens.shape[-1]
-            x = (params["embed"][tokens] +
-                 params["pos"][:S]).astype(cfg.dtype)  # [M, mbl, S, d]
+            x = fam.embed(params, cfg, tokens)         # [M, mbl, S, d]
 
             def stage_fn(stage_layers, h):
                 def body(h, lp):
-                    return _block_sp_tp(cfg, lp, h, "tp"), None
+                    return fam.block(cfg, lp, h, "tp"), None
                 h, _ = lax.scan(body, h, stage_layers)
                 return h
 
             ys = pipeline_forward(stage_fn, params["layers"], x, "pp")
-            ys = tfm.layernorm(ys, params["lnf_g"], params["lnf_b"])
+            ys = fam.final(params, ys)
 
             # EXCLUSIVE loss paths: every rank scores only its own slice —
             # its tp sequence block, and only on the last pipeline stage —
@@ -131,7 +225,7 @@ def make_loss_and_grads(cfg: tfm.TransformerConfig, mesh: Mesh,
             blk = S // tpn
             ys_blk = lax.dynamic_slice_in_dim(ys, ti * blk, blk, axis=2)
             tg_blk = lax.dynamic_slice_in_dim(targets, ti * blk, blk, axis=2)
-            logits = ys_blk.astype(jnp.float32) @ params["embed"].T
+            logits = ys_blk.astype(jnp.float32) @ fam.head(params).T
             logp = jax.nn.log_softmax(logits, axis=-1)
             ll = jnp.take_along_axis(logp, tg_blk[..., None], -1)[..., 0]
             contrib = jnp.where(si == n_stages - 1, jnp.sum(ll), 0.0)
@@ -161,15 +255,16 @@ def make_loss_and_grads(cfg: tfm.TransformerConfig, mesh: Mesh,
             return g
 
         out = dict(grads)
-        for k in ("embed", "pos", "lnf_g", "lnf_b"):
-            out[k] = reduce(grads[k], False, False)
+        for k in grads:
+            if k != "layers":
+                out[k] = reduce(grads[k], False, False)
         out["layers"] = {
-            k: reduce(grads["layers"][k], _tp_sharded(k), True)
+            k: reduce(grads["layers"][k], fam.tp_sharded(k), True)
             for k in grads["layers"]
         }
         return loss, out
 
-    specs = param_specs()
+    specs = fam.specs()
     data_spec = P(None, "dp")
     fn = shard_map(per_shard, mesh=mesh,
                    in_specs=(specs, data_spec, data_spec),
