@@ -41,6 +41,17 @@ def lib() -> ctypes.CDLL:
         _lib.MPIX_Init.restype = ctypes.c_int
         _lib.MPIX_Finalize.restype = ctypes.c_int
         _lib.acx_proxy_stats.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+        _lib.acx_flags_publish.restype = ctypes.c_int
+        _lib.acx_flags_publish.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int]
+        _lib.acx_flags_fetch.restype = ctypes.c_int
+        _lib.acx_flags_fetch.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int]
+        _lib.acx_request_partition_slots.restype = ctypes.c_int
+        _lib.acx_request_partition_slots.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
     return _lib
 
 
@@ -182,6 +193,58 @@ class Runtime:
     def request_free(self, req) -> None:
         if self._lib.MPIX_Request_free(ctypes.byref(req)) != 0:
             raise RuntimeError("MPIX_Request_free failed")
+
+    # -- device<->proxy flag bridge ----------------------------------------
+    # The TPU-native form of the reference's kernel-writes-host-flag-page
+    # coupling (partitioned.cu:200-212 -> init.cpp:82-115): a Pallas kernel
+    # mutates a per-partition device flag buffer (mpi_acx_tpu.ops.flags);
+    # these calls mirror it into / out of the native table the proxy polls.
+
+    def partition_slots(self, req) -> np.ndarray:
+        """Native flag-table slot index of each partition of `req` (the
+        idx array of the reference's device mirror)."""
+        # The C call writes up to cap entries but returns the full count:
+        # probe with cap=0, then fetch exactly n (never truncate silently).
+        n = self._lib.acx_request_partition_slots(req, None, 0)
+        if n < 0:
+            raise RuntimeError("not a partitioned request")
+        out = np.zeros(max(n, 1), dtype=np.int64)
+        got = self._lib.acx_request_partition_slots(
+            req, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n)
+        if got != n:
+            raise RuntimeError(f"partition count changed ({n} -> {got})")
+        return out[:n].copy()
+
+    def publish_partition_flags(self, req, device_flags: np.ndarray) -> int:
+        """Mirror a device flag buffer (one int32 word per partition, the
+        protocol constants of ops.flags) into the native table: every
+        partition the kernel marked PENDING is published to the proxy
+        exactly like a host MPIX_Pready. Idempotent per partition (CAS in
+        the native layer). Returns how many partitions were newly
+        published."""
+        slots = self.partition_slots(req)
+        vals = np.ascontiguousarray(
+            device_flags[:len(slots)], dtype=np.int32)
+        n = self._lib.acx_flags_publish(
+            slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(slots))
+        if n < 0:
+            raise RuntimeError("acx_flags_publish failed")
+        return n
+
+    def fetch_partition_flags(self, req) -> np.ndarray:
+        """Snapshot the native flag word of each partition (COMPLETED once
+        the proxy observed arrival) for lifting into the device flag
+        buffer a Pallas parrived kernel polls."""
+        slots = self.partition_slots(req)
+        out = np.zeros(len(slots), dtype=np.int32)
+        if self._lib.acx_flags_fetch(
+                slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                len(slots)) != 0:
+            raise RuntimeError("acx_flags_fetch failed")
+        return out
 
     # -- collectives / lifecycle -------------------------------------------
 

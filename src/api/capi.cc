@@ -1,7 +1,11 @@
 // tpu-acx: small C exports beyond the MPIX surface, for the Python ctypes
 // bindings (mpi_acx_tpu/runtime.py) — observability the reference lacks
-// (SURVEY.md §5.5).
+// (SURVEY.md §5.5), plus the device<->proxy flag bridge (SURVEY.md §2 C6):
+// the TPU-native counterpart of the reference's host-mapped flag page that
+// a running kernel stores into and the proxy polls
+// (reference partitioned.cu:200-212 -> init.cpp:82-115).
 
+#include <atomic>
 #include <cstdint>
 
 #include "acx/api_internal.h"
@@ -35,6 +39,70 @@ int acx_size(void) {
 uint64_t acx_nflags(void) {
   acx::ApiState& g = acx::GS();
   return g.table == nullptr ? 0 : g.table->size();
+}
+
+// ---- device<->proxy flag bridge -----------------------------------------
+//
+// On the reference, a running CUDA kernel writes PENDING directly into the
+// host-mapped flag word the proxy busy-polls (partitioned.cu:204). A TPU
+// kernel cannot dereference host memory, so the TPU-native path is: the
+// Pallas pready kernel mutates an HBM flag buffer using the SAME protocol
+// constants (mpi_acx_tpu/ops/flags.py), and the Python layer hands that
+// buffer's words here to be mirrored into the proxy-polled native table.
+
+// Device->host direction. For each i whose device-side word is PENDING,
+// CAS the native slot RESERVED->PENDING (exactly what host MPIX_Pready
+// publishes, mpix.cc) — the CAS makes re-mirroring the same buffer
+// idempotent and never regresses ISSUED/COMPLETED slots. Kicks the proxy
+// once if anything was published. Returns the publish count, or -1 before
+// MPIX_Init.
+int acx_flags_publish(const int64_t* slots, const int32_t* vals, int n) {
+  acx::ApiState& g = acx::GS();
+  if (g.table == nullptr || g.proxy == nullptr) return -1;
+  std::atomic<int32_t>* raw = g.table->raw();
+  const int64_t nflags = static_cast<int64_t>(g.table->size());
+  int published = 0;
+  for (int i = 0; i < n; i++) {
+    if (vals[i] != acx::kPending) continue;
+    if (slots[i] < 0 || slots[i] >= nflags) return -1;
+    int32_t expect = acx::kReserved;
+    if (raw[slots[i]].compare_exchange_strong(expect, acx::kPending,
+                                              std::memory_order_acq_rel,
+                                              std::memory_order_acquire))
+      published++;
+  }
+  if (published > 0) g.proxy->Kick();
+  return published;
+}
+
+// Host->device direction: snapshot native flag words (e.g. COMPLETED set
+// by the proxy after a partition arrived, init.cpp:104-115 in the
+// reference) so the Python layer can lift them into the device flag
+// buffer a Pallas parrived kernel polls. Returns 0, or -1 before init /
+// on a bad slot.
+int acx_flags_fetch(const int64_t* slots, int32_t* out, int n) {
+  acx::ApiState& g = acx::GS();
+  if (g.table == nullptr) return -1;
+  std::atomic<int32_t>* raw = g.table->raw();
+  const int64_t nflags = static_cast<int64_t>(g.table->size());
+  for (int i = 0; i < n; i++) {
+    if (slots[i] < 0 || slots[i] >= nflags) return -1;
+    out[i] = raw[slots[i]].load(std::memory_order_acquire);
+  }
+  return 0;
+}
+
+// Partition -> native-slot mapping of a partitioned request: what the
+// reference's MPIX_Prequest_create copies into the device mirror
+// (partitioned.cu:167-184). Returns the partition count (writing up to
+// `cap` entries), or -1 for a non-partitioned/invalid handle.
+int acx_request_partition_slots(void* request, int64_t* out, int cap) {
+  auto* req = static_cast<acx::MpixRequest*>(request);
+  if (req == nullptr || req->magic != acx::kReqMagic ||
+      req->kind == acx::ReqKind::kBasic)
+    return -1;
+  for (int p = 0; p < req->partitions && p < cap; p++) out[p] = req->part_idx[p];
+  return req->partitions;
 }
 
 }  // extern "C"

@@ -1,0 +1,95 @@
+"""Worker for tests/test_device_bridge.py: one acxrun rank.
+
+Demonstrates the full device->proxy->wire->device coupling the reference
+prototypes with CUDA kernels writing host-mapped flags
+(partitioned.cu:200-212 -> init.cpp:82-115), TPU-native:
+
+rank 0 (sender): per partition, ONE Pallas kernel (ops.flags.
+produce_and_pready) computes the partition payload AND marks its flag
+word PENDING in the device flag buffer; the buffer is mirrored into the
+native table (Runtime.publish_partition_flags), where the proxy observes
+PENDING and pushes the partition onto the wire.
+
+rank 1 (receiver): polls the native table into a device mirror
+(Runtime.fetch_partition_flags) and asks the Pallas parrived_all kernel —
+never the host — whether every partition has COMPLETED, then verifies the
+payloads the sender's kernels computed.
+
+Prints BRIDGE_OK <published> on success.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from mpi_acx_tpu.ops import flags as fl  # noqa: E402
+from mpi_acx_tpu.runtime import Runtime  # noqa: E402
+
+PARTS = 4
+ROWS, LANES = 8, 128  # one partition's payload tile
+
+
+def main():
+    rt = Runtime()
+    assert rt.size == 2, rt.size
+    peer = 1 - rt.rank
+    buf = np.zeros((PARTS, ROWS, LANES), dtype=np.float32)
+
+    if rt.rank == 0:
+        req = rt.psend_init(buf, PARTS, dest=peer)
+        rt.start(req)
+        # Device flag buffer, one word per partition, protocol constants
+        # shared with the native table (ops/flags.py == acx/state.h).
+        dev_flags = jnp.full((PARTS,), fl.RESERVED, jnp.int32)
+        published = 0
+        for p in range(PARTS):
+            x = jnp.full((ROWS, LANES), float(p + 1), jnp.float32)
+            # ONE kernel: compute payload + publish readiness (the pattern
+            # the reference's partitioned API exists for).
+            payload, dev_flags = fl.produce_and_pready(
+                lambda t: t * 2.0 + 1.0, x, dev_flags, p)
+            assert int(dev_flags[p]) == fl.PENDING
+            buf[p] = np.asarray(payload)  # payload lands in the wire buffer
+            n = rt.publish_partition_flags(req, np.asarray(dev_flags))
+            published += n
+        assert published == PARTS, published
+        # Re-publishing the same buffer is idempotent (CAS in native land).
+        assert rt.publish_partition_flags(req, np.asarray(dev_flags)) == 0
+        rt.wait(req)
+        rt.request_free(req)
+        rt.barrier()
+        print(f"BRIDGE_OK {published}")
+    else:
+        req = rt.precv_init(buf, PARTS, source=peer)
+        rt.start(req)
+        idxs = jnp.arange(PARTS)
+        deadline = time.time() + 60
+        while True:
+            # Native words -> device mirror -> Pallas poll (the kernel, not
+            # the host, decides arrival — reference ring-partitioned.cu's
+            # wait_until_arrived, as a poll per the no-device-spin rule).
+            mirror = jnp.asarray(rt.fetch_partition_flags(req))
+            if int(fl.parrived_all(mirror, idxs)) == 1:
+                break
+            if time.time() > deadline:
+                raise TimeoutError("partitions never arrived")
+            time.sleep(0.001)
+        rt.wait(req)
+        for p in range(PARTS):
+            np.testing.assert_array_equal(buf[p], (p + 1) * 2.0 + 1.0)
+        rt.request_free(req)
+        rt.barrier()
+        print(f"BRIDGE_OK {PARTS}")
+
+    rt.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Device->proxy flag bridge (SURVEY.md §2 C6, the reference's defining
+coupling): a Pallas kernel's flag write must drive a real wire transfer.
+
+Two acxrun ranks; the sender's partition payloads are computed by Pallas
+kernels that mark readiness in the same kernel, the readiness crosses the
+Python/native boundary into the proxy-polled table, the proxy pushes the
+partitions onto the wire, and the receiver's arrival decision is made by
+the Pallas parrived kernel over a mirror of the native table. See
+tests/device_bridge_worker.py for the per-rank script.
+"""
+
+import os
+import subprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "device_bridge_worker.py")
+
+
+def test_kernel_pready_drives_wire_transfer():
+    subprocess.run(["make", "-C", REPO, "lib", "tools"], check=True,
+                   capture_output=True, timeout=600)
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)  # axon sitecustomize pins the tunnel chip
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    import sys
+    r = subprocess.run(
+        [os.path.join(REPO, "build", "acxrun"), "-np", "2", "-timeout",
+         "240", sys.executable, WORKER],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("BRIDGE_OK 4") == 2, r.stdout + r.stderr
