@@ -1,0 +1,81 @@
+"""MPIX triggers embedded inside a jitted XLA program.
+
+The reference arms CUDA stream memOps / tiny kernels so that *the device
+reaching a point in its queue* fires an MPIX operation
+(reference src/sendrecv.cu:152-208); SURVEY.md §7.1 maps that trigger
+mechanism onto PJRT host callbacks. This module is that mapping:
+``jax.experimental.io_callback(ordered=True)`` nodes compiled INTO the
+program fire exactly when execution reaches them, in program order, and
+run the native enqueue/wait on the host while the rest of the program
+continues — a single jitted computation can compute, trigger a native
+transfer mid-program, and consume the reply.
+
+Ordering: all triggers placed in one program are ordered among themselves
+(ordered=True serializes the callback nodes), which is STRONGER than the
+reference's non-overtaking caveat (its enqueued ops post in arbitrary
+order once triggered, reference README.md:173-176).
+
+Lifetime rule (same as the C API): a send's buffer must stay alive until
+the operation completes. ``send_in_program`` copies the device value into
+a host buffer held in the runtime-wide pending set; call
+``drain_sends(rt)`` (host side, after the program) or let a later
+``recv_in_program`` from the same peer imply completion, exactly like
+MPIX_Wait on the C side.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import io_callback
+
+# Pending enqueued-send registry per Runtime: (request, host buffer) pairs.
+# Module-level (not per-Runtime attribute) so Runtime stays a thin ctypes
+# face over the C API.
+_pending: Dict[int, List[Tuple[object, np.ndarray]]] = {}
+
+
+def send_in_program(rt, x: jax.Array, dest: int, tag: int = 0) -> jax.Array:
+    """Place a send trigger at this point of a jitted program.
+
+    When the executing program reaches this node, the current value of
+    ``x`` is handed to the native runtime as an enqueued send to ``dest``
+    (MPIX_Isend_enqueue through mpi_acx_tpu.runtime). Returns ``x``
+    unchanged so callers keep a data dependence on the triggered value.
+    """
+    def cb(val):
+        buf = np.ascontiguousarray(val)
+        req = rt.isend_enqueue(buf, dest, tag)
+        _pending.setdefault(id(rt), []).append((req, buf))
+
+    io_callback(cb, None, x, ordered=True)
+    return x
+
+
+def recv_in_program(rt, shape, dtype, source: int, tag: int = 0) -> jax.Array:
+    """Place a receive at this point of a jitted program: when execution
+    arrives, enqueue a native receive from ``source`` and wait for it; the
+    received buffer becomes this node's value, consumed by the rest of
+    the program. (MPIX_Irecv_enqueue + MPIX_Wait; the wait runs
+    caller-driven proxy progress, so it completes even with the proxy
+    thread parked.)"""
+    def cb():
+        buf = np.zeros(shape, dtype)
+        req = rt.irecv_enqueue(buf, source, tag)
+        rt.wait(req)
+        return buf
+
+    return io_callback(cb, jax.ShapeDtypeStruct(shape, dtype), ordered=True)
+
+
+def drain_sends(rt) -> int:
+    """Host side: wait out every send this runtime triggered from inside
+    programs (the MPIX_Wait half of the enqueue/wait pair). Returns how
+    many were completed."""
+    done = 0
+    for req, _buf in _pending.pop(id(rt), []):
+        rt.wait(req)
+        done += 1
+    return done

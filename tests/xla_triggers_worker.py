@@ -1,0 +1,66 @@
+"""Worker for tests/test_xla_triggers.py: one acxrun rank.
+
+ONE jitted XLA program per rank that (a) computes, (b) triggers a native
+enqueued send of the intermediate value when execution reaches that
+program point, (c) receives the peer's intermediate mid-program, and
+(d) consumes the reply in further computation — the TPU-native analogue
+of the reference's stream-triggered ring (test/src/ring.c semantics with
+the trigger INSIDE the compiled program, reference sendrecv.cu:152-208).
+
+Prints TRIG_OK <value> on success.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from mpi_acx_tpu.runtime import Runtime  # noqa: E402
+from mpi_acx_tpu import xla_triggers as xt  # noqa: E402
+
+
+def main():
+    rt = Runtime()
+    assert rt.size == 2, rt.size
+    rank, peer = rt.rank, 1 - rt.rank
+    n = 64
+
+    @jax.jit
+    def program(x):
+        y = x * 2.0 + rank                 # compute
+        y = xt.send_in_program(rt, y, peer, tag=7)   # trigger mid-program
+        z = xt.recv_in_program(rt, (n,), np.float32, peer, tag=7)
+        return jnp.sum(y + z), z           # consume the reply in-program
+
+    x = jnp.arange(n, dtype=jnp.float32)
+    total, z = program(x)
+    jax.block_until_ready((total, z))
+    assert xt.drain_sends(rt) == 1
+
+    # Closed-form: y_r = 2*arange + r; total = sum(y_rank + y_peer).
+    ys = [2.0 * np.arange(n) + r for r in (0, 1)]
+    np.testing.assert_allclose(np.asarray(z), ys[peer])
+    expect = float((ys[rank] + ys[peer]).sum())
+    got = float(total)
+    assert got == expect, (got, expect)
+
+    # Re-running the same compiled program re-fires the triggers (the
+    # graph re-fire semantics of the reference, internal.h:183-188).
+    total2, _ = program(x)
+    jax.block_until_ready(total2)
+    assert xt.drain_sends(rt) == 1
+    assert float(total2) == expect
+
+    rt.barrier()
+    print(f"TRIG_OK {got}")
+    rt.finalize()
+
+
+if __name__ == "__main__":
+    main()
