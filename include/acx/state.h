@@ -58,6 +58,11 @@ enum class OpKind : int32_t {
   kParrived,  // recv-side partition arrival poll
 };
 
+// Status.error value for a receive shorter than the matched message
+// (compat MPI_ERR_TRUNCATE; MPI semantics the reference gets from its MPI
+// substrate for free).
+constexpr int kErrTruncate = 17;
+
 // Transfer completion status (maps onto MPI_Status in the compat layer).
 struct Status {
   int source = -1;
@@ -128,8 +133,10 @@ class FlagTable {
   // Raw pointer to the flag word array (exposed to Python / device mirrors).
   std::atomic<int32_t>* raw() { return flags_.get(); }
 
-  // Sweep bound: every slot ever allocated lives below this (monotonic; with
-  // lowest-free-slot allocation it tracks peak concurrency, not table size).
+  // Sweep bound: every live slot is below this. Raised by Allocate; decays
+  // in Free when the top of the live range drains, so with lowest-free-slot
+  // allocation it tracks CURRENT concurrency (a 4096-op burst doesn't tax
+  // every later sweep).
   size_t watermark() const { return watermark_.load(std::memory_order_acquire); }
 
   // Number of non-AVAILABLE slots; the proxy idles when zero.

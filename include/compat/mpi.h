@@ -40,6 +40,10 @@ typedef long long MPI_Count;
 
 #define MPI_SUCCESS 0
 #define MPI_ERR_OTHER 15
+/* Receive buffer smaller than the matched message (value = acx::kErrTruncate;
+ * real MPI raises this through the errhandler, we report it in
+ * status.MPI_ERROR and deliver the truncated prefix). */
+#define MPI_ERR_TRUNCATE 17
 
 #define MPI_THREAD_SINGLE     0
 #define MPI_THREAD_FUNNELED   1

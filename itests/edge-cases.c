@@ -5,6 +5,11 @@
  * 2. Ops enqueued BEFORE stream capture whose waits are recorded DURING
  *    capture: the captured wait must observe-only, and relaunching the
  *    graph must not consume the slot twice (r2 code-review regression).
+ * 3. Truncated receive: buffer shorter than the matched message delivers
+ *    the prefix with status.MPI_ERROR = MPI_ERR_TRUNCATE and the real
+ *    received count (MPI semantics the reference inherits from MPI).
+ * 4. Error returns: MPIX_Prequest_create on a basic (non-partitioned)
+ *    request and on NULL must fail cleanly, not crash.
  */
 #include <stdio.h>
 #include <mpi.h>
@@ -65,6 +70,64 @@ int main(int argc, char **argv) {
     cudaGraphExecDestroy(exec);
     cudaGraphDestroy(graph);
     cudaStreamDestroy(stream);
+
+    /* 3: truncated receive reports MPI_ERR_TRUNCATE + the short count. */
+    cudaStream_t ts;
+    cudaStreamCreate(&ts);
+    {
+        int big[8], small[2] = {-1, -1};
+        MPIX_Request treq[2];
+        MPI_Status tst;
+        int i;
+        for (i = 0; i < 8; i++) big[i] = rank * 100 + i;
+        MPIX_Isend_enqueue(big, 8, MPI_INT, right, 21, MPI_COMM_WORLD,
+                           &treq[0], MPIX_QUEUE_XLA_STREAM, &ts);
+        MPIX_Irecv_enqueue(small, 2, MPI_INT, left, 21, MPI_COMM_WORLD,
+                           &treq[1], MPIX_QUEUE_XLA_STREAM, &ts);
+        cudaStreamSynchronize(ts);
+        if (MPIX_Wait(&treq[1], &tst) != MPI_SUCCESS) errs++;
+        if (tst.MPI_ERROR != MPI_ERR_TRUNCATE) {
+            printf("[%d] truncation: MPI_ERROR=%d want %d\n", rank,
+                   tst.MPI_ERROR, MPI_ERR_TRUNCATE);
+            errs++;
+        }
+        if (tst.acx_bytes != 2 * sizeof(int)) {
+            printf("[%d] truncation: bytes=%zu want %zu\n", rank,
+                   tst.acx_bytes, 2 * sizeof(int));
+            errs++;
+        }
+        if (small[0] != left * 100 + 0 || small[1] != left * 100 + 1) {
+            printf("[%d] truncation: prefix %d,%d\n", rank, small[0],
+                   small[1]);
+            errs++;
+        }
+        if (MPIX_Wait(&treq[0], &tst) != MPI_SUCCESS) errs++;
+        if (tst.MPI_ERROR != MPI_SUCCESS) errs++;   /* sender unaffected */
+    }
+
+    /* 4: Prequest_create misuse fails cleanly. */
+    {
+        int v = 0;
+        MPIX_Request basic;
+        MPIX_Prequest pq = MPIX_PREQUEST_NULL;
+        MPIX_Isend_enqueue(&v, 1, MPI_INT, right, 22, MPI_COMM_WORLD, &basic,
+                           MPIX_QUEUE_XLA_STREAM, &ts);
+        if (MPIX_Prequest_create(basic, &pq) == MPI_SUCCESS) errs++;
+        if (pq != MPIX_PREQUEST_NULL) errs++;
+        if (MPIX_Prequest_create(NULL, &pq) == MPI_SUCCESS) errs++;
+        cudaStreamSynchronize(ts);
+        {   /* drain the matching recv so finalize sees no leaked slots */
+            int w = -1;
+            MPIX_Request r2;
+            MPI_Status st2;
+            MPIX_Irecv_enqueue(&w, 1, MPI_INT, left, 22, MPI_COMM_WORLD, &r2,
+                               MPIX_QUEUE_XLA_STREAM, &ts);
+            cudaStreamSynchronize(ts);
+            if (MPIX_Wait(&r2, &st2) != MPI_SUCCESS) errs++;
+            if (MPIX_Wait(&basic, &st2) != MPI_SUCCESS) errs++;
+        }
+    }
+    cudaStreamDestroy(ts);
 
     MPI_Allreduce(MPI_IN_PLACE, &errs, 1, MPI_INT, MPI_MAX, MPI_COMM_WORLD);
     MPIX_Finalize();

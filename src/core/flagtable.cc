@@ -72,6 +72,35 @@ void FlagTable::Free(int idx) {
   ops_[idx].Reset();
   flags_[idx].store(kAvailable, std::memory_order_release);
   active.fetch_sub(1, std::memory_order_relaxed);
+  // Decay the sweep bound when the top of the live range frees, so sweep
+  // cost returns to O(live ops) after a burst drains instead of staying at
+  // O(peak concurrency) forever.
+  size_t w = watermark_.load(std::memory_order_acquire);
+  if (static_cast<size_t>(idx) + 1 == w) {
+    size_t nw = static_cast<size_t>(idx);
+    while (nw > 0 &&
+           flags_[nw - 1].load(std::memory_order_acquire) == kAvailable)
+      nw--;
+    if (watermark_.compare_exchange_strong(w, nw, std::memory_order_acq_rel,
+                                           std::memory_order_relaxed)) {
+      // Close the decay/Allocate race: a concurrent Allocate may have
+      // reserved a slot in [nw, w) after our downward scan read it as
+      // AVAILABLE but before the CAS — and, having seen the old watermark
+      // cover it, skipped its own raise. Re-verify the range and CAS-max
+      // the watermark back over any live slot found.
+      for (size_t j = w; j > nw; j--) {
+        if (flags_[j - 1].load(std::memory_order_acquire) != kAvailable) {
+          size_t cur = watermark_.load(std::memory_order_relaxed);
+          while (cur < j &&
+                 !watermark_.compare_exchange_weak(
+                     cur, j, std::memory_order_release,
+                     std::memory_order_relaxed)) {
+          }
+          break;
+        }
+      }
+    }
+  }
 }
 
 }  // namespace acx

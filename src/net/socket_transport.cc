@@ -167,9 +167,18 @@ class StreamTransport : public Transport {
 #ifdef PR_SET_PTRACER
     // Let sibling ranks process_vm_readv our send buffers even under
     // Yama ptrace_scope=1 (no-op where Yama is absent; nack path covers
-    // kernels where this still isn't enough). Skipped when the rendezvous
-    // path is disabled so ACX_RV_THRESHOLD=0 keeps ptrace hardening intact.
-    if (size_ > 1 && rv_threshold_ != SIZE_MAX)
+    // kernels where this still isn't enough). SCOPE WARNING: PTRACER_ANY
+    // relaxes Yama for the whole process against ANY same-UID process,
+    // not just sibling ranks — so it is armed only inside an
+    // acxrun-managed job (ACX_FDS set: every same-UID peer is part of
+    // this job's trust domain) or when explicitly requested with
+    // ACX_RV_PTRACER=1; ACX_RV_PTRACER=0 always disables it, and the
+    // rendezvous path stays correct either way via the nack->copy
+    // fallback. Also skipped when rendezvous is off (ACX_RV_THRESHOLD=0).
+    const char* pt = getenv("ACX_RV_PTRACER");
+    const bool want_ptracer =
+        pt != nullptr ? atoi(pt) != 0 : getenv("ACX_FDS") != nullptr;
+    if (size_ > 1 && rv_threshold_ != SIZE_MAX && want_ptracer)
       prctl(PR_SET_PTRACER, PR_SET_PTRACER_ANY, 0, 0, 0);
 #endif
   }
@@ -340,7 +349,9 @@ class StreamTransport : public Transport {
   static void CompleteRecv(RecvReq* r, int src, const Msg& m) {
     const size_t n = m.payload.size() < r->bytes ? m.payload.size() : r->bytes;
     memcpy(r->buf, m.payload.data(), n);
-    r->st = Status{src, r->report_tag != INT_MIN ? r->report_tag : m.tag, 0, n};
+    const int err = m.payload.size() > r->bytes ? kErrTruncate : 0;
+    r->st =
+        Status{src, r->report_tag != INT_MIN ? r->report_tag : m.tag, err, n};
     r->done = true;
   }
 
@@ -365,7 +376,8 @@ class StreamTransport : public Transport {
     }
     const bool ok = !rv_force_fallback_ && got == deliver;
     if (ok) {
-      r->st = Status{src, tag, 0, deliver};
+      r->st = Status{src, tag, full_bytes > r->bytes ? kErrTruncate : 0,
+                     deliver};
       r->done = true;
     } else {
       r->report_tag = tag;
@@ -510,8 +522,8 @@ class StreamTransport : public Transport {
           in.payload_got += n;
         }
         r->st = Status{
-            p, r->report_tag != INT_MIN ? r->report_tag : in.hdr.tag, 0,
-            deliver};
+            p, r->report_tag != INT_MIN ? r->report_tag : in.hdr.tag,
+            in.hdr.bytes > r->bytes ? kErrTruncate : 0, deliver};
         r->done = true;
         in.direct.reset();
         in.hdr_got = 0;
