@@ -22,6 +22,8 @@ def _free_port() -> int:
 
 
 def _run_workers(port):
+    import tempfile
+    ckdir = tempfile.mkdtemp(prefix="acx_mh_ck_")
     procs = []
     try:
         for pid in (0, 1):
@@ -34,6 +36,7 @@ def _run_workers(port):
             env["ACX_COORDINATOR"] = f"127.0.0.1:{port}"
             env["ACX_NPROCS"] = "2"
             env["ACX_PROC_ID"] = str(pid)
+            env["ACX_CKPT_DIR"] = ckdir  # shared fresh checkpoint dir
             procs.append(subprocess.Popen(
                 [sys.executable, WORKER], env=env,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
