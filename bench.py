@@ -37,8 +37,11 @@ sys.path.insert(0, REPO)
 BASELINE_P50_US = 26.6
 BASELINE_PART_BW_GBPS = 1.12
 BASELINE_GPT2_FWD_TOKS = 221_900.0
-BASELINE_FLASH_SPEEDUP_4096 = 5.3
-BASELINE_DECODE_TOKS = 4_700.0
+# Device-side-loop methodology (round 3); round-2's 5.3x was host-side
+# per-call timing, which through the axon tunnel reports dispatch latency
+# rather than kernel time (see BASELINE.md).
+BASELINE_FLASH_SPEEDUP_4096 = 2.4
+BASELINE_DECODE_TOKS = 2_700.0
 
 # v5e bf16 peak: 197 TFLOP/s per chip (public spec).
 V5E_BF16_PEAK_FLOPS = 197e12
@@ -140,7 +143,8 @@ def tpu_child_full():
     from mpi_acx_tpu.ops.attention import attention_reference, flash_attention
     from mpi_acx_tpu.models import transformer as tfm
 
-    def timeit(f, *a, reps=10):
+    def timeit(f, *a, reps=1):
+        """Best-of-3 wall time of one f(*a) call (fully synced)."""
         jax.block_until_ready(f(*a))               # compile + warm
         best = 1e9
         for _ in range(3):
@@ -151,14 +155,34 @@ def tpu_child_full():
             best = min(best, (time.perf_counter() - t0) / reps)
         return best
 
-    # Flash vs dense, GPT-2 head geometry, S=4096.
+    def timeit_device(fn, q, k, v, reps=20):
+        """Device-side rep loop (lax.scan with an iteration-dependent
+        input so XLA can't hoist the body): host-side per-call timing
+        through the axon tunnel reports dispatch latency, not kernel
+        time — sub-ms kernels need the loop ON the device."""
+        @jax.jit
+        def loop(q, k, v):
+            def body(acc, i):
+                qq = q + (i % 2).astype(q.dtype) * 1e-3
+                return acc + fn(qq, k, v).astype(jnp.float32).sum(), None
+            acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                  jnp.arange(reps))
+            return acc
+        float(loop(q, k, v))                       # compile + warm
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(loop(q, k, v))                   # scalar fetch = sync
+            best = min(best, (time.perf_counter() - t0) / reps)
+        return best
+
+    # Flash vs dense, GPT-2 head geometry, S=4096, device-side loops.
     B, S, H, D = 1, 4096, 12, 64
     ks = jax.random.split(jax.random.key(0), 3)
     q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
                for kk in ks)
-    dense = jax.jit(attention_reference)
-    t_dense = timeit(dense, q, k, v)
-    t_flash = timeit(flash_attention, q, k, v)
+    t_dense = timeit_device(attention_reference, q, k, v)
+    t_flash = timeit_device(flash_attention, q, k, v)
     speedup = t_dense / t_flash
 
     # KV-cache greedy decode, B=8, bf16 weights.
@@ -168,7 +192,7 @@ def tpu_child_full():
     B, S_p, n_new = 8, 32, 64
     prompt = jax.random.randint(jax.random.key(1), (B, S_p), 0, cfg.vocab)
     gen = jax.jit(lambda p, t: tfm.generate(p, cfg, t, n_new, max_len=256))
-    decode_toks = B * n_new / timeit(gen, params, prompt, reps=1)
+    decode_toks = B * n_new / timeit(gen, params, prompt)
     print(json.dumps({
         "flash_speedup_s4096": round(speedup, 2),
         "flash_ms": round(t_flash * 1e3, 3),
