@@ -105,6 +105,34 @@ int main(int argc, char **argv) {
         if (tst.MPI_ERROR != MPI_SUCCESS) errs++;   /* sender unaffected */
     }
 
+    /* 3b: typed Allreduce (float SUM, double MIN, int64 MAX) — the MPI
+     * substrate role beyond the INT-only control path. */
+    {
+        float f = (float)rank + 0.5f;
+        double d = 10.0 - rank;
+        long long ll = rank * 7;
+        int p;
+        float fs = 0.0f;
+        double dm = 1e9;
+        long long lm = -1;
+        MPI_Allreduce(MPI_IN_PLACE, &f, 1, MPI_FLOAT, MPI_SUM,
+                      MPI_COMM_WORLD);
+        MPI_Allreduce(MPI_IN_PLACE, &d, 1, MPI_DOUBLE, MPI_MIN,
+                      MPI_COMM_WORLD);
+        MPI_Allreduce(MPI_IN_PLACE, &ll, 1, MPI_INT64_T, MPI_MAX,
+                      MPI_COMM_WORLD);
+        for (p = 0; p < size; p++) {
+            fs += (float)p + 0.5f;
+            if (10.0 - p < dm) dm = 10.0 - p;
+            if (p * 7LL > lm) lm = p * 7LL;
+        }
+        if (f != fs || d != dm || ll != lm) {
+            printf("[%d] typed allreduce: %f/%f %f/%f %lld/%lld\n", rank,
+                   f, fs, d, dm, ll, lm);
+            errs++;
+        }
+    }
+
     /* 4: Prequest_create misuse fails cleanly. */
     {
         int v = 0;

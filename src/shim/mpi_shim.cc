@@ -9,6 +9,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <memory>
+#include <vector>
 #include <sched.h>
 
 #include "acx/api_internal.h"
@@ -119,17 +120,98 @@ int MPI_Barrier(MPI_Comm comm) {
   return MPI_SUCCESS;
 }
 
+}  // extern "C"
+
+namespace {
+
+// Reserved matching context for shim-level collectives so their frames can
+// never collide with user point-to-point tags (the transport reserves -2
+// for its own control frames and -3 for rendezvous fallback).
+constexpr int kCollCtx = -4;
+
+void BlockingSend(const void* buf, size_t bytes, int dst, int tag) {
+  std::unique_ptr<acx::Ticket> t(
+      GS().transport->Isend(buf, bytes, dst, tag, kCollCtx));
+  acx::Status st;
+  while (!t->Test(&st)) sched_yield();
+}
+
+void BlockingRecv(void* buf, size_t bytes, int src, int tag) {
+  std::unique_ptr<acx::Ticket> t(
+      GS().transport->Irecv(buf, bytes, src, tag, kCollCtx));
+  acx::Status st;
+  while (!t->Test(&st)) sched_yield();
+}
+
+template <typename T>
+void ReduceInto(T* acc, const T* in, int count, MPI_Op op) {
+  for (int i = 0; i < count; i++) {
+    switch (op) {
+      case MPI_MAX: acc[i] = acc[i] > in[i] ? acc[i] : in[i]; break;
+      case MPI_MIN: acc[i] = acc[i] < in[i] ? acc[i] : in[i]; break;
+      default: acc[i] += in[i]; break;
+    }
+  }
+}
+
+// Gather-to-0 / reduce / broadcast over the reserved collective context —
+// the same scheme as the transport's AllreduceInt, typed over T.
+template <typename T>
+void AllreduceT(T* data, int count, MPI_Op op) {
+  acx::Transport* tr = GS().transport;
+  const size_t nb = sizeof(T) * static_cast<size_t>(count);
+  if (tr->rank() == 0) {
+    std::vector<T> tmp(count);
+    for (int p = 1; p < tr->size(); p++) {
+      BlockingRecv(tmp.data(), nb, p, 0);
+      ReduceInto(data, tmp.data(), count, op);
+    }
+    for (int p = 1; p < tr->size(); p++) BlockingSend(data, nb, p, 1);
+  } else {
+    BlockingSend(data, nb, 0, 0);
+    BlockingRecv(data, nb, 0, 1);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
 int MPI_Allreduce(const void* sendbuf, void* recvbuf, int count,
                   MPI_Datatype datatype, MPI_Op op, MPI_Comm comm) {
   acx::EnsureTransport();
-  if (datatype != MPI_INT) {
-    std::fprintf(stderr, "tpu-acx MPI shim: Allreduce supports MPI_INT only\n");
-    return MPI_ERR_OTHER;
+  switch (datatype) {  // validate BEFORE DatatypeSize (which exits on bad ids)
+    case MPI_INT: case MPI_CHAR: case MPI_BYTE:
+    case MPI_INT64_T: case MPI_FLOAT: case MPI_DOUBLE:
+      break;
+    default:
+      std::fprintf(stderr, "tpu-acx MPI shim: Allreduce datatype %d\n",
+                   datatype);
+      return MPI_ERR_OTHER;
   }
   if (sendbuf != MPI_IN_PLACE)
-    std::memcpy(recvbuf, sendbuf, sizeof(int32_t) * count);
-  GS().transport->AllreduceInt(static_cast<int32_t*>(recvbuf), count, op,
-                               comm);
+    std::memcpy(recvbuf, sendbuf, acx::DatatypeSize(datatype) * count);
+  switch (datatype) {
+    case MPI_INT:  // transport-native fast path
+      GS().transport->AllreduceInt(static_cast<int32_t*>(recvbuf), count, op,
+                                   comm);
+      break;
+    case MPI_CHAR:
+      AllreduceT(static_cast<int8_t*>(recvbuf), count, op);
+      break;
+    case MPI_BYTE:
+      AllreduceT(static_cast<uint8_t*>(recvbuf), count, op);
+      break;
+    case MPI_INT64_T:
+      AllreduceT(static_cast<int64_t*>(recvbuf), count, op);
+      break;
+    case MPI_FLOAT:
+      AllreduceT(static_cast<float*>(recvbuf), count, op);
+      break;
+    case MPI_DOUBLE:
+      AllreduceT(static_cast<double*>(recvbuf), count, op);
+      break;
+  }
   return MPI_SUCCESS;
 }
 
