@@ -1,14 +1,17 @@
-"""Shared greedy-decode scaffold for the model families.
+"""Shared decode scaffolds for the model families.
 
 Both transformer.generate and llama.generate are this loop closed over
 their own prefill/decode_step; keeping the scaffold in one place keeps
 the max_seq position-clamp guard and the scan wiring from drifting.
+``sample_generate`` is the stochastic sibling (temperature / top-k /
+top-p nucleus), all inside one ``lax.scan`` — fixed shapes, one compile.
 """
 
 from __future__ import annotations
 
 from typing import Callable, Optional
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -38,4 +41,73 @@ def greedy_generate(prefill_fn: Callable, decode_fn: Callable,
         return (cache, nxt), tok
 
     (_, _), toks = lax.scan(step, (cache, first), None, length=n_new)
+    return jnp.concatenate([prompt, jnp.moveaxis(toks, 0, 1)], axis=1)
+
+
+def sample_logits(logits, key, temperature: float = 1.0,
+                  top_k: Optional[int] = None, top_p: Optional[float] = None):
+    """Sample token ids from [B, vocab] f32 logits.
+
+    Filters compose in the standard order: top-k first, then top-p
+    (nucleus) over the surviving mass, then a Gumbel draw at the given
+    temperature. ``temperature=0`` degenerates to argmax. Static-shaped
+    (masking, not gathering), so it jits and scans cleanly.
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    neg = jnp.finfo(logits.dtype).min
+    V = logits.shape[-1]
+    want_k = top_k is not None and top_k < V
+    want_p = top_p is not None and top_p < 1.0
+    if want_k or want_p:
+        # ONE descending sort serves both filters (a second full-vocab
+        # sort per decode step would dominate the filter cost).
+        srt = jnp.sort(logits, axis=-1)[:, ::-1]         # [B, V] desc
+        if want_k:
+            kth = srt[:, top_k - 1][:, None]
+            logits = jnp.where(logits < kth, neg, logits)
+            # Nucleus below operates on the top-k-FILTERED distribution
+            # (sequential composition, the standard order).
+            srt = jnp.where(jnp.arange(V)[None, :] >= top_k, neg, srt)
+        if want_p:
+            # Keep the smallest prefix of descending-prob tokens whose
+            # mass reaches top_p; the top-1 token always survives.
+            probs = jax.nn.softmax(srt, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep = (cum - probs < top_p).at[:, 0].set(True)
+            thresh = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1)
+            logits = jnp.where(logits < thresh[:, None], neg, logits)
+    # Gumbel-max draw == categorical sample over the filtered softmax.
+    g = jax.random.gumbel(key, logits.shape, logits.dtype)
+    return jnp.argmax(logits + g, axis=-1)
+
+
+def sample_generate(prefill_fn: Callable, decode_fn: Callable,
+                    prompt, n_new: int, max_seq: int, key,
+                    temperature: float = 1.0, top_k: Optional[int] = None,
+                    top_p: Optional[float] = None,
+                    max_len: Optional[int] = None):
+    """prompt [B, S] -> [B, S + n_new] by stochastic sampling (temperature
+    / top-k / top-p); same contract as :func:`greedy_generate` plus a PRNG
+    key. One jittable program: the whole decode is a lax.scan."""
+    B, S = prompt.shape
+    if max_len is None:
+        max_len = S + n_new
+    assert S + n_new <= max_len, (S, n_new, max_len)
+    assert S + n_new <= max_seq, (S, n_new, max_seq)
+    logits, cache = prefill_fn(prompt, max_len, True)
+    key, sub = jax.random.split(key)
+    first = sample_logits(logits[:, -1].astype(jnp.float32), sub,
+                          temperature, top_k, top_p).astype(prompt.dtype)
+
+    def step(carry, _):
+        cache, tok, key = carry
+        logits, cache = decode_fn(cache, tok)
+        key, sub = jax.random.split(key)
+        nxt = sample_logits(logits.astype(jnp.float32), sub, temperature,
+                            top_k, top_p).astype(tok.dtype)
+        return (cache, nxt, key), tok
+
+    (_, _, _), toks = lax.scan(step, (cache, first, key), None, length=n_new)
     return jnp.concatenate([prompt, jnp.moveaxis(toks, 0, 1)], axis=1)

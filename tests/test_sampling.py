@@ -1,0 +1,95 @@
+"""Stochastic decoding: sample_logits filters and the sample_generate
+scaffold across both model families."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_acx_tpu.models import llama as lm
+from mpi_acx_tpu.models import transformer as tfm
+from mpi_acx_tpu.models.decoding import sample_logits
+
+
+class TestSampleLogits:
+    def _logits(self, key, b=4, v=64):
+        return jax.random.normal(key, (b, v), jnp.float32) * 3.0
+
+    def test_temperature_zero_is_argmax(self):
+        lg = self._logits(jax.random.key(0))
+        got = sample_logits(lg, jax.random.key(1), temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.argmax(np.asarray(lg), -1))
+
+    def test_top_k_one_is_argmax(self):
+        lg = self._logits(jax.random.key(2))
+        got = sample_logits(lg, jax.random.key(3), top_k=1)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.argmax(np.asarray(lg), -1))
+
+    def test_top_k_never_escapes_the_set(self):
+        lg = self._logits(jax.random.key(4))
+        topk = np.argsort(np.asarray(lg), -1)[:, -8:]
+        for i in range(50):
+            got = np.asarray(sample_logits(lg, jax.random.key(i), top_k=8))
+            for b in range(lg.shape[0]):
+                assert got[b] in topk[b]
+
+    def test_top_p_keeps_nucleus_only(self):
+        # One token holds 99% of the mass: top_p=0.5 must always pick it.
+        lg = jnp.full((2, 16), -10.0).at[:, 3].set(10.0)
+        for i in range(20):
+            got = np.asarray(sample_logits(lg, jax.random.key(i), top_p=0.5))
+            assert (got == 3).all()
+
+    def test_temperature_spreads_mass(self):
+        lg = jnp.zeros((1, 8))  # uniform: samples must not all collide
+        draws = {int(sample_logits(lg, jax.random.key(i))[0])
+                 for i in range(40)}
+        assert len(draws) > 3
+
+    def test_jits(self):
+        lg = self._logits(jax.random.key(5))
+        f = jax.jit(lambda lg, k: sample_logits(lg, k, temperature=0.8,
+                                                top_k=8, top_p=0.9))
+        assert f(lg, jax.random.key(6)).shape == (4,)
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_sample_generate_matches_greedy_at_t0(family):
+    if family == "gpt2":
+        cfg = tfm.tiny_config(n_layers=2)
+        params = tfm.init_params(jax.random.key(0), cfg)
+        gen, gen_s = tfm.generate, tfm.generate_sample
+    else:
+        cfg = lm.tiny_llama(n_layers=2)
+        params = lm.init_params(jax.random.key(0), cfg)
+        gen, gen_s = lm.generate, lm.generate_sample
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab)
+    a = gen(params, cfg, prompt, n_new=6)
+    b = gen_s(params, cfg, prompt, n_new=6, key=jax.random.key(2),
+              temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sample_generate_is_stochastic_and_jittable():
+    cfg = tfm.tiny_config(n_layers=2)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab)
+    f = jax.jit(lambda p, t, k: tfm.generate_sample(
+        p, cfg, t, n_new=8, key=k, temperature=1.0, top_k=16, top_p=0.95))
+    a = f(params, prompt, jax.random.key(2))
+    b = f(params, prompt, jax.random.key(3))
+    assert a.shape == (2, 16)
+    # Prompt preserved; different keys give different continuations.
+    np.testing.assert_array_equal(np.asarray(a[:, :8]), np.asarray(prompt))
+    assert not np.array_equal(np.asarray(a[:, 8:]), np.asarray(b[:, 8:]))
+
+
+def test_top_p_zero_still_returns_top1():
+    # Degenerate nucleus: top_p=0 must keep the single most likely token
+    # (r3 code-review regression: all-masked logits argmax'd to id 0).
+    lg = jnp.full((2, 16), -1.0).at[:, 5].set(4.0)
+    for i in range(10):
+        got = np.asarray(sample_logits(lg, jax.random.key(i), top_p=0.0))
+        assert (got == 5).all(), got
