@@ -31,6 +31,7 @@ from mpi_acx_tpu.parallel.ring_attention import (  # noqa: F401
 )
 from mpi_acx_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_forward,
+    pipeline_forward_interleaved,
     pipeline_loss,
 )
 from mpi_acx_tpu.parallel.ulysses import (  # noqa: F401

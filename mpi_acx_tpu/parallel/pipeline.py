@@ -71,6 +71,94 @@ def pipeline_forward(
     return lax.psum(contrib, axis_name)
 
 
+def pipeline_forward_interleaved(
+    stage_fn: Callable,
+    stage_params,
+    xs: jax.Array,
+    axis_name: str,
+    n_virtual: int,
+) -> jax.Array:
+    """Interleaved virtual-stage pipeline (the Megatron-LM interleaved
+    schedule's forward): device s holds ``v = n_virtual`` chunks, chunk j
+    being global stage ``j*pp + s``. A time slot is ONE chunk application
+    per device — microbatches flow in groups of ``pp`` through chunk 0,
+    then the same group through chunk 1, etc. — so the whole forward
+    takes ``v*n_micro + pp - 1`` chunk-slots per device, of which only
+    ``pp - 1`` are fill/drain. GPipe over the same ``v*pp``-stage model
+    (v layers folded per stage, :func:`pipeline_forward`) wastes
+    ``v*(pp-1)`` chunk-slots; interleaving divides the bubble by ``v``
+    at the price of ``v`` x more ICI hops per activation (cheap).
+
+    Per-shard function (use inside shard_map). stage_params' leading axes
+    are [pp, n_virtual, ...] (shard P(axis_name) on the first). xs:
+    [n_micro, micro_batch, ...] replicated, with ``n_micro % pp == 0``
+    (the schedule's group size — the standard Megatron constraint);
+    returns the final global stage's outputs, replicated.
+
+    Schedule formula: device s at slot t computes, with u = t - s,
+    b = u // pp, chunk j = b % v, microbatch m = (b // v)*pp + u % pp.
+    Every hop (s -> s+1 same-chunk, and pp-1 -> 0 advancing to chunk
+    j+1) is consumed exactly one slot after production, so the carry is
+    a single activation buffer. Fill/drain slots compute clamped garbage
+    that is never collected (the masked-compute construction of
+    :func:`pipeline_forward`, so autodiff through the scan stays exact).
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    v = n_virtual
+    n_micro = xs.shape[0]
+    if n_micro % n_stages != 0:
+        raise ValueError(
+            f"interleaved schedule needs n_micro ({n_micro}) % pp "
+            f"({n_stages}) == 0")
+    ticks = v * n_micro + n_stages - 1
+
+    params = jax.tree.map(lambda p: p[0], stage_params)  # [v, per, ...]
+
+    # One CIRCULAR permute per slot: s -> s+1 is the same-chunk hop and
+    # pp-1 -> 0 is the wrap that advances to the next chunk.
+    ring_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    # Microbatch m's final output leaves device pp-1's chunk v-1 at slot
+    # (v*(m//pp) + v - 1)*pp + m%pp + (pp-1); slot -> m lookup (-1 = not
+    # a collection slot), so outputs accumulate into an [n_micro, ...]
+    # buffer instead of stacking every tick (~v x less activation memory).
+    slot_to_m = [-1] * ticks
+    for m in range(n_micro):
+        tau = ((v * (m // n_stages) + v - 1) * n_stages + m % n_stages
+               + n_stages - 1)
+        slot_to_m[tau] = m
+    slot_to_m = jnp.asarray(slot_to_m)
+
+    def tick(carry, t):
+        buf, acc = carry
+        u = jnp.maximum(t - stage, 0)
+        b = u // n_stages
+        j = b % v
+        m = jnp.clip((b // v) * n_stages + u % n_stages, 0, n_micro - 1)
+        fresh = lax.dynamic_index_in_dim(xs, m, 0, keepdims=False)
+        # Device 0 starts a chunk-0 slot from a fresh microbatch; every
+        # other slot consumes last slot's routed activation.
+        x = jnp.where(jnp.logical_and(stage == 0, j == 0), fresh, buf)
+        pj = jax.tree.map(
+            lambda q: lax.dynamic_index_in_dim(q, j, 0, keepdims=False),
+            params)
+        y = stage_fn(pj, x)
+        mm = slot_to_m[t]
+        upd = lax.dynamic_update_slice_in_dim(
+            acc, y[None], jnp.clip(mm, 0, n_micro - 1), axis=0)
+        acc = jnp.where(
+            jnp.logical_and(mm >= 0, stage == n_stages - 1), upd, acc)
+        nxt = lax.ppermute(y, axis_name, perm=ring_perm)
+        return (nxt, acc), None
+
+    init = lax.pcast(jnp.zeros(xs.shape[1:], xs.dtype), axis_name,
+                     to="varying")
+    acc0 = lax.pcast(jnp.zeros_like(xs), axis_name, to="varying")
+    (_, acc), _ = lax.scan(tick, (init, acc0), jnp.arange(ticks))
+    return lax.psum(acc, axis_name)
+
+
 def pipeline_loss(
     stage_fn: Callable,
     loss_fn: Callable,

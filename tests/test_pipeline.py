@@ -11,7 +11,11 @@ from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
 from mpi_acx_tpu.parallel import make_mesh
-from mpi_acx_tpu.parallel.pipeline import pipeline_forward, pipeline_loss
+from mpi_acx_tpu.parallel.pipeline import (
+    pipeline_forward,
+    pipeline_forward_interleaved,
+    pipeline_loss,
+)
 
 
 @pytest.fixture(scope="module")
@@ -49,6 +53,76 @@ def test_pipeline_matches_sequential(mesh):
         p = {"w": params["w"][s], "b": params["b"][s]}
         want = np.asarray(_stage_fn(p, want))
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("n_virtual,n_micro", [(2, 4), (3, 4), (2, 8)])
+def test_interleaved_pipeline_matches_sequential(mesh, n_virtual, n_micro):
+    """Interleaved virtual stages: v chunks per device, global stage
+    j*pp + s, one chunk-slot per device per tick — outputs must equal
+    the sequential stack of all v*pp stages, for several (v, n_micro)
+    shapes (n_micro a multiple of pp, the schedule's group size)."""
+    d, mb, pp = 8, 3, 4
+    n_global = n_virtual * pp
+    flat = _stack_params(jax.random.key(7), n_global, d)
+    # [G, ...] -> [pp, v, ...] with global stage g = j*pp + s at [s, j]:
+    # index [s, j] must hold stage j*pp + s -> reshape to [v, pp] then
+    # transpose the two leading axes.
+    params = jax.tree.map(
+        lambda p: jnp.swapaxes(p.reshape((n_virtual, pp) + p.shape[1:]),
+                               0, 1), flat)
+    xs = jax.random.normal(jax.random.key(8), (n_micro, mb, d))
+
+    f = shard_map(
+        functools.partial(pipeline_forward_interleaved, _stage_fn,
+                          axis_name="pp", n_virtual=n_virtual),
+        mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+        check_vma=False)
+    got = np.asarray(jax.jit(f)(params, xs))
+
+    want = np.asarray(xs)
+    for g in range(n_global):
+        p = {"w": flat["w"][g], "b": flat["b"][g]}
+        want = np.asarray(_stage_fn(p, want))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_interleaved_pipeline_rejects_ragged_microbatches(mesh):
+    d = 4
+    flat = _stack_params(jax.random.key(12), 8, d)
+    params = jax.tree.map(
+        lambda p: jnp.swapaxes(p.reshape((2, 4) + p.shape[1:]), 0, 1), flat)
+    xs = jax.random.normal(jax.random.key(13), (3, 2, d))  # 3 % pp(4) != 0
+    f = shard_map(
+        functools.partial(pipeline_forward_interleaved, _stage_fn,
+                          axis_name="pp", n_virtual=2),
+        mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+        check_vma=False)
+    with pytest.raises(ValueError, match="n_micro"):
+        f(params, xs)
+
+
+def test_interleaved_pipeline_gradients_flow_to_all_stages(mesh):
+    d, n_micro, mb, v, pp = 4, 4, 2, 2, 4
+    flat = _stack_params(jax.random.key(9), v * pp, d)
+    params = jax.tree.map(
+        lambda p: jnp.swapaxes(p.reshape((v, pp) + p.shape[1:]), 0, 1), flat)
+    xs = jax.random.normal(jax.random.key(10), (n_micro, mb, d))
+    tgt = jax.random.normal(jax.random.key(11), (n_micro, mb, d))
+
+    def loss(params):
+        f = shard_map(
+            lambda p, x, t: jnp.mean(
+                (pipeline_forward_interleaved(_stage_fn, p, x, "pp", v)
+                 - t) ** 2),
+            mesh=mesh, in_specs=(P("pp"), P(), P()), out_specs=P(),
+            check_vma=False)
+        return f(params, xs, tgt)
+
+    g = jax.grad(loss)(params)
+    gw = np.asarray(g["w"])           # [pp, v, d, d]
+    for s in range(pp):
+        for j in range(v):
+            assert np.abs(gw[s, j]).max() > 1e-8, (s, j)
 
 
 def test_pipeline_gradients_flow_to_all_stages(mesh):
