@@ -28,6 +28,9 @@ def main():
     ap.add_argument("--pp", type=int, default=2)
     ap.add_argument("--tp", type=int, default=2)
     ap.add_argument("--ckpt", default="")
+    ap.add_argument("--virtual", type=int, default=1,
+                    help="virtual chunks per device (interleaved pipeline "
+                         "schedule; 1 = GPipe)")
     args = ap.parse_args()
 
     import jax
@@ -52,23 +55,30 @@ def main():
             "JAX_PLATFORMS=cpu for a virtual mesh")
     mesh = mesh_from_devices({"dp": args.dp, "pp": args.pp, "tp": args.tp})
 
+    n_layers = 2 * args.pp * args.virtual
     if args.family == "llama":
         cfg = lm.tiny_llama(vocab=256, d_model=64, n_heads=4, n_kv_heads=2,
-                            n_layers=2 * args.pp, d_ff=128, max_seq=64)
+                            n_layers=n_layers, d_ff=128, max_seq=64)
         params = lm.init_params(jax.random.key(0), cfg)
     else:
         cfg = tfm.tiny_config(vocab=256, d_model=64, n_heads=4,
-                              n_layers=2 * args.pp, d_ff=128, max_seq=64)
+                              n_layers=n_layers, d_ff=128, max_seq=64)
         params = tfm.init_params(jax.random.key(0), cfg)
 
     opt = optax.adamw(3e-3)
-    step, n_stages = make_train_step_optax(cfg, mesh, n_micro=2,
-                                           optimizer=opt)
-    p = tfm.stage_slice(params, n_stages)
+    # Interleaved schedule needs n_micro % pp == 0.
+    M = args.pp if args.virtual > 1 else 2
+    step, n_stages = make_train_step_optax(cfg, mesh, n_micro=M,
+                                           optimizer=opt,
+                                           n_virtual=args.virtual)
+    if args.virtual > 1:
+        p = tfm.stage_slice_interleaved(params, n_stages, args.virtual)
+    else:
+        p = tfm.stage_slice(params, n_stages)
     s = opt.init(p)
 
     # Synthetic copy-task data: predict the next token of a ramp sequence.
-    M, mb, S = 2, 2 * args.dp, 32
+    mb, S = 2 * args.dp, 32
     base = jnp.arange(S)[None, None, :] + jnp.arange(mb)[None, :, None]
     tokens = (base + jnp.arange(M)[:, None, None]) % cfg.vocab
     targets = jnp.roll(tokens, -1, axis=-1)

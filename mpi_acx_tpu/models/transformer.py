@@ -286,3 +286,23 @@ def stage_slice(params: Params, n_stages: int) -> Params:
     out = dict(params)
     out["layers"] = jax.tree.map(rs, params["layers"])
     return out
+
+
+def stage_slice_interleaved(params: Params, n_stages: int,
+                            n_virtual: int) -> Params:
+    """Reshape stacked layers [L, ...] -> [pp, v, L/(pp*v), ...] for the
+    interleaved pipeline schedule: global stage g = j*pp + s lands at
+    [s, j] (device s, chunk j), so consecutive layer blocks snake over
+    the devices n_virtual times."""
+    L = jax.tree.leaves(params["layers"])[0].shape[0]
+    G = n_stages * n_virtual
+    assert L % G == 0, (L, n_stages, n_virtual)
+    per = L // G
+
+    def rs(p):
+        q = p.reshape((n_virtual, n_stages, per) + p.shape[1:])
+        return jnp.swapaxes(q, 0, 1)                # [pp, v, per, ...]
+
+    out = dict(params)
+    out["layers"] = jax.tree.map(rs, params["layers"])
+    return out

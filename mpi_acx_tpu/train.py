@@ -27,7 +27,8 @@ from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mpi_acx_tpu.models import transformer as tfm
-from mpi_acx_tpu.parallel.pipeline import pipeline_forward
+from mpi_acx_tpu.parallel.pipeline import (pipeline_forward,
+                                           pipeline_forward_interleaved)
 from mpi_acx_tpu.parallel.ring_attention import ring_attention_batched
 
 
@@ -181,7 +182,7 @@ def _family(cfg) -> _Family:
     )
 
 
-def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int):
+def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1):
     """Builds a jitted (params, tokens, targets) -> (loss, grads) over a
     ('dp','pp','tp') mesh — the shard_map core every optimizer shares.
     Returned grads carry the same shardings as params, so any elementwise
@@ -190,8 +191,10 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int):
     cfg selects the model family (tfm.TransformerConfig or
     llama.LlamaConfig — both run the same composition through their
     _Family adapter). params must be tfm.stage_slice(init_params(...),
-    pp_size). tokens/targets: [n_micro, micro_batch, S] int32, batch over
-    'dp'.
+    pp_size) — or tfm.stage_slice_interleaved(..., pp_size, n_virtual)
+    when ``n_virtual > 1`` selects the interleaved pipeline schedule
+    (bubble / n_virtual; needs n_micro % pp == 0). tokens/targets:
+    [n_micro, micro_batch, S] int32, batch over 'dp'.
     """
     n_stages = mesh.shape["pp"]
     fam = _family(cfg)
@@ -210,7 +213,11 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int):
                 h, _ = lax.scan(body, h, stage_layers)
                 return h
 
-            ys = pipeline_forward(stage_fn, params["layers"], x, "pp")
+            if n_virtual > 1:
+                ys = pipeline_forward_interleaved(
+                    stage_fn, params["layers"], x, "pp", n_virtual)
+            else:
+                ys = pipeline_forward(stage_fn, params["layers"], x, "pp")
             ys = fam.final(params, ys)
 
             # EXCLUSIVE loss paths: every rank scores only its own slice —
@@ -265,6 +272,13 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int):
         return loss, out
 
     specs = fam.specs()
+    if n_virtual > 1:
+        # Layer leaves gain a chunk axis after 'pp': P(pp, *r) -> P(pp,None,*r).
+        specs = dict(specs)
+        specs["layers"] = {
+            k: P(*((s[0], None) + tuple(s[1:])))
+            for k, s in specs["layers"].items()
+        }
     data_spec = P(None, "dp")
     fn = shard_map(per_shard, mesh=mesh,
                    in_specs=(specs, data_spec, data_spec),
@@ -274,10 +288,11 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int):
 
 
 def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
-                    n_micro: int, lr: float = 1e-2):
+                    n_micro: int, lr: float = 1e-2, n_virtual: int = 1):
     """Jitted (params, tokens, targets) -> (loss, new_params) SGD step
     (stateless optimizer; for stateful ones use make_train_step_optax)."""
-    grad_fn, n_stages = make_loss_and_grads(cfg, mesh, n_micro)
+    grad_fn, n_stages = make_loss_and_grads(cfg, mesh, n_micro,
+                                            n_virtual=n_virtual)
 
     @jax.jit
     def step(params, tokens, targets):
@@ -289,7 +304,7 @@ def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
 
 
 def make_train_step_optax(cfg: tfm.TransformerConfig, mesh: Mesh,
-                          n_micro: int, optimizer):
+                          n_micro: int, optimizer, n_virtual: int = 1):
     """Distributed train step with any optax GradientTransformation.
 
     Returns (step, n_stages): step(params, opt_state, tokens, targets) ->
@@ -301,7 +316,8 @@ def make_train_step_optax(cfg: tfm.TransformerConfig, mesh: Mesh,
     """
     import optax
 
-    grad_fn, n_stages = make_loss_and_grads(cfg, mesh, n_micro)
+    grad_fn, n_stages = make_loss_and_grads(cfg, mesh, n_micro,
+                                            n_virtual=n_virtual)
 
     @jax.jit
     def step(params, opt_state, tokens, targets):

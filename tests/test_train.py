@@ -36,18 +36,25 @@ def _sequential_step(cfg, params, tokens, targets, lr):
     return loss, jax.tree.map(lambda p, g: p - lr * g, params, grads)
 
 
-def _assert_step_matches_sequential(cfg, mesh, params, tokens, targets):
+def _assert_step_matches_sequential(cfg, mesh, params, tokens, targets,
+                                    n_virtual=1):
     lr = 0.1
     step, n_stages = make_train_step(cfg, mesh, n_micro=tokens.shape[0],
-                                     lr=lr)
-    staged = tfm.stage_slice(params, n_stages)
+                                     lr=lr, n_virtual=n_virtual)
+
+    def stage(p):
+        if n_virtual > 1:
+            return tfm.stage_slice_interleaved(p, n_stages, n_virtual)
+        return tfm.stage_slice(p, n_stages)
+
+    staged = stage(params)
 
     dist_loss, dist_new = step(staged, tokens, targets)
     seq_loss, seq_new = _sequential_step(cfg, params, tokens, targets, lr)
 
     np.testing.assert_allclose(float(dist_loss), float(seq_loss), rtol=2e-4)
 
-    seq_staged = tfm.stage_slice(seq_new, n_stages)
+    seq_staged = stage(seq_new)
     flat_d = jax.tree.leaves_with_path(jax.tree.map(np.asarray, dist_new))
     flat_s = dict(
         (jax.tree_util.keystr(k), v)
@@ -80,6 +87,17 @@ def test_step_matches_sequential_across_mesh_shapes(dp, pp, tp):
     tokens = jax.random.randint(jax.random.key(6), (M, mb, S), 0, cfg.vocab)
     targets = jnp.roll(tokens, -1, axis=-1)
     _assert_step_matches_sequential(cfg, mesh, params, tokens, targets)
+
+
+def test_interleaved_schedule_matches_sequential(setup):
+    """The interleaved pipeline schedule (n_virtual=2: 4 layers snake
+    over pp=2 twice) must produce the SAME step as GPipe and the
+    single-device math — same loss, same updated parameters."""
+    cfg, mesh, params, tokens, targets = setup
+    # n_micro must divide by pp for the interleaved schedule.
+    M = tokens.shape[0] - tokens.shape[0] % mesh.shape["pp"]
+    _assert_step_matches_sequential(cfg, mesh, params, tokens[:M],
+                                    targets[:M], n_virtual=2)
 
 
 def test_distributed_training_converges(setup):
