@@ -29,11 +29,16 @@ this kernel on the resident shard and merges blocks by logaddexp.
 Runs compiled on TPU; falls back to Pallas interpret mode elsewhere (the
 CPU test mesh), same code path.
 
-Single-kernel sequence ceiling: K/V are VMEM-resident per (batch, head)
-program, which tops out around S=8192 on v5e (measured: S=8192 compiles
-and runs at 39x over dense; S=16384 exceeds scoped VMEM). Longer
-sequences are the sequence-parallel strategies' job — ring attention /
-Ulysses shard S across chips and call this kernel per shard.
+Two kernel variants share the math:
+* resident (default below S=16384): whole K/V in VMEM per (batch, head)
+  program — fastest at moderate S, but VMEM caps it near S=8192 on v5e;
+* streaming (``streaming=True`` / auto at S>=16384): a fourth,
+  sequential grid dimension feeds ONE double-buffered K/V tile per step
+  with the online-softmax state in VMEM scratch — bit-identical output
+  (verified on-chip), bounded by HBM instead of VMEM (S=32768 measured
+  at 60 ms on v5e where the resident kernel cannot compile).
+Beyond one chip, the sequence-parallel strategies (ring attention /
+Ulysses) shard S across devices and call these kernels per shard.
 """
 
 from __future__ import annotations
@@ -85,6 +90,29 @@ def select_attention(use_flash):
     return flash_attention if use_flash else attention_reference
 
 
+def _online_softmax_step(q, kb, vb, m, l, acc, row0, col0, masked, prec):
+    """One flash block update, shared by the resident and streaming
+    kernels (BASELINE.md's bit-identical claim rests on this being THE
+    single definition): scaled-q x K^T logits, optional causal mask with
+    absolute row/col offsets, and the rescale-and-accumulate of the
+    online-softmax state. Returns (m, l, acc)."""
+    s = jax.lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=prec)  # [BQ, BK] f32
+    if masked:
+        rows = row0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = col0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m - m_new)
+    l_new = corr * l + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = corr * acc + jax.lax.dot_general(
+        p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=prec)
+    return m_new, l_new, acc_new
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
                   scale, causal, emit_lse=False):
     """One (batch, head, q-block) program: online softmax over k blocks.
@@ -113,25 +141,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
         m, l, acc = carry
         kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
         vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=prec)                              # [BQ, BK] f32
-        if masked:
-            rows = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l_new = corr * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = corr * acc + jax.lax.dot_general(
-            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=prec)
-        return m_new, l_new, acc_new
+        return _online_softmax_step(q, kb, vb, m, l, acc, i * block_q,
+                                    j * block_k, masked, prec)
 
     if causal:
         # K/V blocks [0, n_full) lie strictly below the diagonal for every
@@ -181,8 +192,11 @@ def _fit_blocks(S, block_q, block_k):
 
 
 def _flash_fwd_impl(qt, kt, vt, causal, block_q, block_k):
-    """Raw pallas call on [B, H, S, D] operands -> o [B, H, S, D]."""
+    """Raw pallas call on [B, H, Sq, D] / [B, H, Sk, D] operands ->
+    o [B, H, Sq, D] (Sk may differ from Sq in the non-causal case)."""
     B, H, S, D = qt.shape
+    Sk = kt.shape[2]
+    assert not causal or S == Sk, (S, Sk)
     scale = 1.0 / (D ** 0.5)
     kernel = functools.partial(_flash_kernel, block_q=block_q,
                                block_k=block_k, scale=scale, causal=causal)
@@ -192,9 +206,9 @@ def _flash_fwd_impl(qt, kt, vt, causal, block_q, block_k):
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0),
+            pl.BlockSpec((1, 1, Sk, D), lambda b, h, i: (b, h, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0),
+            pl.BlockSpec((1, 1, Sk, D), lambda b, h, i: (b, h, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D),
@@ -205,13 +219,91 @@ def _flash_fwd_impl(qt, kt, vt, causal, block_q, block_k):
     )(qt, kt, vt)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(qt, kt, vt, causal, block_q, block_k):
+def _flash_stream_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                         *, block_q, block_k, scale, causal, n_k):
+    """One (batch, head, q-block, K-BLOCK) grid step of the STREAMING
+    kernel: K/V arrive one [block_k, D] tile per step (Mosaic
+    double-buffers the tile DMA against compute), and the online-softmax
+    state (m, l, acc) lives in VMEM scratch across the sequential k
+    dimension. Unlike _flash_kernel, VMEM holds only one K/V tile — no
+    whole-sequence residency, so S is bounded by HBM, not VMEM."""
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    prec = (jax.lax.Precision.HIGHEST if q_ref.dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: K blocks strictly above the diagonal contribute nothing —
+    # skip their FLOPs entirely (their DMA is pipelined regardless).
+    visible = (jnp.bool_(True) if not causal
+               else j * block_k <= i * block_q + block_q - 1)
+
+    @pl.when(visible)
+    def _compute():
+        q = (q_ref[0, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+        m_new, l_new, acc_new = _online_softmax_step(
+            q, k_ref[0, 0], v_ref[0, 0], m_scr[:], l_scr[:], acc_scr[:],
+            i * block_q, j * block_k, causal, prec)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+        acc_scr[:] = acc_new
+
+    @pl.when(j == n_k - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_scr[:] / l_scr[:]).astype(o_ref.dtype)
+
+
+def _flash_stream_fwd_impl(qt, kt, vt, causal, block_q, block_k):
+    """Raw streaming pallas call on [B, H, S, D] operands."""
+    B, H, S, D = qt.shape
+    Sk = kt.shape[2]
+    assert not causal or S == Sk, (S, Sk)
+    n_k = Sk // block_k
+    scale = 1.0 / (D ** 0.5)
+    kernel = functools.partial(_flash_stream_kernel, block_q=block_q,
+                               block_k=block_k, scale=scale, causal=causal,
+                               n_k=n_k)
+    return pl.pallas_call(
+        kernel,
+        grid=(B, H, S // block_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, i, j: (b, h, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=_out_struct((B, H, S, D), qt.dtype, qt, kt, vt),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=jax.default_backend() != "tpu",
+    )(qt, kt, vt)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(qt, kt, vt, causal, block_q, block_k, streaming=False):
+    if streaming:
+        return _flash_stream_fwd_impl(qt, kt, vt, causal, block_q, block_k)
     return _flash_fwd_impl(qt, kt, vt, causal, block_q, block_k)
 
 
-def _flash_vjp_fwd(qt, kt, vt, causal, block_q, block_k):
-    o = _flash_fwd_impl(qt, kt, vt, causal, block_q, block_k)
+def _flash_vjp_fwd(qt, kt, vt, causal, block_q, block_k, streaming=False):
+    o = _flash(qt, kt, vt, causal, block_q, block_k, streaming)
     return o, (qt, kt, vt, o)
 
 
@@ -316,7 +408,9 @@ def _flash_bwd_blockwise(qt, kt, vt, o, do, causal, block_q, block_k,
     return dq.astype(qt.dtype), dk.astype(kt.dtype), dv.astype(vt.dtype)
 
 
-def _flash_vjp_bwd(causal, block_q, block_k, res, do):
+def _flash_vjp_bwd(causal, block_q, block_k, streaming, res, do):
+    # The blockwise backward is kernel-independent (pure JAX recompute),
+    # so resident and streaming forwards share it.
     qt, kt, vt, o = res
     return _flash_bwd_blockwise(qt, kt, vt, o, do, causal, block_q, block_k)
 
@@ -379,9 +473,10 @@ def _flash_lse_vjp_bwd(causal, block_q, block_k, res, cts):
 _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "streaming"))
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
-                    block_k: int = 512):
+                    block_k: int = 512, streaming: bool | None = None):
     """Flash attention, [B, S, H, D] in / [B, S, H, D] out. Differentiable
     (custom VJP; see module docstring).
 
@@ -391,15 +486,24 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
     multiple of the requested block. On a real TPU, S must be a multiple
     of 128 (Mosaic tiling; ``auto_attention`` guards this) — interpret
     mode (any non-TPU backend) accepts any S that divides by 8.
+
+    ``streaming`` selects the k-grid kernel that holds only ONE K/V tile
+    in VMEM (double-buffered DMA) instead of the whole K/V — required
+    past the resident kernel's ~S=8k VMEM ceiling. ``None`` -> auto: on
+    for S >= 16384.
     """
     B, S, H, D = q.shape
-    block_q, block_k = _fit_blocks(S, block_q, block_k)
+    Sk = k.shape[1]
+    if streaming is None:
+        streaming = Sk >= 16384
+    block_q, _ = _fit_blocks(S, block_q, block_k)
+    _, block_k = _fit_blocks(Sk, block_q, block_k)
 
     def to_bhsd(x):
         return jnp.transpose(x, (0, 2, 1, 3))            # [B, H, S, D]
 
     out = _flash(to_bhsd(q), to_bhsd(k), to_bhsd(v), causal, block_q,
-                 block_k)
+                 block_k, streaming)
     return jnp.transpose(out, (0, 2, 1, 3))              # [B, S, H, D]
 
 

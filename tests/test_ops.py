@@ -93,6 +93,21 @@ class TestFlashAttention:
             np.asarray(out, np.float32), np.asarray(ref, np.float32),
             atol=3e-2, rtol=3e-2)
 
+    @pytest.mark.parametrize("streaming", [False, True])
+    def test_cross_length_kv_attends_all_keys(self, streaming):
+        # Non-causal with Sk != Sq: BOTH kernel paths must attend every
+        # key (r3 code-review regression: the resident specs were built
+        # from q's S and silently dropped keys past it).
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(11), 3)
+        q = jax.random.normal(k1, (1, 128, 2, 32), jnp.float32)
+        k = jax.random.normal(k2, (1, 256, 2, 32), jnp.float32)
+        v = jax.random.normal(k3, (1, 256, 2, 32), jnp.float32)
+        out = flash_attention(q, k, v, causal=False, streaming=streaming,
+                              block_q=64, block_k=64)
+        ref = attention_reference(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
     def test_multiple_q_blocks_causality(self):
         # S spans several q/k blocks; late queries must not see the future.
         q = jnp.ones((1, 512, 1, 64), jnp.float32)
@@ -105,6 +120,60 @@ class TestFlashAttention:
         expect = jnp.arange(512, dtype=jnp.float32) / 2.0
         np.testing.assert_allclose(np.asarray(out[0, :, 0, 0]),
                                    np.asarray(expect), atol=1e-3, rtol=1e-4)
+
+
+class TestFlashAttentionStreaming:
+    """The k-grid streaming kernel (one K/V tile in VMEM, scratch-carried
+    online softmax) must match the resident kernel and the dense
+    reference, values and grads — it is the long-context path past the
+    resident kernel's VMEM ceiling."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(7), 3)
+        q = jax.random.normal(k1, (2, 256, 4, 64), jnp.float32)
+        k = jax.random.normal(k2, (2, 256, 4, 64), jnp.float32)
+        v = jax.random.normal(k3, (2, 256, 4, 64), jnp.float32)
+        out = flash_attention(q, k, v, causal=causal, streaming=True,
+                              block_q=64, block_k=64)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_grad_matches_dense(self):
+        q = jax.random.normal(jax.random.key(8), (1, 128, 2, 32),
+                              jnp.float32)
+        k = jax.random.normal(jax.random.key(9), q.shape, jnp.float32)
+        v = jax.random.normal(jax.random.key(10), q.shape, jnp.float32)
+        gs = jax.grad(lambda q, k, v: (flash_attention(
+            q, k, v, streaming=True, block_q=64, block_k=64) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(lambda q, k, v: (attention_reference(
+            q, k, v) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gs, gd):
+            err = float(jnp.abs(a - b).max() / (jnp.abs(b).max() + 1e-9))
+            assert err < 1e-5, err
+
+    def test_auto_policy_kicks_in_at_16k(self):
+        # streaming=None must select the streaming kernel exactly where
+        # the resident kernel's VMEM ceiling is (S >= 16384).
+        from mpi_acx_tpu.ops import attention as A
+        calls = []
+        orig = A._flash
+
+        def spy(qt, kt, vt, causal, bq, bk, streaming=False):
+            calls.append(streaming)
+            return orig(qt, kt, vt, causal, bq, bk, streaming)
+
+        A._flash = spy
+        try:
+            x = jnp.zeros((1, 128, 1, 32), jnp.float32)
+            A.flash_attention.__wrapped__(x, x, x)          # small: resident
+            big = jnp.zeros((1, 16384, 1, 32), jnp.float32)
+            A.flash_attention.__wrapped__(big, big, big)    # big: streaming
+        finally:
+            A._flash = orig
+        assert calls == [False, True], calls
 
 
 class TestFlashAttentionLse:
