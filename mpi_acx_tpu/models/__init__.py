@@ -24,6 +24,10 @@ from mpi_acx_tpu.models.transformer import (  # noqa: F401
 from mpi_acx_tpu.models.moe import (  # noqa: F401
     MoeConfig,
     init_moe_params,
+    load_balance_loss,
+    make_moe_train_step,
     moe_layer,
+    moe_layer_and_aux,
+    router_z_loss,
 )
 from mpi_acx_tpu.models import llama  # noqa: F401  (namespaced: llama.forward, ...)

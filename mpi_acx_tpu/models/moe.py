@@ -1,11 +1,18 @@
 """Mixture-of-experts layer with expert parallelism over a mesh axis.
 
-Top-1 (switch-style) routing with a static capacity factor: dispatch and
-combine are einsums against a one-hot dispatch tensor, so the whole layer
-is static-shaped for XLA. Expert parallelism shards the expert dimension
-over a mesh axis inside shard_map: tokens travel to their expert's device
-through ``lax.all_to_all`` (the EP collective), are transformed by the
-local experts, and return the same way.
+Top-k (switch-style k=1 / GShard-style k=2) routing with a static capacity
+factor: dispatch and combine are einsums against a one-hot dispatch tensor,
+so the whole layer is static-shaped for XLA. Expert parallelism shards the
+expert dimension over a mesh axis inside shard_map: tokens travel to their
+expert's device through ``lax.all_to_all`` (the EP collective), are
+transformed by the local experts, and return the same way.
+
+Training support: :func:`load_balance_loss` (the Switch-Transformer
+auxiliary loss that keeps routing uniform) and :func:`router_z_loss`
+(logit-magnitude regularizer), both exposed together with the layer output
+by :func:`moe_layer_and_aux`, and :func:`make_moe_train_step` — a jitted
+expert-parallel SGD step over a 1D 'ep' mesh whose loss and gradients are
+validated exactly against the single-device layer (tests/test_moe_train.py).
 """
 
 from __future__ import annotations
@@ -24,6 +31,7 @@ class MoeConfig:
     d_ff: int = 256
     n_experts: int = 8
     capacity_factor: float = 2.0
+    top_k: int = 1     # experts per token (1 = Switch, 2 = GShard-style)
 
 
 def init_moe_params(key: jax.Array, cfg: MoeConfig) -> Dict[str, Any]:
@@ -36,48 +44,82 @@ def init_moe_params(key: jax.Array, cfg: MoeConfig) -> Dict[str, Any]:
     }
 
 
-def _dispatch_tensors(gates: jax.Array, capacity: int):
-    """gates [T, E] -> (dispatch [T, E, C] one-hot, combine [T, E, C])."""
+def _dispatch_tensors(gates: jax.Array, capacity: int, k: int = 1):
+    """gates [T, E] -> (dispatch [T, E, C] one-hot, combine [T, E, C]).
+
+    Top-k routing with per-expert capacity C: choice rank 0 (every token's
+    best expert) claims queue positions first, then rank 1, etc. — the
+    standard priority order, so adding second choices never evicts a
+    token's first choice. Tokens past an expert's capacity are dropped
+    from that expert (their dispatch/combine rows are zero). Combine
+    weights are the router's softmax probabilities of the SURVIVING
+    choices (not renormalized — the Switch/GShard convention, which also
+    keeps the k=1 path bit-identical to a pure argmax router).
+    """
     T, E = gates.shape
-    expert = jnp.argmax(gates, axis=-1)                       # [T]
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)     # [T, E]
-    # Position of each token within its expert's queue.
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot         # [T, E]
-    keep = pos < capacity
-    onehot = onehot * keep
-    posc = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), capacity)  # [T, C]
-    dispatch = onehot[:, :, None] * posc[:, None, :]          # [T, E, C]
-    prob = jnp.sum(jax.nn.softmax(gates, axis=-1) * onehot, -1)  # [T]
-    combine = dispatch * prob[:, None, None]
+    probs = jax.nn.softmax(gates, axis=-1)                    # [T, E]
+    _, idx = lax.top_k(gates, k)                              # [T, k]
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    counts = jnp.zeros((E,), jnp.float32)   # queue fill from earlier ranks
+    for c in range(k):                      # k is static and tiny
+        onehot = jax.nn.one_hot(idx[:, c], E, dtype=jnp.float32)  # [T, E]
+        # Position of each token within its expert's queue, after the
+        # tokens already enqueued by higher-priority choice ranks.
+        pos = ((jnp.cumsum(onehot, axis=0) - 1.0) + counts) * onehot
+        keep = pos < capacity
+        onehot = onehot * keep
+        posc = jax.nn.one_hot(
+            pos.sum(-1).astype(jnp.int32), capacity)          # [T, C]
+        d_c = onehot[:, :, None] * posc[:, None, :]           # [T, E, C]
+        prob = jnp.sum(probs * onehot, -1)                    # [T]
+        dispatch = dispatch + d_c
+        combine = combine + d_c * prob[:, None, None]
+        counts = counts + onehot.sum(0)
     return dispatch, combine
 
 
-def moe_layer(params: Dict[str, Any], x: jax.Array, cfg: MoeConfig,
-              ep_axis: str | None = None) -> jax.Array:
-    """x [T, d] -> [T, d].
+def load_balance_loss(gates: jax.Array, k: int = 1) -> jax.Array:
+    """Switch-Transformer auxiliary load-balancing loss on router logits
+    [T, E]: ``E * sum_e f_e * p_e`` where f_e is the fraction of (token,
+    choice) assignments routed to expert e (pre-capacity) and p_e the mean
+    router probability. Equals 1.0 at perfectly uniform routing (its
+    minimum over f for fixed uniform p), grows as routing collapses."""
+    T, E = gates.shape
+    probs = jax.nn.softmax(gates, axis=-1)
+    _, idx = lax.top_k(gates, k)                              # [T, k]
+    f = jax.nn.one_hot(idx, E, dtype=jnp.float32).sum(1).mean(0)  # [E]
+    return E * jnp.sum(f / k * probs.mean(0))
 
-    With ep_axis set (inside shard_map), the expert dim of params is the
-    LOCAL slice [E/ep, d, ff] and tokens are exchanged by all_to_all:
-    dispatch [T, E_local*ep, C] -> regroup to [ep, T, E_local, C] ->
-    all_to_all over the leading axis, so each device receives every
-    device's tokens for ITS experts (BASELINE-style EP).
-    """
+
+def router_z_loss(gates: jax.Array) -> jax.Array:
+    """Mean squared router logsumexp ([T, E] logits) — keeps gate logits
+    small so the routing softmax stays in its well-conditioned range
+    (the ST-MoE z-loss)."""
+    return jnp.mean(jax.nn.logsumexp(gates.astype(jnp.float32), -1) ** 2)
+
+
+def _moe_forward(params, x, cfg: MoeConfig, ep_axis):
+    """Shared forward: returns (y [T, d], gates [T, E] f32 logits)."""
     T, d = x.shape
     gates = x.astype(jnp.float32) @ params["gate"]
     e_local = params["w1"].shape[0]
     if ep_axis is None:
         E = e_local
         cap = int(cfg.capacity_factor * T / E + 1)
-        dispatch, combine = _dispatch_tensors(gates, cap)
+        dispatch, combine = _dispatch_tensors(gates, cap, cfg.top_k)
         xin = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), dispatch)
         h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin, params["w1"]))
         out = jnp.einsum("ecf,efd->ecd", h, params["w2"])
-        return jnp.einsum("ecd,tec->td", out, combine).astype(x.dtype)
+        return (jnp.einsum("ecd,tec->td", out, combine).astype(x.dtype),
+                gates)
 
     ep = lax.axis_size(ep_axis)
     E = e_local * ep
+    # Capacity is per dispatch group (this rank's T tokens) — the GShard
+    # convention; with tokens sharded over ep, T here is the local count.
     cap = int(cfg.capacity_factor * T / E + 1)
-    dispatch, combine = _dispatch_tensors(gates, cap)          # [T, E, C]
+    dispatch, combine = _dispatch_tensors(gates, cap, cfg.top_k)  # [T,E,C]
     xin = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), dispatch)
     # [E, C, d] -> [ep, E_local, C, d]; all_to_all swaps the ep axis with
     # the device axis so device j holds every sender's slice for ITS
@@ -91,4 +133,82 @@ def moe_layer(params: Dict[str, Any], x: jax.Array, cfg: MoeConfig,
     out = lax.all_to_all(out, ep_axis, split_axis=0, concat_axis=0,
                          tiled=False)
     out = out.reshape(E, cap, d)
-    return jnp.einsum("ecd,tec->td", out, combine).astype(x.dtype)
+    return jnp.einsum("ecd,tec->td", out, combine).astype(x.dtype), gates
+
+
+def moe_layer(params: Dict[str, Any], x: jax.Array, cfg: MoeConfig,
+              ep_axis: str | None = None) -> jax.Array:
+    """x [T, d] -> [T, d].
+
+    With ep_axis set (inside shard_map), the expert dim of params is the
+    LOCAL slice [E/ep, d, ff] and tokens are exchanged by all_to_all:
+    dispatch [T, E_local*ep, C] -> regroup to [ep, T, E_local, C] ->
+    all_to_all over the leading axis, so each device receives every
+    device's tokens for ITS experts (BASELINE-style EP). x may be the
+    rank's exclusive token shard (standard EP: all_to_all then moves real
+    token data between devices) or replicated (each rank redundantly
+    routes the same tokens).
+    """
+    y, _ = _moe_forward(params, x, cfg, ep_axis)
+    return y
+
+
+def moe_layer_and_aux(params: Dict[str, Any], x: jax.Array, cfg: MoeConfig,
+                      ep_axis: str | None = None):
+    """Like :func:`moe_layer` but also returns the training auxiliaries
+    computed from this rank's router logits:
+    ``(y, {"load_balance": .., "router_z": ..})``."""
+    y, gates = _moe_forward(params, x, cfg, ep_axis)
+    return y, {"load_balance": load_balance_loss(gates, cfg.top_k),
+               "router_z": router_z_loss(gates)}
+
+
+def make_moe_train_step(cfg: MoeConfig, mesh, ep_axis: str = "ep",
+                        lr: float = 0.1, aux_weight: float = 1e-2,
+                        z_weight: float = 1e-3):
+    """Expert-parallel SGD train step over a 1D ``ep`` mesh.
+
+    Returns a jitted ``step(params, x, targets) -> (loss, new_params)``
+    with x/targets [T, d] sharded over ``ep`` (each device routes its own
+    token shard; all_to_all carries tokens to their expert's device and
+    back), gate replicated, expert weights sharded. Loss = global mean
+    squared error + aux_weight * load-balance + z_weight * router-z.
+
+    Gradient construction mirrors mpi_acx_tpu.train.make_loss_and_grads:
+    every rank's loss terms cover only its EXCLUSIVE token shard and the
+    scalar is assembled by psum, so each parameter cotangent path is
+    unique; under check_vma=False the psum transpose uniformly scales all
+    cotangents by ep (undone explicitly), after which the replicated gate
+    needs one psum and the expert-sharded leaves none.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    ep_n = mesh.shape[ep_axis]
+
+    def per_shard(params, x, tgt):
+        def loss_fn(params):
+            y, aux = moe_layer_and_aux(params, x, cfg, ep_axis=ep_axis)
+            se = jnp.sum((y.astype(jnp.float32) -
+                          tgt.astype(jnp.float32)) ** 2)
+            raw = (se / (x.shape[1] * x.shape[0] * ep_n)
+                   + (aux_weight * aux["load_balance"]
+                      + z_weight * aux["router_z"]) / ep_n)
+            return lax.psum(raw, ep_axis)
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        g = jax.tree.map(lambda t: t / ep_n, g)   # undo psum seed scaling
+        g = dict(g, gate=lax.psum(g["gate"], ep_axis))
+        return loss, g
+
+    pspecs = {"gate": P(), "w1": P(ep_axis), "w2": P(ep_axis)}
+    grad_fn = shard_map(per_shard, mesh=mesh,
+                        in_specs=(pspecs, P(ep_axis), P(ep_axis)),
+                        out_specs=(P(), pspecs), check_vma=False)
+
+    @jax.jit
+    def step(params, x, tgt):
+        loss, g = grad_fn(params, x, tgt)
+        return loss, jax.tree.map(lambda p, gg: p - lr * gg, params, g)
+
+    return step

@@ -1,0 +1,151 @@
+"""MoE training: top-k routing, auxiliary losses, expert-parallel step.
+
+The reference provides the communication substrate, not MoE (SURVEY.md §0);
+these tests validate the framework's EP training composition the same way
+test_train.py validates dp x pp x tp — exactly against a single-device
+computation of the identical math.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mpi_acx_tpu.models.moe import (
+    MoeConfig, init_moe_params, load_balance_loss, make_moe_train_step,
+    moe_layer, moe_layer_and_aux, router_z_loss,
+)
+
+
+def make_mesh(n, axis="ep"):
+    return Mesh(np.asarray(jax.devices()[:n]), (axis,))
+
+
+def naive_topk_reference(params, x, gates, k):
+    """Per-token loop reference for top-k routing with ample capacity:
+    y[t] = sum over the token's k best experts of p_e * expert_e(x[t])."""
+    probs = np.asarray(jax.nn.softmax(gates, axis=-1))
+    idx = np.argsort(-np.asarray(gates), axis=-1)[:, :k]
+    w1 = np.asarray(params["w1"], np.float64)
+    w2 = np.asarray(params["w2"], np.float64)
+    xs = np.asarray(x, np.float64)
+    out = np.zeros_like(xs)
+    for t in range(xs.shape[0]):
+        for c in range(k):
+            e = idx[t, c]
+            h = np.asarray(jax.nn.gelu(jnp.asarray(xs[t] @ w1[e])))
+            out[t] += probs[t, e] * (h @ w2[e])
+    return out
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_topk_routing_matches_naive(k):
+    """Ample capacity: the einsum dispatch == a per-token loop."""
+    cfg = MoeConfig(d_model=16, d_ff=32, n_experts=4, capacity_factor=16.0,
+                    top_k=k)
+    params = init_moe_params(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (16, 16), jnp.float32)
+    gates = x @ params["gate"]
+    y = moe_layer(params, x, cfg)
+    want = naive_topk_reference(params, x, gates, k)
+    np.testing.assert_allclose(np.asarray(y), want, atol=1e-4, rtol=1e-4)
+
+
+def test_top2_capacity_priority():
+    """First choices claim expert queues before second choices: with
+    capacity 1 per expert, every surviving (expert, slot) belongs to a
+    rank-0 choice whenever one wanted it."""
+    cfg = MoeConfig(d_model=8, d_ff=16, n_experts=2, capacity_factor=0.5,
+                    top_k=2)   # cap = int(0.5 * 4 / 2 + 1) = 2
+    params = init_moe_params(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (4, 8), jnp.float32)
+    gates = x @ params["gate"]
+    from mpi_acx_tpu.models.moe import _dispatch_tensors
+    dispatch, combine = _dispatch_tensors(gates, 2, k=2)
+    # Per-expert load never exceeds capacity.
+    load = np.asarray(dispatch.sum(axis=(0, 2)))
+    assert (load <= 2 + 1e-6).all()
+    # Each surviving (token, expert) weight is that token's router prob.
+    probs = np.asarray(jax.nn.softmax(gates, -1))
+    sel = np.asarray(dispatch.sum(-1))                   # [T, E] 0/1
+    got = np.asarray(combine.sum(-1))
+    np.testing.assert_allclose(got, sel * probs, atol=1e-6)
+    # Rank-0 choices all survived (T=4 first choices spread over 2
+    # experts can exceed cap only if 3+ tokens share a first choice —
+    # then the overflow must be the LAST tokens, not rank promotion).
+    idx0 = np.argsort(-np.asarray(gates), -1)[:, 0]
+    for e in range(2):
+        wanted = np.flatnonzero(idx0 == e)
+        kept = np.flatnonzero(sel[:, e] > 0)
+        # the first min(cap, len) rank-0 claimants are kept
+        assert set(wanted[:2]).issubset(set(kept))
+
+
+def test_load_balance_loss_uniform_vs_collapsed():
+    T, E = 256, 8
+    # Uniform-ish logits -> loss near its 1.0 minimum.
+    g_uni = jax.random.normal(jax.random.key(0), (T, E)) * 1e-3
+    lb_uni = float(load_balance_loss(g_uni))
+    # All tokens routed to expert 0 -> loss near E.
+    g_col = jnp.zeros((T, E)).at[:, 0].set(10.0)
+    lb_col = float(load_balance_loss(g_col))
+    assert abs(lb_uni - 1.0) < 0.1, lb_uni
+    assert lb_col > E * 0.9, lb_col
+    # z-loss is positive and finite.
+    assert 0 < float(router_z_loss(g_uni)) < 100
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_moe_train_step_matches_single_device(k):
+    """EP train step over 8 devices: loss AND updated params match the
+    identical math computed shard-by-shard on one device (capacity is per
+    dispatch group, so the per-shard single-device layer reproduces the
+    EP routing exactly — including drops)."""
+    ep = 8
+    mesh = make_mesh(ep)
+    cfg = MoeConfig(d_model=16, d_ff=32, n_experts=8, capacity_factor=2.0,
+                    top_k=k)
+    params = init_moe_params(jax.random.key(0), cfg)
+    T, d = 64, 16
+    x = jax.random.normal(jax.random.key(1), (T, d), jnp.float32)
+    tgt = jax.random.normal(jax.random.key(2), (T, d), jnp.float32)
+    lr, aw, zw = 0.05, 1e-2, 1e-3
+
+    step = make_moe_train_step(cfg, mesh, lr=lr, aux_weight=aw, z_weight=zw)
+    loss, new_params = step(params, x, tgt)
+
+    def single_loss(p):
+        tl = T // ep
+        tot = 0.0
+        for s in range(ep):
+            xs = jax.lax.dynamic_slice_in_dim(x, s * tl, tl, 0)
+            ts = jax.lax.dynamic_slice_in_dim(tgt, s * tl, tl, 0)
+            y, aux = moe_layer_and_aux(p, xs, cfg)
+            tot = tot + (jnp.sum((y - ts) ** 2) / (T * d)
+                         + (aw * aux["load_balance"]
+                            + zw * aux["router_z"]) / ep)
+        return tot
+
+    want_loss, g = jax.value_and_grad(single_loss)(params)
+    np.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-5)
+    want_new = jax.tree.map(lambda p, gg: p - lr * gg, params, g)
+    for name in ("gate", "w1", "w2"):
+        np.testing.assert_allclose(
+            np.asarray(new_params[name]), np.asarray(want_new[name]),
+            atol=2e-5, rtol=2e-4, err_msg=name)
+
+
+def test_moe_train_step_learns():
+    """A few EP steps reduce the loss on a fixed batch."""
+    mesh = make_mesh(8)
+    cfg = MoeConfig(d_model=16, d_ff=32, n_experts=8, capacity_factor=4.0,
+                    top_k=2)
+    params = init_moe_params(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (64, 16), jnp.float32)
+    tgt = jnp.tanh(x) * 0.5
+    step = make_moe_train_step(cfg, mesh, lr=0.5)
+    l0, params = step(params, x, tgt)
+    for _ in range(5):
+        l1, params = step(params, x, tgt)
+    assert float(l1) < float(l0)
