@@ -31,3 +31,4 @@ from mpi_acx_tpu.models.moe import (  # noqa: F401
     router_z_loss,
 )
 from mpi_acx_tpu.models import llama  # noqa: F401  (namespaced: llama.forward, ...)
+from mpi_acx_tpu.models import moe_transformer  # noqa: F401  (namespaced)

@@ -142,9 +142,9 @@ def moe_layer(params: Dict[str, Any], x: jax.Array, cfg: MoeConfig,
 
     With ep_axis set (inside shard_map), the expert dim of params is the
     LOCAL slice [E/ep, d, ff] and tokens are exchanged by all_to_all:
-    dispatch [T, E_local*ep, C] -> regroup to [ep, T, E_local, C] ->
-    all_to_all over the leading axis, so each device receives every
-    device's tokens for ITS experts (BASELINE-style EP). x may be the
+    the dispatched activations [E, C, d] regroup to [ep, E_local, C, d]
+    and all_to_all over the leading axis gives each device every sender's
+    slice for ITS experts (BASELINE-style EP). x may be the
     rank's exclusive token shard (standard EP: all_to_all then moves real
     token data between devices) or replicated (each rank redundantly
     routes the same tokens).

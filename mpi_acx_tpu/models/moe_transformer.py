@@ -1,0 +1,218 @@
+"""Switch-style MoE transformer: GPT-2 attention, mixture-of-experts FFN.
+
+The third model family (after GPT-2 and Llama), built from the same
+primitives: every block is causal self-attention (flash/dense via the
+shared ops.attention policy) followed by a top-k routed expert FFN
+(models.moe). The layer stack is a ``lax.scan`` over stacked layer
+parameters — one compiled block body — with the router auxiliary losses
+(load-balance, router-z) accumulated through the scan carry.
+
+Distributed training uses the classic DP+EP layout: ONE mesh axis carries
+both the batch shard and the expert shard (experts live across the
+data-parallel ranks; ``lax.all_to_all`` moves tokens to their expert's
+rank and back inside each block). :func:`make_moe_transformer_train_step`
+builds the jitted step; tests/test_moe_train.py validates its loss and
+every updated parameter exactly against the identical math on one device.
+
+The reference ships no models at all (SURVEY.md §0) — model families are
+this framework's application layer over the communication substrate, the
+workloads its BASELINE configs describe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_acx_tpu.models import transformer as tfm
+from mpi_acx_tpu.models.moe import (MoeConfig, load_balance_loss,
+                                    moe_layer_and_aux, router_z_loss)
+from mpi_acx_tpu.ops.attention import select_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeTransformerConfig:
+    vocab: int = 50257
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072          # per-expert FFN width
+    n_experts: int = 8
+    top_k: int = 1
+    capacity_factor: float = 2.0
+    max_seq: int = 1024
+    dtype: Any = jnp.bfloat16
+    use_flash: Optional[bool] = None
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def moe(self) -> MoeConfig:
+        return MoeConfig(d_model=self.d_model, d_ff=self.d_ff,
+                         n_experts=self.n_experts,
+                         capacity_factor=self.capacity_factor,
+                         top_k=self.top_k)
+
+
+def tiny_moe_config(vocab: int = 256, d_model: int = 32, n_heads: int = 2,
+                    n_layers: int = 2, d_ff: int = 64, n_experts: int = 8,
+                    top_k: int = 1, capacity_factor: float = 2.0,
+                    max_seq: int = 64) -> MoeTransformerConfig:
+    return MoeTransformerConfig(
+        vocab=vocab, d_model=d_model, n_heads=n_heads, n_layers=n_layers,
+        d_ff=d_ff, n_experts=n_experts, top_k=top_k,
+        capacity_factor=capacity_factor, max_seq=max_seq)
+
+
+def init_params(key: jax.Array, cfg: MoeTransformerConfig) -> Dict[str, Any]:
+    """Stacked-layer pytree like transformer.init_params: every per-layer
+    tensor has a leading [n_layers] axis; expert tensors additionally
+    carry the [n_experts] axis EP shards."""
+    k = jax.random.split(key, 7)
+    L, d, ff, E = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.n_experts
+    s = 0.02
+
+    def nrm(key, *shape, scale=s):
+        return jax.random.normal(key, shape, jnp.float32) * scale
+
+    return {
+        "embed": nrm(k[0], cfg.vocab, d),
+        "pos": nrm(k[1], cfg.max_seq, d),
+        "lnf_g": jnp.ones((d,)), "lnf_b": jnp.zeros((d,)),
+        "layers": {
+            "ln1_g": jnp.ones((L, d)), "ln1_b": jnp.zeros((L, d)),
+            "wqkv": nrm(k[2], L, d, 3 * d),
+            "wo": nrm(k[3], L, d, d),
+            "ln2_g": jnp.ones((L, d)), "ln2_b": jnp.zeros((L, d)),
+            "gate": nrm(k[4], L, d, E),
+            "w1": nrm(k[5], L, E, d, ff),
+            "w2": nrm(k[6], L, E, ff, d),
+        },
+    }
+
+
+def block(cfg: MoeTransformerConfig, lp: Dict[str, Any], h: jax.Array,
+          ep_axis: str | None = None):
+    """One MoE-transformer block on h [B, S, d]; returns (h, aux) where
+    aux = (load_balance, router_z) from this block's router. With ep_axis
+    set (inside shard_map), lp's gate stays replicated and w1/w2 are the
+    LOCAL expert slices; tokens flow through all_to_all."""
+    B, S, d = h.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+
+    hn = tfm.layernorm(h, lp["ln1_g"], lp["ln1_b"])
+    qkv = hn @ lp["wqkv"].astype(h.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    o = select_attention(cfg.use_flash)(
+        q.reshape(B, S, H, Dh), k.reshape(B, S, H, Dh),
+        v.reshape(B, S, H, Dh))
+    h = h + o.reshape(B, S, d) @ lp["wo"].astype(h.dtype)
+
+    hn = tfm.layernorm(h, lp["ln2_g"], lp["ln2_b"])
+    mp = {"gate": lp["gate"], "w1": lp["w1"], "w2": lp["w2"]}
+    y, aux = moe_layer_and_aux(mp, hn.reshape(B * S, d), cfg.moe,
+                               ep_axis=ep_axis)
+    return h + y.reshape(B, S, d), (aux["load_balance"], aux["router_z"])
+
+
+def forward(params: Dict[str, Any], cfg: MoeTransformerConfig,
+            tokens: jax.Array, ep_axis: str | None = None):
+    """tokens [B, S] -> (logits [B, S, vocab] f32, aux) where aux is the
+    dict of per-layer MEAN router losses."""
+    B, S = tokens.shape
+    h = (params["embed"][tokens] + params["pos"][:S]).astype(cfg.dtype)
+
+    def body(carry, lp):
+        h, lb, rz = carry
+        h, (b_lb, b_rz) = block(cfg, lp, h, ep_axis=ep_axis)
+        return (h, lb + b_lb, rz + b_rz), None
+
+    zero = jnp.zeros((), jnp.float32)
+    (h, lb, rz), _ = lax.scan(body, (h, zero, zero), params["layers"])
+    h = tfm.layernorm(h, params["lnf_g"], params["lnf_b"])
+    logits = h.astype(jnp.float32) @ params["embed"].T
+    L = cfg.n_layers
+    return logits, {"load_balance": lb / L, "router_z": rz / L}
+
+
+def loss_fn(params, cfg: MoeTransformerConfig, tokens, targets,
+            aux_weight: float = 1e-2, z_weight: float = 1e-3,
+            ep_axis: str | None = None):
+    """Mean token cross-entropy + weighted router auxiliaries."""
+    logits, aux = forward(params, cfg, tokens, ep_axis=ep_axis)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+    return (-jnp.mean(ll) + aux_weight * aux["load_balance"]
+            + z_weight * aux["router_z"])
+
+
+def param_specs(ep_axis: str = "dp") -> Dict[str, Any]:
+    """PartitionSpecs: expert tensors shard their [n_experts] dim over the
+    DP+EP mesh axis; everything else replicates."""
+    from jax.sharding import PartitionSpec as P
+    return {
+        "embed": P(), "pos": P(), "lnf_g": P(), "lnf_b": P(),
+        "layers": {
+            "ln1_g": P(), "ln1_b": P(), "wqkv": P(), "wo": P(),
+            "ln2_g": P(), "ln2_b": P(), "gate": P(),
+            "w1": P(None, ep_axis), "w2": P(None, ep_axis),
+        },
+    }
+
+
+def make_moe_transformer_train_step(cfg: MoeTransformerConfig, mesh,
+                                    axis: str = "dp", lr: float = 0.1,
+                                    aux_weight: float = 1e-2,
+                                    z_weight: float = 1e-3):
+    """DP+EP train step: ONE mesh axis shards both the batch and the
+    experts (the classic data-parallel MoE layout — each rank runs the
+    dense parts on its batch shard while hosting E/dp experts that serve
+    every rank's tokens via all_to_all).
+
+    Returns a jitted ``step(params, tokens, targets) -> (loss,
+    new_params)``; tokens/targets [B, S] with B sharded over ``axis``.
+    Gradient construction follows the framework rule (train.py): per-rank
+    loss terms cover only the rank's EXCLUSIVE batch shard, the scalar is
+    psum-assembled (transpose scaling undone), replicated leaves psum
+    their gradients, expert-sharded leaves already accumulate cross-rank
+    token contributions through the all_to_all transpose.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    specs = param_specs(axis)
+
+    def per_shard(params, tokens, targets):
+        def lf(params):
+            return lax.psum(
+                loss_fn(params, cfg, tokens, targets, aux_weight, z_weight,
+                        ep_axis=axis) / n, axis)
+
+        loss, g = jax.value_and_grad(lf)(params)
+        g = jax.tree.map(lambda t: t / n, g)      # undo psum seed scaling
+
+        def reduce(path, t):
+            name = path[-1].key if hasattr(path[-1], "key") else None
+            if name in ("w1", "w2"):
+                return t                           # expert-sharded leaf
+            return lax.psum(t, axis)
+        g = jax.tree_util.tree_map_with_path(reduce, g)
+        return loss, g
+
+    grad_fn = shard_map(per_shard, mesh=mesh,
+                        in_specs=(specs, P(axis), P(axis)),
+                        out_specs=(P(), specs), check_vma=False)
+
+    @jax.jit
+    def step(params, tokens, targets):
+        loss, g = grad_fn(params, tokens, targets)
+        return loss, jax.tree.map(lambda p, gg: p - lr * gg, params, g)
+
+    return step
