@@ -29,9 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from mpi_acx_tpu.models import transformer as tfm
-from mpi_acx_tpu.models.moe import (MoeConfig, load_balance_loss,
-                                    moe_layer_and_aux, router_z_loss)
-from mpi_acx_tpu.ops.attention import select_attention
+from mpi_acx_tpu.models.moe import MoeConfig, moe_layer_and_aux
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,15 +102,11 @@ def block(cfg: MoeTransformerConfig, lp: Dict[str, Any], h: jax.Array,
     set (inside shard_map), lp's gate stays replicated and w1/w2 are the
     LOCAL expert slices; tokens flow through all_to_all."""
     B, S, d = h.shape
-    H, Dh = cfg.n_heads, cfg.head_dim
 
-    hn = tfm.layernorm(h, lp["ln1_g"], lp["ln1_b"])
-    qkv = hn @ lp["wqkv"].astype(h.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    o = select_attention(cfg.use_flash)(
-        q.reshape(B, S, H, Dh), k.reshape(B, S, H, Dh),
-        v.reshape(B, S, H, Dh))
-    h = h + o.reshape(B, S, d) @ lp["wo"].astype(h.dtype)
+    # The attention half IS a GPT-2 block half — share its single
+    # definition (qkv packing + flash/dense policy) with the dense family.
+    q, k, v = tfm._qkv(cfg, lp, h)
+    h = h + tfm._attend(cfg, q, k, v) @ lp["wo"].astype(h.dtype)
 
     hn = tfm.layernorm(h, lp["ln2_g"], lp["ln2_b"])
     mp = {"gate": lp["gate"], "w1": lp["w1"], "w2": lp["w2"]}
@@ -136,7 +130,10 @@ def forward(params: Dict[str, Any], cfg: MoeTransformerConfig,
     zero = jnp.zeros((), jnp.float32)
     (h, lb, rz), _ = lax.scan(body, (h, zero, zero), params["layers"])
     h = tfm.layernorm(h, params["lnf_g"], params["lnf_b"])
-    logits = h.astype(jnp.float32) @ params["embed"].T
+    # bf16 operands, f32 accumulation — the unembed convention the dense
+    # family measured 1.45x whole-model latency for getting wrong.
+    logits = jnp.einsum("bsd,vd->bsv", h, params["embed"].astype(h.dtype),
+                        preferred_element_type=jnp.float32)
     L = cfg.n_layers
     return logits, {"load_balance": lb / L, "router_z": rz / L}
 

@@ -38,4 +38,9 @@ from mpi_acx_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_attention,
     ulysses_attention_sharded,
 )
+from mpi_acx_tpu.parallel.tp_inference import (  # noqa: F401
+    make_tp_generate,
+    tp_param_specs,
+    tp_shard_params,
+)
 from mpi_acx_tpu.parallel import multihost  # noqa: F401

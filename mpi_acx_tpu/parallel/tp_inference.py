@@ -1,0 +1,190 @@
+"""Tensor-parallel (Megatron-style) inference for the GPT-2 family.
+
+Decoding is latency-bound — each autoregressive step is a skinny
+[B, 1, *] pass that one chip's HBM bandwidth gates. Head-parallel
+attention + column/row-parallel MLP split every weight matrix (and the KV
+cache) over a 'tp' mesh axis so each step streams 1/tp of the weights per
+chip, at the cost of two ``psum``s per layer (the classic Megatron
+residual-boundary all-reduces) riding ICI.
+
+The whole generation — prefill, KV cache, the ``lax.scan`` decode loop,
+greedy or temperature/top-k/top-p sampling — runs inside ONE ``shard_map``
+program: the cache never leaves its shard, XLA sees the full schedule, and
+every rank computes identical logits (each psum replicates them), so the
+emitted tokens agree rank-to-rank by construction.
+
+Weight layout: :func:`tp_shard_params` reshapes the stacked GPT-2 pytree
+so the head axis (attention) and FFN axis (MLP) are explicit, and
+:func:`tp_param_specs` shards exactly those axes; everything else
+replicates. Numerics match models.transformer.generate exactly up to
+matmul-split summation order (tests/test_tp_inference.py asserts token
+equality vs the single-device path).
+
+The reference has no serving stack (SURVEY.md §0: "not a training
+framework" — and not an inference one either); this is the
+application-layer counterpart of train.py's tensor parallelism, built on
+the same mesh/collective substrate.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mpi_acx_tpu.models import transformer as tfm
+from mpi_acx_tpu.models.decoding import sample_logits
+from mpi_acx_tpu.ops.attention import select_attention
+
+
+def tp_shard_params(params, cfg: tfm.TransformerConfig):
+    """Re-layout the stacked GPT-2 pytree for head/FFN sharding: wqkv
+    [L, d, 3d] -> [L, d, 3, H, Dh] (the head axis becomes shardable
+    without splitting the packed q/k/v thirds) and wo [L, d, d] ->
+    [L, H, Dh, d] (row-parallel by head)."""
+    L, d = cfg.n_layers, cfg.d_model
+    H, Dh = cfg.n_heads, cfg.head_dim
+    lay = params["layers"]
+    out = dict(params)
+    out["layers"] = dict(
+        lay,
+        wqkv=lay["wqkv"].reshape(L, d, 3, H, Dh),
+        wo=lay["wo"].reshape(L, H, Dh, d),
+    )
+    return out
+
+
+def tp_param_specs(axis: str = "tp"):
+    """PartitionSpecs matching :func:`tp_shard_params` output: attention
+    sharded on the head axis, MLP on the FFN axis, the rest replicated."""
+    return {
+        "embed": P(), "pos": P(), "lnf_g": P(), "lnf_b": P(),
+        "layers": {
+            "ln1_g": P(), "ln1_b": P(),
+            "wqkv": P(None, None, None, axis, None),
+            "wo": P(None, axis),
+            "ln2_g": P(), "ln2_b": P(),
+            "w1": P(None, None, axis), "b1": P(None, axis),
+            "w2": P(None, axis), "b2": P(),
+        },
+    }
+
+
+def make_tp_generate(cfg: tfm.TransformerConfig, mesh: Mesh, n_new: int,
+                     axis: str = "tp", temperature: float = 0.0,
+                     top_k: Optional[int] = None,
+                     top_p: Optional[float] = None):
+    """Builds a jitted tensor-parallel ``generate(params, prompt, key) ->
+    tokens [B, S + n_new]`` over the mesh's ``axis``.
+
+    params is the ORDINARY transformer pytree (tfm.init_params /
+    cast_params output) — the TP re-layout happens inside the jit.
+    ``temperature=0`` is greedy (key unused but still required, so the
+    signature is stable across sampling configs).
+    """
+    tp = mesh.shape[axis]
+    H, Dh, d = cfg.n_heads, cfg.head_dim, cfg.d_model
+    assert H % tp == 0, (H, tp)
+    Hl = H // tp
+    attend = select_attention(cfg.use_flash)
+
+    def attn_prefill(lp, x):
+        """[B, S, d] -> (psummed attention output, local k, v)."""
+        B, S, _ = x.shape
+        h = tfm.layernorm(x, lp["ln1_g"], lp["ln1_b"])
+        qkv = h @ lp["wqkv"].reshape(d, 3 * Hl * Dh).astype(x.dtype)
+        q, k, v = (t.reshape(B, S, Hl, Dh)
+                   for t in jnp.split(qkv, 3, axis=-1))
+        o = attend(q, k, v)
+        part = o.reshape(B, S, Hl * Dh) @ lp["wo"].reshape(
+            Hl * Dh, d).astype(x.dtype)
+        return lax.psum(part, axis), k, v
+
+    def mlp(lp, x):
+        h = tfm.layernorm(x, lp["ln2_g"], lp["ln2_b"])
+        y = jax.nn.gelu(h @ lp["w1"].astype(x.dtype)
+                        + lp["b1"].astype(x.dtype))
+        part = y @ lp["w2"].astype(x.dtype)
+        return x + lax.psum(part, axis) + lp["b2"].astype(x.dtype)
+
+    def unembed(params, x):
+        x = tfm.layernorm(x, params["lnf_g"], params["lnf_b"])
+        return jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(x.dtype),
+                          preferred_element_type=jnp.float32)
+
+    def per_shard(params, prompt, key):
+        B, S = prompt.shape
+        max_len = S + n_new
+        assert max_len <= cfg.max_seq, (max_len, cfg.max_seq)
+
+        # -- prefill: fill the local-head KV cache ----------------------
+        x = (params["embed"][prompt] + params["pos"][:S]).astype(cfg.dtype)
+
+        def pf_body(x, lp):
+            attn, k, v = attn_prefill(lp, x)
+            return mlp(lp, x + attn), (k, v)
+
+        x, (ks, vs) = lax.scan(pf_body, x, params["layers"])
+        logits0 = unembed(params, x[:, -1:])[:, 0]      # [B, vocab] f32
+
+        kc = jnp.zeros((cfg.n_layers, B, max_len, Hl, Dh), cfg.dtype)
+        vc = jnp.zeros_like(kc)
+        kc = lax.dynamic_update_slice(kc, ks, (0,) * 5)
+        vc = lax.dynamic_update_slice(vc, vs, (0,) * 5)
+
+        def pick(logits, k):
+            if temperature == 0.0:
+                return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+            return sample_logits(logits, k, temperature, top_k,
+                                 top_p).astype(prompt.dtype)
+
+        # -- decode loop: one fixed-shape step per new token ------------
+        def dec_body(carry, step_key):
+            kc, vc, pos, tok = carry
+            x = (params["embed"][tok][:, None, :]
+                 + params["pos"][pos][None, None, :]).astype(cfg.dtype)
+
+            def body(x, layer):
+                lp, kcl, vcl = layer
+                h = tfm.layernorm(x, lp["ln1_g"], lp["ln1_b"])
+                qkv = h @ lp["wqkv"].reshape(d, 3 * Hl * Dh).astype(x.dtype)
+                q, k, v = (t.reshape(B, 1, Hl, Dh)
+                           for t in jnp.split(qkv, 3, axis=-1))
+                kcl = lax.dynamic_update_slice(kcl, k, (0, pos, 0, 0))
+                vcl = lax.dynamic_update_slice(vcl, v, (0, pos, 0, 0))
+                s = jnp.einsum("bqhd,bkhd->bhqk", q, kcl).astype(
+                    jnp.float32) / jnp.sqrt(Dh)
+                mask = jnp.arange(max_len) <= pos
+                s = jnp.where(mask[None, None, None], s,
+                              jnp.finfo(jnp.float32).min)
+                p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+                o = jnp.einsum("bhqk,bkhd->bqhd", p, vcl)
+                part = o.reshape(B, 1, Hl * Dh) @ lp["wo"].reshape(
+                    Hl * Dh, d).astype(x.dtype)
+                x = x + lax.psum(part, axis)
+                return mlp(lp, x), (kcl, vcl)
+
+            x, (kc, vc) = lax.scan(body, x, (params["layers"], kc, vc))
+            logits = unembed(params, x)[:, 0]
+            nxt = pick(logits, step_key)
+            return (kc, vc, pos + 1, nxt), tok
+
+        first = pick(logits0, key)
+        keys = jax.random.split(jax.random.fold_in(key, 1), n_new)
+        (_, _, _, _), toks = lax.scan(
+            dec_body, (kc, vc, jnp.asarray(S, jnp.int32), first), keys)
+        return jnp.concatenate([prompt, jnp.moveaxis(toks, 0, 1)], axis=1)
+
+    specs = tp_param_specs(axis)
+    inner = shard_map(per_shard, mesh=mesh,
+                      in_specs=(specs, P(), P()),
+                      out_specs=P(), check_vma=False)
+
+    @jax.jit
+    def generate(params, prompt, key):
+        return inner(tp_shard_params(params, cfg), prompt, key)
+
+    return generate
