@@ -218,11 +218,31 @@ def prefill(params: Params, cfg: LlamaConfig, tokens: jax.Array,
     return logits, cache
 
 
+def grouped_decode_attend(q, kc, vc, pos, max_len, n_rep):
+    """One-token grouped-query attention against an UN-REPEATED KV cache:
+    q [B, 1, Hq, D], kc/vc [B, max_len, Hkv, D] with Hq = Hkv*n_rep ->
+    o [B, 1, Hq*D]. Query head g*n_rep + r reads K/V group g directly —
+    no [B, L, Hq, D] materialization, preserving GQA's cache-bandwidth
+    win. THE single definition of the grouped decode construction (the
+    single-device decode_step and the tensor-parallel path both use it,
+    the latter on its per-rank group slice)."""
+    B = q.shape[0]
+    Hkv, Dh = kc.shape[2], kc.shape[3]
+    qg = q.reshape(B, 1, Hkv, n_rep, Dh)
+    logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, kc).astype(jnp.float32)
+    logits = logits / jnp.sqrt(Dh)
+    mask = jnp.arange(max_len) <= pos
+    logits = jnp.where(mask[None, None, None, None], logits,
+                       jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bgrqk,bkgd->bqgrd", p, vc).reshape(
+        B, 1, Hkv * n_rep * Dh)
+
+
 def decode_step(params: Params, cfg: LlamaConfig, cache,
                 token: jax.Array):
     """One autoregressive step; token [B] -> (logits [B, vocab] f32,
     updated cache). Fixed shapes: jit once per generation."""
-    B = token.shape[0]
     pos = cache["pos"]
     max_len = cache["k"].shape[2]
     n_rep = cfg.n_heads // cfg.n_kv_heads
@@ -234,18 +254,7 @@ def decode_step(params: Params, cfg: LlamaConfig, cache,
         q, k, v = _qkv(cfg, lp, x, positions)            # k,v [B,1,Hkv,D]
         kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
         vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
-        # Grouped attention straight against the un-repeated cache: query
-        # head g*n_rep + r reads K/V group g — no [B, L, Hq, D]
-        # materialization, preserving GQA's cache-bandwidth win.
-        qg = q.reshape(B, 1, cfg.n_kv_heads, n_rep, cfg.head_dim)
-        logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, kc).astype(jnp.float32)
-        logits = logits / jnp.sqrt(cfg.head_dim)
-        mask = jnp.arange(max_len) <= pos
-        logits = jnp.where(mask[None, None, None, None], logits,
-                           jnp.finfo(jnp.float32).min)
-        p = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-        o = jnp.einsum("bgrqk,bkgd->bqgrd", p, vc).reshape(
-            B, 1, cfg.n_heads * cfg.head_dim)
+        o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep)
         x = x + o @ lp["wo"].astype(x.dtype)
         x = _mlp(cfg, lp, x)
         return x, (kc, vc)

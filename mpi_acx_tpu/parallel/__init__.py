@@ -40,7 +40,10 @@ from mpi_acx_tpu.parallel.ulysses import (  # noqa: F401
 )
 from mpi_acx_tpu.parallel.tp_inference import (  # noqa: F401
     make_tp_generate,
+    make_tp_generate_llama,
     tp_param_specs,
+    tp_param_specs_llama,
     tp_shard_params,
+    tp_shard_params_llama,
 )
 from mpi_acx_tpu.parallel import multihost  # noqa: F401

@@ -1,4 +1,4 @@
-"""Tensor-parallel (Megatron-style) inference for the GPT-2 family.
+"""Tensor-parallel (Megatron-style) inference for the model families.
 
 Decoding is latency-bound — each autoregressive step is a skinny
 [B, 1, *] pass that one chip's HBM bandwidth gates. Head-parallel
@@ -9,16 +9,17 @@ residual-boundary all-reduces) riding ICI.
 
 The whole generation — prefill, KV cache, the ``lax.scan`` decode loop,
 greedy or temperature/top-k/top-p sampling — runs inside ONE ``shard_map``
-program: the cache never leaves its shard, XLA sees the full schedule, and
-every rank computes identical logits (each psum replicates them), so the
-emitted tokens agree rank-to-rank by construction.
+program (:func:`_run_generation`, shared by the families): the cache never
+leaves its shard, XLA sees the full schedule, and every rank computes
+identical logits (each psum replicates them), so the emitted tokens agree
+rank-to-rank by construction.
 
-Weight layout: :func:`tp_shard_params` reshapes the stacked GPT-2 pytree
-so the head axis (attention) and FFN axis (MLP) are explicit, and
-:func:`tp_param_specs` shards exactly those axes; everything else
-replicates. Numerics match models.transformer.generate exactly up to
-matmul-split summation order (tests/test_tp_inference.py asserts token
-equality vs the single-device path).
+GPT-2 (:func:`make_tp_generate`) shards the packed qkv by attention head;
+Llama (:func:`make_tp_generate_llama`) shards by KV-HEAD GROUP — each rank
+holds ``n_kv_heads/tp`` K/V heads plus their ``n_rep`` query heads, so the
+per-rank cache keeps GQA's bandwidth win and grouped-query attention runs
+against the un-repeated local cache. Greedy output matches the
+single-device generate paths exactly (tests/test_tp_inference.py).
 
 The reference has no serving stack (SURVEY.md §0: "not a training
 framework" — and not an inference one either); this is the
@@ -35,9 +36,60 @@ import jax.numpy as jnp
 from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from mpi_acx_tpu.models import llama as lm
 from mpi_acx_tpu.models import transformer as tfm
 from mpi_acx_tpu.models.decoding import sample_logits
 from mpi_acx_tpu.ops.attention import select_attention
+
+
+def _run_generation(hooks, layers, prompt, key, n_new, *, pick):
+    """The family-independent TP generation loop (per-shard code).
+
+    hooks: embed(tokens [B,S]) -> x; embed_tok(tok [B], pos) -> x [B,1,d];
+    prefill_layer(x, lp) -> (x, (k, v)); decode_layer(x, (lp, kc, vc),
+    pos, max_len) -> (x, (kc, vc)); finish(x) -> logits [B, S, vocab] f32.
+    """
+    B, S = prompt.shape
+    max_len = S + n_new
+
+    x = hooks["embed"](prompt)
+    x, (ks, vs) = lax.scan(hooks["prefill_layer"], x, layers)
+    logits0 = hooks["finish"](x[:, -1:])[:, 0]            # [B, vocab]
+
+    # Cache layout follows the prefill outputs ([L, B, S, H?, D] local).
+    kc = jnp.zeros(ks.shape[:2] + (max_len,) + ks.shape[3:], ks.dtype)
+    vc = jnp.zeros_like(kc)
+    kc = lax.dynamic_update_slice(kc, ks, (0,) * kc.ndim)
+    vc = lax.dynamic_update_slice(vc, vs, (0,) * vc.ndim)
+
+    def dec_body(carry, step_key):
+        kc, vc, pos, tok = carry
+        x = hooks["embed_tok"](tok, pos)
+
+        def body(x, layer):
+            return hooks["decode_layer"](x, layer, pos, max_len)
+
+        x, (kc, vc) = lax.scan(body, x, (layers, kc, vc))
+        nxt = pick(hooks["finish"](x)[:, 0], step_key)
+        return (kc, vc, pos + 1, nxt), tok
+
+    first = pick(logits0, key)
+    keys = jax.random.split(jax.random.fold_in(key, 1), n_new)
+    (_, _, _, _), toks = lax.scan(
+        dec_body, (kc, vc, jnp.asarray(S, jnp.int32), first), keys)
+    return jnp.concatenate([prompt, jnp.moveaxis(toks, 0, 1)], axis=1)
+
+
+def _make_pick(temperature, top_k, top_p, out_dtype):
+    def pick(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(out_dtype)
+        return sample_logits(logits, k, temperature, top_k,
+                             top_p).astype(out_dtype)
+    return pick
+
+
+# -- GPT-2 family ----------------------------------------------------------
 
 
 def tp_shard_params(params, cfg: tfm.TransformerConfig):
@@ -89,19 +141,6 @@ def make_tp_generate(cfg: tfm.TransformerConfig, mesh: Mesh, n_new: int,
     H, Dh, d = cfg.n_heads, cfg.head_dim, cfg.d_model
     assert H % tp == 0, (H, tp)
     Hl = H // tp
-    attend = select_attention(cfg.use_flash)
-
-    def attn_prefill(lp, x):
-        """[B, S, d] -> (psummed attention output, local k, v)."""
-        B, S, _ = x.shape
-        h = tfm.layernorm(x, lp["ln1_g"], lp["ln1_b"])
-        qkv = h @ lp["wqkv"].reshape(d, 3 * Hl * Dh).astype(x.dtype)
-        q, k, v = (t.reshape(B, S, Hl, Dh)
-                   for t in jnp.split(qkv, 3, axis=-1))
-        o = attend(q, k, v)
-        part = o.reshape(B, S, Hl * Dh) @ lp["wo"].reshape(
-            Hl * Dh, d).astype(x.dtype)
-        return lax.psum(part, axis), k, v
 
     def mlp(lp, x):
         h = tfm.layernorm(x, lp["ln2_g"], lp["ln2_b"])
@@ -110,73 +149,61 @@ def make_tp_generate(cfg: tfm.TransformerConfig, mesh: Mesh, n_new: int,
         part = y @ lp["w2"].astype(x.dtype)
         return x + lax.psum(part, axis) + lp["b2"].astype(x.dtype)
 
-    def unembed(params, x):
-        x = tfm.layernorm(x, params["lnf_g"], params["lnf_b"])
-        return jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(x.dtype),
-                          preferred_element_type=jnp.float32)
+    def local_qkv(lp, x):
+        B, S, _ = x.shape
+        h = tfm.layernorm(x, lp["ln1_g"], lp["ln1_b"])
+        qkv = h @ lp["wqkv"].reshape(d, 3 * Hl * Dh).astype(x.dtype)
+        return (t.reshape(B, S, Hl, Dh) for t in jnp.split(qkv, 3, -1))
+
+    def out_proj(lp, o, x):
+        B, S = o.shape[:2]
+        part = o.reshape(B, S, Hl * Dh) @ lp["wo"].reshape(
+            Hl * Dh, d).astype(x.dtype)
+        return x + lax.psum(part, axis)
 
     def per_shard(params, prompt, key):
-        B, S = prompt.shape
-        max_len = S + n_new
-        assert max_len <= cfg.max_seq, (max_len, cfg.max_seq)
+        assert prompt.shape[1] + n_new <= cfg.max_seq
 
-        # -- prefill: fill the local-head KV cache ----------------------
-        x = (params["embed"][prompt] + params["pos"][:S]).astype(cfg.dtype)
+        def embed(tokens):
+            S = tokens.shape[1]
+            return (params["embed"][tokens]
+                    + params["pos"][:S]).astype(cfg.dtype)
 
-        def pf_body(x, lp):
-            attn, k, v = attn_prefill(lp, x)
-            return mlp(lp, x + attn), (k, v)
+        def embed_tok(tok, pos):
+            return (params["embed"][tok][:, None, :]
+                    + params["pos"][pos][None, None, :]).astype(cfg.dtype)
 
-        x, (ks, vs) = lax.scan(pf_body, x, params["layers"])
-        logits0 = unembed(params, x[:, -1:])[:, 0]      # [B, vocab] f32
+        def prefill_layer(x, lp):
+            q, k, v = local_qkv(lp, x)
+            o = select_attention(cfg.use_flash)(q, k, v)
+            return mlp(lp, out_proj(lp, o, x)), (k, v)
 
-        kc = jnp.zeros((cfg.n_layers, B, max_len, Hl, Dh), cfg.dtype)
-        vc = jnp.zeros_like(kc)
-        kc = lax.dynamic_update_slice(kc, ks, (0,) * 5)
-        vc = lax.dynamic_update_slice(vc, vs, (0,) * 5)
+        def decode_layer(x, layer, pos, max_len):
+            lp, kcl, vcl = layer
+            q, k, v = local_qkv(lp, x)
+            kcl = lax.dynamic_update_slice(kcl, k, (0, pos, 0, 0))
+            vcl = lax.dynamic_update_slice(vcl, v, (0, pos, 0, 0))
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, kcl).astype(
+                jnp.float32) / jnp.sqrt(Dh)
+            mask = jnp.arange(max_len) <= pos
+            s = jnp.where(mask[None, None, None], s,
+                          jnp.finfo(jnp.float32).min)
+            p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p, vcl)
+            return mlp(lp, out_proj(lp, o, x)), (kcl, vcl)
 
-        def pick(logits, k):
-            if temperature == 0.0:
-                return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-            return sample_logits(logits, k, temperature, top_k,
-                                 top_p).astype(prompt.dtype)
+        def finish(x):
+            x = tfm.layernorm(x, params["lnf_g"], params["lnf_b"])
+            return jnp.einsum("bsd,vd->bsv", x,
+                              params["embed"].astype(x.dtype),
+                              preferred_element_type=jnp.float32)
 
-        # -- decode loop: one fixed-shape step per new token ------------
-        def dec_body(carry, step_key):
-            kc, vc, pos, tok = carry
-            x = (params["embed"][tok][:, None, :]
-                 + params["pos"][pos][None, None, :]).astype(cfg.dtype)
-
-            def body(x, layer):
-                lp, kcl, vcl = layer
-                h = tfm.layernorm(x, lp["ln1_g"], lp["ln1_b"])
-                qkv = h @ lp["wqkv"].reshape(d, 3 * Hl * Dh).astype(x.dtype)
-                q, k, v = (t.reshape(B, 1, Hl, Dh)
-                           for t in jnp.split(qkv, 3, axis=-1))
-                kcl = lax.dynamic_update_slice(kcl, k, (0, pos, 0, 0))
-                vcl = lax.dynamic_update_slice(vcl, v, (0, pos, 0, 0))
-                s = jnp.einsum("bqhd,bkhd->bhqk", q, kcl).astype(
-                    jnp.float32) / jnp.sqrt(Dh)
-                mask = jnp.arange(max_len) <= pos
-                s = jnp.where(mask[None, None, None], s,
-                              jnp.finfo(jnp.float32).min)
-                p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
-                o = jnp.einsum("bhqk,bkhd->bqhd", p, vcl)
-                part = o.reshape(B, 1, Hl * Dh) @ lp["wo"].reshape(
-                    Hl * Dh, d).astype(x.dtype)
-                x = x + lax.psum(part, axis)
-                return mlp(lp, x), (kcl, vcl)
-
-            x, (kc, vc) = lax.scan(body, x, (params["layers"], kc, vc))
-            logits = unembed(params, x)[:, 0]
-            nxt = pick(logits, step_key)
-            return (kc, vc, pos + 1, nxt), tok
-
-        first = pick(logits0, key)
-        keys = jax.random.split(jax.random.fold_in(key, 1), n_new)
-        (_, _, _, _), toks = lax.scan(
-            dec_body, (kc, vc, jnp.asarray(S, jnp.int32), first), keys)
-        return jnp.concatenate([prompt, jnp.moveaxis(toks, 0, 1)], axis=1)
+        hooks = {"embed": embed, "embed_tok": embed_tok,
+                 "prefill_layer": prefill_layer,
+                 "decode_layer": decode_layer, "finish": finish}
+        return _run_generation(
+            hooks, params["layers"], prompt, key, n_new,
+            pick=_make_pick(temperature, top_k, top_p, prompt.dtype))
 
     specs = tp_param_specs(axis)
     inner = shard_map(per_shard, mesh=mesh,
@@ -186,5 +213,138 @@ def make_tp_generate(cfg: tfm.TransformerConfig, mesh: Mesh, n_new: int,
     @jax.jit
     def generate(params, prompt, key):
         return inner(tp_shard_params(params, cfg), prompt, key)
+
+    return generate
+
+
+# -- Llama family (GQA: shard by KV-head group) ----------------------------
+
+
+def tp_shard_params_llama(params, cfg: lm.LlamaConfig):
+    """Head-axis re-layout for the Llama pytree: wq [L, d, Hq*Dh] ->
+    [L, d, Hq, Dh], wk/wv -> [L, d, Hkv, Dh], wo -> [L, Hq, Dh, d].
+    Contiguous head chunks keep each KV group's query heads on the same
+    rank as their K/V head (query head h belongs to group h // n_rep)."""
+    L, d = cfg.n_layers, cfg.d_model
+    Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    lay = params["layers"]
+    out = dict(params)
+    out["layers"] = dict(
+        lay,
+        wq=lay["wq"].reshape(L, d, Hq, Dh),
+        wk=lay["wk"].reshape(L, d, Hkv, Dh),
+        wv=lay["wv"].reshape(L, d, Hkv, Dh),
+        wo=lay["wo"].reshape(L, Hq, Dh, d),
+    )
+    return out
+
+
+def tp_param_specs_llama(axis: str = "tp"):
+    return {
+        "embed": P(), "final_norm": P(), "unembed": P(),
+        "layers": {
+            "attn_norm": P(), "mlp_norm": P(),
+            "wq": P(None, None, axis, None),
+            "wk": P(None, None, axis, None),
+            "wv": P(None, None, axis, None),
+            "wo": P(None, axis),
+            "w_gate": P(None, None, axis),
+            "w_up": P(None, None, axis),
+            "w_down": P(None, axis),
+        },
+    }
+
+
+def make_tp_generate_llama(cfg: lm.LlamaConfig, mesh: Mesh, n_new: int,
+                           axis: str = "tp", temperature: float = 0.0,
+                           top_k: Optional[int] = None,
+                           top_p: Optional[float] = None):
+    """Tensor-parallel Llama generation: ``tp`` must divide
+    ``n_kv_heads``; each rank serves ``n_kv_heads/tp`` KV groups and their
+    query heads, so the local cache stays un-repeated (GQA's bandwidth
+    win per rank) and grouped-query decode runs exactly as the
+    single-device path (llama.decode_step), just on the group slice.
+    """
+    tp = mesh.shape[axis]
+    Hq, Hkv, Dh, d = (cfg.n_heads, cfg.n_kv_heads, cfg.head_dim,
+                      cfg.d_model)
+    assert Hkv % tp == 0, (Hkv, tp)
+    n_rep = Hq // Hkv
+    Hkv_l, Hq_l = Hkv // tp, (Hkv // tp) * n_rep
+
+    def mlp(lp, x):
+        h = lm.rmsnorm(x, lp["mlp_norm"])
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(x.dtype))
+        up = h @ lp["w_up"].astype(x.dtype)
+        part = (gate * up) @ lp["w_down"].astype(x.dtype)
+        return x + lax.psum(part, axis)
+
+    def local_qkv(lp, x, positions):
+        B, S, _ = x.shape
+        h = lm.rmsnorm(x, lp["attn_norm"])
+        q = (h @ lp["wq"].reshape(d, Hq_l * Dh).astype(x.dtype)).reshape(
+            B, S, Hq_l, Dh)
+        k = (h @ lp["wk"].reshape(d, Hkv_l * Dh).astype(x.dtype)).reshape(
+            B, S, Hkv_l, Dh)
+        v = (h @ lp["wv"].reshape(d, Hkv_l * Dh).astype(x.dtype)).reshape(
+            B, S, Hkv_l, Dh)
+        q = lm.rope(q, positions, cfg.rope_theta)
+        k = lm.rope(k, positions, cfg.rope_theta)
+        return q, k, v
+
+    def out_proj(lp, o, x):
+        B, S = o.shape[:2]
+        part = o.reshape(B, S, Hq_l * Dh) @ lp["wo"].reshape(
+            Hq_l * Dh, d).astype(x.dtype)
+        return x + lax.psum(part, axis)
+
+    def per_shard(params, prompt, key):
+        assert prompt.shape[1] + n_new <= cfg.max_seq
+
+        def embed(tokens):
+            return params["embed"][tokens].astype(cfg.dtype)
+
+        def embed_tok(tok, pos):
+            return params["embed"][tok][:, None, :].astype(cfg.dtype)
+
+        def prefill_layer(x, lp):
+            S = x.shape[1]
+            q, k, v = local_qkv(lp, x, jnp.arange(S))
+            kr, vr = lm._repeat_kv(k, n_rep), lm._repeat_kv(v, n_rep)
+            o = select_attention(cfg.use_flash)(q, kr, vr)
+            return mlp(lp, out_proj(lp, o, x)), (k, v)
+
+        def decode_layer(x, layer, pos, max_len):
+            lp, kcl, vcl = layer
+            q, k, v = local_qkv(lp, x, jnp.full((1,), pos))
+            kcl = lax.dynamic_update_slice(kcl, k, (0, pos, 0, 0))
+            vcl = lax.dynamic_update_slice(vcl, v, (0, pos, 0, 0))
+            # The shared grouped-GQA construction, on this rank's slice.
+            o = lm.grouped_decode_attend(q, kcl, vcl, pos, max_len,
+                                         n_rep).reshape(
+                x.shape[0], 1, Hq_l, Dh)
+            return mlp(lp, out_proj(lp, o, x)), (kcl, vcl)
+
+        def finish(x):
+            x = lm.rmsnorm(x, params["final_norm"])
+            return jnp.einsum("bsd,vd->bsv", x,
+                              params["unembed"].astype(x.dtype),
+                              preferred_element_type=jnp.float32)
+
+        hooks = {"embed": embed, "embed_tok": embed_tok,
+                 "prefill_layer": prefill_layer,
+                 "decode_layer": decode_layer, "finish": finish}
+        return _run_generation(
+            hooks, params["layers"], prompt, key, n_new,
+            pick=_make_pick(temperature, top_k, top_p, prompt.dtype))
+
+    specs = tp_param_specs_llama(axis)
+    inner = shard_map(per_shard, mesh=mesh,
+                      in_specs=(specs, P(), P()),
+                      out_specs=P(), check_vma=False)
+
+    @jax.jit
+    def generate(params, prompt, key):
+        return inner(tp_shard_params_llama(params, cfg), prompt, key)
 
     return generate
