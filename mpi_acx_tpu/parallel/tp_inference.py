@@ -319,10 +319,9 @@ def make_tp_generate_llama(cfg: lm.LlamaConfig, mesh: Mesh, n_new: int,
             q, k, v = local_qkv(lp, x, jnp.full((1,), pos))
             kcl = lax.dynamic_update_slice(kcl, k, (0, pos, 0, 0))
             vcl = lax.dynamic_update_slice(vcl, v, (0, pos, 0, 0))
-            # The shared grouped-GQA construction, on this rank's slice.
-            o = lm.grouped_decode_attend(q, kcl, vcl, pos, max_len,
-                                         n_rep).reshape(
-                x.shape[0], 1, Hq_l, Dh)
+            # The shared grouped-GQA construction, on this rank's slice;
+            # its flat [B, 1, Hq_l*Dh] output feeds out_proj directly.
+            o = lm.grouped_decode_attend(q, kcl, vcl, pos, max_len, n_rep)
             return mlp(lp, out_proj(lp, o, x)), (kcl, vcl)
 
         def finish(x):

@@ -37,10 +37,10 @@ def _sequential_step(cfg, params, tokens, targets, lr):
 
 
 def _assert_step_matches_sequential(cfg, mesh, params, tokens, targets,
-                                    n_virtual=1):
+                                    n_virtual=1, remat=False):
     lr = 0.1
     step, n_stages = make_train_step(cfg, mesh, n_micro=tokens.shape[0],
-                                     lr=lr, n_virtual=n_virtual)
+                                     lr=lr, n_virtual=n_virtual, remat=remat)
 
     def stage(p):
         if n_virtual > 1:
@@ -98,6 +98,16 @@ def test_interleaved_schedule_matches_sequential(setup):
     M = tokens.shape[0] - tokens.shape[0] % mesh.shape["pp"]
     _assert_step_matches_sequential(cfg, mesh, params, tokens[:M],
                                     targets[:M], n_virtual=2)
+
+
+def test_remat_step_matches_sequential(setup):
+    """jax.checkpoint per layer must not change the math: the remat step
+    produces the same loss and parameters as the plain step and the
+    single-device reference (it only trades activation memory for
+    recompute FLOPs)."""
+    cfg, mesh, params, tokens, targets = setup
+    _assert_step_matches_sequential(cfg, mesh, params, tokens, targets,
+                                    remat=True)
 
 
 def test_distributed_training_converges(setup):
