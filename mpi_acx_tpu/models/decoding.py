@@ -16,6 +16,27 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def grouped_decode_attend(q, kc, vc, pos, max_len, n_rep):
+    """One-token grouped-query attention against an UN-REPEATED KV cache:
+    q [B, 1, Hq, D], kc/vc [B, max_len, Hkv, D] with Hq = Hkv*n_rep ->
+    o [B, 1, Hq*D]. Query head g*n_rep + r reads K/V group g directly —
+    no [B, L, Hq, D] materialization, preserving GQA's cache-bandwidth
+    win. With n_rep=1 this IS plain multi-head decode attention, so all
+    three families' decode steps and the tensor-parallel paths share
+    this single definition."""
+    B = q.shape[0]
+    Hkv, Dh = kc.shape[2], kc.shape[3]
+    qg = q.reshape(B, 1, Hkv, n_rep, Dh)
+    logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, kc).astype(jnp.float32)
+    logits = logits / jnp.sqrt(Dh)
+    mask = jnp.arange(max_len) <= pos
+    logits = jnp.where(mask[None, None, None, None], logits,
+                       jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bgrqk,bkgd->bqgrd", p, vc).reshape(
+        B, 1, Hkv * n_rep * Dh)
+
+
 def greedy_generate(prefill_fn: Callable, decode_fn: Callable,
                     prompt, n_new: int, max_seq: int,
                     max_len: Optional[int] = None):

@@ -218,25 +218,9 @@ def prefill(params: Params, cfg: LlamaConfig, tokens: jax.Array,
     return logits, cache
 
 
-def grouped_decode_attend(q, kc, vc, pos, max_len, n_rep):
-    """One-token grouped-query attention against an UN-REPEATED KV cache:
-    q [B, 1, Hq, D], kc/vc [B, max_len, Hkv, D] with Hq = Hkv*n_rep ->
-    o [B, 1, Hq*D]. Query head g*n_rep + r reads K/V group g directly —
-    no [B, L, Hq, D] materialization, preserving GQA's cache-bandwidth
-    win. THE single definition of the grouped decode construction (the
-    single-device decode_step and the tensor-parallel path both use it,
-    the latter on its per-rank group slice)."""
-    B = q.shape[0]
-    Hkv, Dh = kc.shape[2], kc.shape[3]
-    qg = q.reshape(B, 1, Hkv, n_rep, Dh)
-    logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, kc).astype(jnp.float32)
-    logits = logits / jnp.sqrt(Dh)
-    mask = jnp.arange(max_len) <= pos
-    logits = jnp.where(mask[None, None, None, None], logits,
-                       jnp.finfo(jnp.float32).min)
-    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bgrqk,bkgd->bqgrd", p, vc).reshape(
-        B, 1, Hkv * n_rep * Dh)
+from mpi_acx_tpu.models.decoding import (  # noqa: F401  (re-export)
+    grouped_decode_attend,
+)
 
 
 def decode_step(params: Params, cfg: LlamaConfig, cache,

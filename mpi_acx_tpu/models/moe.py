@@ -185,6 +185,9 @@ def make_moe_train_step(cfg: MoeConfig, mesh, ep_axis: str = "ep",
     from jax.sharding import PartitionSpec as P
 
     ep_n = mesh.shape[ep_axis]
+    assert cfg.n_experts % ep_n == 0, (
+        f"n_experts ({cfg.n_experts}) must divide by the {ep_axis!r} mesh "
+        f"axis ({ep_n})")
 
     def per_shard(params, x, tgt):
         def loss_fn(params):

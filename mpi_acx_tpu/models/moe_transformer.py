@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from mpi_acx_tpu.models import transformer as tfm
-from mpi_acx_tpu.models.moe import MoeConfig, moe_layer_and_aux
+from mpi_acx_tpu.models.moe import MoeConfig, moe_layer, moe_layer_and_aux
 
 
 @dataclasses.dataclass(frozen=True)
@@ -149,6 +149,70 @@ def loss_fn(params, cfg: MoeTransformerConfig, tokens, targets,
             + z_weight * aux["router_z"])
 
 
+# -- KV-cache decode -------------------------------------------------------
+
+
+def _moe_ffn(cfg: MoeTransformerConfig, lp: Dict[str, Any], h: jax.Array):
+    """The block's routed FFN on h [B, S, d] (token axis flattened for the
+    router); single-device (inference) path, aux losses not needed."""
+    B, S, d = h.shape
+    hn = tfm.layernorm(h, lp["ln2_g"], lp["ln2_b"])
+    mp = {"gate": lp["gate"], "w1": lp["w1"], "w2": lp["w2"]}
+    y = moe_layer(mp, hn.reshape(B * S, d), cfg.moe)
+    return h + y.reshape(B, S, d)
+
+
+def init_kv_cache(cfg: MoeTransformerConfig, batch: int, max_len: int):
+    """Same cache layout as the dense family (cfg duck-types)."""
+    return tfm.init_kv_cache(cfg, batch, max_len)
+
+
+def prefill(params: Dict[str, Any], cfg: MoeTransformerConfig,
+            tokens: jax.Array, max_len: int, last_only: bool = False):
+    """Prompt pass filling a fresh KV cache — the dense family's scaffold
+    with the routed FFN plugged in (tfm.prefill's ``ffn`` hook). Routing
+    capacity during prefill is per (B*S)-token batch, exactly as in
+    forward."""
+    return tfm.prefill(params, cfg, tokens, max_len, last_only,
+                       ffn=_moe_ffn)
+
+
+def decode_step(params: Dict[str, Any], cfg: MoeTransformerConfig, cache,
+                token: jax.Array):
+    """One autoregressive step via the dense family's scaffold. The
+    router sees the B decode tokens as its dispatch group (capacity =
+    cf*B/E+1), which differs from the dense forward's (B*S)-token group:
+    cached decode reproduces the dense computation only in the drop-free
+    regime — keep ``capacity_factor >= n_experts`` when serving (with
+    cf < E a popular expert can drop tokens the dense pass would seat,
+    silently diverging)."""
+    return tfm.decode_step(params, cfg, cache, token, ffn=_moe_ffn)
+
+
+def generate(params: Dict[str, Any], cfg: MoeTransformerConfig,
+             prompt: jax.Array, n_new: int,
+             max_len: Optional[int] = None) -> jax.Array:
+    """Greedy decode: prompt [B, S] -> [B, S + n_new]."""
+    from mpi_acx_tpu.models.decoding import greedy_generate
+    return greedy_generate(
+        lambda t, ml, lo: prefill(params, cfg, t, ml, last_only=lo),
+        lambda c, t: decode_step(params, cfg, c, t),
+        prompt, n_new, cfg.max_seq, max_len)
+
+
+def generate_sample(params: Dict[str, Any], cfg: MoeTransformerConfig,
+                    prompt: jax.Array, n_new: int, key: jax.Array,
+                    temperature: float = 1.0, top_k: Optional[int] = None,
+                    top_p: Optional[float] = None,
+                    max_len: Optional[int] = None) -> jax.Array:
+    """Stochastic decode (temperature / top-k / top-p nucleus)."""
+    from mpi_acx_tpu.models.decoding import sample_generate
+    return sample_generate(
+        lambda t, ml, lo: prefill(params, cfg, t, ml, last_only=lo),
+        lambda c, t: decode_step(params, cfg, c, t),
+        prompt, n_new, cfg.max_seq, key, temperature, top_k, top_p, max_len)
+
+
 def param_specs(ep_axis: str = "dp") -> Dict[str, Any]:
     """PartitionSpecs: expert tensors shard their [n_experts] dim over the
     DP+EP mesh axis; everything else replicates."""
@@ -184,6 +248,9 @@ def make_moe_transformer_train_step(cfg: MoeTransformerConfig, mesh,
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
+    assert cfg.n_experts % n == 0, (
+        f"n_experts ({cfg.n_experts}) must divide by the {axis!r} mesh "
+        f"axis ({n})")
     specs = param_specs(axis)
 
     def per_shard(params, tokens, targets):

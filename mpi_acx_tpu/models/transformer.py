@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from mpi_acx_tpu.models.decoding import grouped_decode_attend
+
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
@@ -180,14 +182,20 @@ def _mlp(cfg: TransformerConfig, lp: Params, x: jax.Array):
 
 
 def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
-            max_len: int, last_only: bool = False):
+            max_len: int, last_only: bool = False, ffn=None):
     """Run the prompt through the model, filling a fresh KV cache.
 
     tokens [B, S] -> (logits [B, S, vocab] f32, cache with pos=S).
     With ``last_only`` the unembedding runs on the final position alone
     (logits [B, 1, vocab]) — for generation, which discards the rest,
     this skips ~1/3 of prefill FLOPs and the [B, S, vocab] materialization.
+
+    ``ffn(cfg, lp, x) -> x`` overrides the block's feed-forward half
+    (default :func:`_mlp`); the MoE family reuses this whole scaffold
+    with its routed FFN (models/moe_transformer.py) — the cache layout,
+    scan wiring, and guards live only here.
     """
+    ffn = ffn or _mlp
     B, S = tokens.shape
     assert S <= max_len, (S, max_len)
     assert S <= cfg.max_seq, (S, cfg.max_seq)
@@ -196,7 +204,7 @@ def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
     def body(x, lp):
         q, k, v = _qkv(cfg, lp, x)
         x = x + _attend(cfg, q, k, v) @ lp["wo"].astype(x.dtype)
-        x = _mlp(cfg, lp, x)
+        x = ffn(cfg, lp, x)
         return x, (k, v)
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])
@@ -214,10 +222,11 @@ def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
 
 
 def decode_step(params: Params, cfg: TransformerConfig, cache,
-                token: jax.Array):
+                token: jax.Array, ffn=None):
     """One autoregressive step. token [B] int32 -> (logits [B, vocab] f32,
-    updated cache). Fixed shapes: jit once, run for the whole generation."""
-    B = token.shape[0]
+    updated cache). Fixed shapes: jit once, run for the whole generation.
+    ``ffn`` overrides the feed-forward half as in :func:`prefill`."""
+    ffn = ffn or _mlp
     pos = cache["pos"]
     max_len = cache["k"].shape[2]
     x = (params["embed"][token][:, None, :]
@@ -228,15 +237,9 @@ def decode_step(params: Params, cfg: TransformerConfig, cache,
         q, k, v = _qkv(cfg, lp, x)                     # [B, 1, H, Dh]
         kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
         vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kc).astype(jnp.float32)
-        logits = logits / jnp.sqrt(cfg.head_dim)
-        mask = jnp.arange(max_len) <= pos              # [max_len]
-        logits = jnp.where(mask[None, None, None], logits,
-                           jnp.finfo(jnp.float32).min)
-        p = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-        o = jnp.einsum("bhqk,bkhd->bqhd", p, vc).reshape(B, 1, cfg.d_model)
+        o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep=1)
         x = x + o @ lp["wo"].astype(x.dtype)
-        x = _mlp(cfg, lp, x)
+        x = ffn(cfg, lp, x)
         return x, (kc, vc)
 
     x, (ks, vs) = lax.scan(body, x, (params["layers"], cache["k"],

@@ -38,7 +38,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from mpi_acx_tpu.models import llama as lm
 from mpi_acx_tpu.models import transformer as tfm
-from mpi_acx_tpu.models.decoding import sample_logits
+from mpi_acx_tpu.models.decoding import grouped_decode_attend, sample_logits
 from mpi_acx_tpu.ops.attention import select_attention
 
 
@@ -183,13 +183,8 @@ def make_tp_generate(cfg: tfm.TransformerConfig, mesh: Mesh, n_new: int,
             q, k, v = local_qkv(lp, x)
             kcl = lax.dynamic_update_slice(kcl, k, (0, pos, 0, 0))
             vcl = lax.dynamic_update_slice(vcl, v, (0, pos, 0, 0))
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, kcl).astype(
-                jnp.float32) / jnp.sqrt(Dh)
-            mask = jnp.arange(max_len) <= pos
-            s = jnp.where(mask[None, None, None], s,
-                          jnp.finfo(jnp.float32).min)
-            p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
-            o = jnp.einsum("bhqk,bkhd->bqhd", p, vcl)
+            # Shared MHA decode attention (GQA construction, n_rep=1).
+            o = grouped_decode_attend(q, kcl, vcl, pos, max_len, n_rep=1)
             return mlp(lp, out_proj(lp, o, x)), (kcl, vcl)
 
         def finish(x):
@@ -321,7 +316,7 @@ def make_tp_generate_llama(cfg: lm.LlamaConfig, mesh: Mesh, n_new: int,
             vcl = lax.dynamic_update_slice(vcl, v, (0, pos, 0, 0))
             # The shared grouped-GQA construction, on this rank's slice;
             # its flat [B, 1, Hq_l*Dh] output feeds out_proj directly.
-            o = lm.grouped_decode_attend(q, kcl, vcl, pos, max_len, n_rep)
+            o = grouped_decode_attend(q, kcl, vcl, pos, max_len, n_rep)
             return mlp(lp, out_proj(lp, o, x)), (kcl, vcl)
 
         def finish(x):
