@@ -37,6 +37,7 @@ sys.path.insert(0, REPO)
 BASELINE_P50_US = 26.6
 BASELINE_PART_BW_GBPS = 1.12
 BASELINE_GPT2_FWD_TOKS = 221_900.0
+BASELINE_GPT2_FWD_B16S512_TOKS = 377_600.0  # saturating shape (r3)
 # Device-side-loop methodology (round 3); round-2's 5.3x was host-side
 # per-call timing, which through the axon tunnel reports dispatch latency
 # rather than kernel time (see BASELINE.md).
@@ -292,6 +293,9 @@ def main(full: bool = False):
         gate("partitioned_bw_gbps", bw, BASELINE_PART_BW_GBPS)
         gate("gpt2_fwd_tokens_per_s",
              (fwd or {}).get("gpt2_fwd_tokens_per_s"), BASELINE_GPT2_FWD_TOKS)
+        gate("gpt2_fwd_b16s512_tokens_per_s",
+             (fwd or {}).get("gpt2_fwd_b16s512_tokens_per_s"),
+             BASELINE_GPT2_FWD_B16S512_TOKS)
         gate("flash_speedup_s4096",
              (sec or {}).get("flash_speedup_s4096"),
              BASELINE_FLASH_SPEEDUP_4096)

@@ -183,7 +183,8 @@ def _family(cfg) -> _Family:
 
 
 def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
-                        remat: bool = False):
+                        remat: bool = False,
+                        dp_quant_bits: int | None = None):
     """Builds a jitted (params, tokens, targets) -> (loss, grads) over a
     ('dp','pp','tp') mesh — the shard_map core every optimizer shares.
     Returned grads carry the same shardings as params, so any elementwise
@@ -205,6 +206,12 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
     HBM, not the MXU, is the binding constraint. Gradients are the same
     function, so the exact-match tests hold with remat on
     (tests/test_train.py).
+
+    ``dp_quant_bits=8`` replaces the exact dp-gradient pmean with the
+    int8-quantized ring all-reduce (parallel/quantized.py, after EQuARX)
+    — ~4x less traffic on the dp axis, the one that rides DCN in
+    multi-slice layouts, at ~<1% gradient error. None (default) keeps
+    gradient sync exact.
     """
     n_stages = mesh.shape["pp"]
     fam = _family(cfg)
@@ -268,7 +275,11 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
         # attention/norm leaves, 'pp'+'tp' for the embedding family); no
         # reduction over axes the leaf is sharded on.
         def reduce(g, tp_sharded: bool, pp_sharded: bool):
-            g = lax.pmean(g, "dp")
+            if dp_quant_bits is not None:
+                from mpi_acx_tpu.parallel.quantized import quantized_pmean
+                g = quantized_pmean(g, "dp", dp_quant_bits)
+            else:
+                g = lax.pmean(g, "dp")
             if not tp_sharded:
                 g = lax.psum(g, "tp")
             if not pp_sharded:
@@ -303,12 +314,13 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
 
 def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
                     n_micro: int, lr: float = 1e-2, n_virtual: int = 1,
-                    remat: bool = False):
+                    remat: bool = False, dp_quant_bits: int | None = None):
     """Jitted (params, tokens, targets) -> (loss, new_params) SGD step
     (stateless optimizer; for stateful ones use make_train_step_optax)."""
     grad_fn, n_stages = make_loss_and_grads(cfg, mesh, n_micro,
                                             n_virtual=n_virtual,
-                                            remat=remat)
+                                            remat=remat,
+                                            dp_quant_bits=dp_quant_bits)
 
     @jax.jit
     def step(params, tokens, targets):
@@ -321,7 +333,8 @@ def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
 
 def make_train_step_optax(cfg: tfm.TransformerConfig, mesh: Mesh,
                           n_micro: int, optimizer, n_virtual: int = 1,
-                          remat: bool = False):
+                          remat: bool = False,
+                          dp_quant_bits: int | None = None):
     """Distributed train step with any optax GradientTransformation.
 
     Returns (step, n_stages): step(params, opt_state, tokens, targets) ->
@@ -335,7 +348,8 @@ def make_train_step_optax(cfg: tfm.TransformerConfig, mesh: Mesh,
 
     grad_fn, n_stages = make_loss_and_grads(cfg, mesh, n_micro,
                                             n_virtual=n_virtual,
-                                            remat=remat)
+                                            remat=remat,
+                                            dp_quant_bits=dp_quant_bits)
 
     @jax.jit
     def step(params, opt_state, tokens, targets):

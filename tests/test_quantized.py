@@ -1,0 +1,125 @@
+"""Quantized ring all-reduce (parallel/quantized.py, after EQuARX):
+accuracy vs the exact collective, rank agreement, and end-to-end training
+with quantized dp-gradient sync.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from mpi_acx_tpu.parallel.mesh import mesh_from_devices
+from mpi_acx_tpu.parallel.quantized import quantized_pmean, quantized_psum
+
+
+def _run(mesh, fn, x, axis="x"):
+    """Per-rank inputs x [n, ...] -> stacked per-rank outputs [n, ...]."""
+    f = shard_map(fn, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+                  check_vma=False)
+    return jax.jit(f)(x)
+
+
+@pytest.mark.parametrize("shape", [(1024,), (4096,), (64, 33)])
+def test_quantized_psum_close_to_exact(shape):
+    """Error envelope of the int8 ring vs the exact psum on an 8-ring:
+    each of the 7 reduce-scatter hops re-quantizes the partial sum at
+    ~1/254 of its max-abs, so worst-case elementwise error accumulates
+    linearly in ring length (measured ~1.5% of the result's max-norm)
+    while the MEAN error stays an order of magnitude tighter — the
+    regime gradient descent cares about."""
+    n = 8
+    mesh = mesh_from_devices({"x": n}, jax.devices()[:n])
+    x = jax.random.normal(jax.random.key(0), (n,) + shape, jnp.float32)
+
+    got = _run(mesh, lambda v: quantized_psum(v[0], "x")[None], x)
+    want = np.asarray(x.sum(0))
+    scale = np.abs(want).max() + 1e-6
+    for r in range(n):
+        diff = np.abs(np.asarray(got[r]) - want)
+        assert diff.max() / scale < 0.025, (r, diff.max() / scale)
+        assert diff.mean() / scale < 0.004, (r, diff.mean() / scale)
+
+
+@pytest.mark.parametrize("shape", [(33,), (16, 7), (3, 5, 11)])
+def test_quantized_psum_small_leaf_is_exact(shape):
+    """Leaves below n*_BLOCK elements take the exact-psum fallback (the
+    quantized ring would cost more bytes AND more hops there)."""
+    n = 8
+    mesh = mesh_from_devices({"x": n}, jax.devices()[:n])
+    x = jax.random.normal(jax.random.key(0), (n,) + shape, jnp.float32)
+    got = _run(mesh, lambda v: quantized_psum(v[0], "x")[None], x)
+    want = np.asarray(x.sum(0))
+    for r in range(n):
+        np.testing.assert_allclose(np.asarray(got[r]), want, rtol=1e-5,
+                                   atol=1e-5)
+
+
+def test_quantized_psum_identical_on_all_ranks():
+    """The all-gather phase distributes ONE quantized value, so every
+    rank holds bit-identical results (no rank-dependent rounding)."""
+    n = 8
+    mesh = mesh_from_devices({"x": n}, jax.devices()[:n])
+    x = jax.random.normal(jax.random.key(1), (n, 1037), jnp.float32)
+    got = np.asarray(_run(mesh, lambda v: quantized_psum(v[0], "x")[None], x))
+    for r in range(1, n):
+        np.testing.assert_array_equal(got[0], got[r])
+
+
+def test_quantized_psum_zero_and_axis1():
+    n = 8
+    mesh = mesh_from_devices({"x": n}, jax.devices()[:n])
+    z = jnp.zeros((n, 2048), jnp.float32)
+    got = np.asarray(_run(mesh, lambda v: quantized_psum(v[0], "x")[None], z))
+    np.testing.assert_array_equal(got, np.zeros((n, 2048)))
+    # Axis of size 1: exact passthrough.
+    mesh1 = mesh_from_devices({"x": 1}, jax.devices()[:1])
+    y = jax.random.normal(jax.random.key(2), (1, 33), jnp.float32)
+    got1 = _run(mesh1, lambda v: quantized_psum(v[0], "x")[None], y)
+    np.testing.assert_allclose(np.asarray(got1), np.asarray(y), rtol=1e-6)
+
+
+def test_quantized_pmean_matches_scaled_psum():
+    n = 4
+    mesh = mesh_from_devices({"x": n}, jax.devices()[:n])
+    x = jax.random.normal(jax.random.key(3), (n, 1100), jnp.float32)
+    got = _run(mesh, lambda v: quantized_pmean(v[0], "x")[None], x)
+    want = _run(mesh, lambda v: (quantized_psum(v[0], "x") / n)[None], x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_train_step_with_quantized_dp_sync_converges():
+    """dp_quant_bits=8 through the full dp x pp x tp step: the first-step
+    loss equals the exact step's (loss is computed before grad sync), the
+    updated parameters stay within quantization tolerance of the exact
+    step's, and training still converges on a fixed batch."""
+    from mpi_acx_tpu.models import transformer as tfm
+    from mpi_acx_tpu.train import make_train_step
+
+    cfg = tfm.tiny_config(vocab=61, d_model=32, n_heads=2, n_layers=2,
+                          d_ff=64, max_seq=16)
+    mesh = mesh_from_devices({"dp": 2, "pp": 2, "tp": 2})
+    params = tfm.init_params(jax.random.key(0), cfg)
+    M, mb, S = 2, 4, 16
+    tok = jax.random.randint(jax.random.key(1), (M, mb, S), 0, cfg.vocab)
+    tgt = jnp.roll(tok, -1, -1)
+
+    exact_step, n_st = make_train_step(cfg, mesh, n_micro=M, lr=0.1)
+    quant_step, _ = make_train_step(cfg, mesh, n_micro=M, lr=0.1,
+                                    dp_quant_bits=8)
+    staged = tfm.stage_slice(params, n_st)
+    le, pe = exact_step(staged, tok, tgt)
+    lq, pq = quant_step(staged, tok, tgt)
+    np.testing.assert_allclose(float(le), float(lq), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(pe), jax.tree.leaves(pq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-3, rtol=0.05)
+
+    p = staged
+    l0 = None
+    for _ in range(8):
+        loss, p = quant_step(p, tok, tgt)
+        if l0 is None:
+            l0 = float(loss)
+    assert float(loss) < l0, (float(loss), l0)
