@@ -38,6 +38,10 @@ from mpi_acx_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_attention,
     ulysses_attention_sharded,
 )
+from mpi_acx_tpu.parallel.quantized import (  # noqa: F401
+    quantized_pmean,
+    quantized_psum,
+)
 from mpi_acx_tpu.parallel.tp_inference import (  # noqa: F401
     make_tp_generate,
     make_tp_generate_llama,
