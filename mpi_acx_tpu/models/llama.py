@@ -226,25 +226,28 @@ from mpi_acx_tpu.models.decoding import (  # noqa: F401  (re-export)
 def decode_step(params: Params, cfg: LlamaConfig, cache,
                 token: jax.Array):
     """One autoregressive step; token [B] -> (logits [B, vocab] f32,
-    updated cache). Fixed shapes: jit once per generation."""
+    updated cache). Fixed shapes: jit once per generation.
+
+    The cache update runs through the shared carry-scan
+    (decoding.decode_layer_scan): in-place updates, 1.9x faster decode
+    on v5e than scan-ys stacking."""
+    from mpi_acx_tpu.models.decoding import decode_layer_scan
+
     pos = cache["pos"]
     max_len = cache["k"].shape[2]
     n_rep = cfg.n_heads // cfg.n_kv_heads
     x = params["embed"][token][:, None, :].astype(cfg.dtype)
     positions = jnp.full((1,), pos)
 
-    def body(x, layer):
-        lp, kc, vc = layer
-        q, k, v = _qkv(cfg, lp, x, positions)            # k,v [B,1,Hkv,D]
-        kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
-        vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
-        o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep)
-        x = x + o @ lp["wo"].astype(x.dtype)
-        x = _mlp(cfg, lp, x)
-        return x, (kc, vc)
+    def qkv_fn(lp, x, pos):
+        return _qkv(cfg, lp, x, positions)               # k,v [B,1,Hkv,D]
 
-    x, (ks, vs) = lax.scan(body, x, (params["layers"], cache["k"],
-                                     cache["v"]))
+    def attend_fn(lp, x, q, kc, vc, pos):
+        o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep)
+        return _mlp(cfg, lp, x + o @ lp["wo"].astype(x.dtype))
+
+    x, ks, vs = decode_layer_scan(params["layers"], x, cache["k"],
+                                  cache["v"], pos, qkv_fn, attend_fn)
     x = rmsnorm(x, params["final_norm"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["unembed"].astype(x.dtype),
                         preferred_element_type=jnp.float32)[:, 0]
