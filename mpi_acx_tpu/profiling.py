@@ -1,0 +1,117 @@
+"""Device-side profiling and step timing for the TPU compute layer.
+
+The native runtime has its own op-lifecycle Chrome trace (ACX_TRACE,
+src/core/trace.cc — the host plane's observability); this module is the
+device half: XLA/TPU profiler capture and honest wall-clock step
+statistics. The reference's only observability is printf-with--DDEBUG
+(SURVEY.md §5.1/§5.5) — both halves here exceed it.
+
+Timing rule learned the hard way on the tunneled chip (BASELINE.md):
+host-side per-call timing of sub-ms device work measures dispatch RTT,
+not the device. ``StepTimer`` forces a ``block_until_ready`` sync per
+step so each sample is a true device round-trip; for sub-ms kernels use
+a device-side rep loop (bench.py's methodology) instead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture an XLA profiler trace into ``logdir`` (TensorBoard's
+    profile plugin / xprof format). Wrap the region of interest:
+
+        with profiling.trace("/tmp/prof"):
+            jax.block_until_ready(step(params, batch))
+    """
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named sub-region inside a trace (shows as a span in the viewer):
+
+        with profiling.annotate("attention"):
+            o = flash_attention(q, k, v)
+    """
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Wall-clock statistics over training/serving steps.
+
+    Each timed region ends with ``jax.block_until_ready`` on the value
+    handed to ``stop`` (or the region's result), so a sample covers the
+    full device execution, not just dispatch. Percentiles use the sorted
+    sample list (no interpolation — honest for small n).
+
+        timer = StepTimer()
+        for batch in data:
+            with timer.step() as t:
+                loss, params = train_step(params, batch)
+                t.sync(loss)
+        print(timer.summary())
+    """
+
+    class _Region:
+        def __init__(self):
+            self._value = None
+            self._synced = False
+
+        def sync(self, value: Any):
+            """Register the value whose readiness ends the step."""
+            self._value = value
+            self._synced = True
+
+    def __init__(self):
+        self.samples: List[float] = []
+
+    @contextlib.contextmanager
+    def step(self):
+        region = StepTimer._Region()
+        t0 = time.perf_counter()
+        yield region
+        if not region._synced:
+            # Without a sync point the sample would measure async DISPATCH
+            # only — the exact pitfall this class exists to prevent
+            # (module docstring). Fail loudly rather than record it.
+            raise RuntimeError(
+                "StepTimer.step() region ended without sync(value); the "
+                "sample would time dispatch, not the device step")
+        jax.block_until_ready(region._value)
+        self.samples.append(time.perf_counter() - t0)
+
+    def _pct(self, p: float) -> float:
+        s = sorted(self.samples)
+        # Nearest-rank percentile: the ceil(p*n)-th smallest sample.
+        return s[max(0, math.ceil(p * len(s)) - 1)]
+
+    def summary(self) -> Dict[str, float]:
+        if not self.samples:
+            return {"steps": 0}
+        n = len(self.samples)
+        return {
+            "steps": n,
+            "mean_s": sum(self.samples) / n,
+            "p50_s": self._pct(0.50),
+            "p90_s": self._pct(0.90),
+            "max_s": max(self.samples),
+        }
+
+    def dump(self, path: str, extra: Optional[Dict[str, Any]] = None):
+        """Write summary + raw samples as JSON."""
+        out = dict(self.summary(), samples=self.samples, **(extra or {}))
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        return out

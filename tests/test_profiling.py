@@ -1,0 +1,62 @@
+"""Device-side profiling helpers (mpi_acx_tpu/profiling.py)."""
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+
+from mpi_acx_tpu import profiling
+
+
+def test_trace_writes_profile(tmp_path):
+    logdir = str(tmp_path / "prof")
+    with profiling.trace(logdir):
+        with profiling.annotate("matmul"):
+            x = jnp.ones((128, 128))
+            jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+    files = glob.glob(os.path.join(logdir, "**", "*"), recursive=True)
+    assert any(os.path.isfile(f) for f in files), files
+
+
+def test_step_timer_stats_and_dump(tmp_path):
+    t = profiling.StepTimer()
+    f = jax.jit(lambda a: a * 2 + 1)
+    x = jnp.arange(1024.0)
+    for _ in range(5):
+        with t.step() as region:
+            region.sync(f(x))
+    s = t.summary()
+    assert s["steps"] == 5
+    assert 0 < s["p50_s"] <= s["p90_s"] <= s["max_s"]
+    assert abs(s["mean_s"] - sum(t.samples) / 5) < 1e-12
+    out = t.dump(str(tmp_path / "steps.json"), extra={"tag": "test"})
+    loaded = json.load(open(tmp_path / "steps.json"))
+    assert loaded["tag"] == "test" and len(loaded["samples"]) == 5
+    assert out["steps"] == 5
+
+
+def test_step_timer_empty():
+    assert profiling.StepTimer().summary() == {"steps": 0}
+
+
+def test_step_timer_requires_sync():
+    t = profiling.StepTimer()
+    try:
+        with t.step():
+            pass
+    except RuntimeError as e:
+        assert "sync" in str(e)
+    else:
+        raise AssertionError("unsynced region must raise")
+    assert t.samples == []
+
+
+def test_percentiles_nearest_rank():
+    t = profiling.StepTimer()
+    t.samples = [float(i) for i in range(1, 11)]   # 1..10
+    s = t.summary()
+    assert s["p50_s"] == 5.0    # ceil(0.5*10)=5th smallest
+    assert s["p90_s"] == 9.0    # ceil(0.9*10)=9th smallest, not the max
+    assert s["max_s"] == 10.0
