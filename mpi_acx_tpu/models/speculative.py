@@ -1,0 +1,207 @@
+"""Speculative decoding: a small draft model proposes, the target model
+verifies k tokens in ONE forward pass.
+
+Autoregressive decode is latency-bound — every token costs a full pass
+whose time is dominated by streaming the target's weights. Speculative
+decoding amortizes that stream: the cheap draft model proposes k-1
+tokens with sequential cached steps, then the target scores the pending
+token plus all proposals in a single k-wide cached window pass (one
+weight stream for up to k emitted tokens). The wall-clock win requires
+the weight-streaming-bound regime (a real-size target on HBM) and a
+draft the target usually agrees with; the mechanism — R window passes
+instead of n_new sequential steps — is asserted directly in the tests
+(6.0x fewer target passes at full acceptance, k=6). Greedy acceptance
+keeps the output equal to the target-only greedy decode up to
+floating-point argmax ties: the window pass and sequential decode use
+differently-ordered contractions (~1e-8 apart in f32), so a position
+whose top-2 logits tie within that noise — or within bf16 rounding
+under bf16 compute — can break the equality; the draft can never
+otherwise change which tokens appear, only how fast
+(tests/test_speculative.py asserts token equality against
+transformer.generate for arbitrary draft/target pairs in f32).
+
+TPU-first construction: the whole loop is one jitted ``lax.while_loop``
+with static shapes — a fixed-k draft scan, a fixed-width target window
+pass, and a token buffer sized S + n_new + k for the final-round
+overshoot. Cache rollback is free by design: both KV caches keep their
+stale entries for rejected positions, which are always overwritten by
+the pass that next occupies those positions before any query can attend
+to them (queries at position p attend only to entries <= p, and every
+position is re-written in order).
+
+The reference has no serving stack at all (SURVEY.md §0); this sits on
+the same decode substrate as the other families
+(decoding.decode_layer_scan, grouped_decode_attend).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_acx_tpu.models import transformer as tfm
+from mpi_acx_tpu.models.decoding import decode_layer_scan
+
+
+def _window_pass(params, cfg, cache, tokens):
+    """Process a W-token window against the cache: tokens [1, W] occupy
+    positions pos..pos+W-1; returns (logits [1, W, vocab] f32, cache with
+    pos advanced by W). Row w attends cache entries <= pos+w (the entries
+    for this window are written before the attention reads them)."""
+    B, W = tokens.shape
+    pos = cache["pos"]
+    max_len = cache["k"].shape[2]
+    x = (params["embed"][tokens]
+         + lax.dynamic_slice_in_dim(params["pos"], pos, W, 0)[None]
+         ).astype(cfg.dtype)
+
+    def qkv_fn(lp, x, pos):
+        return tfm._qkv(cfg, lp, x)                    # [1, W, H, Dh]
+
+    def attend_fn(lp, x, q, kc, vc, pos):
+        s = jnp.einsum("bwhd,bkhd->bhwk", q, kc).astype(jnp.float32)
+        s = s / jnp.sqrt(cfg.head_dim)
+        rows = pos + jnp.arange(W)[:, None]            # [W, 1]
+        cols = jnp.arange(max_len)[None, :]            # [1, max_len]
+        s = jnp.where((cols <= rows)[None, None], s,
+                      jnp.finfo(jnp.float32).min)
+        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhwk,bkhd->bwhd", p, vc).reshape(
+            B, W, cfg.d_model)
+        return tfm._mlp(cfg, lp, x + o @ lp["wo"].astype(x.dtype))
+
+    x, ks, vs = decode_layer_scan(params["layers"], x, cache["k"],
+                                  cache["v"], pos, qkv_fn, attend_fn)
+    x = tfm.layernorm(x, params["lnf_g"], params["lnf_b"])
+    logits = jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(x.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, {"k": ks, "v": vs, "pos": pos + W}
+
+
+@functools.lru_cache(maxsize=64)
+def _build(draft_cfg: tfm.TransformerConfig, cfg: tfm.TransformerConfig,
+           S: int, n_new: int, k: int):
+    """One compiled speculative loop per (configs, shapes) — configs are
+    frozen dataclasses, so they key the cache; repeat calls to
+    :func:`speculative_generate` reuse the jitted program instead of
+    re-tracing (a fresh inner jit per call costs seconds of compile)."""
+    cap = S + n_new + k                      # overshoot slack, last round
+    assert cap <= cfg.max_seq and cap <= draft_cfg.max_seq, (
+        cap, cfg.max_seq, draft_cfg.max_seq)
+
+    @jax.jit
+    def run(draft_params, params, prompt):
+        t_logits, t_cache = tfm.prefill(params, cfg, prompt, cap,
+                                        last_only=True)
+        _, d_cache = tfm.prefill(draft_params, draft_cfg, prompt, cap,
+                                 last_only=True)
+        pending = jnp.argmax(t_logits[:, -1], -1).astype(prompt.dtype)
+
+        buf = jnp.zeros((1, cap), prompt.dtype)
+        buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
+        buf = lax.dynamic_update_slice(buf, pending[:, None], (0, S))
+
+        # State: (n_emitted_after_prompt, pending token, caches, buf,
+        # rounds, accepted). `pending` sits at position S+n-1... by the
+        # decode convention the pending token occupies pos and is not in
+        # any cache yet.
+        def cond(state):
+            n, *_ = state
+            return n < n_new
+
+        def body(state):
+            n, pending, d_cache, t_cache, buf, rounds, acc = state
+
+            # -- draft: k cached greedy steps; the first k-1 outputs are
+            # the proposals. The k-th step exists to WRITE the draft's
+            # cache entry for position P+k-1 (the last proposal's seat):
+            # at full acceptance the next round starts past it and would
+            # otherwise leave a permanent zero hole the draft attends to
+            # forever. At partial acceptance the extra entry is stale but
+            # sits at >= the rolled-back pos, so later rounds rewrite it
+            # before any query can see it.
+            def dstep(carry, _):
+                cache, tok = carry
+                lg, cache = tfm.decode_step(draft_params, draft_cfg,
+                                            cache, tok)
+                nxt = jnp.argmax(lg, -1).astype(tok.dtype)
+                return (cache, nxt), nxt
+
+            (d_cache, _), props = lax.scan(
+                dstep, (d_cache, pending), None, length=k)
+            props = props[:k - 1, 0]                     # [k-1]
+
+            # -- target: one window pass over [pending, props] ----------
+            window = jnp.concatenate([pending, props])[None]   # [1, k]
+            t_logits, t_cache = _window_pass(params, cfg, t_cache, window)
+            targets = jnp.argmax(t_logits[0], -1).astype(
+                prompt.dtype)                            # [k]
+            # targets[i] = target's token for position pos+i+1.
+
+            # -- accept the longest matching prefix ---------------------
+            matches = props == targets[:k - 1]           # [k-1]
+            m = jnp.argmin(
+                jnp.concatenate([matches, jnp.zeros((1,), bool)]))
+            m = m.astype(jnp.int32)                      # 0..k-1 accepted
+            bonus = targets[m]
+            # The emitted tokens for positions P+1..P+m+1 are exactly
+            # targets[0..m] (accepted proposals equal the target chain,
+            # and targets[m] is the bonus/correction). Write the whole
+            # window — entries past m are garbage that the next round
+            # overwrites before the final trim can expose them.
+            buf = lax.dynamic_update_slice(buf, targets[None], (0, S + n))
+
+            emitted = m + 1
+            n = n + emitted
+            # Roll both caches to the new pending position: pending now
+            # sits at S + n - 1... i.e. cache pos = S + n - 1.
+            newpos = jnp.asarray(S, jnp.int32) + n - 1
+            d_cache = dict(d_cache, pos=newpos)
+            t_cache = dict(t_cache, pos=newpos)
+            pending = bonus[None]
+            return (n, pending, d_cache, t_cache, buf, rounds + 1,
+                    acc + m)
+
+        state = (jnp.asarray(1, jnp.int32), pending, d_cache, t_cache,
+                 buf, jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32))
+        n, pending, d_cache, t_cache, buf, rounds, acc = lax.while_loop(
+            cond, body, state)
+        return buf[:, :S + n_new], rounds, acc
+
+    return run
+
+
+def speculative_generate(
+    draft_params, draft_cfg: tfm.TransformerConfig,
+    params, cfg: tfm.TransformerConfig,
+    prompt: jax.Array, n_new: int, k: int = 4,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Greedy speculative decode (B=1 — it is a latency technique).
+
+    Returns ``(tokens [1, S + n_new], stats)`` where tokens EXACTLY equal
+    ``transformer.generate(params, cfg, prompt, n_new)`` and stats counts
+    ``{"rounds": R, "drafted_accepted": A}`` — the target ran R window
+    passes (vs n_new sequential steps for plain decode), and A of the
+    R*(k-1) drafted tokens were accepted.
+
+    Each round: the draft runs ``k-1`` cached greedy steps from the
+    pending token; the target scores the pending token plus the k-1
+    proposals in one k-wide window pass; the longest prefix of proposals
+    matching the target's own argmax chain is emitted, plus the target's
+    next token (the "bonus" — also the correction when a proposal is
+    rejected). A round therefore emits 1..k tokens at the cost of ONE
+    target pass + k-1 draft steps.
+
+    The compiled loop is cached per (configs, prompt length, n_new, k),
+    so repeat calls with the same shapes are trace-free.
+    """
+    B, S = prompt.shape
+    assert B == 1, "speculative decoding is per-sequence (B=1)"
+    assert k >= 2, k
+    run = _build(draft_cfg, cfg, S, n_new, k)
+    tokens, rounds, acc = run(draft_params, params, prompt)
+    return tokens, {"rounds": rounds, "drafted_accepted": acc}

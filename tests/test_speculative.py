@@ -1,0 +1,126 @@
+"""Speculative decoding: exact greedy equality and acceptance accounting.
+
+The defining property: for ANY draft model, the output tokens equal the
+target-only greedy decode — the draft changes only the round count.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_acx_tpu.models import transformer as tfm
+from mpi_acx_tpu.models.speculative import speculative_generate
+
+
+def _cfg(n_layers, max_seq=128, vocab=64):
+    c = tfm.tiny_config(vocab=vocab, d_model=32, n_heads=2,
+                        n_layers=n_layers, d_ff=64, max_seq=max_seq)
+    return tfm.TransformerConfig(**{**c.__dict__, "dtype": jnp.float32})
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_exact_match_random_draft(k):
+    """A random (unrelated) draft: almost nothing gets accepted, output
+    still EXACTLY equals the target-only greedy decode."""
+    cfg = _cfg(2)
+    dcfg = _cfg(1)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    dparams = tfm.init_params(jax.random.key(7), dcfg)
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab)
+    n_new = 24
+
+    want = tfm.generate(params, cfg, prompt, n_new,
+                        max_len=prompt.shape[1] + n_new + k)
+    got, stats = speculative_generate(dparams, dcfg, params, cfg, prompt,
+                                      n_new, k=k)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # Every round emits at least one token.
+    assert int(stats["rounds"]) <= n_new
+
+
+def test_perfect_draft_amortizes_rounds():
+    """Draft == target: every proposal is accepted, so each round emits k
+    tokens and the target runs ~n_new/k window passes instead of n_new
+    steps — the speedup mechanism, observable in the round count."""
+    cfg = _cfg(2)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab)
+    n_new, k = 24, 4
+
+    want = tfm.generate(params, cfg, prompt, n_new,
+                        max_len=prompt.shape[1] + n_new + k)
+    got, stats = speculative_generate(params, cfg, params, cfg, prompt,
+                                      n_new, k=k)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    rounds = int(stats["rounds"])
+    # ceil((n_new - 1) / k) + 1 rounds would be perfect; allow slack for
+    # the prefill bonus accounting but require real amortization.
+    assert rounds <= -(-n_new // k) + 1, rounds
+    assert int(stats["drafted_accepted"]) >= (k - 1) * (rounds - 1)
+
+
+def test_trained_draft_accepts_most():
+    """A draft trained on the same copy task as the target accepts most
+    proposals — the realistic deployment regime (distilled draft)."""
+    cfg = _cfg(2, vocab=32)
+    dcfg = _cfg(1, vocab=32)
+    tok = jax.random.randint(jax.random.key(1), (8, 16), 0, 32)
+    tgt = tok   # predict-current: rollout repeats the final token
+
+    def train(c, key, steps=60):
+        p = tfm.init_params(key, c)
+        import optax
+        opt = optax.adam(3e-2)
+        st = opt.init(p)
+        loss_g = jax.jit(jax.value_and_grad(
+            lambda p: tfm.loss_fn(p, c, tok, tgt)))
+        for _ in range(steps):
+            _, g = loss_g(p)
+            up, st = opt.update(g, st)
+            p = optax.apply_updates(p, up)
+        return p
+
+    params = train(cfg, jax.random.key(0))
+    dparams = train(dcfg, jax.random.key(9))
+    prompt = tok[:1, :8]
+    n_new, k = 16, 4
+
+    want = tfm.generate(params, cfg, prompt, n_new, max_len=8 + n_new + k)
+    got, stats = speculative_generate(dparams, dcfg, params, cfg, prompt,
+                                      n_new, k=k)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    rounds = int(stats["rounds"])
+    acc = int(stats["drafted_accepted"])
+    # Both models learned the task, so acceptance is high and rounds are
+    # far below n_new (each round emits ~k tokens).
+    assert rounds <= n_new // 2, (rounds, acc)
+    assert acc >= rounds, (rounds, acc)
+
+
+def test_batch_rejected():
+    cfg = _cfg(2)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab)
+    with pytest.raises(AssertionError):
+        speculative_generate(params, cfg, params, cfg, prompt, 4)
+
+
+def test_no_draft_cache_hole_at_full_acceptance():
+    """Regression: at full acceptance the rollback jumps past the last
+    proposal's seat; the draft must still have written that cache entry
+    (an unwritten zero K/V row would perturb every later draft step and
+    silently erode acceptance). With draft == target, acceptance must
+    stay PERFECT across many rounds — any hole shows up as a rejected
+    proposal."""
+    cfg = _cfg(2, max_seq=256)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab)
+    n_new, k = 61, 4
+    want = tfm.generate(params, cfg, prompt, n_new,
+                        max_len=prompt.shape[1] + n_new + k)
+    got, stats = speculative_generate(params, cfg, params, cfg, prompt,
+                                      n_new, k=k)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    rounds, acc = int(stats["rounds"]), int(stats["drafted_accepted"])
+    assert acc == rounds * (k - 1), (acc, rounds)
