@@ -80,3 +80,54 @@ def test_op_trace_partitioned(tmp_path):
         seen |= {e["name"] for e in d["traceEvents"]}
     assert {"psend_slot", "precv_slot", "pready_marked", "pready_wire",
             "parrived"} <= seen, seen
+
+
+# -- acxrun failure detection (exceeds reference's abort-only story) -------
+
+
+def _acxrun(*args, timeout=60):
+    return subprocess.run(
+        [os.path.join(REPO, "build", "acxrun"), *args],
+        capture_output=True, text=True, timeout=timeout)
+
+
+def test_acxrun_attributes_failing_rank():
+    """A nonzero rank exit is attributed by rank and code, the job exit
+    propagates the code, and peers are reported as it tears them down."""
+    r = _acxrun("-np", "3", "-timeout", "30", "sh", "-c",
+                'if [ "$ACX_RANK" = 1 ]; then exit 3; fi; '
+                'sleep 30 >/dev/null 2>&1')
+    assert r.returncode == 3, (r.returncode, r.stderr)
+    assert "status rank=1 exit=3" in r.stderr, r.stderr
+    assert "rank 1 failed first" in r.stderr, r.stderr
+    # Peers the SUPERVISOR tore down are tagged killed=1, so a harness
+    # counting untagged exit=/signal= lines counts ONE genuine failure.
+    assert "killed=1" in r.stderr, r.stderr
+    genuine = [ln for ln in r.stderr.splitlines()
+               if "status rank=" in ln and "killed=1" not in ln
+               and "stuck=1" not in ln]
+    assert len(genuine) == 1, r.stderr
+
+
+def test_acxrun_names_stuck_ranks_on_timeout():
+    """On timeout the supervisor lists exactly the ranks that never
+    exited before killing them."""
+    # The sleeping rank's fds are redirected so the orphaned sleep cannot
+    # hold our capture pipes open past acxrun's own exit.
+    r = _acxrun("-np", "3", "-timeout", "1", "sh", "-c",
+                'if [ "$ACX_RANK" = 2 ]; then sleep 60 >/dev/null 2>&1; fi; '
+                'exit 0')
+    assert r.returncode == 124, (r.returncode, r.stderr)
+    assert "stuck ranks: 2" in r.stderr, r.stderr
+    assert "status rank=2 stuck=1" in r.stderr, r.stderr
+    # The healthy ranks are NOT reported stuck.
+    assert "status rank=0" not in r.stderr, r.stderr
+
+
+def test_acxrun_signal_attribution():
+    """A rank killed by a signal is reported with that signal."""
+    r = _acxrun("-np", "2", "-timeout", "30", "sh", "-c",
+                'if [ "$ACX_RANK" = 0 ]; then kill -SEGV $$; fi; '
+                'sleep 30 >/dev/null 2>&1')
+    assert r.returncode == 128 + 11, (r.returncode, r.stderr)
+    assert "status rank=0 signal=11" in r.stderr, r.stderr

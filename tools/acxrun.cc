@@ -14,6 +14,15 @@
 // Exit status: 0 iff every rank exited 0. If any rank exits nonzero or a
 // timeout fires, the remaining ranks are killed (matching mpiexec behavior
 // on MPI_Abort).
+//
+// Failure detection (exceeds the reference, whose only story is
+// MPI_ERRORS_ARE_FATAL abort — SURVEY.md §5.3): the supervisor attributes
+// every failure to a rank. The FIRST failing rank is named with its exit
+// code or signal before peers are torn down, every abnormal exit is
+// reported per rank, and on timeout the set of still-running (stuck)
+// ranks is listed — turning "the job hung" into "rank 2 never exited".
+// A machine-readable `acxrun: status rank=R ...` line per abnormal rank
+// goes to stderr for harnesses to parse.
 
 #include <errno.h>
 #include <signal.h>
@@ -152,14 +161,41 @@ int main(int argc, char** argv) {
   alarm(timeout_s);
   int worst = 0;
   int live = np;
+  // Per-rank terminal status for attribution: -1 = still running,
+  // otherwise the rank's effective exit code (128+sig for signals).
+  std::vector<int> status_of(np, -1);
+  // Ranks the SUPERVISOR signaled (teardown/timeout): their deaths are
+  // induced, not failures, and are tagged killed=1 so a harness counting
+  // `status rank=R exit=`/`signal=` lines counts only genuine failures.
+  std::vector<bool> killed_by_us(np, false);
+  auto rank_of = [&](pid_t pid) {
+    for (int r = 0; r < np; r++)
+      if (pids[r] == pid) return r;
+    return -1;
+  };
   while (live > 0) {
     int st = 0;
     pid_t pid = wait(&st);
     if (pid < 0) {
       if (errno == EINTR) {
-        fprintf(stderr, "acxrun: timeout after %ds, killing ranks\n",
-                timeout_s);
-        for (int r = 0; r < np; r++) kill(pids[r], SIGKILL);
+        // Timeout: name the stuck ranks before killing them — the
+        // difference between "the job hung" and "rank 2 never exited".
+        std::string stuck;
+        for (int r = 0; r < np; r++) {
+          if (status_of[r] < 0) {
+            if (!stuck.empty()) stuck += ',';
+            stuck += std::to_string(r);
+          }
+        }
+        fprintf(stderr,
+                "acxrun: timeout after %ds; stuck ranks: %s (killing)\n",
+                timeout_s, stuck.empty() ? "none" : stuck.c_str());
+        for (int r = 0; r < np; r++)
+          if (status_of[r] < 0) {
+            fprintf(stderr, "acxrun: status rank=%d stuck=1\n", r);
+            killed_by_us[r] = true;
+            kill(pids[r], SIGKILL);
+          }
         worst = worst ? worst : 124;
         timeout_s = 5;
         alarm(5);
@@ -168,13 +204,33 @@ int main(int argc, char** argv) {
       break;
     }
     live--;
+    int rank = rank_of(pid);
     int code = WIFEXITED(st) ? WEXITSTATUS(st)
                              : 128 + (WIFSIGNALED(st) ? WTERMSIG(st) : 0);
+    if (rank >= 0) status_of[rank] = code;
     if (code != 0) {
-      if (!worst) worst = code;
-      // One rank failed: take the job down like mpiexec does on MPI_Abort.
+      bool induced = rank >= 0 && killed_by_us[rank];
+      if (WIFSIGNALED(st)) {
+        fprintf(stderr, "acxrun: status rank=%d signal=%d%s\n", rank,
+                WTERMSIG(st), induced ? " killed=1" : "");
+      } else {
+        fprintf(stderr, "acxrun: status rank=%d exit=%d%s\n", rank, code,
+                induced ? " killed=1" : "");
+      }
+      if (induced) continue;   // supervisor-induced death, not a failure
+      if (!worst) {
+        worst = code;
+        // First failure: attribute it, then take the job down like
+        // mpiexec does on MPI_Abort.
+        fprintf(stderr,
+                "acxrun: rank %d failed first; terminating %d peer(s)\n",
+                rank, live);
+      }
       for (int r = 0; r < np; r++)
-        if (pids[r] != pid) kill(pids[r], SIGTERM);
+        if (pids[r] != pid && status_of[r] < 0) {
+          killed_by_us[r] = true;
+          kill(pids[r], SIGTERM);
+        }
     }
   }
   return worst;
