@@ -86,8 +86,11 @@ def test_op_trace_partitioned(tmp_path):
 
 
 def _acxrun(*args, timeout=60):
+    import sys
+    sys.path.insert(0, REPO)
+    from mpi_acx_tpu import runtime
     return subprocess.run(
-        [os.path.join(REPO, "build", "acxrun"), *args],
+        [runtime.acxrun_path(), *args],
         capture_output=True, text=True, timeout=timeout)
 
 
@@ -131,3 +134,23 @@ def test_acxrun_signal_attribution():
                 'sleep 30 >/dev/null 2>&1')
     assert r.returncode == 128 + 11, (r.returncode, r.stderr)
     assert "status rank=0 signal=11" in r.stderr, r.stderr
+
+
+def test_acxrun_two_simultaneous_genuine_failures():
+    """Two ranks failing on their own must never have their GENUINE exit
+    codes mistagged killed=1 (the teardown sweep drains already-dead
+    zombies before marking peers). Whether the slower rank is reaped as
+    its own exit or caught mid-flight by the teardown SIGTERM is a race;
+    what must NEVER appear is its genuine exit code tagged as induced."""
+    r = _acxrun("-np", "4", "-timeout", "30", "sh", "-c",
+                'case "$ACX_RANK" in 1) exit 3;; 2) exit 5;; '
+                '*) sleep 30 >/dev/null 2>&1;; esac')
+    assert r.returncode in (3, 5), (r.returncode, r.stderr)
+    # The mistag signature the drain exists to prevent:
+    assert "exit=3 killed=1" not in r.stderr, r.stderr
+    assert "exit=5 killed=1" not in r.stderr, r.stderr
+    genuine = [ln for ln in r.stderr.splitlines()
+               if "status rank=" in ln and "killed=1" not in ln]
+    # In the overwhelmingly common schedule both zombies form before the
+    # teardown sweep and BOTH genuine failures are reported untagged.
+    assert len(genuine) >= 1, r.stderr

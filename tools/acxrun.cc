@@ -173,6 +173,33 @@ int main(int argc, char** argv) {
       if (pids[r] == pid) return r;
     return -1;
   };
+  // Record one reaped child: status bookkeeping + the attribution line.
+  // Returns true iff this was a GENUINE failure (nonzero, not a death
+  // the supervisor itself induced).
+  auto reap_one = [&](pid_t pid, int st) {
+    live--;
+    int rank = rank_of(pid);
+    int code = WIFEXITED(st) ? WEXITSTATUS(st)
+                             : 128 + (WIFSIGNALED(st) ? WTERMSIG(st) : 0);
+    if (rank >= 0) status_of[rank] = code;
+    if (code == 0) return false;
+    bool induced = rank >= 0 && killed_by_us[rank];
+    if (WIFSIGNALED(st)) {
+      fprintf(stderr, "acxrun: status rank=%d signal=%d%s\n", rank,
+              WTERMSIG(st), induced ? " killed=1" : "");
+    } else {
+      fprintf(stderr, "acxrun: status rank=%d exit=%d%s\n", rank, code,
+              induced ? " killed=1" : "");
+    }
+    if (induced) return false;
+    if (!worst) {
+      worst = code;
+      fprintf(stderr,
+              "acxrun: rank %d failed first; terminating %d peer(s)\n",
+              rank, live);
+    }
+    return true;
+  };
   while (live > 0) {
     int st = 0;
     pid_t pid = wait(&st);
@@ -203,31 +230,18 @@ int main(int argc, char** argv) {
       }
       break;
     }
-    live--;
-    int rank = rank_of(pid);
-    int code = WIFEXITED(st) ? WEXITSTATUS(st)
-                             : 128 + (WIFSIGNALED(st) ? WTERMSIG(st) : 0);
-    if (rank >= 0) status_of[rank] = code;
-    if (code != 0) {
-      bool induced = rank >= 0 && killed_by_us[rank];
-      if (WIFSIGNALED(st)) {
-        fprintf(stderr, "acxrun: status rank=%d signal=%d%s\n", rank,
-                WTERMSIG(st), induced ? " killed=1" : "");
-      } else {
-        fprintf(stderr, "acxrun: status rank=%d exit=%d%s\n", rank, code,
-                induced ? " killed=1" : "");
-      }
-      if (induced) continue;   // supervisor-induced death, not a failure
-      if (!worst) {
-        worst = code;
-        // First failure: attribute it, then take the job down like
-        // mpiexec does on MPI_Abort.
-        fprintf(stderr,
-                "acxrun: rank %d failed first; terminating %d peer(s)\n",
-                rank, live);
-      }
+    if (reap_one(pid, st)) {
+      // Genuine failure: before attributing teardown to the peers,
+      // DRAIN ranks that already died on their own (kill() on an
+      // unreaped zombie "succeeds", which would mistag a simultaneous
+      // second genuine failure as supervisor-induced).
+      int st2 = 0;
+      pid_t p2;
+      while (live > 0 && (p2 = waitpid(-1, &st2, WNOHANG)) > 0)
+        reap_one(p2, st2);
+      // Take the job down like mpiexec does on MPI_Abort.
       for (int r = 0; r < np; r++)
-        if (pids[r] != pid && status_of[r] < 0) {
+        if (status_of[r] < 0) {
           killed_by_us[r] = true;
           kill(pids[r], SIGTERM);
         }
