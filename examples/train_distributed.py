@@ -31,6 +31,10 @@ def main():
     ap.add_argument("--virtual", type=int, default=1,
                     help="virtual chunks per device (interleaved pipeline "
                          "schedule; 1 = GPipe)")
+    ap.add_argument("--data", default="",
+                    help="binary uint16 token file to train on (streamed "
+                         "through mpi_acx_tpu.data with device prefetch); "
+                         "default: synthetic ramp task")
     args = ap.parse_args()
 
     import jax
@@ -77,11 +81,33 @@ def main():
         p = tfm.stage_slice(params, n_stages)
     s = opt.init(p)
 
-    # Synthetic copy-task data: predict the next token of a ramp sequence.
     mb, S = 2 * args.dp, 32
-    base = jnp.arange(S)[None, None, :] + jnp.arange(mb)[None, :, None]
-    tokens = (base + jnp.arange(M)[:, None, None]) % cfg.vocab
-    targets = jnp.roll(tokens, -1, axis=-1)
+    if args.data:
+        # Stream real tokens: memmap file -> [M, mb, S+1] windows staged
+        # on device by a background prefetch thread, already dp-sharded
+        # (and globally addressable, which the multi-host deployment the
+        # header describes requires).
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from mpi_acx_tpu.data import TokenDataset, batches, prefetch
+        ds = TokenDataset(args.data)
+        sh = NamedSharding(mesh, P(None, "dp"))
+
+        def windows():
+            for w in batches(ds, M * mb, S, seed=0, n_batches=args.steps):
+                yield (w.astype(np.int32) % cfg.vocab).reshape(
+                    M, mb, S + 1)
+
+        def stream():
+            for w in prefetch(windows(), sharding=sh):
+                yield w[:, :, :-1], w[:, :, 1:]
+        data_iter = stream()
+    else:
+        # Synthetic copy task: predict the next token of a ramp sequence.
+        base = jnp.arange(S)[None, None, :] + jnp.arange(mb)[None, :, None]
+        tokens = (base + jnp.arange(M)[:, None, None]) % cfg.vocab
+        targets = jnp.roll(tokens, -1, axis=-1)
+        data_iter = iter(lambda: (tokens, targets), None)  # repeat forever
 
     ck = None
     if args.ckpt:
@@ -89,6 +115,7 @@ def main():
         ck = Checkpointer(args.ckpt)
 
     for i in range(args.steps):
+        tokens, targets = next(data_iter)
         loss, p, s = step(p, s, tokens, targets)
         if i % 5 == 0 or i == args.steps - 1:
             print(f"step {i:3d}  loss {float(loss):.4f}", flush=True)

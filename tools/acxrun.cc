@@ -183,7 +183,11 @@ int main(int argc, char** argv) {
                              : 128 + (WIFSIGNALED(st) ? WTERMSIG(st) : 0);
     if (rank >= 0) status_of[rank] = code;
     if (code == 0) return false;
-    bool induced = rank >= 0 && killed_by_us[rank];
+    // Induced deaths are SIGNAL deaths of ranks we signaled — the
+    // supervisor only ever sends signals, so a WIFEXITED nonzero code is
+    // always the rank's own (genuine) failure, even if our SIGTERM was
+    // in flight when it exited. This closes the last mistag window.
+    bool induced = rank >= 0 && killed_by_us[rank] && WIFSIGNALED(st);
     if (WIFSIGNALED(st)) {
       fprintf(stderr, "acxrun: status rank=%d signal=%d%s\n", rank,
               WTERMSIG(st), induced ? " killed=1" : "");
