@@ -240,6 +240,69 @@ def tpu_child_full():
     }))
 
 
+def tpu_child_spec():
+    """Child process: on-chip speculative-decoding wall-clock. Trains the
+    GPT-2 125M target and a 2-layer draft on a repetition task (so the
+    draft's proposals usually match), then times plain greedy decode vs
+    the speculative loop at the same (B=1, n_new) workload. Informational
+    row — never regression-gated (acceptance depends on the task)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from mpi_acx_tpu.models import transformer as tfm
+    from mpi_acx_tpu.models.speculative import speculative_generate
+
+    import dataclasses
+    n_new, k = 128, 4
+    cfg = tfm.gpt2_small()
+    dcfg = dataclasses.replace(cfg, n_layers=2)
+    tok = jax.random.randint(jax.random.key(1), (8, 64), 0, cfg.vocab)
+
+    def train(c, key, steps=40):
+        p = tfm.init_params(key, c)
+        opt = optax.adam(3e-3)
+        st = opt.init(p)
+
+        @jax.jit
+        def step(p, st):
+            loss, g = jax.value_and_grad(tfm.loss_fn)(p, c, tok, tok)
+            up, st = opt.update(g, st)
+            return optax.apply_updates(p, up), st, loss
+        for _ in range(steps):
+            p, st, _ = step(p, st)
+        return tfm.cast_params(p, jnp.bfloat16)
+
+    params = train(cfg, jax.random.key(0))
+    dparams = train(dcfg, jax.random.key(5))
+    prompt = tok[:1, :32]
+
+    gen = jax.jit(lambda p, t: tfm.generate(
+        p, cfg, t, n_new, max_len=32 + n_new + k))
+    jax.block_until_ready(gen(params, prompt))
+    t0 = time.perf_counter()
+    jax.block_until_ready(gen(params, prompt))
+    t_plain = time.perf_counter() - t0
+
+    out, stats = speculative_generate(dparams, dcfg, params, cfg, prompt,
+                                      n_new, k=k)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out, stats = speculative_generate(dparams, dcfg, params, cfg, prompt,
+                                      n_new, k=k)
+    jax.block_until_ready(out)
+    t_spec = time.perf_counter() - t0
+    rounds = int(stats["rounds"])
+    print(json.dumps({
+        "spec_speedup": round(t_plain / t_spec, 2),
+        "spec_plain_ms": round(t_plain * 1e3, 1),
+        "spec_ms": round(t_spec * 1e3, 1),
+        "spec_rounds": rounds,
+        "spec_target_pass_reduction": round(n_new / rounds, 2),
+        "spec_accepted": int(stats["drafted_accepted"]),
+        "device": str(jax.devices()[0].platform),
+    }))
+
+
 def main(full: bool = False):
     p50, bw = native_bench()
     out = {
@@ -275,6 +338,14 @@ def main(full: bool = False):
             out.update(sec)
         else:
             out["tpu_full_error"] = err2
+        # Speculative decode wall-clock: informational, isolated in its
+        # own child so a failure cannot cost the gated rows above.
+        spec, err3 = _run_tpu_child(
+            "spec", attempts=2 if fwd is not None else 1, timeout=600)
+        if spec is not None:
+            out.update(spec)
+        else:
+            out["tpu_spec_error"] = err3
         # Regression gate: every starred/TPU BASELINE.md row, 10%.
         def gate(name, value, baseline, higher_is_better=True):
             if value is None:
@@ -318,5 +389,7 @@ if __name__ == "__main__":
         tpu_child_fwd()
     elif "--tpu-child-full" in sys.argv:
         tpu_child_full()
+    elif "--tpu-child-spec" in sys.argv:
+        tpu_child_spec()
     else:
         main(full="--full" in sys.argv)

@@ -152,13 +152,16 @@ def loss_fn(params, cfg: MoeTransformerConfig, tokens, targets,
 # -- KV-cache decode -------------------------------------------------------
 
 
-def _moe_ffn(cfg: MoeTransformerConfig, lp: Dict[str, Any], h: jax.Array):
-    """The block's routed FFN on h [B, S, d] (token axis flattened for the
-    router); single-device (inference) path, aux losses not needed."""
+def _moe_ffn(cfg: MoeTransformerConfig, lp: Dict[str, Any], h: jax.Array,
+             ep_axis: str | None = None):
+    """The block's routed FFN on h [B, S, d] (token axis flattened for
+    the router), aux losses not needed: the inference path (ep_axis
+    None) and the distributed train step's expert-parallel path (train
+    ._moe_block_sp_tp passes its tp axis) share this one wrapper."""
     B, S, d = h.shape
     hn = tfm.layernorm(h, lp["ln2_g"], lp["ln2_b"])
     mp = {"gate": lp["gate"], "w1": lp["w1"], "w2": lp["w2"]}
-    y = moe_layer(mp, hn.reshape(B * S, d), cfg.moe)
+    y = moe_layer(mp, hn.reshape(B * S, d), cfg.moe, ep_axis=ep_axis)
     return h + y.reshape(B, S, d)
 
 

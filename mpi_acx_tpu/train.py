@@ -32,20 +32,19 @@ from mpi_acx_tpu.parallel.pipeline import (pipeline_forward,
 from mpi_acx_tpu.parallel.ring_attention import ring_attention_batched
 
 
-def _block_sp_tp(cfg: tfm.TransformerConfig, lp: Dict[str, Any],
-                 h: jax.Array, tp_axis: str) -> jax.Array:
-    """Transformer block, sequence-parallel attention + tensor-parallel MLP.
-
-    h: [mb, S, d] replicated over tp. lp's w1/b1/w2 are the LOCAL tp slices
-    (shard_map hands us [d, ff/tp] etc.); wqkv/wo are replicated.
-    """
+def _gpt2_attn_sp(cfg, lp: Dict[str, Any], h: jax.Array,
+                  tp_axis: str) -> jax.Array:
+    """The GPT-2-layout attention half under sequence parallelism: each
+    tp rank projects q/k/v for ITS sequence block, ring attention rotates
+    K/V blocks on ICI, and the outputs are re-assembled with one
+    all_gather. Shared by the dense and MoE families (same ln1/wqkv/wo
+    leaf names)."""
     tpn = lax.axis_size(tp_axis)
     ti = lax.axis_index(tp_axis)
     mb, S, d = h.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     blk = S // tpn
 
-    # --- attention: shard the SEQUENCE over tp; ring-attend K/V blocks ---
     hn = tfm.layernorm(h, lp["ln1_g"], lp["ln1_b"])
     loc = lax.dynamic_slice_in_dim(hn, ti * blk, blk, axis=1)  # [mb,blk,d]
     qkv = loc @ lp["wqkv"].astype(h.dtype)
@@ -58,7 +57,17 @@ def _block_sp_tp(cfg: tfm.TransformerConfig, lp: Dict[str, Any],
     o = o @ lp["wo"].astype(h.dtype)
     # Re-assemble the full sequence on every tp rank.
     attn = lax.all_gather(o, tp_axis, axis=1, tiled=True)     # [mb, S, d]
-    h = h + attn
+    return h + attn
+
+
+def _block_sp_tp(cfg: tfm.TransformerConfig, lp: Dict[str, Any],
+                 h: jax.Array, tp_axis: str) -> jax.Array:
+    """Transformer block, sequence-parallel attention + tensor-parallel MLP.
+
+    h: [mb, S, d] replicated over tp. lp's w1/b1/w2 are the LOCAL tp slices
+    (shard_map hands us [d, ff/tp] etc.); wqkv/wo are replicated.
+    """
+    h = _gpt2_attn_sp(cfg, lp, h, tp_axis)
 
     # --- MLP: shard the FFN dim over tp; one psum to reduce ---
     hn = tfm.layernorm(h, lp["ln2_g"], lp["ln2_b"])
@@ -66,6 +75,33 @@ def _block_sp_tp(cfg: tfm.TransformerConfig, lp: Dict[str, Any],
                     lp["b1"].astype(h.dtype))                 # [mb,S,ff/tp]
     part = y @ lp["w2"].astype(h.dtype)
     return h + lax.psum(part, tp_axis) + lp["b2"].astype(h.dtype)
+
+
+def _moe_block_sp_tp(cfg, lp: Dict[str, Any], h: jax.Array,
+                     tp_axis: str) -> jax.Array:
+    """MoE-transformer block under the flagship composition: the GPT-2
+    attention half (sequence-parallel ring attention), then the routed
+    expert FFN with EXPERTS sharded over the tp axis — tokens stay
+    replicated over tp, and `moe_layer`'s all_to_all carries each rank's
+    dispatched activations to the rank owning their expert and back
+    (EP folded onto the tp mesh axis; BASELINE-style EP over ICI).
+
+    Tradeoff stated plainly: tokens are REPLICATED over tp here, so each
+    rank routes every token and expert-FFN FLOPs per rank equal the
+    single-device count — tp parallelizes expert WEIGHTS (memory) and
+    the attention/MLP halves, not expert compute. Routing each rank's
+    exclusive sequence block instead would divide expert rows by tp at
+    the price of per-block routing groups (different capacity
+    semantics); that variant is future work.
+
+    Router auxiliary losses are not threaded through the pipeline scan —
+    the dp(+ep) step in models/moe_transformer.py is the aux-regularized
+    trainer; this path is the pp x tp scale-out, documented CE-only.
+    """
+    from mpi_acx_tpu.models.moe_transformer import _moe_ffn
+
+    h = _gpt2_attn_sp(cfg, lp, h, tp_axis)
+    return _moe_ffn(cfg, lp, h, ep_axis=tp_axis)
 
 
 def _llama_block_sp_tp(cfg, lp: Dict[str, Any], h: jax.Array,
@@ -146,6 +182,23 @@ def llama_param_specs(stage: bool = True) -> Dict[str, Any]:
     }
 
 
+def moe_param_specs(stage: bool = True) -> Dict[str, Any]:
+    """PartitionSpecs for the stage-sliced MoE-transformer pytree: the
+    EXPERT dim of w1/w2 shards over 'tp' (EP on the tp mesh axis);
+    attention, norms, and the gate replicate per stage."""
+    pp = "pp" if stage else None
+    return {
+        "embed": P(), "pos": P(), "lnf_g": P(), "lnf_b": P(),
+        "layers": {
+            "ln1_g": P(pp), "ln1_b": P(pp),
+            "wqkv": P(pp), "wo": P(pp),
+            "ln2_g": P(pp), "ln2_b": P(pp),
+            "gate": P(pp),
+            "w1": P(pp, None, "tp"), "w2": P(pp, None, "tp"),
+        },
+    }
+
+
 class _Family:
     """Model-family adapter: everything make_loss_and_grads needs to run a
     family through the dp x pp x tp/sp composition."""
@@ -161,6 +214,7 @@ class _Family:
 
 def _family(cfg) -> _Family:
     from mpi_acx_tpu.models.llama import LlamaConfig, rmsnorm
+    from mpi_acx_tpu.models.moe_transformer import MoeTransformerConfig
 
     if isinstance(cfg, LlamaConfig):
         return _Family(
@@ -170,6 +224,16 @@ def _family(cfg) -> _Family:
             head=lambda p: p["unembed"],
             specs=llama_param_specs,
             tp_sharded=lambda k: k in ("w_gate", "w_up", "w_down"),
+        )
+    if isinstance(cfg, MoeTransformerConfig):
+        return _Family(
+            block=_moe_block_sp_tp,
+            embed=lambda p, c, t: (p["embed"][t] +
+                                   p["pos"][:t.shape[-1]]).astype(c.dtype),
+            final=lambda p, ys: tfm.layernorm(ys, p["lnf_g"], p["lnf_b"]),
+            head=lambda p: p["embed"],
+            specs=moe_param_specs,
+            tp_sharded=lambda k: k in ("w1", "w2"),
         )
     return _Family(
         block=_block_sp_tp,
@@ -215,6 +279,11 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
     """
     n_stages = mesh.shape["pp"]
     fam = _family(cfg)
+    from mpi_acx_tpu.models.moe_transformer import MoeTransformerConfig
+    if isinstance(cfg, MoeTransformerConfig):
+        assert cfg.n_experts % mesh.shape["tp"] == 0, (
+            f"n_experts ({cfg.n_experts}) must divide by the 'tp' mesh "
+            f"axis ({mesh.shape['tp']}) — experts shard over tp")
 
     def per_shard(params, tokens, targets):
         def loss_fn(params):
