@@ -17,24 +17,28 @@ from jax import lax
 
 
 def grouped_decode_attend(q, kc, vc, pos, max_len, n_rep):
-    """One-token grouped-query attention against an UN-REPEATED KV cache:
-    q [B, 1, Hq, D], kc/vc [B, max_len, Hkv, D] with Hq = Hkv*n_rep ->
-    o [B, 1, Hq*D]. Query head g*n_rep + r reads K/V group g directly —
-    no [B, L, Hq, D] materialization, preserving GQA's cache-bandwidth
-    win. With n_rep=1 this IS plain multi-head decode attention, so all
-    three families' decode steps and the tensor-parallel paths share
-    this single definition."""
-    B = q.shape[0]
+    """W-token grouped-query attention against an UN-REPEATED KV cache:
+    q [B, W, Hq, D] occupying positions pos..pos+W-1, kc/vc
+    [B, max_len, Hkv, D] with Hq = Hkv*n_rep -> o [B, W, Hq*D]. Query
+    head g*n_rep + r reads K/V group g directly — no [B, L, Hq, D]
+    materialization, preserving GQA's cache-bandwidth win; window row w
+    attends cache entries <= pos+w. With n_rep=1 this IS plain
+    multi-head attention and with W=1 the ordinary decode step, so every
+    decode path — the three families' steps, the tensor-parallel loops,
+    and the speculative window passes — shares this single definition of
+    the scale/mask/softmax math."""
+    B, W = q.shape[:2]
     Hkv, Dh = kc.shape[2], kc.shape[3]
-    qg = q.reshape(B, 1, Hkv, n_rep, Dh)
+    qg = q.reshape(B, W, Hkv, n_rep, Dh)
     logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, kc).astype(jnp.float32)
     logits = logits / jnp.sqrt(Dh)
-    mask = jnp.arange(max_len) <= pos
-    logits = jnp.where(mask[None, None, None, None], logits,
+    rows = pos + jnp.arange(W)[:, None]                # [W, 1]
+    cols = jnp.arange(max_len)[None, :]                # [1, max_len]
+    logits = jnp.where((cols <= rows)[None, None, None], logits,
                        jnp.finfo(jnp.float32).min)
     p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bgrqk,bkgd->bqgrd", p, vc).reshape(
-        B, 1, Hkv * n_rep * Dh)
+        B, W, Hkv * n_rep * Dh)
 
 
 def decode_layer_scan(layers, x, kc_all, vc_all, pos, qkv_fn, attend_fn):

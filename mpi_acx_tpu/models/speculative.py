@@ -43,8 +43,48 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from mpi_acx_tpu.models import llama as lm
 from mpi_acx_tpu.models import transformer as tfm
-from mpi_acx_tpu.models.decoding import decode_layer_scan
+from mpi_acx_tpu.models.decoding import (decode_layer_scan,
+                                          grouped_decode_attend)
+
+
+def _window_pass_llama(params, cfg, cache, tokens):
+    """Llama counterpart of :func:`_window_pass`: RoPE at the window's
+    absolute positions and grouped-query attention against the
+    un-repeated GQA cache (the W-token generalization of
+    decoding.grouped_decode_attend)."""
+    W = tokens.shape[1]
+    pos = cache["pos"]
+    max_len = cache["k"].shape[2]
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    x = params["embed"][tokens].astype(cfg.dtype)
+    positions = pos + jnp.arange(W)
+
+    def qkv_fn(lp, x, _pos):
+        return lm._qkv(cfg, lp, x, positions)
+
+    def attend_fn(lp, x, q, kc, vc, _pos):
+        o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep)
+        return lm._mlp(cfg, lp, x + o @ lp["wo"].astype(x.dtype))
+
+    x, ks, vs = decode_layer_scan(params["layers"], x, cache["k"],
+                                  cache["v"], pos, qkv_fn, attend_fn)
+    x = lm.rmsnorm(x, params["final_norm"])
+    logits = jnp.einsum("bsd,vd->bsv", x, params["unembed"].astype(x.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, {"k": ks, "v": vs, "pos": pos + W}
+
+
+def _family_ops(cfg):
+    """(prefill, decode_step, window_pass) for a config's model family."""
+    if isinstance(cfg, lm.LlamaConfig):
+        return lm.prefill, lm.decode_step, _window_pass_llama
+    if isinstance(cfg, tfm.TransformerConfig):
+        return tfm.prefill, tfm.decode_step, _window_pass
+    raise TypeError(
+        f"speculative decoding supports the GPT-2 and Llama families; "
+        f"got {type(cfg).__name__}")
 
 
 def _window_pass(params, cfg, cache, tokens):
@@ -52,7 +92,7 @@ def _window_pass(params, cfg, cache, tokens):
     positions pos..pos+W-1; returns (logits [1, W, vocab] f32, cache with
     pos advanced by W). Row w attends cache entries <= pos+w (the entries
     for this window are written before the attention reads them)."""
-    B, W = tokens.shape
+    W = tokens.shape[1]
     pos = cache["pos"]
     max_len = cache["k"].shape[2]
     x = (params["embed"][tokens]
@@ -63,15 +103,7 @@ def _window_pass(params, cfg, cache, tokens):
         return tfm._qkv(cfg, lp, x)                    # [1, W, H, Dh]
 
     def attend_fn(lp, x, q, kc, vc, pos):
-        s = jnp.einsum("bwhd,bkhd->bhwk", q, kc).astype(jnp.float32)
-        s = s / jnp.sqrt(cfg.head_dim)
-        rows = pos + jnp.arange(W)[:, None]            # [W, 1]
-        cols = jnp.arange(max_len)[None, :]            # [1, max_len]
-        s = jnp.where((cols <= rows)[None, None], s,
-                      jnp.finfo(jnp.float32).min)
-        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
-        o = jnp.einsum("bhwk,bkhd->bwhd", p, vc).reshape(
-            B, W, cfg.d_model)
+        o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep=1)
         return tfm._mlp(cfg, lp, x + o @ lp["wo"].astype(x.dtype))
 
     x, ks, vs = decode_layer_scan(params["layers"], x, cache["k"],
@@ -83,8 +115,7 @@ def _window_pass(params, cfg, cache, tokens):
 
 
 @functools.lru_cache(maxsize=64)
-def _build(draft_cfg: tfm.TransformerConfig, cfg: tfm.TransformerConfig,
-           S: int, n_new: int, k: int):
+def _build(draft_cfg, cfg, S: int, n_new: int, k: int):
     """One compiled speculative loop per (configs, shapes) — configs are
     frozen dataclasses, so they key the cache; repeat calls to
     :func:`speculative_generate` reuse the jitted program instead of
@@ -92,13 +123,15 @@ def _build(draft_cfg: tfm.TransformerConfig, cfg: tfm.TransformerConfig,
     cap = S + n_new + k                      # overshoot slack, last round
     assert cap <= cfg.max_seq and cap <= draft_cfg.max_seq, (
         cap, cfg.max_seq, draft_cfg.max_seq)
+    t_prefill, _t_decode, t_window = _family_ops(cfg)
+    d_prefill, d_decode, _ = _family_ops(draft_cfg)
 
     @jax.jit
     def run(draft_params, params, prompt):
-        t_logits, t_cache = tfm.prefill(params, cfg, prompt, cap,
-                                        last_only=True)
-        _, d_cache = tfm.prefill(draft_params, draft_cfg, prompt, cap,
-                                 last_only=True)
+        t_logits, t_cache = t_prefill(params, cfg, prompt, cap,
+                                      last_only=True)
+        _, d_cache = d_prefill(draft_params, draft_cfg, prompt, cap,
+                               last_only=True)
         pending = jnp.argmax(t_logits[:, -1], -1).astype(prompt.dtype)
 
         buf = jnp.zeros((1, cap), prompt.dtype)
@@ -126,8 +159,7 @@ def _build(draft_cfg: tfm.TransformerConfig, cfg: tfm.TransformerConfig,
             # before any query can see it.
             def dstep(carry, _):
                 cache, tok = carry
-                lg, cache = tfm.decode_step(draft_params, draft_cfg,
-                                            cache, tok)
+                lg, cache = d_decode(draft_params, draft_cfg, cache, tok)
                 nxt = jnp.argmax(lg, -1).astype(tok.dtype)
                 return (cache, nxt), nxt
 
@@ -137,7 +169,7 @@ def _build(draft_cfg: tfm.TransformerConfig, cfg: tfm.TransformerConfig,
 
             # -- target: one window pass over [pending, props] ----------
             window = jnp.concatenate([pending, props])[None]   # [1, k]
-            t_logits, t_cache = _window_pass(params, cfg, t_cache, window)
+            t_logits, t_cache = t_window(params, cfg, t_cache, window)
             targets = jnp.argmax(t_logits[0], -1).astype(
                 prompt.dtype)                            # [k]
             # targets[i] = target's token for position pos+i+1.
@@ -176,14 +208,17 @@ def _build(draft_cfg: tfm.TransformerConfig, cfg: tfm.TransformerConfig,
 
 
 def speculative_generate(
-    draft_params, draft_cfg: tfm.TransformerConfig,
-    params, cfg: tfm.TransformerConfig,
+    draft_params, draft_cfg, params, cfg,
     prompt: jax.Array, n_new: int, k: int = 4,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Greedy speculative decode (B=1 — it is a latency technique).
 
-    Returns ``(tokens [1, S + n_new], stats)`` where tokens EXACTLY equal
-    ``transformer.generate(params, cfg, prompt, n_new)`` and stats counts
+    cfg/draft_cfg select the model family per config type (GPT-2
+    TransformerConfig or LlamaConfig; the families may even be mixed, but
+    the vocabularies must match — asserted). Returns ``(tokens
+    [1, S + n_new], stats)`` where tokens equal the target family's
+    ``generate(params, cfg, prompt, n_new)`` (up to fp argmax ties, see
+    module docstring) and stats counts
     ``{"rounds": R, "drafted_accepted": A}`` — the target ran R window
     passes (vs n_new sequential steps for plain decode), and A of the
     R*(k-1) drafted tokens were accepted.
@@ -202,6 +237,9 @@ def speculative_generate(
     B, S = prompt.shape
     assert B == 1, "speculative decoding is per-sequence (B=1)"
     assert k >= 2, k
+    assert draft_cfg.vocab == cfg.vocab, (
+        f"draft/target vocabularies differ ({draft_cfg.vocab} vs "
+        f"{cfg.vocab}) — acceptance would be meaningless")
     run = _build(draft_cfg, cfg, S, n_new, k)
     tokens, rounds, acc = run(draft_params, params, prompt)
     return tokens, {"rounds": rounds, "drafted_accepted": acc}

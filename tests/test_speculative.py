@@ -124,3 +124,44 @@ def test_no_draft_cache_hole_at_full_acceptance():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
     rounds, acc = int(stats["rounds"]), int(stats["drafted_accepted"])
     assert acc == rounds * (k - 1), (acc, rounds)
+
+
+# -- Llama family ----------------------------------------------------------
+
+from mpi_acx_tpu.models import llama as lm
+
+
+def _lcfg(n_layers, n_kv=2, max_seq=128, vocab=64):
+    c = lm.tiny_llama(vocab=vocab, d_model=32, n_heads=4, n_kv_heads=n_kv,
+                      n_layers=n_layers, d_ff=64, max_seq=max_seq)
+    return lm.LlamaConfig(**{**c.__dict__, "dtype": jnp.float32})
+
+
+def test_llama_exact_match_random_draft():
+    """GQA window verification: output equals llama.generate exactly
+    for an unrelated random draft."""
+    cfg, dcfg = _lcfg(2), _lcfg(1)
+    params = lm.init_params(jax.random.key(0), cfg)
+    dparams = lm.init_params(jax.random.key(7), dcfg)
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab)
+    n_new, k = 20, 4
+    want = lm.generate(params, cfg, prompt, n_new,
+                       max_len=prompt.shape[1] + n_new + k)
+    got, stats = speculative_generate(dparams, dcfg, params, cfg, prompt,
+                                      n_new, k=k)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_llama_perfect_draft_full_acceptance():
+    cfg = _lcfg(2, max_seq=256)
+    params = lm.init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab)
+    n_new, k = 41, 4
+    want = lm.generate(params, cfg, prompt, n_new,
+                       max_len=prompt.shape[1] + n_new + k)
+    got, stats = speculative_generate(params, cfg, params, cfg, prompt,
+                                      n_new, k=k)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    rounds, acc = int(stats["rounds"]), int(stats["drafted_accepted"])
+    assert acc == rounds * (k - 1), (acc, rounds)
+    assert rounds <= -(-n_new // k) + 1, rounds
