@@ -153,6 +153,39 @@ def moe_layer(params: Dict[str, Any], x: jax.Array, cfg: MoeConfig,
     return y
 
 
+def moe_layer_replicated_ep(params: Dict[str, Any], x: jax.Array,
+                            cfg: MoeConfig, ep_axis: str) -> jax.Array:
+    """Expert parallelism for REPLICATED tokens (per-shard function).
+
+    When every rank already holds the same x [T, d] (the tensor-parallel
+    serving path and the flagship train step's blocks), the all_to_all
+    exchange is pure overhead: each rank can route all T tokens itself,
+    run only its LOCAL expert block, and let ONE psum assemble the
+    combined output — 1/ep the expert FLOPs per rank and one collective
+    per layer instead of two all_to_alls over redundant copies. The
+    dispatch/combine tensors are computed identically to the
+    single-device path, so routing (capacity, drops) is bit-equal.
+
+    Use :func:`moe_layer` with ``ep_axis`` when tokens are SHARDED (the
+    dp+ep training layout) — there the all_to_all moves real data.
+    """
+    T, d = x.shape
+    gates = x.astype(jnp.float32) @ params["gate"]
+    e_local = params["w1"].shape[0]
+    ep = lax.axis_size(ep_axis)
+    E = e_local * ep
+    cap = int(cfg.capacity_factor * T / E + 1)
+    dispatch, combine = _dispatch_tensors(gates, cap, cfg.top_k)  # [T,E,C]
+    e0 = lax.axis_index(ep_axis) * e_local
+    disp_l = lax.dynamic_slice_in_dim(dispatch, e0, e_local, axis=1)
+    comb_l = lax.dynamic_slice_in_dim(combine, e0, e_local, axis=1)
+    xin = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), disp_l)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin, params["w1"]))
+    out = jnp.einsum("ecf,efd->ecd", h, params["w2"])
+    part = jnp.einsum("ecd,tec->td", out, comb_l)
+    return lax.psum(part, ep_axis).astype(x.dtype)
+
+
 def moe_layer_and_aux(params: Dict[str, Any], x: jax.Array, cfg: MoeConfig,
                       ep_axis: str | None = None):
     """Like :func:`moe_layer` but also returns the training auxiliaries

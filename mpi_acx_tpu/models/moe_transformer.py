@@ -29,7 +29,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from mpi_acx_tpu.models import transformer as tfm
-from mpi_acx_tpu.models.moe import MoeConfig, moe_layer, moe_layer_and_aux
+from mpi_acx_tpu.models.moe import (MoeConfig, moe_layer,
+                                    moe_layer_and_aux,
+                                    moe_layer_replicated_ep)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -153,15 +155,22 @@ def loss_fn(params, cfg: MoeTransformerConfig, tokens, targets,
 
 
 def _moe_ffn(cfg: MoeTransformerConfig, lp: Dict[str, Any], h: jax.Array,
-             ep_axis: str | None = None):
+             ep_axis: str | None = None, replicated: bool = False):
     """The block's routed FFN on h [B, S, d] (token axis flattened for
-    the router), aux losses not needed: the inference path (ep_axis
-    None) and the distributed train step's expert-parallel path (train
-    ._moe_block_sp_tp passes its tp axis) share this one wrapper."""
+    the router), aux losses not needed — one wrapper for three callers:
+    single-device inference (ep_axis None), and with ``ep_axis`` set the
+    expert-parallel paths: ``replicated=True`` when h is replicated over
+    the axis (TP serving, the flagship train blocks — local expert
+    block + one psum, 1/ep the FLOPs), False when tokens are sharded
+    (all_to_all moves real data)."""
     B, S, d = h.shape
     hn = tfm.layernorm(h, lp["ln2_g"], lp["ln2_b"])
     mp = {"gate": lp["gate"], "w1": lp["w1"], "w2": lp["w2"]}
-    y = moe_layer(mp, hn.reshape(B * S, d), cfg.moe, ep_axis=ep_axis)
+    flat = hn.reshape(B * S, d)
+    if ep_axis is not None and replicated:
+        y = moe_layer_replicated_ep(mp, flat, cfg.moe, ep_axis)
+    else:
+        y = moe_layer(mp, flat, cfg.moe, ep_axis=ep_axis)
     return h + y.reshape(B, S, d)
 
 

@@ -45,9 +45,12 @@ from mpi_acx_tpu.parallel.quantized import (  # noqa: F401
 from mpi_acx_tpu.parallel.tp_inference import (  # noqa: F401
     make_tp_generate,
     make_tp_generate_llama,
+    make_tp_generate_moe,
     tp_param_specs,
     tp_param_specs_llama,
+    tp_param_specs_moe,
     tp_shard_params,
     tp_shard_params_llama,
+    tp_shard_params_moe,
 )
 from mpi_acx_tpu.parallel import multihost  # noqa: F401

@@ -132,10 +132,11 @@ def tp_param_specs(axis: str = "tp"):
     }
 
 
-def make_tp_generate(cfg: tfm.TransformerConfig, mesh: Mesh, n_new: int,
+def make_tp_generate(cfg, mesh: Mesh, n_new: int,
                      axis: str = "tp", temperature: float = 0.0,
                      top_k: Optional[int] = None,
-                     top_p: Optional[float] = None):
+                     top_p: Optional[float] = None,
+                     ffn=None, specs=None, shard_params=None):
     """Builds a jitted tensor-parallel ``generate(params, prompt, key) ->
     tokens [B, S + n_new]`` over the mesh's ``axis``.
 
@@ -143,18 +144,29 @@ def make_tp_generate(cfg: tfm.TransformerConfig, mesh: Mesh, n_new: int,
     cast_params output) — the TP re-layout happens inside the jit.
     ``temperature=0`` is greedy (key unused but still required, so the
     signature is stable across sampling configs).
+
+    ``ffn(lp, x) -> x`` overrides the per-layer feed-forward half (the
+    dense column/row-parallel MLP by default), with ``specs``/
+    ``shard_params`` overriding the weight layout to match — the GPT-2-
+    attention MoE family plugs in its expert-parallel FFN this way
+    (:func:`make_tp_generate_moe`), mirroring the single-device ffn hook
+    on tfm.prefill/decode_step.
     """
     tp = mesh.shape[axis]
     H, Dh, d = cfg.n_heads, cfg.head_dim, cfg.d_model
     assert H % tp == 0, (H, tp)
     Hl = H // tp
 
-    def mlp(lp, x):
+    def dense_mlp(lp, x):
         h = tfm.layernorm(x, lp["ln2_g"], lp["ln2_b"])
         y = jax.nn.gelu(h @ lp["w1"].astype(x.dtype)
                         + lp["b1"].astype(x.dtype))
         part = y @ lp["w2"].astype(x.dtype)
         return x + lax.psum(part, axis) + lp["b2"].astype(x.dtype)
+
+    mlp = ffn or dense_mlp
+    shard_params_fn = shard_params or tp_shard_params
+    specs = specs or tp_param_specs(axis)
 
     def local_qkv(lp, x):
         B, S, _ = x.shape
@@ -207,16 +219,64 @@ def make_tp_generate(cfg: tfm.TransformerConfig, mesh: Mesh, n_new: int,
             hooks, params["layers"], prompt, key, n_new,
             pick=_make_pick(temperature, top_k, top_p, prompt.dtype))
 
-    specs = tp_param_specs(axis)
     inner = shard_map(per_shard, mesh=mesh,
                       in_specs=(specs, P(), P()),
                       out_specs=P(), check_vma=False)
 
     @jax.jit
     def generate(params, prompt, key):
-        return inner(tp_shard_params(params, cfg), prompt, key)
+        return inner(shard_params_fn(params, cfg), prompt, key)
 
     return generate
+
+
+# -- MoE family (attention by head, experts over the same axis) ------------
+
+
+# Attention re-layout is exactly the dense family's (cfg duck-types);
+# expert tensors keep their layout — the [n_experts] dim shards directly.
+tp_shard_params_moe = tp_shard_params
+
+
+def tp_param_specs_moe(axis: str = "tp"):
+    return {
+        "embed": P(), "pos": P(), "lnf_g": P(), "lnf_b": P(),
+        "layers": {
+            "ln1_g": P(), "ln1_b": P(),
+            "wqkv": P(None, None, None, axis, None),
+            "wo": P(None, axis),
+            "ln2_g": P(), "ln2_b": P(), "gate": P(),
+            "w1": P(None, axis), "w2": P(None, axis),
+        },
+    }
+
+
+def make_tp_generate_moe(cfg, mesh: Mesh, n_new: int, axis: str = "tp",
+                         temperature: float = 0.0,
+                         top_k: Optional[int] = None,
+                         top_p: Optional[float] = None):
+    """Tensor-parallel MoE-transformer generation: the dense GPT-2
+    builder with the expert-parallel routed FFN plugged into its ffn
+    hook. Attention splits by head (two psums per layer); each rank
+    hosts ``n_experts/tp`` experts and, since tokens are replicated
+    after the attention psum, the replicated-EP path applies — every
+    rank routes all tokens but runs only its LOCAL expert block, one
+    psum assembling the output (1/tp the expert FLOPs; routing is
+    bit-equal to the single-device dispatch, same groups and
+    capacity)."""
+    from mpi_acx_tpu.models.moe_transformer import _moe_ffn
+
+    assert cfg.n_experts % mesh.shape[axis] == 0, (
+        cfg.n_experts, mesh.shape[axis])
+
+    def moe_ffn(lp, x):
+        return _moe_ffn(cfg, lp, x, ep_axis=axis, replicated=True)
+
+    return make_tp_generate(cfg, mesh, n_new, axis=axis,
+                            temperature=temperature, top_k=top_k,
+                            top_p=top_p, ffn=moe_ffn,
+                            specs=tp_param_specs_moe(axis),
+                            shard_params=tp_shard_params_moe)
 
 
 # -- Llama family (GQA: shard by KV-head group) ----------------------------

@@ -86,13 +86,12 @@ def _moe_block_sp_tp(cfg, lp: Dict[str, Any], h: jax.Array,
     dispatched activations to the rank owning their expert and back
     (EP folded onto the tp mesh axis; BASELINE-style EP over ICI).
 
-    Tradeoff stated plainly: tokens are REPLICATED over tp here, so each
-    rank routes every token and expert-FFN FLOPs per rank equal the
-    single-device count — tp parallelizes expert WEIGHTS (memory) and
-    the attention/MLP halves, not expert compute. Routing each rank's
-    exclusive sequence block instead would divide expert rows by tp at
-    the price of per-block routing groups (different capacity
-    semantics); that variant is future work.
+    Tokens are REPLICATED over tp here, so the replicated-EP path
+    applies: each rank routes all tokens but runs only its LOCAL expert
+    block, and one psum assembles the output — 1/tp the expert FLOPs
+    per rank and a single collective per layer
+    (moe.moe_layer_replicated_ep; routing is bit-equal to the
+    single-device dispatch).
 
     Router auxiliary losses are not threaded through the pipeline scan —
     the dp(+ep) step in models/moe_transformer.py is the aux-regularized
@@ -101,7 +100,7 @@ def _moe_block_sp_tp(cfg, lp: Dict[str, Any], h: jax.Array,
     from mpi_acx_tpu.models.moe_transformer import _moe_ffn
 
     h = _gpt2_attn_sp(cfg, lp, h, tp_axis)
-    return _moe_ffn(cfg, lp, h, ep_axis=tp_axis)
+    return _moe_ffn(cfg, lp, h, ep_axis=tp_axis, replicated=True)
 
 
 def _llama_block_sp_tp(cfg, lp: Dict[str, Any], h: jax.Array,
