@@ -1,0 +1,158 @@
+/* tpu-acx integration test: seeded randomized exercise of the MPIX
+ * surface ("fuzz"). Both ranks derive the SAME schedule from a shared
+ * seed (ACX_FUZZ_SEED env, default 12345), so every send has a matching
+ * receive; payloads are deterministic functions of (seed, round, slot,
+ * element) and verified byte-for-byte on arrival.
+ *
+ * Each round randomizes: message sizes (1 .. ~16K ints), tags, the number
+ * of in-flight op pairs, the ENQUEUE ORDER of sends vs receives, and the
+ * completion style (host MPIX_Wait vs stream MPIX_Waitall_enqueue).
+ * Every 4th round runs a partitioned exchange with a random partition
+ * count and a random Pready ORDER (out-of-order readiness is the
+ * reference's flagship semantics). The reference has no randomized
+ * tests at all (SURVEY.md §4 lists the gaps as TODOs to inherit-fix).
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <mpi.h>
+#include <mpi-acx.h>
+
+#define MAX_PAIRS 8
+#define MAX_ELEMS 16384
+#define ROUNDS 24
+
+static unsigned long long st;
+static unsigned rnd(void) {            /* xorshift64*, same on all ranks */
+    st ^= st >> 12; st ^= st << 25; st ^= st >> 27;
+    return (unsigned)((st * 2685821657736338717ULL) >> 33);
+}
+
+static int payload(unsigned seed, int round, int slot, int i) {
+    return (int)(seed ^ (round * 2654435761u) ^ (slot * 40503u) ^ i);
+}
+
+int main(int argc, char **argv) {
+    int provided, rank, size, errs = 0;
+    MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    if (MPIX_Init()) MPI_Abort(MPI_COMM_WORLD, 2);
+
+    const char *se = getenv("ACX_FUZZ_SEED");
+    unsigned seed = se ? (unsigned)strtoul(se, NULL, 10) : 12345u;
+    st = seed * 0x9E3779B97F4A7C15ULL + 1;
+    if (rank == 0) printf("fuzz: seed=%u rounds=%d\n", seed, ROUNDS);
+
+    const int right = (rank + 1) % size;
+    const int left = (rank + size - 1) % size;
+    static int sbuf[MAX_PAIRS][MAX_ELEMS], rbuf[MAX_PAIRS][MAX_ELEMS];
+
+    cudaStream_t stream;
+    cudaStreamCreate(&stream);
+
+    for (int round = 0; round < ROUNDS; round++) {
+        if (round % 4 == 3) {
+            /* -- partitioned round: random partitions, random Pready order */
+            int nparts = 1 + (int)(rnd() % 8);
+            int per = 1 + (int)(rnd() % 256);
+            int n = nparts * per;
+            MPIX_Request sreq, rreq;
+            MPIX_Psend_init(sbuf[0], nparts, per, MPI_INT, right, round,
+                            MPI_COMM_WORLD, MPI_INFO_NULL, &sreq);
+            MPIX_Precv_init(rbuf[0], nparts, per, MPI_INT, left, round,
+                            MPI_COMM_WORLD, MPI_INFO_NULL, &rreq);
+            int reps = 1 + (int)(rnd() % 3);     /* persistent restart */
+            for (int it = 0; it < reps; it++) {
+                /* Rep-dependent payload + cleared rbuf: every RESTART
+                 * must deliver fresh bytes, not coast on rep 0's. */
+                for (int i = 0; i < n; i++) {
+                    sbuf[0][i] = payload(seed, round, 0, i) ^ (it * 40961);
+                    rbuf[0][i] = -1;
+                }
+                MPIX_Request both[2] = {sreq, rreq};
+                MPIX_Startall(2, both);
+                /* Fisher-Yates over partition indices = random order. */
+                int order[8];
+                for (int p = 0; p < nparts; p++) order[p] = p;
+                for (int p = nparts - 1; p > 0; p--) {
+                    int j = (int)(rnd() % (unsigned)(p + 1));
+                    int t = order[p]; order[p] = order[j]; order[j] = t;
+                }
+                for (int p = 0; p < nparts; p++)
+                    MPIX_Pready(order[p], sreq);
+                MPI_Status stt[2];
+                MPIX_Waitall(2, both, stt);
+                for (int i = 0; i < n; i++) {
+                    if (rbuf[0][i] !=
+                        (payload(seed, round, 0, i) ^ (it * 40961))) {
+                        errs++;
+                        if (errs < 5)
+                            printf("[%d] r%d rep %d part elem %d: got %d\n",
+                                   rank, round, it, i, rbuf[0][i]);
+                        break;
+                    }
+                }
+            }
+            MPIX_Request_free(&sreq);
+            MPIX_Request_free(&rreq);
+            continue;
+        }
+
+        /* -- enqueued round: random pair count/sizes/order/wait style -- */
+        int pairs = 1 + (int)(rnd() % MAX_PAIRS);
+        int elems[MAX_PAIRS], tags[MAX_PAIRS];
+        for (int p = 0; p < pairs; p++) {
+            elems[p] = 1 + (int)(rnd() % MAX_ELEMS);
+            tags[p] = 100 + (int)(rnd() % 64) + 64 * p; /* unique per slot */
+            for (int i = 0; i < elems[p]; i++)
+                sbuf[p][i] = payload(seed, round, p, i);
+            for (int i = 0; i < elems[p]; i++) rbuf[p][i] = -1;
+        }
+        MPIX_Request reqs[2 * MAX_PAIRS];
+        int recv_first = (int)(rnd() % 2);
+        int wait_on_stream = (int)(rnd() % 2);
+        for (int pass = 0; pass < 2; pass++) {
+            int do_recv = (pass == 0) == (recv_first == 1);
+            for (int p = 0; p < pairs; p++) {
+                if (do_recv)
+                    MPIX_Irecv_enqueue(rbuf[p], elems[p], MPI_INT, left,
+                                       tags[p], MPI_COMM_WORLD,
+                                       &reqs[2 * p + 1],
+                                       MPIX_QUEUE_XLA_STREAM, &stream);
+                else
+                    MPIX_Isend_enqueue(sbuf[p], elems[p], MPI_INT, right,
+                                       tags[p], MPI_COMM_WORLD,
+                                       &reqs[2 * p],
+                                       MPIX_QUEUE_XLA_STREAM, &stream);
+            }
+        }
+        if (wait_on_stream) {
+            MPIX_Waitall_enqueue(2 * pairs, reqs, MPI_STATUSES_IGNORE,
+                                 MPIX_QUEUE_XLA_STREAM, &stream);
+            cudaStreamSynchronize(stream);
+        } else {
+            cudaStreamSynchronize(stream);     /* triggers fired */
+            MPIX_Waitall(2 * pairs, reqs, MPI_STATUSES_IGNORE);
+        }
+        for (int p = 0; p < pairs; p++) {
+            for (int i = 0; i < elems[p]; i++) {
+                if (rbuf[p][i] != payload(seed, round, p, i)) {
+                    errs++;
+                    if (errs < 5)
+                        printf("[%d] r%d pair %d elem %d: got %d want %d\n",
+                               rank, round, p, i, rbuf[p][i],
+                               payload(seed, round, p, i));
+                    break;
+                }
+            }
+        }
+    }
+
+    cudaStreamDestroy(stream);
+    MPIX_Finalize();
+    int total = 0;
+    MPI_Allreduce(&errs, &total, 1, MPI_INT, MPI_MAX, MPI_COMM_WORLD);
+    MPI_Finalize();
+    if (rank == 0) printf("fuzz: %s\n", total ? "FAILED" : "OK");
+    return total ? 1 : 0;
+}

@@ -99,18 +99,34 @@ def router_z_loss(gates: jax.Array) -> jax.Array:
     return jnp.mean(jax.nn.logsumexp(gates.astype(jnp.float32), -1) ** 2)
 
 
+def _route(params, x, cfg: MoeConfig, E: int):
+    """Shared routing prologue: (gates [T,E] f32, dispatch, combine, cap).
+    THE single source of the capacity formula and dispatch convention —
+    every MoE execution path (single-device, sharded-token all_to_all EP,
+    replicated-token EP) routes through here, which is what the
+    bit-equal-routing guarantees in their docstrings rest on."""
+    gates = x.astype(jnp.float32) @ params["gate"]
+    cap = int(cfg.capacity_factor * x.shape[0] / E + 1)
+    dispatch, combine = _dispatch_tensors(gates, cap, cfg.top_k)
+    return gates, dispatch, combine, cap
+
+
+def _expert_ffn(xin, params):
+    """The expert MLP body on [..., E?, C, d] queues (leading axes ride
+    einsum ellipses); one definition for every path."""
+    h = jax.nn.gelu(jnp.einsum("...ecd,edf->...ecf", xin, params["w1"]))
+    return jnp.einsum("...ecf,efd->...ecd", h, params["w2"])
+
+
 def _moe_forward(params, x, cfg: MoeConfig, ep_axis):
     """Shared forward: returns (y [T, d], gates [T, E] f32 logits)."""
     T, d = x.shape
-    gates = x.astype(jnp.float32) @ params["gate"]
     e_local = params["w1"].shape[0]
     if ep_axis is None:
         E = e_local
-        cap = int(cfg.capacity_factor * T / E + 1)
-        dispatch, combine = _dispatch_tensors(gates, cap, cfg.top_k)
+        gates, dispatch, combine, _ = _route(params, x, cfg, E)
         xin = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), dispatch)
-        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin, params["w1"]))
-        out = jnp.einsum("ecf,efd->ecd", h, params["w2"])
+        out = _expert_ffn(xin, params)
         return (jnp.einsum("ecd,tec->td", out, combine).astype(x.dtype),
                 gates)
 
@@ -118,8 +134,7 @@ def _moe_forward(params, x, cfg: MoeConfig, ep_axis):
     E = e_local * ep
     # Capacity is per dispatch group (this rank's T tokens) — the GShard
     # convention; with tokens sharded over ep, T here is the local count.
-    cap = int(cfg.capacity_factor * T / E + 1)
-    dispatch, combine = _dispatch_tensors(gates, cap, cfg.top_k)  # [T,E,C]
+    gates, dispatch, combine, cap = _route(params, x, cfg, E)
     xin = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), dispatch)
     # [E, C, d] -> [ep, E_local, C, d]; all_to_all swaps the ep axis with
     # the device axis so device j holds every sender's slice for ITS
@@ -127,8 +142,7 @@ def _moe_forward(params, x, cfg: MoeConfig, ep_axis):
     xin = xin.reshape(ep, e_local, cap, d)
     xin = lax.all_to_all(xin, ep_axis, split_axis=0, concat_axis=0,
                          tiled=False)
-    h = jax.nn.gelu(jnp.einsum("secd,edf->secf", xin, params["w1"]))
-    out = jnp.einsum("secf,efd->secd", h, params["w2"])
+    out = _expert_ffn(xin, params)
     # Route results back to their senders.
     out = lax.all_to_all(out, ep_axis, split_axis=0, concat_axis=0,
                          tiled=False)
@@ -170,18 +184,15 @@ def moe_layer_replicated_ep(params: Dict[str, Any], x: jax.Array,
     dp+ep training layout) — there the all_to_all moves real data.
     """
     T, d = x.shape
-    gates = x.astype(jnp.float32) @ params["gate"]
     e_local = params["w1"].shape[0]
     ep = lax.axis_size(ep_axis)
     E = e_local * ep
-    cap = int(cfg.capacity_factor * T / E + 1)
-    dispatch, combine = _dispatch_tensors(gates, cap, cfg.top_k)  # [T,E,C]
+    _, dispatch, combine, _ = _route(params, x, cfg, E)   # [T, E, C]
     e0 = lax.axis_index(ep_axis) * e_local
     disp_l = lax.dynamic_slice_in_dim(dispatch, e0, e_local, axis=1)
     comb_l = lax.dynamic_slice_in_dim(combine, e0, e_local, axis=1)
     xin = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), disp_l)
-    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin, params["w1"]))
-    out = jnp.einsum("ecf,efd->ecd", h, params["w2"])
+    out = _expert_ffn(xin, params)
     part = jnp.einsum("ecd,tec->td", out, comb_l)
     return lax.psum(part, ep_axis).astype(x.dtype)
 

@@ -81,10 +81,8 @@ def _moe_block_sp_tp(cfg, lp: Dict[str, Any], h: jax.Array,
                      tp_axis: str) -> jax.Array:
     """MoE-transformer block under the flagship composition: the GPT-2
     attention half (sequence-parallel ring attention), then the routed
-    expert FFN with EXPERTS sharded over the tp axis — tokens stay
-    replicated over tp, and `moe_layer`'s all_to_all carries each rank's
-    dispatched activations to the rank owning their expert and back
-    (EP folded onto the tp mesh axis; BASELINE-style EP over ICI).
+    expert FFN with EXPERTS sharded over the tp axis (EP folded onto the
+    tp mesh axis).
 
     Tokens are REPLICATED over tp here, so the replicated-EP path
     applies: each rank routes all tokens but runs only its LOCAL expert
