@@ -34,4 +34,5 @@ from mpi_acx_tpu.models import llama  # noqa: F401  (namespaced: llama.forward, 
 from mpi_acx_tpu.models import moe_transformer  # noqa: F401  (namespaced)
 from mpi_acx_tpu.models.speculative import (  # noqa: F401
     speculative_generate,
+    speculative_sample,
 )

@@ -113,13 +113,23 @@ def _window_pass(params, cfg, cache, tokens):
                         preferred_element_type=jnp.float32)
     return logits, {"k": ks, "v": vs, "pos": pos + W}
 
+def _make_run(draft_cfg, cfg, S, n_new, k, pick0, draft_pick, decide):
+    """The ONE speculative round skeleton (prefill, draft scan with the
+    k-th cache-seat step, window pass, buffer/cache bookkeeping,
+    while_loop) shared by the greedy and stochastic variants, which
+    differ only through three hooks:
 
-@functools.lru_cache(maxsize=64)
-def _build(draft_cfg, cfg, S: int, n_new: int, k: int):
-    """One compiled speculative loop per (configs, shapes) — configs are
-    frozen dataclasses, so they key the cache; repeat calls to
-    :func:`speculative_generate` reuse the jitted program instead of
-    re-tracing (a fresh inner jit per call costs seconds of compile)."""
+    pick0(logits [1,V], key) -> pending [1]      (first token)
+    draft_pick(lg [1,V], key) -> nxt [1]         (proposal choice)
+    decide(props [k-1], q_logits [k-1,V], p_logits [k,V], key)
+        -> (emit [k], m, pending [1])            (accept + finalize)
+
+    Cache invariants (identical for both variants): the draft runs k
+    steps so full-acceptance rounds leave no unwritten cache seat; stale
+    entries sit at >= the rolled-back pos and are rewritten before any
+    query can see them; buffer garbage past slot m is overwritten next
+    round or trimmed by the final ``buf[:, :S + n_new]``.
+    """
     cap = S + n_new + k                      # overshoot slack, last round
     assert cap <= cfg.max_seq and cap <= draft_cfg.max_seq, (
         cap, cfg.max_seq, draft_cfg.max_seq)
@@ -127,84 +137,166 @@ def _build(draft_cfg, cfg, S: int, n_new: int, k: int):
     d_prefill, d_decode, _ = _family_ops(draft_cfg)
 
     @jax.jit
-    def run(draft_params, params, prompt):
+    def run(draft_params, params, prompt, key):
         t_logits, t_cache = t_prefill(params, cfg, prompt, cap,
                                       last_only=True)
         _, d_cache = d_prefill(draft_params, draft_cfg, prompt, cap,
                                last_only=True)
-        pending = jnp.argmax(t_logits[:, -1], -1).astype(prompt.dtype)
+        key, k0 = jax.random.split(key)
+        pending = pick0(t_logits[:, -1], k0).astype(prompt.dtype)
 
         buf = jnp.zeros((1, cap), prompt.dtype)
         buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
         buf = lax.dynamic_update_slice(buf, pending[:, None], (0, S))
 
         # State: (n_emitted_after_prompt, pending token, caches, buf,
-        # rounds, accepted). `pending` sits at position S+n-1... by the
-        # decode convention the pending token occupies pos and is not in
-        # any cache yet.
+        # key, rounds, accepted). By the decode convention the pending
+        # token occupies cache pos = S + n - 1 and is in no cache yet.
         def cond(state):
             n, *_ = state
             return n < n_new
 
         def body(state):
-            n, pending, d_cache, t_cache, buf, rounds, acc = state
+            n, pending, d_cache, t_cache, buf, key, rounds, acc = state
+            key, kd, kdec = jax.random.split(key, 3)
 
-            # -- draft: k cached greedy steps; the first k-1 outputs are
-            # the proposals. The k-th step exists to WRITE the draft's
-            # cache entry for position P+k-1 (the last proposal's seat):
-            # at full acceptance the next round starts past it and would
-            # otherwise leave a permanent zero hole the draft attends to
-            # forever. At partial acceptance the extra entry is stale but
-            # sits at >= the rolled-back pos, so later rounds rewrite it
-            # before any query can see it.
-            def dstep(carry, _):
+            def dstep(carry, skey):
                 cache, tok = carry
                 lg, cache = d_decode(draft_params, draft_cfg, cache, tok)
-                nxt = jnp.argmax(lg, -1).astype(tok.dtype)
-                return (cache, nxt), nxt
+                nxt = draft_pick(lg, skey).astype(tok.dtype)
+                return (cache, nxt), (nxt, lg[0])
 
-            (d_cache, _), props = lax.scan(
-                dstep, (d_cache, pending), None, length=k)
-            props = props[:k - 1, 0]                     # [k-1]
+            (d_cache, _), (props_all, q_logits) = lax.scan(
+                dstep, (d_cache, pending), jax.random.split(kd, k))
+            props = props_all[:k - 1, 0]                   # [k-1]
 
-            # -- target: one window pass over [pending, props] ----------
             window = jnp.concatenate([pending, props])[None]   # [1, k]
             t_logits, t_cache = t_window(params, cfg, t_cache, window)
-            targets = jnp.argmax(t_logits[0], -1).astype(
-                prompt.dtype)                            # [k]
-            # targets[i] = target's token for position pos+i+1.
 
-            # -- accept the longest matching prefix ---------------------
-            matches = props == targets[:k - 1]           # [k-1]
-            m = jnp.argmin(
-                jnp.concatenate([matches, jnp.zeros((1,), bool)]))
-            m = m.astype(jnp.int32)                      # 0..k-1 accepted
-            bonus = targets[m]
-            # The emitted tokens for positions P+1..P+m+1 are exactly
-            # targets[0..m] (accepted proposals equal the target chain,
-            # and targets[m] is the bonus/correction). Write the whole
-            # window — entries past m are garbage that the next round
+            emit, m, pending = decide(props, q_logits[:k - 1],
+                                      t_logits[0], kdec)
+            emit = emit.astype(prompt.dtype)
+            pending = pending.astype(prompt.dtype)
+            # Entries of emit past slot m are garbage the next round
             # overwrites before the final trim can expose them.
-            buf = lax.dynamic_update_slice(buf, targets[None], (0, S + n))
+            buf = lax.dynamic_update_slice(buf, emit[None], (0, S + n))
 
-            emitted = m + 1
-            n = n + emitted
-            # Roll both caches to the new pending position: pending now
-            # sits at S + n - 1... i.e. cache pos = S + n - 1.
+            n = n + m + 1
+            # Roll both caches to the new pending position S + n - 1.
             newpos = jnp.asarray(S, jnp.int32) + n - 1
             d_cache = dict(d_cache, pos=newpos)
             t_cache = dict(t_cache, pos=newpos)
-            pending = bonus[None]
-            return (n, pending, d_cache, t_cache, buf, rounds + 1,
+            return (n, pending, d_cache, t_cache, buf, key, rounds + 1,
                     acc + m)
 
         state = (jnp.asarray(1, jnp.int32), pending, d_cache, t_cache,
-                 buf, jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32))
-        n, pending, d_cache, t_cache, buf, rounds, acc = lax.while_loop(
-            cond, body, state)
+                 buf, key, jnp.asarray(0, jnp.int32),
+                 jnp.asarray(0, jnp.int32))
+        n, pending, d_cache, t_cache, buf, key, rounds, acc = \
+            lax.while_loop(cond, body, state)
         return buf[:, :S + n_new], rounds, acc
 
     return run
+
+
+@functools.lru_cache(maxsize=64)
+def _build(draft_cfg, cfg, S: int, n_new: int, k: int):
+    """Compiled GREEDY speculative loop: argmax proposals, the longest
+    prefix matching the target's argmax chain accepted, the target's
+    argmax as the bonus/correction. One compiled program per (configs,
+    shapes) — the configs are frozen dataclasses, so they key the
+    lru_cache and repeat calls are trace-free. The public wrapper passes
+    a dummy key (the greedy hooks ignore randomness)."""
+    def pick0(logits, key):
+        return jnp.argmax(logits, -1)
+
+    def draft_pick(lg, key):
+        return jnp.argmax(lg, -1)
+
+    def decide(props, q_logits, t_logits, key):
+        targets = jnp.argmax(t_logits, -1).astype(props.dtype)   # [k]
+        matches = props == targets[:k - 1]
+        m = jnp.argmin(jnp.concatenate([matches, jnp.zeros((1,), bool)]))
+        m = m.astype(jnp.int32)
+        # Emitted tokens are exactly targets[0..m] (accepted proposals
+        # equal the target chain; targets[m] is the bonus/correction).
+        return targets, m, targets[m][None]
+
+    return _make_run(draft_cfg, cfg, S, n_new, k, pick0, draft_pick,
+                     decide)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_sample(draft_cfg, cfg, S: int, n_new: int, k: int,
+                  temperature: float):
+    """Compiled STOCHASTIC speculative loop (the Leviathan/Chen
+    accept/resample algorithm): proposals are SAMPLED from the draft at
+    ``temperature``, each accepted with probability min(1, p(x)/q(x))
+    under the target's distribution p and the draft's q; on rejection
+    the token is resampled from normalize(max(p - q, 0)). Every emitted
+    token is therefore distributed EXACTLY as target-only sampling at
+    the same temperature (the algorithm's defining guarantee —
+    tests/test_speculative.py checks the two-token joint distribution
+    against exact teacher-forced target probabilities)."""
+    assert temperature > 0.0, temperature
+    inv_t = 1.0 / temperature
+
+    def pick0(logits, key):
+        return jax.random.categorical(key, logits * inv_t, axis=-1)
+
+    def draft_pick(lg, key):
+        return jax.random.categorical(key, lg * inv_t, axis=-1)
+
+    def decide(props, q_logits, t_logits, key):
+        ka, kr = jax.random.split(key)
+        q = jax.nn.softmax(q_logits * inv_t, -1)       # [k-1, V]
+        p = jax.nn.softmax(t_logits * inv_t, -1)       # [k, V]
+        # Accept x_i with prob min(1, p_i(x_i)/q_i(x_i)).
+        idx = props.astype(jnp.int32)
+        p_x = jnp.take_along_axis(p[:k - 1], idx[:, None], 1)[:, 0]
+        q_x = jnp.take_along_axis(q, idx[:, None], 1)[:, 0]
+        u = jax.random.uniform(ka, (k - 1,))
+        accept = u * q_x < p_x                         # [k-1]
+        m = jnp.argmin(jnp.concatenate([accept, jnp.zeros((1,), bool)]))
+        m = m.astype(jnp.int32)                        # accepted count
+        # Final token: on rejection at slot m, resample from the
+        # residual (p_m - q_m)^+; at full acceptance, a free sample
+        # from p_{k-1}.
+        p_m = p[m]
+        q_m = q[jnp.minimum(m, k - 2)]
+        residual = jnp.where(m < k - 1,
+                             jnp.maximum(p_m - q_m, 0.0), p_m)
+        # All-zero residual (p <= q everywhere, numerically) falls back
+        # to p_m — distribution-correct when p == q.
+        residual = jnp.where(residual.sum() > 0, residual, p_m)
+        y = jax.random.categorical(kr, jnp.log(residual + 1e-30))
+        emit = jnp.concatenate([props, jnp.zeros((1,), props.dtype)])
+        emit = lax.dynamic_update_slice(
+            emit, y[None].astype(props.dtype), (m,))
+        return emit, m, y[None]
+
+    return _make_run(draft_cfg, cfg, S, n_new, k, pick0, draft_pick,
+                     decide)
+
+
+def speculative_sample(
+    draft_params, draft_cfg, params, cfg,
+    prompt: jax.Array, n_new: int, key: jax.Array, k: int = 4,
+    temperature: float = 1.0,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Stochastic speculative decode (B=1): same round structure as
+    :func:`speculative_generate` but with SAMPLED proposals and the
+    accept/resample rule, so every emitted token follows the target's
+    sampling distribution at ``temperature`` exactly — the draft changes
+    only latency, never the distribution. Returns ``(tokens, stats)``
+    like the greedy variant."""
+    B, S = prompt.shape
+    assert B == 1, "speculative decoding is per-sequence (B=1)"
+    assert k >= 2, k
+    assert draft_cfg.vocab == cfg.vocab, (draft_cfg.vocab, cfg.vocab)
+    run = _build_sample(draft_cfg, cfg, S, n_new, k, float(temperature))
+    tokens, rounds, acc = run(draft_params, params, prompt, key)
+    return tokens, {"rounds": rounds, "drafted_accepted": acc}
 
 
 def speculative_generate(
@@ -241,5 +333,6 @@ def speculative_generate(
         f"draft/target vocabularies differ ({draft_cfg.vocab} vs "
         f"{cfg.vocab}) — acceptance would be meaningless")
     run = _build(draft_cfg, cfg, S, n_new, k)
-    tokens, rounds, acc = run(draft_params, params, prompt)
+    tokens, rounds, acc = run(draft_params, params, prompt,
+                              jax.random.key(0))   # hooks ignore it
     return tokens, {"rounds": rounds, "drafted_accepted": acc}
