@@ -78,20 +78,33 @@ def _window_pass_llama(params, cfg, cache, tokens):
 
 def _family_ops(cfg):
     """(prefill, decode_step, window_pass) for a config's model family."""
+    from mpi_acx_tpu.models.moe_transformer import (MoeTransformerConfig,
+                                                    _moe_ffn)
+
     if isinstance(cfg, lm.LlamaConfig):
         return lm.prefill, lm.decode_step, _window_pass_llama
+    if isinstance(cfg, MoeTransformerConfig):
+        # The MoE family rides the GPT-2 scaffold with its routed FFN
+        # plugged into every pass (same hook as prefill/decode_step).
+        return (functools.partial(tfm.prefill, ffn=_moe_ffn),
+                functools.partial(tfm.decode_step, ffn=_moe_ffn),
+                functools.partial(_window_pass, ffn=_moe_ffn))
     if isinstance(cfg, tfm.TransformerConfig):
         return tfm.prefill, tfm.decode_step, _window_pass
     raise TypeError(
-        f"speculative decoding supports the GPT-2 and Llama families; "
-        f"got {type(cfg).__name__}")
+        f"speculative decoding supports the GPT-2, Llama, and "
+        f"MoE-transformer families; got {type(cfg).__name__}")
 
 
-def _window_pass(params, cfg, cache, tokens):
+def _window_pass(params, cfg, cache, tokens, ffn=None):
     """Process a W-token window against the cache: tokens [1, W] occupy
     positions pos..pos+W-1; returns (logits [1, W, vocab] f32, cache with
     pos advanced by W). Row w attends cache entries <= pos+w (the entries
-    for this window are written before the attention reads them)."""
+    for this window are written before the attention reads them).
+    ``ffn(cfg, lp, x) -> x`` overrides the feed-forward half, exactly as
+    on tfm.prefill/decode_step — the MoE family plugs in its routed FFN.
+    """
+    ffn = ffn or tfm._mlp
     W = tokens.shape[1]
     pos = cache["pos"]
     max_len = cache["k"].shape[2]
@@ -104,7 +117,7 @@ def _window_pass(params, cfg, cache, tokens):
 
     def attend_fn(lp, x, q, kc, vc, pos):
         o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep=1)
-        return tfm._mlp(cfg, lp, x + o @ lp["wo"].astype(x.dtype))
+        return ffn(cfg, lp, x + o @ lp["wo"].astype(x.dtype))
 
     x, ks, vs = decode_layer_scan(params["layers"], x, cache["k"],
                                   cache["v"], pos, qkv_fn, attend_fn)
@@ -279,6 +292,22 @@ def _build_sample(draft_cfg, cfg, S: int, n_new: int, k: int,
                      decide)
 
 
+def _check_moe_target(cfg):
+    """An MoE TARGET must be in the drop-free capacity regime: the window
+    pass routes k tokens as ONE dispatch group while plain decode routes
+    1, so with tight capacity a popular expert could drop tokens in one
+    pass and not the other — silently breaking the exactness guarantees.
+    capacity_factor >= n_experts makes every group drop-free (each
+    expert can seat every token). A MoE DRAFT needs no guard: it only
+    shapes acceptance, never the emitted distribution."""
+    from mpi_acx_tpu.models.moe_transformer import MoeTransformerConfig
+    if isinstance(cfg, MoeTransformerConfig):
+        assert cfg.capacity_factor >= cfg.n_experts, (
+            f"MoE speculative target needs drop-free routing "
+            f"(capacity_factor {cfg.capacity_factor} < n_experts "
+            f"{cfg.n_experts}); see moe_transformer.decode_step")
+
+
 def speculative_sample(
     draft_params, draft_cfg, params, cfg,
     prompt: jax.Array, n_new: int, key: jax.Array, k: int = 4,
@@ -294,6 +323,7 @@ def speculative_sample(
     assert B == 1, "speculative decoding is per-sequence (B=1)"
     assert k >= 2, k
     assert draft_cfg.vocab == cfg.vocab, (draft_cfg.vocab, cfg.vocab)
+    _check_moe_target(cfg)
     run = _build_sample(draft_cfg, cfg, S, n_new, k, float(temperature))
     tokens, rounds, acc = run(draft_params, params, prompt, key)
     return tokens, {"rounds": rounds, "drafted_accepted": acc}
@@ -306,8 +336,10 @@ def speculative_generate(
     """Greedy speculative decode (B=1 — it is a latency technique).
 
     cfg/draft_cfg select the model family per config type (GPT-2
-    TransformerConfig or LlamaConfig; the families may even be mixed, but
-    the vocabularies must match — asserted). Returns ``(tokens
+    TransformerConfig, LlamaConfig, or MoeTransformerConfig — an MoE
+    target additionally requires drop-free capacity, see
+    _check_moe_target; the families may be mixed freely, but the
+    vocabularies must match — asserted). Returns ``(tokens
     [1, S + n_new], stats)`` where tokens equal the target family's
     ``generate(params, cfg, prompt, n_new)`` (up to fp argmax ties, see
     module docstring) and stats counts
@@ -332,6 +364,7 @@ def speculative_generate(
     assert draft_cfg.vocab == cfg.vocab, (
         f"draft/target vocabularies differ ({draft_cfg.vocab} vs "
         f"{cfg.vocab}) — acceptance would be meaningless")
+    _check_moe_target(cfg)
     run = _build(draft_cfg, cfg, S, n_new, k)
     tokens, rounds, acc = run(draft_params, params, prompt,
                               jax.random.key(0))   # hooks ignore it

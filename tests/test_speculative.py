@@ -240,3 +240,57 @@ def test_speculative_sample_reproducible_and_valid():
     body = np.asarray(a)
     assert ((0 <= body) & (body < cfg.vocab)).all()
     assert int(sa["rounds"]) <= 20
+
+
+# -- MoE family ------------------------------------------------------------
+
+from mpi_acx_tpu.models import moe_transformer as mtf
+import dataclasses
+
+
+def _mcfg(n_layers, max_seq=128, vocab=64):
+    c = mtf.tiny_moe_config(vocab=vocab, d_model=32, n_heads=2,
+                            n_layers=n_layers, d_ff=64, n_experts=4,
+                            top_k=2, capacity_factor=4.0, max_seq=max_seq)
+    return dataclasses.replace(c, dtype=jnp.float32)
+
+
+def test_moe_exact_match_random_draft():
+    """MoE target with a dense GPT-2 draft: output equals mtf.generate
+    exactly (drop-free capacity, so the window's k-token routing group
+    equals the stepwise per-token routing)."""
+    cfg = _mcfg(2)
+    dcfg = _cfg(1)
+    params = mtf.init_params(jax.random.key(0), cfg)
+    dparams = tfm.init_params(jax.random.key(7), dcfg)
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab)
+    n_new, k = 18, 4
+    want = mtf.generate(params, cfg, prompt, n_new,
+                        max_len=prompt.shape[1] + n_new + k)
+    got, _ = speculative_generate(dparams, dcfg, params, cfg, prompt,
+                                  n_new, k=k)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_moe_perfect_draft_full_acceptance():
+    cfg = _mcfg(2, max_seq=256)
+    params = mtf.init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab)
+    n_new, k = 41, 4
+    want = mtf.generate(params, cfg, prompt, n_new,
+                        max_len=prompt.shape[1] + n_new + k)
+    got, stats = speculative_generate(params, cfg, params, cfg, prompt,
+                                      n_new, k=k)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    rounds, acc = int(stats["rounds"]), int(stats["drafted_accepted"])
+    assert acc == rounds * (k - 1), (acc, rounds)
+
+
+def test_moe_target_tight_capacity_rejected():
+    """An MoE target outside the drop-free regime is rejected with a
+    clear message (window-vs-stepwise routing groups could diverge)."""
+    cfg = dataclasses.replace(_mcfg(2), capacity_factor=2.0)  # < E=4
+    params = mtf.init_params(jax.random.key(0), cfg)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(AssertionError, match="drop-free"):
+        speculative_generate(params, cfg, params, cfg, prompt, 4)
