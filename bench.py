@@ -44,6 +44,8 @@ BASELINE_GPT2_FWD_B16S512_TOKS = 377_600.0  # saturating shape (r3)
 BASELINE_FLASH_SPEEDUP_4096 = 2.4
 BASELINE_DECODE_TOKS = 2_700.0
 BASELINE_TRAIN_TOKS = 78_000.0  # device-side scan-loop measurement (r3)
+# Deterministic (CPU-compiled HLO) — measured 3.88x; gate below it.
+BASELINE_QUANT_TRAFFIC_REDUCTION = 3.5
 
 # v5e bf16 peak: 197 TFLOP/s per chip (public spec).
 V5E_BF16_PEAK_FLOPS = 197e12
@@ -64,18 +66,19 @@ def native_bench():
     return float(m.group(1)), float(m.group(2))
 
 
-def _run_tpu_child(mode: str, attempts: int = 3, timeout: int = 420):
+def _run_tpu_child(mode: str, attempts: int = 3, timeout: int = 420,
+                   child_flag: str = "tpu-child", env: dict | None = None):
     if attempts < 1:
         return None, "skipped (previous TPU child exhausted its retries)"
-    """Run `bench.py --tpu-child-<mode>` in a fresh process, retrying on
-    failure/hang. Returns (parsed dict | None, last_error | None)."""
+    """Run `bench.py --<child_flag>-<mode>` in a fresh process, retrying
+    on failure/hang. Returns (parsed dict | None, last_error | None)."""
     last = None
     for i in range(attempts):
         try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
-                 f"--tpu-child-{mode}"],
-                capture_output=True, text=True, timeout=timeout)
+                 f"--{child_flag}-{mode}"],
+                env=env, capture_output=True, text=True, timeout=timeout)
             for line in r.stdout.splitlines():
                 if line.startswith("{"):
                     return json.loads(line), None
@@ -303,6 +306,68 @@ def tpu_child_spec():
     }))
 
 
+def cpu_child_quant():
+    """Child process (forced CPU, 8 virtual devices): wire-byte ratio of
+    the int8-quantized ring all-reduce vs an f32 ring with the identical
+    schedule, counted from collective-permute payload types in the
+    compiled HLO. Deterministic — no chip, no weather — so the driver's
+    artifact carries a perf-design metric even when the TPU tunnel is
+    down."""
+    import re as _re
+    import jax
+    # This child is CPU by definition: pin unconditionally so a direct
+    # `bench.py --cpu-child-quant` invocation cannot block in the pinned
+    # accelerator plugin's init loop (the round-2 dryrun failure mode).
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from mpi_acx_tpu.parallel import mesh_from_devices
+    from mpi_acx_tpu.parallel.quantized import ring_psum
+
+    n, SZ = 8, 131072
+    mesh = mesh_from_devices({"x": n}, jax.devices()[:n])
+
+    def wire_bytes(fn):
+        f = shard_map(fn, mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+                      check_vma=False)
+        txt = jax.jit(f).lower(
+            jnp.zeros((n, SZ), jnp.float32)).compile().as_text()
+        per = {"u8": 1, "s8": 1, "pred": 1, "bf16": 2, "f16": 2,
+               "f32": 4, "s32": 4}
+        total = 0
+        for mm in _re.finditer(
+                r"(u8|s8|pred|f32|s32|bf16|f16)\[([\d,]*)\]\S* "
+                r"collective-permute", txt):
+            cnt = 1
+            for d in mm.group(2).split(","):
+                if d:
+                    cnt *= int(d)
+            total += cnt * per[mm.group(1)]
+        return total
+
+    # Numerator and denominator share ONE ring skeleton
+    # (quantized.ring_psum), so the comparison cannot silently drift.
+    bq = wire_bytes(lambda v: ring_psum(v[0], "x", quantize=True)[None])
+    be = wire_bytes(lambda v: ring_psum(v[0], "x", quantize=False)[None])
+    print(json.dumps({
+        "quant_allreduce_wire_bytes": bq,
+        "exact_ring_wire_bytes": be,
+        "quant_allreduce_traffic_reduction": round(be / max(bq, 1), 2),
+    }))
+
+
+def _run_cpu_child(mode: str, timeout: int = 300):
+    """_run_tpu_child with a forced 8-virtual-device CPU backend (the
+    pinned axon platform must never initialize here)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return _run_tpu_child(mode, attempts=1, timeout=timeout,
+                          child_flag="cpu-child", env=env)
+
+
 def main(full: bool = False):
     p50, bw = native_bench()
     out = {
@@ -328,6 +393,13 @@ def main(full: bool = False):
             fwd["gpt2_fwd_tokens_per_s"] / BASELINE_GPT2_FWD_TOKS, 3)
     else:
         out["tpu_error"] = err     # LOUD: never silently drop the metric
+
+    # Deterministic, chip-independent design metric (CPU-compiled HLO).
+    qb, qerr = _run_cpu_child("quant")
+    if qb is not None:
+        out.update(qb)
+    else:
+        out["quant_bytes_error"] = qerr
 
     checks = []
     if full:
@@ -375,6 +447,9 @@ def main(full: bool = False):
         gate("train_step_tokens_per_s",
              (sec or {}).get("train_step_tokens_per_s"),
              BASELINE_TRAIN_TOKS)
+        gate("quant_allreduce_traffic_reduction",
+             (qb or {}).get("quant_allreduce_traffic_reduction"),
+             BASELINE_QUANT_TRAFFIC_REDUCTION)
         out["regressions"] = [c["metric"] for c in checks if not c["ok"]]
         with open(os.path.join(REPO, "BENCH_FULL.json"), "w") as f:
             json.dump({"checks": checks, "result": out}, f, indent=1)
@@ -385,7 +460,9 @@ def main(full: bool = False):
 
 
 if __name__ == "__main__":
-    if "--tpu-child-fwd" in sys.argv:
+    if "--cpu-child-quant" in sys.argv:
+        cpu_child_quant()
+    elif "--tpu-child-fwd" in sys.argv:
         tpu_child_fwd()
     elif "--tpu-child-full" in sys.argv:
         tpu_child_full()

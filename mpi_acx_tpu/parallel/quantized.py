@@ -47,6 +47,75 @@ def _quant(x: jax.Array, qmax: float):
     return q, s
 
 
+def ring_psum(x: jax.Array, axis_name: str,
+              quantize: bool = True) -> jax.Array:
+    """Ring reduce-scatter + all-gather all-reduce (per-shard function).
+
+    ``quantize=True`` sends every hop as int8 + per-block f32 scales
+    (~4x less wire traffic, the EQuARX scheme); ``quantize=False`` sends
+    raw f32 — the EXACT all-reduce on the IDENTICAL hop schedule, which
+    is what bench.py's wire-byte comparison measures against (one
+    skeleton, so the two variants cannot silently diverge).
+    """
+    n = lax.axis_size(axis_name)
+    qmax = 127.0
+    r = lax.axis_index(axis_name)
+    ring = [(i, (i + 1) % n) for i in range(n)]
+
+    shape, size = x.shape, x.size
+    c = -(-size // n)                                   # ceil chunk size
+    c = -(-c // _BLOCK) * _BLOCK                        # round to blocks
+    flat = jnp.zeros((n * c,), jnp.float32).at[:size].set(
+        x.astype(jnp.float32).reshape(-1))
+    acc = flat.reshape(n, c)
+
+    def hop(chunk):
+        """Encode, permute one step along the ring, decode -> [c] f32."""
+        if not quantize:
+            return lax.ppermute(chunk, axis_name, ring)
+        q, s = _quant(chunk, qmax)
+        q = lax.ppermute(q, axis_name, ring)
+        s = lax.ppermute(s, axis_name, ring)
+        return (q * s).reshape(-1)
+
+    # -- reduce-scatter: n-1 hops; after step t, the chunk each rank
+    # just accumulated holds t+2 ranks' contributions. Rank r ends
+    # owning the fully reduced chunk (r + 1) mod n.
+    for t in range(n - 1):
+        si = (r - t) % n                                # traced index
+        chunk = lax.dynamic_slice_in_dim(acc, si, 1, 0)[0]
+        got = hop(chunk)
+        ri = (r - t - 1) % n
+        upd = lax.dynamic_slice_in_dim(acc, ri, 1, 0)[0] + got
+        acc = lax.dynamic_update_slice_in_dim(acc, upd[None], ri, 0)
+
+    owned = (r + 1) % n
+    reduced = lax.dynamic_slice_in_dim(acc, owned, 1, 0)[0]
+
+    # -- all-gather: every rank broadcasts its reduced chunk around the
+    # ring, encoded ONCE (the owner also keeps the decode-of-encode
+    # value so all ranks hold bit-identical results).
+    if quantize:
+        q, s = _quant(reduced, qmax)
+        cur = (q * s).reshape(-1)
+    else:
+        q = s = None
+        cur = reduced
+    out = jnp.zeros((n, c), jnp.float32)
+    out = lax.dynamic_update_slice_in_dim(out, cur[None], owned, 0)
+    for t in range(1, n):
+        if quantize:
+            q = lax.ppermute(q, axis_name, ring)
+            s = lax.ppermute(s, axis_name, ring)
+            cur = (q * s).reshape(-1)
+        else:
+            cur = lax.ppermute(cur, axis_name, ring)
+        idx = (r - t + 1) % n
+        out = lax.dynamic_update_slice_in_dim(out, cur[None], idx, 0)
+
+    return out.reshape(-1)[:size].reshape(shape)
+
+
 def quantized_psum(x: jax.Array, axis_name: str, bits: int = 8) -> jax.Array:
     """All-reduce-sum of ``x`` over ``axis_name`` with int8-quantized ring
     hops (per-shard function — call inside shard_map). Returns f32 of
@@ -64,50 +133,7 @@ def quantized_psum(x: jax.Array, axis_name: str, bits: int = 8) -> jax.Array:
         # serialized hops would move MORE bytes at MORE latency than the
         # exact all-reduce — fall back to it (also exact, a bonus).
         return lax.psum(x.astype(jnp.float32), axis_name)
-    qmax = float(2 ** (bits - 1) - 1)
-    r = lax.axis_index(axis_name)
-    ring = [(i, (i + 1) % n) for i in range(n)]
-
-    shape, size = x.shape, x.size
-    c = -(-size // n)                                   # ceil chunk size
-    c = -(-c // _BLOCK) * _BLOCK                        # round to blocks
-    flat = jnp.zeros((n * c,), jnp.float32).at[:size].set(
-        x.astype(jnp.float32).reshape(-1))
-    acc = flat.reshape(n, c)
-
-    def send_recv(q, s):
-        return (lax.ppermute(q, axis_name, ring),
-                lax.ppermute(s, axis_name, ring))
-
-    # -- reduce-scatter: n-1 quantized hops; after step t, the chunk each
-    # rank just accumulated holds t+2 ranks' contributions. Rank r ends
-    # owning the fully reduced chunk (r + 1) mod n.
-    for t in range(n - 1):
-        si = (r - t) % n                                # traced index
-        chunk = lax.dynamic_slice_in_dim(acc, si, 1, 0)[0]
-        q, s = send_recv(*_quant(chunk, qmax))
-        ri = (r - t - 1) % n
-        upd = (lax.dynamic_slice_in_dim(acc, ri, 1, 0)[0]
-               + (q * s).reshape(-1))
-        acc = lax.dynamic_update_slice_in_dim(acc, upd[None], ri, 0)
-
-    owned = (r + 1) % n
-    reduced = lax.dynamic_slice_in_dim(acc, owned, 1, 0)[0]
-
-    # -- all-gather: every rank broadcasts its reduced chunk around the
-    # ring, quantized ONCE (the owner also keeps the dequantized-quantized
-    # value so all ranks hold bit-identical results).
-    q, s = _quant(reduced, qmax)
-    out = jnp.zeros((n, c), jnp.float32)
-    out = lax.dynamic_update_slice_in_dim(
-        out, (q * s).reshape(1, c), owned, 0)
-    for t in range(1, n):
-        q, s = send_recv(q, s)
-        idx = (r - t + 1) % n
-        out = lax.dynamic_update_slice_in_dim(
-            out, (q * s).reshape(1, c), idx, 0)
-
-    return out.reshape(-1)[:size].reshape(shape)
+    return ring_psum(x, axis_name, quantize=True)
 
 
 def quantized_pmean(x: jax.Array, axis_name: str, bits: int = 8):
