@@ -41,6 +41,7 @@ from mpi_acx_tpu.parallel.ulysses import (  # noqa: F401
 from mpi_acx_tpu.parallel.quantized import (  # noqa: F401
     quantized_pmean,
     quantized_psum,
+    ring_psum,
 )
 from mpi_acx_tpu.parallel.tp_inference import (  # noqa: F401
     make_tp_generate,
