@@ -68,10 +68,10 @@ def native_bench():
 
 def _run_tpu_child(mode: str, attempts: int = 3, timeout: int = 420,
                    child_flag: str = "tpu-child", env: dict | None = None):
-    if attempts < 1:
-        return None, "skipped (previous TPU child exhausted its retries)"
     """Run `bench.py --<child_flag>-<mode>` in a fresh process, retrying
     on failure/hang. Returns (parsed dict | None, last_error | None)."""
+    if attempts < 1:
+        return None, "skipped (previous TPU child exhausted its retries)"
     last = None
     for i in range(attempts):
         try:

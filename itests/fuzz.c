@@ -27,8 +27,12 @@ static unsigned rnd(void) {            /* xorshift64*, same on all ranks */
     return (unsigned)((st * 2685821657736338717ULL) >> 33);
 }
 
-static int payload(unsigned seed, int round, int slot, int i) {
-    return (int)(seed ^ (round * 2654435761u) ^ (slot * 40503u) ^ i);
+/* src is the SENDING rank: mixing it in makes cross-rank misrouting
+ * (right round/slot, wrong source) visible to the verifier, which
+ * checks against its left neighbor's rank. */
+static int payload(unsigned seed, int round, int slot, int i, int src) {
+    return (int)(seed ^ (round * 2654435761u) ^ (slot * 40503u) ^ i
+                 ^ (src * 0x85EBCA6Bu));
 }
 
 int main(int argc, char **argv) {
@@ -41,7 +45,14 @@ int main(int argc, char **argv) {
     const char *se = getenv("ACX_FUZZ_SEED");
     unsigned seed = se ? (unsigned)strtoul(se, NULL, 10) : 12345u;
     st = seed * 0x9E3779B97F4A7C15ULL + 1;
-    if (rank == 0) printf("fuzz: seed=%u rounds=%d\n", seed, ROUNDS);
+    /* Negative control: with ACX_FUZZ_CANARY=1, rank 0 deliberately
+     * corrupts one received element in round 0 and the run SUCCEEDS
+     * only if the verifier catches it — proving the harness can see
+     * corruption, not just confirm clean runs. */
+    const char *ce = getenv("ACX_FUZZ_CANARY");
+    int canary = ce && atoi(ce);
+    if (rank == 0) printf("fuzz: seed=%u rounds=%d canary=%d\n",
+                          seed, ROUNDS, canary);
 
     const int right = (rank + 1) % size;
     const int left = (rank + size - 1) % size;
@@ -66,7 +77,8 @@ int main(int argc, char **argv) {
                 /* Rep-dependent payload + cleared rbuf: every RESTART
                  * must deliver fresh bytes, not coast on rep 0's. */
                 for (int i = 0; i < n; i++) {
-                    sbuf[0][i] = payload(seed, round, 0, i) ^ (it * 40961);
+                    sbuf[0][i] = payload(seed, round, 0, i, rank)
+                                 ^ (it * 40961);
                     rbuf[0][i] = -1;
                 }
                 MPIX_Request both[2] = {sreq, rreq};
@@ -84,7 +96,7 @@ int main(int argc, char **argv) {
                 MPIX_Waitall(2, both, stt);
                 for (int i = 0; i < n; i++) {
                     if (rbuf[0][i] !=
-                        (payload(seed, round, 0, i) ^ (it * 40961))) {
+                        (payload(seed, round, 0, i, left) ^ (it * 40961))) {
                         errs++;
                         if (errs < 5)
                             printf("[%d] r%d rep %d part elem %d: got %d\n",
@@ -105,7 +117,7 @@ int main(int argc, char **argv) {
             elems[p] = 1 + (int)(rnd() % MAX_ELEMS);
             tags[p] = 100 + (int)(rnd() % 64) + 64 * p; /* unique per slot */
             for (int i = 0; i < elems[p]; i++)
-                sbuf[p][i] = payload(seed, round, p, i);
+                sbuf[p][i] = payload(seed, round, p, i, rank);
             for (int i = 0; i < elems[p]; i++) rbuf[p][i] = -1;
         }
         MPIX_Request reqs[2 * MAX_PAIRS];
@@ -134,14 +146,16 @@ int main(int argc, char **argv) {
             cudaStreamSynchronize(stream);     /* triggers fired */
             MPIX_Waitall(2 * pairs, reqs, MPI_STATUSES_IGNORE);
         }
+        if (canary && round == 0 && rank == 0)
+            rbuf[0][0] ^= 0x5A5A5A5A;
         for (int p = 0; p < pairs; p++) {
             for (int i = 0; i < elems[p]; i++) {
-                if (rbuf[p][i] != payload(seed, round, p, i)) {
+                if (rbuf[p][i] != payload(seed, round, p, i, left)) {
                     errs++;
                     if (errs < 5)
                         printf("[%d] r%d pair %d elem %d: got %d want %d\n",
                                rank, round, p, i, rbuf[p][i],
-                               payload(seed, round, p, i));
+                               payload(seed, round, p, i, left));
                     break;
                 }
             }
@@ -153,6 +167,9 @@ int main(int argc, char **argv) {
     int total = 0;
     MPI_Allreduce(&errs, &total, 1, MPI_INT, MPI_MAX, MPI_COMM_WORLD);
     MPI_Finalize();
-    if (rank == 0) printf("fuzz: %s\n", total ? "FAILED" : "OK");
-    return total ? 1 : 0;
+    int failed = canary ? (total == 0) : (total != 0);
+    if (rank == 0)
+        printf("fuzz: %s%s\n", failed ? "FAILED" : "OK",
+               canary ? " (canary)" : "");
+    return failed ? 1 : 0;
 }
