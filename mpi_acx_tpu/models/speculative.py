@@ -292,6 +292,40 @@ def _build_sample(draft_cfg, cfg, S: int, n_new: int, k: int,
                      decide)
 
 
+@functools.lru_cache(maxsize=64)
+def _build_batched(draft_cfg, cfg, S: int, n_new: int, k: int,
+                   temperature):
+    """Batched speculative loop as ``vmap`` of the single-sequence
+    program (temperature None = greedy, else stochastic).
+
+    Rows advance INDEPENDENTLY: each row is the complete B=1
+    ``lax.while_loop`` round loop, and JAX's while_loop batching rule
+    lifts the batch to ONE loop that runs while any row is active,
+    select-guarding every row's carry by its own predicate — a finished
+    row's buffer, caches, and stats stop changing while the stragglers
+    run on. Per-row cache positions, buffer offsets, and acceptance
+    counts fall out of the same rule (the scalar ``pos`` becomes a [B]
+    vector, the dynamic updates become scatters). This is the TPU-first
+    answer to per-row speculative state that CUDA serving stacks
+    hand-schedule: the transform, not the kernel, carries the
+    bookkeeping. Masked work on finished rows is the usual batched-
+    speculation cost and is bounded by the slowest row's round count.
+    """
+    if temperature is None:
+        run = _build(draft_cfg, cfg, S, n_new, k)
+    else:
+        run = _build_sample(draft_cfg, cfg, S, n_new, k, temperature)
+
+    @jax.jit
+    def runb(draft_params, params, prompts, keys):
+        tokens, rounds, acc = jax.vmap(
+            lambda row, kk: run(draft_params, params, row[None], kk)
+        )(prompts, keys)
+        return tokens[:, 0], rounds, acc
+
+    return runb
+
+
 def _check_moe_target(cfg):
     """An MoE TARGET must be in the drop-free capacity regime: the window
     pass routes k tokens as ONE dispatch group while plain decode routes
@@ -313,19 +347,27 @@ def speculative_sample(
     prompt: jax.Array, n_new: int, key: jax.Array, k: int = 4,
     temperature: float = 1.0,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Stochastic speculative decode (B=1): same round structure as
+    """Stochastic speculative decode: same round structure as
     :func:`speculative_generate` but with SAMPLED proposals and the
     accept/resample rule, so every emitted token follows the target's
     sampling distribution at ``temperature`` exactly — the draft changes
     only latency, never the distribution. Returns ``(tokens, stats)``
-    like the greedy variant."""
+    like the greedy variant; at B > 1 each row samples under its own
+    fold of ``key`` and the stats are per-row (see
+    :func:`speculative_generate`)."""
     B, S = prompt.shape
-    assert B == 1, "speculative decoding is per-sequence (B=1)"
     assert k >= 2, k
     assert draft_cfg.vocab == cfg.vocab, (draft_cfg.vocab, cfg.vocab)
     _check_moe_target(cfg)
-    run = _build_sample(draft_cfg, cfg, S, n_new, k, float(temperature))
-    tokens, rounds, acc = run(draft_params, params, prompt, key)
+    if B == 1:
+        run = _build_sample(draft_cfg, cfg, S, n_new, k,
+                            float(temperature))
+        tokens, rounds, acc = run(draft_params, params, prompt, key)
+    else:
+        runb = _build_batched(draft_cfg, cfg, S, n_new, k,
+                              float(temperature))
+        tokens, rounds, acc = runb(draft_params, params, prompt,
+                                   jax.random.split(key, B))
     return tokens, {"rounds": rounds, "drafted_accepted": acc}
 
 
@@ -333,19 +375,24 @@ def speculative_generate(
     draft_params, draft_cfg, params, cfg,
     prompt: jax.Array, n_new: int, k: int = 4,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Greedy speculative decode (B=1 — it is a latency technique).
+    """Greedy speculative decode.
 
     cfg/draft_cfg select the model family per config type (GPT-2
     TransformerConfig, LlamaConfig, or MoeTransformerConfig — an MoE
     target additionally requires drop-free capacity, see
     _check_moe_target; the families may be mixed freely, but the
     vocabularies must match — asserted). Returns ``(tokens
-    [1, S + n_new], stats)`` where tokens equal the target family's
-    ``generate(params, cfg, prompt, n_new)`` (up to fp argmax ties, see
-    module docstring) and stats counts
+    [B, S + n_new], stats)`` where each row of tokens equals the target
+    family's ``generate(params, cfg, prompt, n_new)`` on that row (up to
+    fp argmax ties, see module docstring) and stats counts
     ``{"rounds": R, "drafted_accepted": A}`` — the target ran R window
     passes (vs n_new sequential steps for plain decode), and A of the
-    R*(k-1) drafted tokens were accepted.
+    R*(k-1) drafted tokens were accepted. At B == 1 both stats are
+    scalars; at B > 1 they are per-row [B] vectors and rows advance
+    independently through the vmap-lifted loop (see
+    :func:`_build_batched`) — each row's output and stats are those of
+    its own B=1 run, while wall-clock is bounded by the slowest row
+    (finished rows ride along masked until the batch drains).
 
     Each round: the draft runs ``k-1`` cached greedy steps from the
     pending token; the target scores the pending token plus the k-1
@@ -359,13 +406,18 @@ def speculative_generate(
     so repeat calls with the same shapes are trace-free.
     """
     B, S = prompt.shape
-    assert B == 1, "speculative decoding is per-sequence (B=1)"
     assert k >= 2, k
     assert draft_cfg.vocab == cfg.vocab, (
         f"draft/target vocabularies differ ({draft_cfg.vocab} vs "
         f"{cfg.vocab}) — acceptance would be meaningless")
     _check_moe_target(cfg)
-    run = _build(draft_cfg, cfg, S, n_new, k)
-    tokens, rounds, acc = run(draft_params, params, prompt,
-                              jax.random.key(0))   # hooks ignore it
+    if B == 1:
+        run = _build(draft_cfg, cfg, S, n_new, k)
+        tokens, rounds, acc = run(draft_params, params, prompt,
+                                  jax.random.key(0))   # hooks ignore it
+    else:
+        runb = _build_batched(draft_cfg, cfg, S, n_new, k, None)
+        tokens, rounds, acc = runb(
+            draft_params, params, prompt,
+            jax.random.split(jax.random.key(0), B))    # hooks ignore it
     return tokens, {"rounds": rounds, "drafted_accepted": acc}

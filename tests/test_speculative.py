@@ -98,12 +98,71 @@ def test_trained_draft_accepts_most():
     assert acc >= rounds, (rounds, acc)
 
 
-def test_batch_rejected():
+def test_batched_rows_match_single_row_runs():
+    """B=8: every row of the batched greedy decode equals BOTH the
+    target-only greedy decode of that row and the B=1 speculative run of
+    that row, and each row's round count matches its own B=1 run — rows
+    advance independently through the vmap-lifted loop; neighbors cannot
+    change a row's output or its pace.
+
+    The batch is GENUINELY mixed-pace (asserted): with these seeds the
+    per-row round counts span 4..9, so a regression that couples rows —
+    e.g. stats not select-guarded, every row reporting the slowest row's
+    rounds — cannot hide behind uniform acceptance."""
     cfg = _cfg(2)
+    dcfg = _cfg(1)
     params = tfm.init_params(jax.random.key(0), cfg)
-    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab)
-    with pytest.raises(AssertionError):
-        speculative_generate(params, cfg, params, cfg, prompt, 4)
+    dparams = tfm.init_params(jax.random.key(7), dcfg)
+    B, n_new, k = 8, 16, 4
+    prompts = jax.random.randint(jax.random.key(1), (B, 8), 0, cfg.vocab)
+
+    got, stats = speculative_generate(dparams, dcfg, params, cfg,
+                                      prompts, n_new, k=k)
+    assert got.shape == (B, 8 + n_new)
+    assert stats["rounds"].shape == (B,)
+    rounds = [int(r) for r in stats["rounds"]]
+    assert len(set(rounds)) > 1, rounds      # really mixed pace
+    for b in range(B):
+        row = prompts[b:b + 1]
+        want = tfm.generate(params, cfg, row, n_new,
+                            max_len=8 + n_new + k)
+        np.testing.assert_array_equal(np.asarray(got[b:b + 1]),
+                                      np.asarray(want))
+        solo, sstats = speculative_generate(dparams, dcfg, params, cfg,
+                                            row, n_new, k=k)
+        np.testing.assert_array_equal(np.asarray(got[b:b + 1]),
+                                      np.asarray(solo))
+        assert int(stats["rounds"][b]) == int(sstats["rounds"])
+        assert (int(stats["drafted_accepted"][b])
+                == int(sstats["drafted_accepted"]))
+
+
+def test_batched_sample_rows_match_single_row_subkey_runs():
+    """Batched STOCHASTIC decode: row b of the B>1 call must equal the
+    B=1 ``speculative_sample`` run with ``jax.random.split(key, B)[b]``
+    — the documented per-row key fold — proving the vmapped while_loop
+    select-guards the stochastic carry (keys, residual resampling, buf)
+    exactly as the greedy one."""
+    from mpi_acx_tpu.models.speculative import speculative_sample
+    cfg = _cfg(2)
+    dcfg = _cfg(1)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    dparams = tfm.init_params(jax.random.key(7), dcfg)
+    B, n_new, k = 4, 12, 4
+    prompts = jax.random.randint(jax.random.key(2), (B, 8), 0, cfg.vocab)
+    key = jax.random.key(11)
+
+    got, stats = speculative_sample(dparams, dcfg, params, cfg, prompts,
+                                    n_new, key, k=k, temperature=0.9)
+    assert got.shape == (B, 8 + n_new)
+    subkeys = jax.random.split(key, B)
+    for b in range(B):
+        solo, sstats = speculative_sample(dparams, dcfg, params, cfg,
+                                          prompts[b:b + 1], n_new,
+                                          subkeys[b], k=k, temperature=0.9)
+        np.testing.assert_array_equal(np.asarray(got[b:b + 1]),
+                                      np.asarray(solo))
+        assert int(stats["rounds"][b]) == int(sstats["rounds"])
 
 
 def test_no_draft_cache_hole_at_full_acceptance():
