@@ -30,6 +30,7 @@ from mpi_acx_tpu.parallel.ring_attention import (  # noqa: F401
     blockwise_attention_reference,
 )
 from mpi_acx_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_1f1b_loss_and_grads,
     pipeline_forward,
     pipeline_forward_interleaved,
     pipeline_loss,
