@@ -167,6 +167,24 @@ def moe_layer(params: Dict[str, Any], x: jax.Array, cfg: MoeConfig,
     return y
 
 
+def _moe_forward_replicated(params, x, cfg: MoeConfig, ep_axis):
+    """Replicated-token EP forward: returns (y [T, d], gates [T, E] f32)
+    — the shared body of :func:`moe_layer_replicated_ep` and its
+    aux-returning twin."""
+    T, d = x.shape
+    e_local = params["w1"].shape[0]
+    ep = lax.axis_size(ep_axis)
+    E = e_local * ep
+    gates, dispatch, combine, _ = _route(params, x, cfg, E)  # [T, E, C]
+    e0 = lax.axis_index(ep_axis) * e_local
+    disp_l = lax.dynamic_slice_in_dim(dispatch, e0, e_local, axis=1)
+    comb_l = lax.dynamic_slice_in_dim(combine, e0, e_local, axis=1)
+    xin = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), disp_l)
+    out = _expert_ffn(xin, params)
+    part = jnp.einsum("ecd,tec->td", out, comb_l)
+    return lax.psum(part, ep_axis).astype(x.dtype), gates
+
+
 def moe_layer_replicated_ep(params: Dict[str, Any], x: jax.Array,
                             cfg: MoeConfig, ep_axis: str) -> jax.Array:
     """Expert parallelism for REPLICATED tokens (per-shard function).
@@ -183,18 +201,53 @@ def moe_layer_replicated_ep(params: Dict[str, Any], x: jax.Array,
     Use :func:`moe_layer` with ``ep_axis`` when tokens are SHARDED (the
     dp+ep training layout) — there the all_to_all moves real data.
     """
-    T, d = x.shape
-    e_local = params["w1"].shape[0]
+    y, _ = _moe_forward_replicated(params, x, cfg, ep_axis)
+    return y
+
+
+def moe_layer_replicated_ep_and_aux(params: Dict[str, Any], x: jax.Array,
+                                    cfg: MoeConfig, ep_axis: str):
+    """:func:`moe_layer_replicated_ep` plus the training auxiliaries
+    (computed from the full replicated gates, so every rank holds the
+    same aux values — gate/contribute them on ONE rank per replication
+    group when assembling an exclusive-path loss)."""
+    y, gates = _moe_forward_replicated(params, x, cfg, ep_axis)
+    return y, {"load_balance": load_balance_loss(gates, cfg.top_k),
+               "router_z": router_z_loss(gates)}
+
+
+def moe_layer_sharded_dispatch(params: Dict[str, Any], x: jax.Array,
+                               cfg: MoeConfig, ep_axis: str) -> jax.Array:
+    """REAL expert-parallel dispatch for REPLICATED tokens (per-shard
+    function): the serving-side counterpart of the training EP path.
+
+    Where :func:`moe_layer_replicated_ep` has every rank route and
+    dispatch ALL T tokens (only the expert FLOPs shard), here each rank
+    takes its EXCLUSIVE T/ep token slice, routes just those, and the
+    capacity-bounded ``all_to_all`` machinery of :func:`moe_layer`
+    carries them to their expert's rank and back — per-rank routed token
+    counts genuinely shard (router + dispatch/combine einsums drop from
+    T to T/ep tokens per rank). One ``all_gather`` re-replicates the
+    outputs for the next attention block.
+
+    Capacity is per dispatch group (each rank's T/ep tokens), so in the
+    drop-free regime (``capacity_factor >= n_experts``, the serving
+    guard) outputs are token-identical to the single-device layer; with
+    tight capacity the drop pattern is per-group, exactly like the dp+ep
+    training layout. Requires ``T % ep == 0`` (shapes are static — this
+    raises at trace time).
+    """
     ep = lax.axis_size(ep_axis)
-    E = e_local * ep
-    _, dispatch, combine, _ = _route(params, x, cfg, E)   # [T, E, C]
-    e0 = lax.axis_index(ep_axis) * e_local
-    disp_l = lax.dynamic_slice_in_dim(dispatch, e0, e_local, axis=1)
-    comb_l = lax.dynamic_slice_in_dim(combine, e0, e_local, axis=1)
-    xin = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), disp_l)
-    out = _expert_ffn(xin, params)
-    part = jnp.einsum("ecd,tec->td", out, comb_l)
-    return lax.psum(part, ep_axis).astype(x.dtype)
+    T, d = x.shape
+    if T % ep != 0:
+        raise ValueError(
+            f"sharded EP dispatch needs tokens ({T}) % ep ({ep}) == 0; "
+            f"use moe_layer_replicated_ep for indivisible shapes")
+    Tl = T // ep
+    r = lax.axis_index(ep_axis)
+    xl = lax.dynamic_slice_in_dim(x, r * Tl, Tl, axis=0)
+    yl = moe_layer(params, xl, cfg, ep_axis=ep_axis)
+    return lax.all_gather(yl, ep_axis, axis=0, tiled=True)
 
 
 def moe_layer_and_aux(params: Dict[str, Any], x: jax.Array, cfg: MoeConfig,

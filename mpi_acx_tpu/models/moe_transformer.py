@@ -155,23 +155,44 @@ def loss_fn(params, cfg: MoeTransformerConfig, tokens, targets,
 
 
 def _moe_ffn(cfg: MoeTransformerConfig, lp: Dict[str, Any], h: jax.Array,
-             ep_axis: str | None = None, replicated: bool = False):
+             ep_axis: str | None = None, replicated: bool = False,
+             sharded_dispatch: bool = False, with_aux: bool = False):
     """The block's routed FFN on h [B, S, d] (token axis flattened for
-    the router), aux losses not needed — one wrapper for three callers:
-    single-device inference (ep_axis None), and with ``ep_axis`` set the
-    expert-parallel paths: ``replicated=True`` when h is replicated over
-    the axis (TP serving, the flagship train blocks — local expert
-    block + one psum, 1/ep the FLOPs), False when tokens are sharded
-    (all_to_all moves real data)."""
+    the router) — one wrapper for every caller: single-device inference
+    (ep_axis None), and with ``ep_axis`` set the expert-parallel paths:
+    ``replicated=True`` when h is replicated over the axis and every
+    rank should route all tokens (the flagship train blocks — local
+    expert block + one psum, 1/ep the FLOPs); ``sharded_dispatch=True``
+    when h is replicated but each rank should route only its exclusive
+    1/ep token slice through the training path's all_to_all (the TP
+    serving default, moe.moe_layer_sharded_dispatch); neither when
+    tokens are already sharded (all_to_all moves real data).
+    ``with_aux=True`` additionally returns this router's
+    ``(load_balance, router_z)`` pair for training losses."""
+    from mpi_acx_tpu.models.moe import moe_layer_and_aux, \
+        moe_layer_replicated_ep_and_aux, moe_layer_sharded_dispatch
+    assert not (replicated and sharded_dispatch)
     B, S, d = h.shape
     hn = tfm.layernorm(h, lp["ln2_g"], lp["ln2_b"])
     mp = {"gate": lp["gate"], "w1": lp["w1"], "w2": lp["w2"]}
     flat = hn.reshape(B * S, d)
-    if ep_axis is not None and replicated:
-        y = moe_layer_replicated_ep(mp, flat, cfg.moe, ep_axis)
+    if ep_axis is not None and sharded_dispatch:
+        assert not with_aux, "aux needs full gates; use replicated"
+        y = moe_layer_sharded_dispatch(mp, flat, cfg.moe, ep_axis)
+    elif ep_axis is not None and replicated:
+        if with_aux:
+            y, aux = moe_layer_replicated_ep_and_aux(mp, flat, cfg.moe,
+                                                     ep_axis)
+        else:
+            y = moe_layer_replicated_ep(mp, flat, cfg.moe, ep_axis)
+    elif with_aux:
+        y, aux = moe_layer_and_aux(mp, flat, cfg.moe, ep_axis=ep_axis)
     else:
         y = moe_layer(mp, flat, cfg.moe, ep_axis=ep_axis)
-    return h + y.reshape(B, S, d)
+    out = h + y.reshape(B, S, d)
+    if with_aux:
+        return out, (aux["load_balance"], aux["router_z"])
+    return out
 
 
 def init_kv_cache(cfg: MoeTransformerConfig, batch: int, max_len: int):

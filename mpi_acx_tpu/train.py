@@ -78,7 +78,7 @@ def _block_sp_tp(cfg: tfm.TransformerConfig, lp: Dict[str, Any],
 
 
 def _moe_block_sp_tp(cfg, lp: Dict[str, Any], h: jax.Array,
-                     tp_axis: str) -> jax.Array:
+                     tp_axis: str):
     """MoE-transformer block under the flagship composition: the GPT-2
     attention half (sequence-parallel ring attention), then the routed
     expert FFN with EXPERTS sharded over the tp axis (EP folded onto the
@@ -91,14 +91,18 @@ def _moe_block_sp_tp(cfg, lp: Dict[str, Any], h: jax.Array,
     (moe.moe_layer_replicated_ep; routing is bit-equal to the
     single-device dispatch).
 
-    Router auxiliary losses are not threaded through the pipeline scan —
-    the dp(+ep) step in models/moe_transformer.py is the aux-regularized
-    trainer; this path is the pp x tp scale-out, documented CE-only.
-    """
+    Returns ``(h, (load_balance, router_z))`` — the router auxiliaries
+    ride the pipeline scan's aux accumulator (pipeline_forward
+    ``with_aux``) into the flagship loss, so pp x tp MoE training
+    carries the same regularization as the dp(+ep) trainer
+    (models/moe_transformer.py). The aux pair is replicated over tp
+    (full gates on every rank); the loss gates its contribution to
+    ti == 0 to keep cotangent paths exclusive."""
     from mpi_acx_tpu.models.moe_transformer import _moe_ffn
 
     h = _gpt2_attn_sp(cfg, lp, h, tp_axis)
-    return _moe_ffn(cfg, lp, h, ep_axis=tp_axis, replicated=True)
+    return _moe_ffn(cfg, lp, h, ep_axis=tp_axis, replicated=True,
+                    with_aux=True)
 
 
 def _llama_block_sp_tp(cfg, lp: Dict[str, Any], h: jax.Array,
@@ -200,13 +204,15 @@ class _Family:
     """Model-family adapter: everything make_loss_and_grads needs to run a
     family through the dp x pp x tp/sp composition."""
 
-    def __init__(self, block, embed, final, head, specs, tp_sharded):
-        self.block = block           # (cfg, lp, h, tp_axis) -> h
+    def __init__(self, block, embed, final, head, specs, tp_sharded,
+                 has_aux=False):
+        self.block = block           # (cfg, lp, h, tp_axis) -> h | (h, aux)
         self.embed = embed           # (params, cfg, tokens) -> x [...,S,d]
         self.final = final           # (params, ys) -> ys
         self.head = head             # (params) -> [vocab, d] logits matrix
         self.specs = specs           # () -> PartitionSpec tree
         self.tp_sharded = tp_sharded  # layer-leaf name -> bool
+        self.has_aux = has_aux       # block returns (h, (balance, z))
 
 
 def _family(cfg) -> _Family:
@@ -231,6 +237,7 @@ def _family(cfg) -> _Family:
             head=lambda p: p["embed"],
             specs=moe_param_specs,
             tp_sharded=lambda k: k in ("w1", "w2"),
+            has_aux=True,
         )
     return _Family(
         block=_block_sp_tp,
@@ -245,7 +252,8 @@ def _family(cfg) -> _Family:
 
 def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
                         remat: bool = False,
-                        dp_quant_bits: int | None = None):
+                        dp_quant_bits: int | None = None,
+                        aux_weight: float = 1e-2, z_weight: float = 1e-3):
     """Builds a jitted (params, tokens, targets) -> (loss, grads) over a
     ('dp','pp','tp') mesh — the shard_map core every optimizer shares.
     Returned grads carry the same shardings as params, so any elementwise
@@ -273,6 +281,14 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
     — ~4x less traffic on the dp axis, the one that rides DCN in
     multi-slice layouts, at ~<1% gradient error. None (default) keeps
     gradient sync exact.
+
+    For the MoE family the loss is CE + ``aux_weight`` * load-balance +
+    ``z_weight`` * router-z, with the router auxiliaries threaded
+    through the pipeline scan (pipeline_forward ``with_aux``) and
+    normalized per (layer, microbatch) router call — at pp=tp=1,
+    n_micro=1 the scalar exact-matches the dp+ep trainer's
+    moe_transformer.loss_fn (tests/test_train_moe_flagship.py). The
+    weights are ignored by the dense families.
     """
     n_stages = mesh.shape["pp"]
     fam = _family(cfg)
@@ -294,17 +310,33 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
             if remat:
                 layer_fn = jax.checkpoint(layer_fn)
 
-            def stage_fn(stage_layers, h):
-                def body(h, lp):
-                    return layer_fn(lp, h), None
-                h, _ = lax.scan(body, h, stage_layers)
-                return h
+            if fam.has_aux:
+                def stage_fn(stage_layers, h):
+                    def body(carry, lp):
+                        h, lb, rz = carry
+                        h, (b_lb, b_rz) = layer_fn(lp, h)
+                        return (h, lb + b_lb, rz + b_rz), None
+                    zero = jnp.zeros((), jnp.float32)
+                    (h, lb, rz), _ = lax.scan(body, (h, zero, zero),
+                                              stage_layers)
+                    return h, (lb, rz)
+            else:
+                def stage_fn(stage_layers, h):
+                    def body(h, lp):
+                        return layer_fn(lp, h), None
+                    h, _ = lax.scan(body, h, stage_layers)
+                    return h
 
+            aux = None
             if n_virtual > 1:
                 ys = pipeline_forward_interleaved(
-                    stage_fn, params["layers"], x, "pp", n_virtual)
+                    stage_fn, params["layers"], x, "pp", n_virtual,
+                    with_aux=fam.has_aux)
             else:
-                ys = pipeline_forward(stage_fn, params["layers"], x, "pp")
+                ys = pipeline_forward(stage_fn, params["layers"], x, "pp",
+                                      with_aux=fam.has_aux)
+            if fam.has_aux:
+                ys, aux = ys
             ys = fam.final(params, ys)
 
             # EXCLUSIVE loss paths: every rank scores only its own slice —
@@ -323,9 +355,25 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
             logp = jax.nn.log_softmax(logits, axis=-1)
             ll = jnp.take_along_axis(logp, tg_blk[..., None], -1)[..., 0]
             contrib = jnp.where(si == n_stages - 1, jnp.sum(ll), 0.0)
-            total = lax.psum(contrib, ("pp", "tp"))
+            if fam.has_aux:
+                # Aux is replicated over tp (full gates everywhere) and
+                # device-varying over pp (each stage owns its layers):
+                # gate to ti == 0 for an exclusive cotangent path, then
+                # the same psum that assembles the CE sums every stage's
+                # contribution exactly once. Normalize per router call —
+                # one call per (layer, microbatch) — to match the dp+ep
+                # trainer's mean-over-layers convention.
+                lb_c = jnp.where(ti == 0, aux[0], 0.0)
+                rz_c = jnp.where(ti == 0, aux[1], 0.0)
+                total, lb_t, rz_t = lax.psum((contrib, lb_c, rz_c),
+                                             ("pp", "tp"))
+                calls = cfg.n_layers * tokens.shape[0]
+                aux_term = (aux_weight * lb_t + z_weight * rz_t) / calls
+            else:
+                total = lax.psum(contrib, ("pp", "tp"))
+                aux_term = 0.0
             n_tok = tokens.shape[0] * tokens.shape[1] * S
-            return -total / n_tok
+            return -total / n_tok + aux_term
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         # With check_vma=False the transpose of psum is psum (replication is
@@ -380,13 +428,16 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
 
 def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
                     n_micro: int, lr: float = 1e-2, n_virtual: int = 1,
-                    remat: bool = False, dp_quant_bits: int | None = None):
+                    remat: bool = False, dp_quant_bits: int | None = None,
+                    aux_weight: float = 1e-2, z_weight: float = 1e-3):
     """Jitted (params, tokens, targets) -> (loss, new_params) SGD step
     (stateless optimizer; for stateful ones use make_train_step_optax)."""
     grad_fn, n_stages = make_loss_and_grads(cfg, mesh, n_micro,
                                             n_virtual=n_virtual,
                                             remat=remat,
-                                            dp_quant_bits=dp_quant_bits)
+                                            dp_quant_bits=dp_quant_bits,
+                                            aux_weight=aux_weight,
+                                            z_weight=z_weight)
 
     @jax.jit
     def step(params, tokens, targets):
@@ -400,7 +451,8 @@ def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
 def make_train_step_optax(cfg: tfm.TransformerConfig, mesh: Mesh,
                           n_micro: int, optimizer, n_virtual: int = 1,
                           remat: bool = False,
-                          dp_quant_bits: int | None = None):
+                          dp_quant_bits: int | None = None,
+                          aux_weight: float = 1e-2, z_weight: float = 1e-3):
     """Distributed train step with any optax GradientTransformation.
 
     Returns (step, n_stages): step(params, opt_state, tokens, targets) ->
@@ -415,7 +467,9 @@ def make_train_step_optax(cfg: tfm.TransformerConfig, mesh: Mesh,
     grad_fn, n_stages = make_loss_and_grads(cfg, mesh, n_micro,
                                             n_virtual=n_virtual,
                                             remat=remat,
-                                            dp_quant_bits=dp_quant_bits)
+                                            dp_quant_bits=dp_quant_bits,
+                                            aux_weight=aux_weight,
+                                            z_weight=z_weight)
 
     @jax.jit
     def step(params, opt_state, tokens, targets):

@@ -272,8 +272,10 @@ def test_moe_through_distributed_train_step():
     all_to_all inside each pipeline stage), and the step EXACTLY matches
     the single-device math computed per (microbatch, dp-shard) group —
     routing capacity is per dispatch group, so the groups reproduce the
-    distributed routing bit-for-bit, drops included. (This path is
-    CE-only; the aux-regularized trainer is make_moe_transformer_train_step.)"""
+    distributed routing bit-for-bit, drops included. The loss INCLUDES
+    the router auxiliaries (threaded through the pipeline scan's aux
+    accumulator), matching CE + aux_weight*balance + z_weight*z averaged
+    per group exactly as the reference math below computes it."""
     from mpi_acx_tpu.models import transformer as tfm
     from mpi_acx_tpu.train import make_train_step
 
@@ -303,10 +305,15 @@ def test_moe_through_distributed_train_step():
                                            (1, mbl, S))[0]
                 tg = jax.lax.dynamic_slice(targets, (m, s_ * mbl, 0),
                                            (1, mbl, S))[0]
-                logits, _ = mtf.forward(p, cfg, tk)
+                logits, aux = mtf.forward(p, cfg, tk)
                 logp = jax.nn.log_softmax(logits, axis=-1)
                 ll = jnp.take_along_axis(logp, tg[..., None], -1)[..., 0]
-                tot = tot - jnp.mean(ll) / (M * dp)
+                # CE plus the per-group router auxiliaries (forward
+                # returns the layer-mean), averaged over groups — the
+                # flagship's per-(layer, microbatch) normalization.
+                tot = tot + (-jnp.mean(ll)
+                             + 1e-2 * aux["load_balance"]
+                             + 1e-3 * aux["router_z"]) / (M * dp)
         return tot
 
     seq_loss, g = jax.value_and_grad(single_loss)(params)
