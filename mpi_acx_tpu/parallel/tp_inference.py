@@ -254,23 +254,44 @@ def tp_param_specs_moe(axis: str = "tp"):
 def make_tp_generate_moe(cfg, mesh: Mesh, n_new: int, axis: str = "tp",
                          temperature: float = 0.0,
                          top_k: Optional[int] = None,
-                         top_p: Optional[float] = None):
+                         top_p: Optional[float] = None,
+                         ep_dispatch: str = "sharded"):
     """Tensor-parallel MoE-transformer generation: the dense GPT-2
     builder with the expert-parallel routed FFN plugged into its ffn
     hook. Attention splits by head (two psums per layer); each rank
-    hosts ``n_experts/tp`` experts and, since tokens are replicated
-    after the attention psum, the replicated-EP path applies — every
-    rank routes all tokens but runs only its LOCAL expert block, one
-    psum assembling the output (1/tp the expert FLOPs; routing is
-    bit-equal to the single-device dispatch, same groups and
-    capacity)."""
+    hosts ``n_experts/tp`` experts.
+
+    ``ep_dispatch`` selects how tokens reach their experts:
+
+    * ``"sharded"`` (default) — REAL expert-parallel dispatch
+      (moe.moe_layer_sharded_dispatch): each rank routes only its
+      exclusive 1/tp token slice and the capacity-bounded
+      ``all_to_all`` of the training EP path carries tokens to their
+      expert's rank and back, then one all_gather re-replicates.
+      Router + dispatch work per rank genuinely scales as 1/tp —
+      this is the path that scales past small tp. Requires the batch
+      to divide by tp (decode routes B tokens per step; asserted at
+      trace time).
+    * ``"replicated"`` — every rank routes ALL tokens, local expert
+      block + one psum (moe.moe_layer_replicated_ep): only the expert
+      FLOPs shard, but any batch size works (B=1 latency serving) and
+      routing is bit-equal to the single-device dispatch at any
+      capacity.
+
+    In the drop-free regime (``capacity_factor >= n_experts``, the
+    serving guard — see moe_transformer.decode_step) both paths emit
+    tokens identical to the single-device ``generate``
+    (tests/test_tp_inference.py covers tp=4 and tp=8)."""
     from mpi_acx_tpu.models.moe_transformer import _moe_ffn
 
     assert cfg.n_experts % mesh.shape[axis] == 0, (
         cfg.n_experts, mesh.shape[axis])
+    assert ep_dispatch in ("sharded", "replicated"), ep_dispatch
 
     def moe_ffn(lp, x):
-        return _moe_ffn(cfg, lp, x, ep_axis=axis, replicated=True)
+        return _moe_ffn(cfg, lp, x, ep_axis=axis,
+                        replicated=ep_dispatch == "replicated",
+                        sharded_dispatch=ep_dispatch == "sharded")
 
     return make_tp_generate(cfg, mesh, n_new, axis=axis,
                             temperature=temperature, top_k=top_k,

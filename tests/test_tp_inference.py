@@ -138,29 +138,57 @@ from mpi_acx_tpu.parallel.tp_inference import make_tp_generate_moe
 import dataclasses
 
 
-def _setup_moe(tp, dtype=jnp.float32):
+def _setup_moe(tp, dtype=jnp.float32, batch=2, n_heads=4):
     mesh = mesh_from_devices({"tp": tp}, jax.devices()[:tp])
-    cfg = mtf.tiny_moe_config(vocab=128, d_model=32, n_heads=4,
+    cfg = mtf.tiny_moe_config(vocab=128, d_model=32, n_heads=n_heads,
                               n_layers=2, d_ff=64, n_experts=8, top_k=2,
                               capacity_factor=8.0, max_seq=64)
     cfg = dataclasses.replace(cfg, dtype=dtype)
     params = mtf.init_params(jax.random.key(0), cfg)
-    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab)
+    prompt = jax.random.randint(jax.random.key(1), (batch, 8), 0,
+                                cfg.vocab)
     return mesh, cfg, params, prompt
 
 
 @pytest.mark.parametrize("tp", [2, 4])
-def test_tp_moe_greedy_matches_single_device(tp):
-    """Expert-parallel TP decode emits the same tokens as mtf.generate
+def test_tp_moe_greedy_matches_single_device_replicated(tp):
+    """Replicated-EP TP decode emits the same tokens as mtf.generate
     (identical dispatch groups and capacity, so routing is equal — not
-    just close)."""
+    just close). Works at any batch (B=2 here, indivisible by tp=4)."""
     mesh, cfg, params, prompt = _setup_moe(tp)
     n_new = 10
     want = mtf.generate(params, cfg, prompt, n_new,
                         max_len=prompt.shape[1] + n_new)
-    gen = make_tp_generate_moe(cfg, mesh, n_new)
+    gen = make_tp_generate_moe(cfg, mesh, n_new,
+                               ep_dispatch="replicated")
     got = gen(params, prompt, jax.random.key(2))
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("tp", [4, 8])
+def test_tp_moe_greedy_matches_single_device_sharded(tp):
+    """REAL-EP TP decode (the default): each rank routes only its B/tp
+    token slice, the training path's capacity-bounded all_to_all moves
+    tokens to their expert's rank and back — and in the drop-free
+    capacity regime the emitted tokens are still identical to the
+    single-device mtf.generate at tp=4 AND tp=8."""
+    mesh, cfg, params, prompt = _setup_moe(tp, batch=8, n_heads=8)
+    n_new = 10
+    want = mtf.generate(params, cfg, prompt, n_new,
+                        max_len=prompt.shape[1] + n_new)
+    gen = make_tp_generate_moe(cfg, mesh, n_new)   # sharded default
+    got = gen(params, prompt, jax.random.key(2))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tp_moe_sharded_rejects_indivisible_batch():
+    """Sharded dispatch routes B tokens per decode step: B=2 does not
+    divide tp=4, and the trace-time guard must say so (pointing at the
+    replicated path as the fallback)."""
+    mesh, cfg, params, prompt = _setup_moe(4)
+    gen = make_tp_generate_moe(cfg, mesh, 4)
+    with pytest.raises(ValueError, match="replicated"):
+        gen(params, prompt, jax.random.key(2))
 
 
 def test_tp_moe_expert_split_rejected():
