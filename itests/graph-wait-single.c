@@ -1,0 +1,133 @@
+/* tpu-acx integration test: graph-mode SINGLE MPIX_Wait_enqueue +
+ * non-overtaking ordering stress.
+ *
+ * Closes the coverage hole SURVEY.md §4 flags in the reference: its
+ * graph-construction test only ever exercises MPIX_Waitall_enqueue
+ * (reference test/src/ring-all-graph-construction.c:79), leaving the
+ * single-wait graph path untested — which is exactly where the
+ * reference's latent bug lives (wait kernel armed with PENDING instead
+ * of COMPLETED, reference src/sendrecv.cu:411). Part 1 composes a ring
+ * exchange from single-op graphs with ONE MPIX_Wait_enqueue PER REQUEST
+ * (send and recv each get their own wait node), chains them with
+ * dependency edges, destroys the component graphs, and relaunches the
+ * executable `size` times — a wait that observed the wrong state would
+ * either hang (waiting for a value the flag never revisits) or let the
+ * relaunch read a stale buffer, and the circulated value check catches
+ * both.
+ *
+ * Part 2 is a non-overtaking stress the reference explicitly punts on
+ * (reference README.md:173-176): two in-flight same-peer/same-tag pairs
+ * per round, enqueue order alternating, for many rounds. Our transport
+ * matches FIFO per (src, tag, ctx) (src/net/socket_transport.cc:332),
+ * so the first-posted receive MUST complete with the first-sent payload.
+ */
+#include <stdio.h>
+#include <mpi.h>
+#include <mpi-acx.h>
+
+int main(int argc, char **argv) {
+    int provided, rank, size, errs = 0;
+
+    MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided);
+    if (provided < MPI_THREAD_MULTIPLE) MPI_Abort(MPI_COMM_WORLD, 1);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    if (MPIX_Init()) MPI_Abort(MPI_COMM_WORLD, 2);
+
+    const int right = (rank + 1) % size;
+    const int left = (rank + size - 1) % size;
+
+    /* ---- Part 1: single Wait_enqueue nodes in a composed graph ---- */
+    int send_val = rank + 1, recv_val = -1;
+    MPIX_Request req[2];
+    cudaGraph_t g_send, g_recv, g_wait_recv, g_wait_send, graph;
+    cudaGraphNode_t n_send, n_recv, n_wrecv, n_wsend;
+
+    MPIX_Isend_enqueue(&send_val, 1, MPI_INT, right, 11, MPI_COMM_WORLD,
+                       &req[0], MPIX_QUEUE_XLA_GRAPH, &g_send);
+    MPIX_Irecv_enqueue(&recv_val, 1, MPI_INT, left, 11, MPI_COMM_WORLD,
+                       &req[1], MPIX_QUEUE_XLA_GRAPH, &g_recv);
+    /* The hole itself: one wait PER REQUEST, not a Waitall batch. */
+    MPIX_Wait_enqueue(&req[1], MPI_STATUS_IGNORE, MPIX_QUEUE_XLA_GRAPH,
+                      &g_wait_recv);
+    MPIX_Wait_enqueue(&req[0], MPI_STATUS_IGNORE, MPIX_QUEUE_XLA_GRAPH,
+                      &g_wait_send);
+
+    if (cudaGraphCreate(&graph, 0) != cudaSuccess)
+        MPI_Abort(MPI_COMM_WORLD, 2);
+    cudaGraphAddChildGraphNode(&n_send, graph, NULL, 0, g_send);
+    cudaGraphAddChildGraphNode(&n_recv, graph, &n_send, 1, g_recv);
+    cudaGraphAddChildGraphNode(&n_wrecv, graph, &n_recv, 1, g_wait_recv);
+    cudaGraphAddChildGraphNode(&n_wsend, graph, &n_wrecv, 1, g_wait_send);
+
+    cudaGraphExec_t exec;
+    if (cudaGraphInstantiate(&exec, graph, NULL, NULL, 0) != cudaSuccess)
+        MPI_Abort(MPI_COMM_WORLD, 2);
+    /* Components die first: the exec's refcounted cleanup owns the ops. */
+    cudaGraphDestroy(g_send);
+    cudaGraphDestroy(g_recv);
+    cudaGraphDestroy(g_wait_recv);
+    cudaGraphDestroy(g_wait_send);
+
+    for (int i = 0; i < size; i++) {
+        cudaGraphLaunch(exec, 0);
+        cudaMemcpyAsync(&send_val, &recv_val, sizeof(int),
+                        cudaMemcpyHostToHost, 0);
+    }
+    cudaStreamSynchronize(0);
+    cudaGraphExecDestroy(exec);
+    cudaGraphDestroy(graph);
+
+    if (recv_val != rank + 1) {
+        printf("[%d] graph single-wait: got %d after circulation, want %d\n",
+               rank, recv_val, rank + 1);
+        errs++;
+    }
+
+    /* ---- Part 2: non-overtaking, two in-flight same-peer/same-tag ---- */
+    cudaStream_t stream;
+    cudaStreamCreate(&stream);
+    for (int round = 0; round < 200; round++) {
+        int s[2] = {1000 * rank + 2 * round, 1000 * rank + 2 * round + 1};
+        int r[2] = {-1, -1};
+        MPIX_Request q[4];
+        /* Alternate enqueue order so neither side's posting order is a
+         * fixed pattern the matching could accidentally depend on. */
+        if (round % 2 == 0) {
+            MPIX_Isend_enqueue(&s[0], 1, MPI_INT, right, 7, MPI_COMM_WORLD,
+                               &q[0], MPIX_QUEUE_XLA_STREAM, &stream);
+            MPIX_Isend_enqueue(&s[1], 1, MPI_INT, right, 7, MPI_COMM_WORLD,
+                               &q[1], MPIX_QUEUE_XLA_STREAM, &stream);
+            MPIX_Irecv_enqueue(&r[0], 1, MPI_INT, left, 7, MPI_COMM_WORLD,
+                               &q[2], MPIX_QUEUE_XLA_STREAM, &stream);
+            MPIX_Irecv_enqueue(&r[1], 1, MPI_INT, left, 7, MPI_COMM_WORLD,
+                               &q[3], MPIX_QUEUE_XLA_STREAM, &stream);
+        } else {
+            MPIX_Irecv_enqueue(&r[0], 1, MPI_INT, left, 7, MPI_COMM_WORLD,
+                               &q[2], MPIX_QUEUE_XLA_STREAM, &stream);
+            MPIX_Irecv_enqueue(&r[1], 1, MPI_INT, left, 7, MPI_COMM_WORLD,
+                               &q[3], MPIX_QUEUE_XLA_STREAM, &stream);
+            MPIX_Isend_enqueue(&s[0], 1, MPI_INT, right, 7, MPI_COMM_WORLD,
+                               &q[0], MPIX_QUEUE_XLA_STREAM, &stream);
+            MPIX_Isend_enqueue(&s[1], 1, MPI_INT, right, 7, MPI_COMM_WORLD,
+                               &q[1], MPIX_QUEUE_XLA_STREAM, &stream);
+        }
+        cudaStreamSynchronize(stream);          /* triggers fired */
+        MPI_Status st[4];
+        MPIX_Waitall(4, q, st);
+        int want0 = 1000 * left + 2 * round;
+        if (r[0] != want0 || r[1] != want0 + 1) {
+            if (errs < 5)
+                printf("[%d] r%d OVERTAKE: got (%d,%d) want (%d,%d)\n",
+                       rank, round, r[0], r[1], want0, want0 + 1);
+            errs++;
+        }
+    }
+    cudaStreamDestroy(stream);
+
+    MPI_Allreduce(MPI_IN_PLACE, &errs, 1, MPI_INT, MPI_MAX, MPI_COMM_WORLD);
+    MPIX_Finalize();
+    MPI_Finalize();
+    if (rank == 0 && errs == 0) printf("graph-wait-single: OK\n");
+    return errs != 0;
+}
