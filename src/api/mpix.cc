@@ -183,6 +183,7 @@ std::function<void()> MakeWaiter(int idx, MPI_Status* status,
   Proxy* proxy = GS().proxy;
   return [table, proxy, idx, status, graph_owned] {
     SpinUntil(table, proxy, idx, kCompleted);
+    ACX_TRACE_EVENT("wait_observed", idx);
     CopyStatus(table->op(idx).status, status);
     if (!graph_owned) {
       table->Store(idx, kCleanup);
@@ -246,6 +247,7 @@ int HostWaitBasic(MpixRequest* req, MPI_Status* status) {
     return kErr;
   }
   SpinUntil(g.table, g.proxy, idx, kCompleted);
+  ACX_TRACE_EVENT("wait_observed", idx);
   CopyStatus(g.table->op(idx).status, status);
   g.table->Store(idx, kCleanup);  // proxy frees request + ticket + slot
   g.proxy->Kick();

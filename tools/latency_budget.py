@@ -1,0 +1,110 @@
+#!/usr/bin/env python3
+"""Trace-backed enqueue-latency budget (VERDICT r03 item 9).
+
+Runs the enqueued ping-pong under ACX_TRACE (nanosecond event clock)
+and attributes rank 0's op latency segment by segment, separately for
+the send op and the recv op (anchored at trigger_fired = the flag going
+PENDING, the reference's device-write instant):
+
+    trigger_fired -> i{send,recv}_issued   proxy pickup of PENDING
+    issued        -> op_completed          wire + peer + completion poll
+    op_completed  -> wait_observed         waiter pickup of COMPLETED
+
+The SEND op's completed->wait segment absorbs the whole round trip
+(the app waits on its recv first); the RECV op's completed->wait is the
+true waiter-pickup cost. A future p50 move can thus be pinned to a
+segment (code) or seen as uniform inflation (host weather). Tracing
+itself costs ~0.1-0.2 us per event (mutexed ns clock), so the traced
+totals read above the untraced bench_pingpong p50 — compare SHAPES,
+not absolutes, across runs.
+
+Usage: python tools/latency_budget.py [--msg-bytes N]  (builds if needed)
+Prints one JSON line with per-segment p50/p90 in microseconds.
+"""
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--msg-bytes", type=int, default=8)
+    args = ap.parse_args()
+
+    subprocess.run(["make", "-C", REPO, "itest", "tools"], check=True,
+                   capture_output=True)
+    with tempfile.TemporaryDirectory() as td:
+        env = dict(os.environ)
+        env["ACX_TRACE"] = os.path.join(td, "lb")
+        env["ACX_TRACE_CAP"] = "2000000"
+        r = subprocess.run(
+            [os.path.join(REPO, "build", "acxrun"), "-np", "2",
+             "-timeout", "300",
+             os.path.join(REPO, "build", "bench_pingpong"),
+             str(args.msg_bytes)],
+            env=env, capture_output=True, text=True, timeout=400)
+        if r.returncode != 0:
+            sys.exit(f"bench_pingpong failed: {r.stdout} {r.stderr}")
+        bench_line = next((l for l in r.stdout.splitlines()
+                           if l.startswith("BENCH")), "")
+        d = json.loads(
+            open(os.path.join(td, "lb.rank0.trace.json")).read())
+
+    # Stitch per-op lifecycles: events for one op share a slot (tid) and
+    # the slot is reused only after slot_reclaimed, so one pass with a
+    # per-slot open dict reconstructs each lifecycle. The API-exit
+    # "isend_enqueue" log point lands AFTER the inline host-queue
+    # trigger, so the budget anchors on trigger_fired (= the moment the
+    # flag goes PENDING — the reference's device-write instant).
+    # Two budgets. The SEND op's completed->wait segment absorbs the
+    # whole round trip (rank 0 waits on its recv first), so its useful
+    # segments are proxy pickup and wire issue. The RECV op is the one
+    # the app actively spins on, so its completed->wait is the true
+    # waiter-pickup cost, and its issued->completed is peer + wire.
+    KINDS = {"send": ["trigger_fired", "isend_issued", "op_completed",
+                      "wait_observed"],
+             "recv": ["trigger_fired", "irecv_issued", "op_completed",
+                      "wait_observed"]}
+    names = {n for seg in KINDS.values() for n in seg}
+    open_ops = {}
+    ops = {"send": [], "recv": []}
+    for e in d["traceEvents"]:
+        name, slot, ts = e["name"], e["tid"], float(e["ts"])
+        if name == "slot_reclaimed":
+            op = open_ops.pop(slot, None)
+            if op is None:
+                continue
+            for kind, seg in KINDS.items():
+                if all(s in op for s in seg):
+                    ops[kind].append(op)
+        elif name in names:
+            open_ops.setdefault(slot, {})[name] = ts
+
+    if not ops["send"] or not ops["recv"]:
+        sys.exit("no complete lifecycles found in trace")
+
+    def stats(v):
+        v = sorted(v)
+        return {"p50_us": round(statistics.median(v), 3),
+                "p90_us": round(v[int(0.9 * len(v))], 3)}
+
+    out = {"bench_line": bench_line}
+    for kind, seg in KINDS.items():
+        kops = ops[kind][20:] or ops[kind]   # drop cold-start
+        out[f"n_{kind}"] = len(kops)
+        for a, b in zip(seg, seg[1:]):
+            out[f"{kind}:{a}->{b}"] = stats([op[b] - op[a] for op in kops])
+        out[f"{kind}:total"] = stats(
+            [op["wait_observed"] - op["trigger_fired"] for op in kops])
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
