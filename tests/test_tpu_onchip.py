@@ -1,0 +1,62 @@
+"""ON-CHIP proof of the trigger + bridge plane (VERDICT r03 item 2).
+
+Opt-in: these run only in a real TPU session (``ACX_TPU_TESTS=1`` with
+the axon tunnel healthy) — the CI/CPU suite covers the same code paths
+under the interpreter via test_xla_triggers / test_device_bridge; THIS
+file is the evidence that a compiled jitted program on the actual chip
+fires io_callback triggers and that a compiled (not interpret-mode)
+Pallas flag kernel publishes through the device->proxy bridge, driving
+a real 2-rank wire transfer (rank 0 on TPU, rank 1 on CPU).
+
+The same worker also runs in cpu/cpu mode unconditionally, so the
+launch plumbing itself stays continuously tested.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "tpu_onchip_worker.py")
+
+
+def _run(rank0_platform):
+    subprocess.run(["make", "-C", REPO, "lib", "tools"], check=True,
+                   capture_output=True, timeout=600)
+    env = dict(os.environ)
+    if rank0_platform == "cpu":
+        # CPU/CPU mode must not touch the tunnel.
+        env.pop("PYTHONPATH", None)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+    else:
+        # Let rank 0 load the session's real platform (axon): drop the
+        # conftest's cpu pin and the virtual-device flags; keep
+        # PYTHONPATH (the axon sitecustomize wires the tunnel).
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)
+    env["ACX_RANK0_PLATFORM"] = rank0_platform
+    return subprocess.run(
+        [os.path.join(REPO, "build", "acxrun"), "-np", "2", "-timeout",
+         "420", sys.executable, WORKER],
+        env=env, capture_output=True, text=True, timeout=480)
+
+
+def test_onchip_worker_cpu_mode():
+    """The worker's program shapes and plumbing, chip-free."""
+    r = _run("cpu")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("ONCHIP_OK") == 2, r.stdout + r.stderr
+
+
+@pytest.mark.skipif(os.environ.get("ACX_TPU_TESTS") != "1",
+                    reason="needs a live TPU session (ACX_TPU_TESTS=1)")
+def test_onchip_trigger_and_bridge_real_tpu():
+    """Rank 0 on the REAL chip: compiled program fires the trigger,
+    compiled Pallas kernel publishes through the bridge."""
+    r = _run("default")   # rank 0 keeps the session platform (axon)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ONCHIP_OK tpu" in r.stdout, r.stdout + r.stderr
+    assert r.stdout.count("ONCHIP_OK") == 2, r.stdout + r.stderr
