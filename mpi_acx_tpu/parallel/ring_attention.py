@@ -34,6 +34,15 @@ from mpi_acx_tpu.parallel.collective import _ring_perm
 
 _NEG = float(jnp.finfo(jnp.float32).min)
 
+# Flash engages automatically only when the PER-SHARD Q block is at
+# least this long (and 128-aligned): below it the kernel's grid/launch
+# overhead loses to one fused dense block on the measured v5e crossover.
+# NOTE the cliff when choosing tp: the shard is S/tp, so e.g. S=2048 at
+# tp=8 gives 256-long shards and the SP path runs the (exact,
+# identical-math) dense blocks — pass use_flash=True to force the
+# kernel, or keep S/tp >= this threshold for the flash win at scale.
+FLASH_MIN_SHARD = 1024
+
 
 def _dense_block(q32, kk, vv, mask):
     """One Q-block x K-block dense attention: returns (normalized_out
@@ -69,8 +78,11 @@ def ring_attention_batched(q: jax.Array, k: jax.Array, v: jax.Array,
     the bandwidth GQA exists to save) and each block broadcasts them to
     the query heads locally, where XLA fuses the broadcast into the dots.
 
-    use_flash: None -> auto (Pallas kernel on TPU for shards past the
-    measured crossover), True/False -> force. The dense and flash paths
+    use_flash: None -> auto (Pallas kernel on TPU when the PER-SHARD
+    length reaches :data:`FLASH_MIN_SHARD` and is 128-aligned — note
+    the shard is the global sequence over the tp/sp degree, so high tp
+    can silently drop the auto path below the crossover; see
+    FLASH_MIN_SHARD), True/False -> force. The dense and flash paths
     produce identical math; both yield (normalized block output, lse) and
     merge with logaddexp, so switching kernels never changes numerics
     beyond float roundoff.
@@ -80,8 +92,8 @@ def ring_attention_batched(q: jax.Array, k: jax.Array, v: jax.Array,
     mb, sq, h, dh = q.shape
     assert k.shape[2] * kv_repeat == h, (k.shape, h, kv_repeat)
     if use_flash is None:
-        use_flash = (jax.default_backend() == "tpu" and sq >= 1024
-                     and sq % 128 == 0)
+        use_flash = (jax.default_backend() == "tpu"
+                     and sq >= FLASH_MIN_SHARD and sq % 128 == 0)
 
     def expand(x):
         # kv-head g serves query heads [g*kv_repeat, (g+1)*kv_repeat) —

@@ -270,6 +270,16 @@ class Runtime:
 
     def finalize(self) -> None:
         if self._open:
+            undrained = getattr(self, "_inprogram_sends", [])
+            if undrained:
+                # Mirrors the native finalize's leaked-slot diagnostic:
+                # in-program sends were triggered but never waited
+                # (xla_triggers.drain_sends) — their host buffers and
+                # slots are about to be torn down under them.
+                import sys
+                print(f"tpu-acx: finalize: {len(undrained)} in-program "
+                      f"send(s) never drained (xla_triggers.drain_sends)",
+                      file=sys.stderr)
             self._lib.MPIX_Finalize()
             self._lib.MPI_Finalize()
             self._open = False
