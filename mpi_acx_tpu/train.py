@@ -253,7 +253,8 @@ def _family(cfg) -> _Family:
 def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
                         remat: bool = False,
                         dp_quant_bits: int | None = None,
-                        aux_weight: float = 1e-2, z_weight: float = 1e-3):
+                        aux_weight: float = 1e-2, z_weight: float = 1e-3,
+                        schedule: str = "gpipe"):
     """Builds a jitted (params, tokens, targets) -> (loss, grads) over a
     ('dp','pp','tp') mesh — the shard_map core every optimizer shares.
     Returned grads carry the same shardings as params, so any elementwise
@@ -289,6 +290,19 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
     n_micro=1 the scalar exact-matches the dp+ep trainer's
     moe_transformer.loss_fn (tests/test_train_moe_flagship.py). The
     weights are ignored by the dense families.
+
+    ``schedule="1f1b"`` swaps the autodiff-through-the-scan backward for
+    the memory-bounded 1F1B schedule (pipeline._schedule_1f1b): one slot
+    scan whose body runs the stage forward and an explicit ``jax.vjp``
+    backward from a pp-deep input ring buffer, so peak activation
+    residency is O(pp) instead of O(n_micro) scan residuals. Same loss
+    and gradients as the GPipe path (tests/test_train_1f1b.py asserts
+    exact parity at dp2 x pp2 x tp2 for all three families). Because
+    every rank must execute the stage collectives in lockstep, the slot
+    body computes both the forward and the backward unconditionally and
+    masks the accumulations (~2x the op count of the cond-based
+    pipeline-level schedule; the win is memory, not FLOPs). Requires
+    ``n_virtual == 1``.
     """
     n_stages = mesh.shape["pp"]
     fam = _family(cfg)
@@ -297,6 +311,46 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
         assert cfg.n_experts % mesh.shape["tp"] == 0, (
             f"n_experts ({cfg.n_experts}) must divide by the 'tp' mesh "
             f"axis ({mesh.shape['tp']}) — experts shard over tp")
+    assert schedule in ("gpipe", "1f1b"), schedule
+    if schedule == "1f1b":
+        assert n_virtual == 1, "1F1B is the non-interleaved schedule"
+
+    def reduce_grad(g, tp_sharded: bool, pp_sharded: bool):
+        """Gradient reduction rule shared by both schedules: pmean over
+        dp (mean loss over the global batch), psum over every axis the
+        leaf is REPLICATED on, nothing over sharded axes."""
+        if dp_quant_bits is not None:
+            from mpi_acx_tpu.parallel.quantized import quantized_pmean
+            g = quantized_pmean(g, "dp", dp_quant_bits)
+        else:
+            g = lax.pmean(g, "dp")
+        if not tp_sharded:
+            g = lax.psum(g, "tp")
+        if not pp_sharded:
+            g = lax.psum(g, "pp")
+        return g
+
+    def make_stage_fn():
+        layer_fn = lambda lp, h: fam.block(cfg, lp, h, "tp")  # noqa: E731
+        if remat:
+            layer_fn = jax.checkpoint(layer_fn)
+        if fam.has_aux:
+            def stage_fn(stage_layers, h):
+                def body(carry, lp):
+                    h, lb, rz = carry
+                    h, (b_lb, b_rz) = layer_fn(lp, h)
+                    return (h, lb + b_lb, rz + b_rz), None
+                zero = jnp.zeros((), jnp.float32)
+                (h, lb, rz), _ = lax.scan(body, (h, zero, zero),
+                                          stage_layers)
+                return h, (lb, rz)
+        else:
+            def stage_fn(stage_layers, h):
+                def body(h, lp):
+                    return layer_fn(lp, h), None
+                h, _ = lax.scan(body, h, stage_layers)
+                return h
+        return stage_fn
 
     def per_shard(params, tokens, targets):
         def loss_fn(params):
@@ -305,27 +359,7 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
             # path is exclusive to stage 0 by construction.
             S = tokens.shape[-1]
             x = fam.embed(params, cfg, tokens)         # [M, mbl, S, d]
-
-            layer_fn = lambda lp, h: fam.block(cfg, lp, h, "tp")  # noqa: E731
-            if remat:
-                layer_fn = jax.checkpoint(layer_fn)
-
-            if fam.has_aux:
-                def stage_fn(stage_layers, h):
-                    def body(carry, lp):
-                        h, lb, rz = carry
-                        h, (b_lb, b_rz) = layer_fn(lp, h)
-                        return (h, lb + b_lb, rz + b_rz), None
-                    zero = jnp.zeros((), jnp.float32)
-                    (h, lb, rz), _ = lax.scan(body, (h, zero, zero),
-                                              stage_layers)
-                    return h, (lb, rz)
-            else:
-                def stage_fn(stage_layers, h):
-                    def body(h, lp):
-                        return layer_fn(lp, h), None
-                    h, _ = lax.scan(body, h, stage_layers)
-                    return h
+            stage_fn = make_stage_fn()
 
             aux = None
             if n_virtual > 1:
@@ -384,29 +418,205 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
         grads = jax.tree.map(lambda g: g / group, grads)
         loss = lax.pmean(loss, "dp")
 
-        # Gradient reduction rule: pmean over dp (mean loss over the global
-        # batch); psum over every axis the leaf is REPLICATED on ('tp' for
-        # attention/norm leaves, 'pp'+'tp' for the embedding family); no
-        # reduction over axes the leaf is sharded on.
-        def reduce(g, tp_sharded: bool, pp_sharded: bool):
-            if dp_quant_bits is not None:
-                from mpi_acx_tpu.parallel.quantized import quantized_pmean
-                g = quantized_pmean(g, "dp", dp_quant_bits)
-            else:
-                g = lax.pmean(g, "dp")
-            if not tp_sharded:
-                g = lax.psum(g, "tp")
-            if not pp_sharded:
-                g = lax.psum(g, "pp")
-            return g
-
+        # Gradient reduction rule: see reduce_grad ('tp' psum for
+        # attention/norm leaves, 'pp'+'tp' for the embedding family; no
+        # reduction over axes the leaf is sharded on).
         out = dict(grads)
         for k in grads:
             if k != "layers":
-                out[k] = reduce(grads[k], False, False)
+                out[k] = reduce_grad(grads[k], False, False)
         out["layers"] = {
-            k: reduce(grads["layers"][k], fam.tp_sharded(k), True)
+            k: reduce_grad(grads["layers"][k], fam.tp_sharded(k), True)
             for k in grads["layers"]
+        }
+        return loss, out
+
+    def per_shard_1f1b(params, tokens, targets):
+        """The 1F1B counterpart of per_shard: manual backward, O(pp)
+        activation residency. See make_loss_and_grads docstring; the
+        schedule tables and correctness story live in
+        parallel.pipeline (_schedule_1f1b / pipeline_1f1b_loss_and_grads
+        — this is that construction with the flagship's tp collectives,
+        tail (final-norm + head) and embedding vjps, and MoE aux seeds
+        folded in). Collectives inside the stage force select-masked
+        (not cond-skipped) execution: every rank runs the forward and
+        the backward body each slot, in lockstep."""
+        from mpi_acx_tpu.parallel.pipeline import _schedule_1f1b
+        M, mbl, S = tokens.shape
+        P_stages = n_stages
+        T, fwd_np, bwd_np, arr_np, K = _schedule_1f1b(P_stages, M)
+        fwd_tab = jnp.asarray(fwd_np)
+        bwd_tab = jnp.asarray(bwd_np)
+        arr_tab = jnp.asarray(arr_np)
+
+        stage = lax.axis_index("pp")
+        tpn = lax.axis_size("tp")
+        ti = lax.axis_index("tp")
+        last = P_stages - 1
+        blk = S // tpn
+        n_tok = M * mbl * S
+        calls = cfg.n_layers * M
+        fwd_perm = [(i, i + 1) for i in range(P_stages - 1)]
+        bwd_perm = [(i, i - 1) for i in range(1, P_stages)]
+
+        slayers = jax.tree.map(lambda p: p[0], params["layers"])
+        tail = {k: v for k, v in params.items() if k != "layers"}
+        stage_fn = make_stage_fn()
+
+        x_all = fam.embed(params, cfg, tokens)     # [M, mbl, S, d]
+        mb_shape = x_all.shape[1:]
+        zero_act = jnp.zeros(mb_shape, x_all.dtype)
+
+        def embed_m(tailp, tok_m):
+            return fam.embed(dict(tailp, layers=slayers), cfg, tok_m)
+
+        def tail_ll(tailp, y, tgt_m):
+            # This rank's EXCLUSIVE loss share for one microbatch: the
+            # local tp sequence slice, collective-free (assembly is one
+            # psum of the accumulated scalars after the scan).
+            full = dict(tailp, layers=slayers)
+            ys = fam.final(full, y)
+            ys_blk = lax.dynamic_slice_in_dim(ys, ti * blk, blk, axis=1)
+            tg_blk = lax.dynamic_slice_in_dim(tgt_m, ti * blk, blk,
+                                              axis=1)
+            logits = ys_blk.astype(jnp.float32) @ fam.head(full).T
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(logp, tg_blk[..., None], -1)[..., 0]
+            return jnp.sum(ll)
+
+        def slot(carry, t):
+            ib, fmsg, bmsg, gl, gt, lacc, lbacc, rzacc = carry
+
+            # 1) Bank an arriving activation.
+            am = arr_tab[stage, t]
+            ib = jnp.where(
+                am >= 0,
+                lax.dynamic_update_index_in_dim(
+                    ib, fmsg, jnp.maximum(am, 0) % K, 0),
+                ib)
+
+            # 2) Forward (masked, never cond-skipped: lockstep
+            # collectives).
+            mf = fwd_tab[stage, t]
+            mfc = jnp.maximum(mf, 0)
+            fresh = lax.dynamic_index_in_dim(x_all, mfc, 0,
+                                             keepdims=False)
+            x_f = jnp.where(stage == 0, fresh,
+                            lax.dynamic_index_in_dim(ib, mfc % K, 0,
+                                                     keepdims=False))
+            ib = jnp.where(
+                mf >= 0,
+                lax.dynamic_update_index_in_dim(ib, x_f, mfc % K, 0),
+                ib)
+            out_f = stage_fn(slayers, x_f)
+            y_f = out_f[0] if fam.has_aux else out_f
+
+            # 3) Backward: recompute from the banked input (remat) and
+            # seed — the loss cotangent at the last stage, the
+            # neighbor's dx elsewhere; MoE aux seeds apply at EVERY
+            # stage (each owns its layers' routers), gated to ti == 0
+            # for the exclusive-path rule.
+            mb_ = bwd_tab[stage, t]
+            mbc = jnp.maximum(mb_, 0)
+            x_b = lax.dynamic_index_in_dim(ib, mbc % K, 0,
+                                           keepdims=False)
+            out_b, vjp_fn = jax.vjp(
+                lambda sl, x: stage_fn(sl, x), slayers, x_b)
+            y_b = out_b[0] if fam.has_aux else out_b
+            tgt_m = lax.dynamic_index_in_dim(targets, mbc, 0,
+                                             keepdims=False)
+
+            # tail_ll and embed_m are collective-free, so (unlike the
+            # stage body) they may run under per-device lax.cond: only
+            # the one stage that consumes each vjp pays for it.
+            zero_tail = jax.tree.map(
+                lambda p: jnp.zeros_like(p), tail)
+
+            def loss_side(y_):
+                llsum, tail_vjp = jax.vjp(
+                    lambda tp_, yy: tail_ll(tp_, yy, tgt_m), tail, y_)
+                d_tail, dy = tail_vjp(
+                    jnp.asarray(-1.0 / n_tok, llsum.dtype))
+                return llsum, d_tail, dy.astype(y_.dtype)
+
+            llsum, d_tail_loss, dy_loss = lax.cond(
+                stage == last, loss_side,
+                lambda y_: (jnp.zeros((), jnp.float32), zero_tail,
+                            jnp.zeros_like(y_)), y_b)
+            dy = jnp.where(stage == last, dy_loss,
+                           bmsg.astype(y_b.dtype))
+            if fam.has_aux:
+                gate = (ti == 0).astype(jnp.float32)
+                seed = (dy, (aux_weight / calls * gate,
+                             z_weight / calls * gate))
+            else:
+                seed = dy
+            d_layers, dx = vjp_fn(seed)
+
+            bmask = mb_ >= 0
+            gl = jax.tree.map(
+                lambda a, d: a + jnp.where(bmask, d, 0), gl, d_layers)
+            lastmask = jnp.logical_and(bmask, stage == last)
+            gt = jax.tree.map(
+                lambda a, d: a + jnp.where(lastmask, d, 0), gt,
+                d_tail_loss)
+            # Embedding-side tail grads: exclusive to stage 0, where
+            # the pipeline consumed x_all.
+            tok_m = lax.dynamic_index_in_dim(tokens, mbc, 0,
+                                             keepdims=False)
+
+            def embed_side(dx_):
+                _, embed_vjp = jax.vjp(
+                    lambda tp_: embed_m(tp_, tok_m), tail)
+                (d,) = embed_vjp(dx_.astype(x_all.dtype))
+                return d
+
+            d_tail_embed = lax.cond(stage == 0, embed_side,
+                                    lambda dx_: zero_tail, dx)
+            emask = jnp.logical_and(bmask, stage == 0)
+            gt = jax.tree.map(
+                lambda a, d: a + jnp.where(emask, d, 0), gt,
+                d_tail_embed)
+            lacc = lacc + jnp.where(lastmask, llsum, 0.0)
+            if fam.has_aux:
+                g0 = jnp.logical_and(bmask, ti == 0)
+                lbacc = lbacc + jnp.where(g0, out_b[1][0], 0.0)
+                rzacc = rzacc + jnp.where(g0, out_b[1][1], 0.0)
+
+            # 4) Lockstep exchanges.
+            fmsg = lax.ppermute(jnp.where(mf >= 0, y_f, zero_act),
+                                "pp", perm=fwd_perm)
+            bmsg = lax.ppermute(
+                jnp.where(bmask, dx, jnp.zeros_like(dx)), "pp",
+                perm=bwd_perm)
+            return (ib, fmsg, bmsg, gl, gt, lacc, lbacc, rzacc), None
+
+        varying = lambda a: lax.pcast(a, "pp", to="varying")  # noqa: E731
+        init = (
+            varying(jnp.zeros((K,) + mb_shape, x_all.dtype)),
+            varying(zero_act), varying(zero_act),
+            jax.tree.map(lambda p: varying(jnp.zeros_like(p)), slayers),
+            jax.tree.map(lambda p: varying(jnp.zeros_like(p)), tail),
+            varying(jnp.zeros((), jnp.float32)),
+            varying(jnp.zeros((), jnp.float32)),
+            varying(jnp.zeros((), jnp.float32)),
+        )
+        (ib, fmsg, bmsg, gl, gt, lacc, lbacc, rzacc), _ = lax.scan(
+            slot, init, jnp.arange(T))
+
+        total_ll, lb_t, rz_t = lax.psum((lacc, lbacc, rzacc),
+                                        ("pp", "tp"))
+        loss = -total_ll / n_tok
+        if fam.has_aux:
+            loss = loss + (aux_weight * lb_t + z_weight * rz_t) / calls
+        loss = lax.pmean(loss, "dp")
+
+        # These are TRUE local grads (manual vjp with exclusive seeds —
+        # no autodiff loss-assembly psum to undo); reduce directly.
+        out = {k: reduce_grad(gt[k], False, False) for k in gt}
+        out["layers"] = {
+            k: reduce_grad(gl[k][None], fam.tp_sharded(k), True)
+            for k in gl
         }
         return loss, out
 
@@ -419,7 +629,8 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
             for k, s in specs["layers"].items()
         }
     data_spec = P(None, "dp")
-    fn = shard_map(per_shard, mesh=mesh,
+    body = per_shard_1f1b if schedule == "1f1b" else per_shard
+    fn = shard_map(body, mesh=mesh,
                    in_specs=(specs, data_spec, data_spec),
                    out_specs=(P(), specs),
                    check_vma=False)
@@ -429,7 +640,8 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
 def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
                     n_micro: int, lr: float = 1e-2, n_virtual: int = 1,
                     remat: bool = False, dp_quant_bits: int | None = None,
-                    aux_weight: float = 1e-2, z_weight: float = 1e-3):
+                    aux_weight: float = 1e-2, z_weight: float = 1e-3,
+                    schedule: str = "gpipe"):
     """Jitted (params, tokens, targets) -> (loss, new_params) SGD step
     (stateless optimizer; for stateful ones use make_train_step_optax)."""
     grad_fn, n_stages = make_loss_and_grads(cfg, mesh, n_micro,
@@ -437,7 +649,8 @@ def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
                                             remat=remat,
                                             dp_quant_bits=dp_quant_bits,
                                             aux_weight=aux_weight,
-                                            z_weight=z_weight)
+                                            z_weight=z_weight,
+                                            schedule=schedule)
 
     @jax.jit
     def step(params, tokens, targets):
@@ -452,7 +665,8 @@ def make_train_step_optax(cfg: tfm.TransformerConfig, mesh: Mesh,
                           n_micro: int, optimizer, n_virtual: int = 1,
                           remat: bool = False,
                           dp_quant_bits: int | None = None,
-                          aux_weight: float = 1e-2, z_weight: float = 1e-3):
+                          aux_weight: float = 1e-2, z_weight: float = 1e-3,
+                          schedule: str = "gpipe"):
     """Distributed train step with any optax GradientTransformation.
 
     Returns (step, n_stages): step(params, opt_state, tokens, targets) ->
@@ -469,7 +683,8 @@ def make_train_step_optax(cfg: tfm.TransformerConfig, mesh: Mesh,
                                             remat=remat,
                                             dp_quant_bits=dp_quant_bits,
                                             aux_weight=aux_weight,
-                                            z_weight=z_weight)
+                                            z_weight=z_weight,
+                                            schedule=schedule)
 
     @jax.jit
     def step(params, opt_state, tokens, targets):
