@@ -22,7 +22,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--family", choices=["gpt2", "llama"], default="gpt2")
+    ap.add_argument("--family", choices=["gpt2", "llama", "moe"],
+                    default="gpt2")
+    ap.add_argument("--schedule", choices=["gpipe", "1f1b"],
+                    default="gpipe",
+                    help="pipeline schedule: GPipe (autodiff backward) "
+                         "or 1F1B (O(pp) activation residency)")
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--dp", type=int, default=2)
     ap.add_argument("--pp", type=int, default=2)
@@ -64,6 +69,13 @@ def main():
         cfg = lm.tiny_llama(vocab=256, d_model=64, n_heads=4, n_kv_heads=2,
                             n_layers=n_layers, d_ff=128, max_seq=64)
         params = lm.init_params(jax.random.key(0), cfg)
+    elif args.family == "moe":
+        from mpi_acx_tpu.models import moe_transformer as mtf
+        cfg = mtf.tiny_moe_config(vocab=256, d_model=64, n_heads=4,
+                                  n_layers=n_layers, d_ff=128,
+                                  n_experts=2 * args.tp,
+                                  capacity_factor=4.0, max_seq=64)
+        params = mtf.init_params(jax.random.key(0), cfg)
     else:
         cfg = tfm.tiny_config(vocab=256, d_model=64, n_heads=4,
                               n_layers=n_layers, d_ff=128, max_seq=64)
@@ -74,7 +86,8 @@ def main():
     M = args.pp if args.virtual > 1 else 2
     step, n_stages = make_train_step_optax(cfg, mesh, n_micro=M,
                                            optimizer=opt,
-                                           n_virtual=args.virtual)
+                                           n_virtual=args.virtual,
+                                           schedule=args.schedule)
     if args.virtual > 1:
         p = tfm.stage_slice_interleaved(params, n_stages, args.virtual)
     else:
