@@ -41,6 +41,9 @@ def main():
                          "through mpi_acx_tpu.data with device prefetch); "
                          "default: synthetic ramp task")
     args = ap.parse_args()
+    if args.schedule == "1f1b" and args.virtual > 1:
+        ap.error("--schedule 1f1b is the non-interleaved schedule; "
+                 "drop --virtual")
 
     import jax
     # Hosts with a pinned accelerator plugin (e.g. the axon tunnel) register

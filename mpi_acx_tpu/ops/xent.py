@@ -1,0 +1,122 @@
+"""Memory-bounded cross-entropy: online-logsumexp over vocab chunks.
+
+The [tokens, vocab] logits tensor is the largest single allocation in a
+training step (GPT-2 bench shape B=8 S=512, V=50257: ~0.8 GB in f32 —
+bigger than the model). This op computes the per-token target
+log-likelihood ``logit[target] - logsumexp(logits)`` WITHOUT ever
+materializing the full logits:
+
+* forward — one ``lax.scan`` over vocab chunks carrying the running
+  (max, sumexp, target-logit) triple; peak extra memory is one
+  [T, chunk] tile.
+* backward — ``jax.custom_vjp``: residuals are just (h, head, lse, g)
+  — O(T) beyond the inputs — and a second scan recomputes each chunk's
+  logits to form the softmax cotangents tile by tile. This is the
+  flash-attention trade applied to the unembedding: recompute a tile
+  instead of storing the O(T·V) intermediate.
+
+Exact up to float summation order (tests pin values and gradients
+against the naive log_softmax path at 1e-6 and the compiled temp
+memory at a fraction of the naive step's).
+
+The reference has no model stack at all (SURVEY.md §0); this is TPU
+framework territory — the same trick "How to Scale Your Model"-style
+recipes assume for large-vocab training.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = float(jnp.finfo(jnp.float32).min)
+
+
+def _pad_head(head: jax.Array, chunk: int):
+    V = head.shape[0]
+    pad = (-V) % chunk
+    if pad:
+        head = jnp.pad(head, ((0, pad), (0, 0)))
+    return head, V, (V + pad) // chunk
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def chunked_xent_ll(h: jax.Array, head: jax.Array, targets: jax.Array,
+                    chunk: int = 8192) -> jax.Array:
+    """Per-token target log-likelihood, vocab processed ``chunk`` rows
+    at a time. ``h`` [T, d] (any float dtype; compute in f32),
+    ``head`` [V, d], ``targets`` [T] int. Returns [T] f32 equal to
+    ``log_softmax(h @ head.T)[t, targets[t]]``."""
+    ll, _ = _xent_fwd_scan(h, head, targets, chunk)
+    return ll
+
+
+def _xent_fwd_scan(h, head, targets, chunk):
+    T = h.shape[0]
+    h32 = h.astype(jnp.float32)
+    headp, V, n_chunks = _pad_head(head, chunk)
+    lanes = jnp.arange(chunk)
+
+    def body(carry, c0):
+        m, s, tl = carry
+        head_c = lax.dynamic_slice_in_dim(headp, c0, chunk, 0)
+        lg = h32 @ head_c.astype(jnp.float32).T            # [T, chunk]
+        lg = jnp.where((c0 + lanes)[None, :] < V, lg, _NEG)
+        cm = jnp.max(lg, axis=-1)
+        nm = jnp.maximum(m, cm)
+        s = s * jnp.exp(m - nm) + jnp.sum(jnp.exp(lg - nm[:, None]), -1)
+        idx = targets - c0
+        inb = jnp.logical_and(idx >= 0, idx < chunk)
+        got = jnp.take_along_axis(
+            lg, jnp.clip(idx, 0, chunk - 1)[:, None], 1)[:, 0]
+        tl = tl + jnp.where(inb, got, 0.0)
+        return (nm, s, tl), None
+
+    init = (jnp.full((T,), _NEG, jnp.float32),
+            jnp.zeros((T,), jnp.float32), jnp.zeros((T,), jnp.float32))
+    (m, s, tl), _ = lax.scan(
+        body, init, jnp.arange(n_chunks, dtype=jnp.int32) * chunk)
+    lse = m + jnp.log(s)
+    return tl - lse, lse
+
+
+def _xent_fwd(h, head, targets, chunk):
+    ll, lse = _xent_fwd_scan(h, head, targets, chunk)
+    return ll, (h, head, targets, lse)
+
+
+def _xent_bwd(chunk, res, g):
+    h, head, targets, lse = res
+    T, d = h.shape
+    h32 = h.astype(jnp.float32)
+    headp, V, n_chunks = _pad_head(head, chunk)
+    lanes = jnp.arange(chunk)
+
+    def body(carry, c0):
+        dh, dheadp = carry
+        head_c = lax.dynamic_slice_in_dim(headp, c0, chunk, 0)
+        lg = h32 @ head_c.astype(jnp.float32).T            # recompute tile
+        valid = (c0 + lanes)[None, :] < V
+        p = jnp.where(valid, jnp.exp(lg - lse[:, None]), 0.0)
+        idx = targets - c0
+        onehot = jnp.logical_and(idx[:, None] == lanes[None, :],
+                                 valid).astype(jnp.float32)
+        coef = (onehot - p) * g[:, None]                   # [T, chunk]
+        dh = dh + coef @ head_c.astype(jnp.float32)
+        # coef is already zero on padded lanes, so dhead rows past V
+        # stay zero and the final [:V] trim is exact.
+        dhead_c = coef.T @ h32                             # [chunk, d]
+        dheadp = lax.dynamic_update_slice_in_dim(dheadp, dhead_c, c0, 0)
+        return (dh, dheadp), None
+
+    init = (jnp.zeros((T, d), jnp.float32),
+            jnp.zeros_like(headp, dtype=jnp.float32))
+    (dh, dheadp), _ = lax.scan(
+        body, init, jnp.arange(n_chunks, dtype=jnp.int32) * chunk)
+    return (dh.astype(h.dtype), dheadp[:V].astype(head.dtype), None)
+
+
+chunked_xent_ll.defvjp(_xent_fwd, _xent_bwd)

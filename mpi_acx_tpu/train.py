@@ -254,7 +254,8 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
                         remat: bool = False,
                         dp_quant_bits: int | None = None,
                         aux_weight: float = 1e-2, z_weight: float = 1e-3,
-                        schedule: str = "gpipe"):
+                        schedule: str = "gpipe",
+                        xent_chunk: int | None = None):
     """Builds a jitted (params, tokens, targets) -> (loss, grads) over a
     ('dp','pp','tp') mesh — the shard_map core every optimizer shares.
     Returned grads carry the same shardings as params, so any elementwise
@@ -314,6 +315,23 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
     assert schedule in ("gpipe", "1f1b"), schedule
     if schedule == "1f1b":
         assert n_virtual == 1, "1F1B is the non-interleaved schedule"
+
+    def ll_sum(head_mat, ys_blk, tg_blk):
+        """Summed target log-likelihood of a rank's exclusive slice.
+        ``xent_chunk`` selects the memory-bounded chunked-vocab path
+        (ops/xent.py — the [tokens, vocab] logits tensor never
+        materializes; identical values/grads up to fp summation order),
+        None the naive log_softmax."""
+        if xent_chunk is None:
+            logits = ys_blk.astype(jnp.float32) @ head_mat.T
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(logp, tg_blk[..., None], -1)[..., 0]
+            return jnp.sum(ll)
+        from mpi_acx_tpu.ops.xent import chunked_xent_ll
+        d = ys_blk.shape[-1]
+        return jnp.sum(chunked_xent_ll(
+            ys_blk.reshape(-1, d), head_mat, tg_blk.reshape(-1),
+            xent_chunk))
 
     def reduce_grad(g, tp_sharded: bool, pp_sharded: bool):
         """Gradient reduction rule shared by both schedules: pmean over
@@ -385,10 +403,9 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
             blk = S // tpn
             ys_blk = lax.dynamic_slice_in_dim(ys, ti * blk, blk, axis=2)
             tg_blk = lax.dynamic_slice_in_dim(targets, ti * blk, blk, axis=2)
-            logits = ys_blk.astype(jnp.float32) @ fam.head(params).T
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            ll = jnp.take_along_axis(logp, tg_blk[..., None], -1)[..., 0]
-            contrib = jnp.where(si == n_stages - 1, jnp.sum(ll), 0.0)
+            contrib = jnp.where(si == n_stages - 1,
+                                ll_sum(fam.head(params), ys_blk, tg_blk),
+                                0.0)
             if fam.has_aux:
                 # Aux is replicated over tp (full gates everywhere) and
                 # device-varying over pp (each stage owns its layers):
@@ -479,10 +496,7 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
             ys_blk = lax.dynamic_slice_in_dim(ys, ti * blk, blk, axis=1)
             tg_blk = lax.dynamic_slice_in_dim(tgt_m, ti * blk, blk,
                                               axis=1)
-            logits = ys_blk.astype(jnp.float32) @ fam.head(full).T
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            ll = jnp.take_along_axis(logp, tg_blk[..., None], -1)[..., 0]
-            return jnp.sum(ll)
+            return ll_sum(fam.head(full), ys_blk, tg_blk)
 
         def slot(carry, t):
             ib, fmsg, bmsg, gl, gt, lacc, lbacc, rzacc = carry
@@ -641,7 +655,8 @@ def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
                     n_micro: int, lr: float = 1e-2, n_virtual: int = 1,
                     remat: bool = False, dp_quant_bits: int | None = None,
                     aux_weight: float = 1e-2, z_weight: float = 1e-3,
-                    schedule: str = "gpipe"):
+                    schedule: str = "gpipe",
+                    xent_chunk: int | None = None):
     """Jitted (params, tokens, targets) -> (loss, new_params) SGD step
     (stateless optimizer; for stateful ones use make_train_step_optax)."""
     grad_fn, n_stages = make_loss_and_grads(cfg, mesh, n_micro,
@@ -650,7 +665,8 @@ def make_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
                                             dp_quant_bits=dp_quant_bits,
                                             aux_weight=aux_weight,
                                             z_weight=z_weight,
-                                            schedule=schedule)
+                                            schedule=schedule,
+                                            xent_chunk=xent_chunk)
 
     @jax.jit
     def step(params, tokens, targets):
@@ -666,7 +682,8 @@ def make_train_step_optax(cfg: tfm.TransformerConfig, mesh: Mesh,
                           remat: bool = False,
                           dp_quant_bits: int | None = None,
                           aux_weight: float = 1e-2, z_weight: float = 1e-3,
-                          schedule: str = "gpipe"):
+                          schedule: str = "gpipe",
+                          xent_chunk: int | None = None):
     """Distributed train step with any optax GradientTransformation.
 
     Returns (step, n_stages): step(params, opt_state, tokens, targets) ->
@@ -684,7 +701,8 @@ def make_train_step_optax(cfg: tfm.TransformerConfig, mesh: Mesh,
                                             dp_quant_bits=dp_quant_bits,
                                             aux_weight=aux_weight,
                                             z_weight=z_weight,
-                                            schedule=schedule)
+                                            schedule=schedule,
+                                            xent_chunk=xent_chunk)
 
     @jax.jit
     def step(params, opt_state, tokens, targets):
