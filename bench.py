@@ -233,12 +233,31 @@ def tpu_child_full():
     train_toks = tok.size / (
         timeit(train_loop, params_f32, ostate, tok, tgt) / treps)
 
+    # A/B: the same step with chunked-vocab CE (ops/xent.py) — the
+    # [4096, 50257] logits tensor (~0.8 GB f32) never materializes;
+    # measures whether the saved HBM traffic beats the scan overhead.
+    @jax.jit
+    def train_loop_chunked(p, s, tok, tgt):
+        def body(carry, _):
+            p, s = carry
+            loss, g = jax.value_and_grad(
+                lambda p: tfm.loss_fn(p, cfg, tok, tgt,
+                                      xent_chunk=8192))(p)
+            upd, s = opt.update(g, s, p)
+            return (optax.apply_updates(p, upd), s), loss
+        (_, _), losses = jax.lax.scan(body, (p, s), None, length=treps)
+        return losses[-1]
+
+    train_toks_chunked = tok.size / (
+        timeit(train_loop_chunked, params_f32, ostate, tok, tgt) / treps)
+
     print(json.dumps({
         "flash_speedup_s4096": round(speedup, 2),
         "flash_ms": round(t_flash * 1e3, 3),
         "dense_ms": round(t_dense * 1e3, 3),
         "decode_tokens_per_s": round(decode_toks, 1),
         "train_step_tokens_per_s": round(train_toks, 1),
+        "train_step_xentchunk_tokens_per_s": round(train_toks_chunked, 1),
         "device": str(jax.devices()[0].platform),
     }))
 

@@ -132,8 +132,29 @@ def forward(params: Params, cfg: TransformerConfig,
 
 
 def loss_fn(params: Params, cfg: TransformerConfig, tokens: jax.Array,
-            targets: jax.Array) -> jax.Array:
-    """Mean next-token cross-entropy."""
+            targets: jax.Array,
+            xent_chunk: int | None = None) -> jax.Array:
+    """Mean next-token cross-entropy.
+
+    ``xent_chunk`` selects the memory-bounded chunked-vocab CE
+    (ops/xent.py): the [B, S, vocab] logits never materialize — the
+    hidden states go straight into the online-logsumexp scan, and the
+    custom VJP recomputes logit tiles in the backward. Same values and
+    gradients up to fp summation order; the win is HBM (the logits are
+    the largest tensor in a training step at GPT-2 vocab)."""
+    if xent_chunk is not None:
+        from mpi_acx_tpu.ops.xent import chunked_xent_ll
+        B, S = tokens.shape
+        x = (params["embed"][tokens] + params["pos"][:S]).astype(cfg.dtype)
+
+        def body(x, lp):
+            return block(cfg, lp, x), None
+
+        x, _ = lax.scan(body, x, params["layers"])
+        x = layernorm(x, params["lnf_g"], params["lnf_b"])
+        ll = chunked_xent_ll(x.reshape(B * S, -1), params["embed"],
+                             targets.reshape(-1), xent_chunk)
+        return -jnp.mean(ll)
     logits = forward(params, cfg, tokens)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
