@@ -172,7 +172,26 @@ def forward(params: Params, cfg: LlamaConfig,
 
 
 def loss_fn(params: Params, cfg: LlamaConfig, tokens: jax.Array,
-            targets: jax.Array) -> jax.Array:
+            targets: jax.Array,
+            xent_chunk: int | None = None) -> jax.Array:
+    """Mean next-token cross-entropy; ``xent_chunk`` selects the
+    memory-bounded chunked-vocab CE (ops/xent.py — the [B, S, vocab]
+    logits never materialize; same values/grads to fp summation
+    order)."""
+    if xent_chunk is not None:
+        from mpi_acx_tpu.ops.xent import chunked_xent_ll
+        B, S = tokens.shape
+        x = params["embed"][tokens].astype(cfg.dtype)
+        positions = jnp.arange(S)
+
+        def body(x, lp):
+            return block(cfg, lp, x, positions), None
+
+        x, _ = lax.scan(body, x, params["layers"])
+        x = rmsnorm(x, params["final_norm"])
+        ll = chunked_xent_ll(x.reshape(B * S, -1), params["unembed"],
+                             targets.reshape(-1), xent_chunk)
+        return -jnp.mean(ll)
     logits = forward(params, cfg, tokens)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

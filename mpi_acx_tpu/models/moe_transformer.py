@@ -117,10 +117,10 @@ def block(cfg: MoeTransformerConfig, lp: Dict[str, Any], h: jax.Array,
     return h + y.reshape(B, S, d), (aux["load_balance"], aux["router_z"])
 
 
-def forward(params: Dict[str, Any], cfg: MoeTransformerConfig,
+def _hidden(params: Dict[str, Any], cfg: MoeTransformerConfig,
             tokens: jax.Array, ep_axis: str | None = None):
-    """tokens [B, S] -> (logits [B, S, vocab] f32, aux) where aux is the
-    dict of per-layer MEAN router losses."""
+    """Shared trunk: tokens -> (final-normed hidden states [B, S, d],
+    aux dict of per-layer MEAN router losses)."""
     B, S = tokens.shape
     h = (params["embed"][tokens] + params["pos"][:S]).astype(cfg.dtype)
 
@@ -132,18 +132,36 @@ def forward(params: Dict[str, Any], cfg: MoeTransformerConfig,
     zero = jnp.zeros((), jnp.float32)
     (h, lb, rz), _ = lax.scan(body, (h, zero, zero), params["layers"])
     h = tfm.layernorm(h, params["lnf_g"], params["lnf_b"])
+    L = cfg.n_layers
+    return h, {"load_balance": lb / L, "router_z": rz / L}
+
+
+def forward(params: Dict[str, Any], cfg: MoeTransformerConfig,
+            tokens: jax.Array, ep_axis: str | None = None):
+    """tokens [B, S] -> (logits [B, S, vocab] f32, aux) where aux is the
+    dict of per-layer MEAN router losses."""
+    h, aux = _hidden(params, cfg, tokens, ep_axis=ep_axis)
     # bf16 operands, f32 accumulation — the unembed convention the dense
     # family measured 1.45x whole-model latency for getting wrong.
     logits = jnp.einsum("bsd,vd->bsv", h, params["embed"].astype(h.dtype),
                         preferred_element_type=jnp.float32)
-    L = cfg.n_layers
-    return logits, {"load_balance": lb / L, "router_z": rz / L}
+    return logits, aux
 
 
 def loss_fn(params, cfg: MoeTransformerConfig, tokens, targets,
             aux_weight: float = 1e-2, z_weight: float = 1e-3,
-            ep_axis: str | None = None):
-    """Mean token cross-entropy + weighted router auxiliaries."""
+            ep_axis: str | None = None, xent_chunk: int | None = None):
+    """Mean token cross-entropy + weighted router auxiliaries;
+    ``xent_chunk`` selects the memory-bounded chunked-vocab CE
+    (ops/xent.py — no logits materialization)."""
+    if xent_chunk is not None:
+        from mpi_acx_tpu.ops.xent import chunked_xent_ll
+        B, S = tokens.shape
+        h, aux = _hidden(params, cfg, tokens, ep_axis=ep_axis)
+        ll = chunked_xent_ll(h.reshape(B * S, -1), params["embed"],
+                             targets.reshape(-1), xent_chunk)
+        return (-jnp.mean(ll) + aux_weight * aux["load_balance"]
+                + z_weight * aux["router_z"])
     logits, aux = forward(params, cfg, tokens, ep_axis=ep_axis)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
