@@ -1,8 +1,11 @@
 """End-to-end inference example: KV-cache decode with greedy or sampled
-continuation, on either model family.
+continuation, on either model family — optionally speculative (a small
+draft proposes, the target verifies k tokens per window pass) and
+batched (rows advance independently).
 
   python examples/generate_text.py --family llama --temperature 0.8 \
       --top-k 40 --top-p 0.95
+  python examples/generate_text.py --speculative --batch 4
 """
 
 import argparse
@@ -20,6 +23,12 @@ def main():
                     help="0 = greedy argmax")
     ap.add_argument("--top-k", type=int, default=None)
     ap.add_argument("--top-p", type=float, default=None)
+    ap.add_argument("--speculative", action="store_true",
+                    help="draft-proposes / target-verifies decoding "
+                         "(greedy: output equals plain greedy decode)")
+    ap.add_argument("--batch", type=int, default=1,
+                    help="rows decode together; each row's output and "
+                         "round count equal its own solo run")
     args = ap.parse_args()
 
     import jax
@@ -39,15 +48,36 @@ def main():
         params = tfm.init_params(jax.random.key(0), cfg)
         gen, gen_s = tfm.generate, tfm.generate_sample
 
-    prompt = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
-    if args.temperature == 0.0 and args.top_k is None and args.top_p is None:
+    base = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    prompt = jnp.tile(base, (args.batch, 1)).at[:, -1].add(
+        jnp.arange(args.batch))
+    if args.speculative:
+        import dataclasses
+        from mpi_acx_tpu.models.speculative import (speculative_generate,
+                                                    speculative_sample)
+        dcfg = dataclasses.replace(cfg, n_layers=1)
+        if args.family == "llama":
+            dparams = lm.init_params(jax.random.key(7), dcfg)
+        else:
+            dparams = tfm.init_params(jax.random.key(7), dcfg)
+        if args.temperature == 0.0:
+            out, stats = speculative_generate(dparams, dcfg, params, cfg,
+                                              prompt, args.n_new, k=4)
+        else:
+            out, stats = speculative_sample(
+                dparams, dcfg, params, cfg, prompt, args.n_new,
+                jax.random.key(42), k=4, temperature=args.temperature)
+        import numpy as np
+        print("rounds per row:", np.asarray(stats["rounds"]).tolist())
+    elif args.temperature == 0.0 and args.top_k is None and args.top_p is None:
         out = gen(params, cfg, prompt, n_new=args.n_new)
     else:
         out = gen_s(params, cfg, prompt, n_new=args.n_new,
                     key=jax.random.key(42), temperature=args.temperature,
                     top_k=args.top_k, top_p=args.top_p)
-    print(f"{args.family} prompt: ", prompt[0].tolist())
-    print(f"{args.family} output: ", out[0, prompt.shape[1]:].tolist())
+    for b in range(args.batch):
+        print(f"{args.family} row {b}: ",
+              out[b, prompt.shape[1]:].tolist())
     print("example OK")
 
 
