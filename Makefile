@@ -113,6 +113,7 @@ check: ctest itest tools
 	@for t in $(ITEST_BINS); do echo "== acxrun -np 2 $$t (rendezvous-all)"; ACX_RV_THRESHOLD=1 $(BUILD)/acxrun -np 2 $$t || exit 1; done
 	@for t in $(ITEST_BINS); do echo "== acxrun -np 2 $$t (rendezvous-nack)"; ACX_RV_THRESHOLD=1 ACX_RV_FORCE_FALLBACK=1 $(BUILD)/acxrun -np 2 $$t || exit 1; done
 	@for t in $(ITEST_BINS); do echo "== acxrun -np 2 $$t (rendezvous-socket)"; ACX_RV_THRESHOLD=1 $(BUILD)/acxrun -np 2 -transport socket $$t || exit 1; done
+	@for t in $(ITEST_BINS); do echo "== acxrun -np 4 $$t (shm, 4 ranks)"; $(BUILD)/acxrun -np 4 $$t || exit 1; done
 	@echo "== acxrun -np 2 fuzz (canary: corruption must be DETECTED)"
 	@ACX_FUZZ_CANARY=1 $(BUILD)/acxrun -np 2 $(BUILD)/itests/fuzz || exit 1
 	@echo "ALL NATIVE TESTS PASSED"

@@ -63,11 +63,12 @@ int main(int argc, char** argv) {
   MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided);
   MPI_Comm_rank(MPI_COMM_WORLD, &g_rank);
   MPI_Comm_size(MPI_COMM_WORLD, &size);
-  if (size != 2) {
-    if (g_rank == 0) fprintf(stderr, "concurrent-stress needs -np 2\n");
+  if (size % 2 != 0) {
+    if (g_rank == 0)
+      fprintf(stderr, "concurrent-stress needs an even -np\n");
     MPI_Abort(MPI_COMM_WORLD, 2);
   }
-  g_peer = 1 - g_rank;
+  g_peer = g_rank ^ 1;   /* xor pairing: (0,1), (2,3), ... */
   if (MPIX_Init()) MPI_Abort(MPI_COMM_WORLD, 2);
 
   pthread_t th[THREADS];
