@@ -126,7 +126,8 @@ def _window_pass(params, cfg, cache, tokens, ffn=None):
                         preferred_element_type=jnp.float32)
     return logits, {"k": ks, "v": vs, "pos": pos + W}
 
-def _make_run(draft_cfg, cfg, S, n_new, k, pick0, draft_pick, decide):
+def _make_run(draft_cfg, cfg, S, n_new, k, pick0, draft_pick, decide,
+              ops=None):
     """The ONE speculative round skeleton (prefill, draft scan with the
     k-th cache-seat step, window pass, buffer/cache bookkeeping,
     while_loop) shared by the greedy and stochastic variants, which
@@ -137,6 +138,13 @@ def _make_run(draft_cfg, cfg, S, n_new, k, pick0, draft_pick, decide):
     decide(props [k-1], q_logits [k-1,V], p_logits [k,V], key)
         -> (emit [k], m, pending [1])            (accept + finalize)
 
+    ``ops`` overrides the model-family operations as a tuple
+    ``(t_prefill, t_window, d_prefill, d_decode)`` with the family
+    signatures (params first) — the tensor-parallel speculative builder
+    injects per-shard TP variants here; None selects the single-device
+    family ops by config type. Returns the RAW traceable ``run`` —
+    callers jit it themselves (or embed it in an outer shard_map/jit).
+
     Cache invariants (identical for both variants): the draft runs k
     steps so full-acceptance rounds leave no unwritten cache seat; stale
     entries sit at >= the rolled-back pos and are rewritten before any
@@ -146,10 +154,12 @@ def _make_run(draft_cfg, cfg, S, n_new, k, pick0, draft_pick, decide):
     cap = S + n_new + k                      # overshoot slack, last round
     assert cap <= cfg.max_seq and cap <= draft_cfg.max_seq, (
         cap, cfg.max_seq, draft_cfg.max_seq)
-    t_prefill, _t_decode, t_window = _family_ops(cfg)
-    d_prefill, d_decode, _ = _family_ops(draft_cfg)
+    if ops is None:
+        t_prefill, _t_decode, t_window = _family_ops(cfg)
+        d_prefill, d_decode, _ = _family_ops(draft_cfg)
+    else:
+        t_prefill, t_window, d_prefill, d_decode = ops
 
-    @jax.jit
     def run(draft_params, params, prompt, key):
         t_logits, t_cache = t_prefill(params, cfg, prompt, cap,
                                       last_only=True)
@@ -212,14 +222,11 @@ def _make_run(draft_cfg, cfg, S, n_new, k, pick0, draft_pick, decide):
     return run
 
 
-@functools.lru_cache(maxsize=64)
-def _build(draft_cfg, cfg, S: int, n_new: int, k: int):
-    """Compiled GREEDY speculative loop: argmax proposals, the longest
-    prefix matching the target's argmax chain accepted, the target's
-    argmax as the bonus/correction. One compiled program per (configs,
-    shapes) — the configs are frozen dataclasses, so they key the
-    lru_cache and repeat calls are trace-free. The public wrapper passes
-    a dummy key (the greedy hooks ignore randomness)."""
+def _greedy_hooks(k: int):
+    """(pick0, draft_pick, decide) for GREEDY speculation: argmax
+    proposals, the longest prefix matching the target's argmax chain
+    accepted, the target's argmax as the bonus/correction. The hooks
+    ignore their key arguments."""
     def pick0(logits, key):
         return jnp.argmax(logits, -1)
 
@@ -235,22 +242,30 @@ def _build(draft_cfg, cfg, S: int, n_new: int, k: int):
         # equal the target chain; targets[m] is the bonus/correction).
         return targets, m, targets[m][None]
 
-    return _make_run(draft_cfg, cfg, S, n_new, k, pick0, draft_pick,
-                     decide)
+    return pick0, draft_pick, decide
 
 
 @functools.lru_cache(maxsize=64)
-def _build_sample(draft_cfg, cfg, S: int, n_new: int, k: int,
-                  temperature: float):
-    """Compiled STOCHASTIC speculative loop (the Leviathan/Chen
-    accept/resample algorithm): proposals are SAMPLED from the draft at
-    ``temperature``, each accepted with probability min(1, p(x)/q(x))
-    under the target's distribution p and the draft's q; on rejection
-    the token is resampled from normalize(max(p - q, 0)). Every emitted
-    token is therefore distributed EXACTLY as target-only sampling at
-    the same temperature (the algorithm's defining guarantee —
-    tests/test_speculative.py checks the two-token joint distribution
-    against exact teacher-forced target probabilities)."""
+def _build(draft_cfg, cfg, S: int, n_new: int, k: int):
+    """Compiled GREEDY speculative loop (hooks: _greedy_hooks). One
+    compiled program per (configs, shapes) — the configs are frozen
+    dataclasses, so they key the lru_cache and repeat calls are
+    trace-free. The public wrapper passes a dummy key."""
+    run = _make_run(draft_cfg, cfg, S, n_new, k, *_greedy_hooks(k))
+    return jax.jit(run)
+
+
+def _sample_hooks(k: int, temperature: float):
+    """(pick0, draft_pick, decide) for STOCHASTIC speculation (the
+    Leviathan/Chen accept/resample algorithm): proposals are SAMPLED
+    from the draft at ``temperature``, each accepted with probability
+    min(1, p(x)/q(x)) under the target's distribution p and the
+    draft's q; on rejection the token is resampled from
+    normalize(max(p - q, 0)). Every emitted token is therefore
+    distributed EXACTLY as target-only sampling at the same temperature
+    (the algorithm's defining guarantee — tests/test_speculative.py
+    checks the two-token joint distribution against exact
+    teacher-forced target probabilities)."""
     assert temperature > 0.0, temperature
     inv_t = 1.0 / temperature
 
@@ -288,8 +303,17 @@ def _build_sample(draft_cfg, cfg, S: int, n_new: int, k: int,
             emit, y[None].astype(props.dtype), (m,))
         return emit, m, y[None]
 
-    return _make_run(draft_cfg, cfg, S, n_new, k, pick0, draft_pick,
-                     decide)
+    return pick0, draft_pick, decide
+
+
+@functools.lru_cache(maxsize=64)
+def _build_sample(draft_cfg, cfg, S: int, n_new: int, k: int,
+                  temperature: float):
+    """Compiled STOCHASTIC speculative loop (hooks: _sample_hooks);
+    cached per (configs, shapes, temperature) like :func:`_build`."""
+    run = _make_run(draft_cfg, cfg, S, n_new, k,
+                    *_sample_hooks(k, temperature))
+    return jax.jit(run)
 
 
 @functools.lru_cache(maxsize=64)

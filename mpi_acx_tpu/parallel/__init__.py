@@ -48,6 +48,7 @@ from mpi_acx_tpu.parallel.tp_inference import (  # noqa: F401
     make_tp_generate,
     make_tp_generate_llama,
     make_tp_generate_moe,
+    make_tp_speculative_generate,
     tp_param_specs,
     tp_param_specs_llama,
     tp_param_specs_moe,

@@ -65,10 +65,7 @@ def _run_generation(hooks, layers, prompt, key, n_new, *, pick):
     logits0 = hooks["finish"](x[:, -1:])[:, 0]            # [B, vocab]
 
     # Cache layout follows the prefill outputs ([L, B, S, H?, D] local).
-    kc = jnp.zeros(ks.shape[:2] + (max_len,) + ks.shape[3:], ks.dtype)
-    vc = jnp.zeros_like(kc)
-    kc = lax.dynamic_update_slice(kc, ks, (0,) * kc.ndim)
-    vc = lax.dynamic_update_slice(vc, vs, (0,) * vc.ndim)
+    kc, vc = _init_kv_from_prefill(ks, vs, max_len)
 
     def dec_body(carry, step_key):
         kc, vc, pos, tok = carry
@@ -132,6 +129,60 @@ def tp_param_specs(axis: str = "tp"):
     }
 
 
+def _gpt2_embed(params, cfg, tokens):
+    """Token + learned-position embedding (replicated leaves)."""
+    S = tokens.shape[1]
+    return (params["embed"][tokens] + params["pos"][:S]).astype(cfg.dtype)
+
+
+def _gpt2_finish(params, cfg, x):
+    """Final layernorm + tied unembedding -> f32 logits."""
+    x = tfm.layernorm(x, params["lnf_g"], params["lnf_b"])
+    return jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(x.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def _init_kv_from_prefill(ks, vs, cap):
+    """Allocate [L, B, cap, H_local, D] caches and land the prefill
+    K/V at positions [0, S)."""
+    kc = jnp.zeros(ks.shape[:2] + (cap,) + ks.shape[3:], ks.dtype)
+    vc = jnp.zeros_like(kc)
+    kc = lax.dynamic_update_slice(kc, ks, (0,) * kc.ndim)
+    vc = lax.dynamic_update_slice(vc, vs, (0,) * vc.ndim)
+    return kc, vc
+
+
+def _gpt2_tp_layer_ops(cfg, tp: int, axis: str):
+    """The head/FFN-split per-layer primitives shared by TP generation
+    and TP speculative decoding: (local_qkv, out_proj, dense_mlp) over
+    this rank's Hl = n_heads/tp head slice (two psums per layer at the
+    residual boundaries — the classic Megatron split)."""
+    H, Dh, d = cfg.n_heads, cfg.head_dim, cfg.d_model
+    assert H % tp == 0, (H, tp)
+    Hl = H // tp
+
+    def local_qkv(lp, x):
+        B, S, _ = x.shape
+        h = tfm.layernorm(x, lp["ln1_g"], lp["ln1_b"])
+        qkv = h @ lp["wqkv"].reshape(d, 3 * Hl * Dh).astype(x.dtype)
+        return (t.reshape(B, S, Hl, Dh) for t in jnp.split(qkv, 3, -1))
+
+    def out_proj(lp, o, x):
+        B, S = o.shape[:2]
+        part = o.reshape(B, S, Hl * Dh) @ lp["wo"].reshape(
+            Hl * Dh, d).astype(x.dtype)
+        return x + lax.psum(part, axis)
+
+    def dense_mlp(lp, x):
+        h = tfm.layernorm(x, lp["ln2_g"], lp["ln2_b"])
+        y = jax.nn.gelu(h @ lp["w1"].astype(x.dtype)
+                        + lp["b1"].astype(x.dtype))
+        part = y @ lp["w2"].astype(x.dtype)
+        return x + lax.psum(part, axis) + lp["b2"].astype(x.dtype)
+
+    return local_qkv, out_proj, dense_mlp
+
+
 def make_tp_generate(cfg, mesh: Mesh, n_new: int,
                      axis: str = "tp", temperature: float = 0.0,
                      top_k: Optional[int] = None,
@@ -153,40 +204,16 @@ def make_tp_generate(cfg, mesh: Mesh, n_new: int,
     on tfm.prefill/decode_step.
     """
     tp = mesh.shape[axis]
-    H, Dh, d = cfg.n_heads, cfg.head_dim, cfg.d_model
-    assert H % tp == 0, (H, tp)
-    Hl = H // tp
-
-    def dense_mlp(lp, x):
-        h = tfm.layernorm(x, lp["ln2_g"], lp["ln2_b"])
-        y = jax.nn.gelu(h @ lp["w1"].astype(x.dtype)
-                        + lp["b1"].astype(x.dtype))
-        part = y @ lp["w2"].astype(x.dtype)
-        return x + lax.psum(part, axis) + lp["b2"].astype(x.dtype)
-
+    local_qkv, out_proj, dense_mlp = _gpt2_tp_layer_ops(cfg, tp, axis)
     mlp = ffn or dense_mlp
     shard_params_fn = shard_params or tp_shard_params
     specs = specs or tp_param_specs(axis)
-
-    def local_qkv(lp, x):
-        B, S, _ = x.shape
-        h = tfm.layernorm(x, lp["ln1_g"], lp["ln1_b"])
-        qkv = h @ lp["wqkv"].reshape(d, 3 * Hl * Dh).astype(x.dtype)
-        return (t.reshape(B, S, Hl, Dh) for t in jnp.split(qkv, 3, -1))
-
-    def out_proj(lp, o, x):
-        B, S = o.shape[:2]
-        part = o.reshape(B, S, Hl * Dh) @ lp["wo"].reshape(
-            Hl * Dh, d).astype(x.dtype)
-        return x + lax.psum(part, axis)
 
     def per_shard(params, prompt, key):
         assert prompt.shape[1] + n_new <= cfg.max_seq
 
         def embed(tokens):
-            S = tokens.shape[1]
-            return (params["embed"][tokens]
-                    + params["pos"][:S]).astype(cfg.dtype)
+            return _gpt2_embed(params, cfg, tokens)
 
         def embed_tok(tok, pos):
             return (params["embed"][tok][:, None, :]
@@ -206,10 +233,7 @@ def make_tp_generate(cfg, mesh: Mesh, n_new: int,
             return mlp(lp, out_proj(lp, o, x))
 
         def finish(x):
-            x = tfm.layernorm(x, params["lnf_g"], params["lnf_b"])
-            return jnp.einsum("bsd,vd->bsv", x,
-                              params["embed"].astype(x.dtype),
-                              preferred_element_type=jnp.float32)
+            return _gpt2_finish(params, cfg, x)
 
         hooks = {"embed": embed, "embed_tok": embed_tok,
                  "prefill_layer": prefill_layer,
@@ -428,5 +452,132 @@ def make_tp_generate_llama(cfg: lm.LlamaConfig, mesh: Mesh, n_new: int,
     @jax.jit
     def generate(params, prompt, key):
         return inner(tp_shard_params_llama(params, cfg), prompt, key)
+
+    return generate
+
+
+# -- Tensor-parallel SPECULATIVE decoding ----------------------------------
+
+
+def _tp_family_ops(cfg, tp: int, axis: str):
+    """GPT-2-family ops with the speculative-core signatures
+    (models.speculative._make_run ``ops``), tensor-parallel per shard:
+    (prefill, window, decode). Each rank holds its Hl-head slice of the
+    weights and KV cache; logits are assembled replicated by the
+    per-layer psums, so the speculative accept/roll-back control flow —
+    argmax chains, acceptance counts, while_loop conditions — computes
+    identically on every rank by construction."""
+    local_qkv, out_proj, mlp = _gpt2_tp_layer_ops(cfg, tp, axis)
+
+    embed = lambda params, tokens: _gpt2_embed(params, cfg, tokens)  # noqa: E731
+    finish = lambda params, x: _gpt2_finish(params, cfg, x)  # noqa: E731
+
+    def qkv_fn(lp, x, pos):
+        return tuple(local_qkv(lp, x))
+
+    def make_attend(max_len):
+        def attend_fn(lp, x, q, kcl, vcl, pos):
+            o = grouped_decode_attend(q, kcl, vcl, pos, max_len, n_rep=1)
+            return mlp(lp, out_proj(lp, o, x))
+        return attend_fn
+
+    def prefill(params, _cfg, tokens, cap, last_only=True):
+        x = embed(params, tokens)
+
+        def pl(x, lp):
+            q, k_, v_ = local_qkv(lp, x)
+            o = select_attention(cfg.use_flash)(q, k_, v_)
+            return mlp(lp, out_proj(lp, o, x)), (k_, v_)
+
+        x, (ks, vs) = lax.scan(pl, x, params["layers"])
+        logits = finish(params, x[:, -1:] if last_only else x)
+        kc, vc = _init_kv_from_prefill(ks, vs, cap)
+        return logits, {"k": kc, "v": vc,
+                        "pos": jnp.asarray(tokens.shape[1], jnp.int32)}
+
+    def decode(params, _cfg, cache, tok):
+        pos = cache["pos"]
+        max_len = cache["k"].shape[2]
+        x = (params["embed"][tok][:, None, :]
+             + params["pos"][pos][None, None, :]).astype(cfg.dtype)
+        x, kc, vc = decode_layer_scan(
+            params["layers"], x, cache["k"], cache["v"], pos, qkv_fn,
+            make_attend(max_len))
+        logits = finish(params, x)[:, 0]                  # [B, vocab]
+        return logits, {"k": kc, "v": vc, "pos": pos + 1}
+
+    def window(params, _cfg, cache, tokens):
+        W = tokens.shape[1]
+        pos = cache["pos"]
+        max_len = cache["k"].shape[2]
+        x = (params["embed"][tokens]
+             + lax.dynamic_slice_in_dim(params["pos"], pos, W, 0)[None]
+             ).astype(cfg.dtype)
+        x, kc, vc = decode_layer_scan(
+            params["layers"], x, cache["k"], cache["v"], pos, qkv_fn,
+            make_attend(max_len))
+        logits = finish(params, x)                        # [1, W, vocab]
+        return logits, {"k": kc, "v": vc, "pos": pos + W}
+
+    return prefill, window, decode
+
+
+def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
+                                 k: int = 4, axis: str = "tp",
+                                 temperature: float = 0.0):
+    """Tensor-parallel SPECULATIVE decoding: draft proposes, target
+    verifies k tokens per window pass — with BOTH models Megatron-split
+    over the mesh's ``axis`` inside one shard_map program (per-rank
+    head slices of weights and KV caches, two psums per layer). The
+    latency technique and the weight-streaming split compose: each
+    draft step and each k-wide target window streams 1/tp of the
+    weights per chip.
+
+    GPT-2 family only (TransformerConfig draft and target — the other
+    families' TP speculation composes the same way and can reuse
+    _tp_family_ops' pattern). ``temperature=0`` is greedy: output
+    tokens equal the single-device ``speculative_generate`` AND the
+    target-only greedy decode (tests/test_tp_inference.py asserts both
+    at tp=2/4); otherwise the stochastic accept/resample hooks run with
+    the replicated key, every rank drawing identical samples.
+
+    Returns a jitted ``generate(draft_params, params, prompt, key) ->
+    (tokens [1, S+n_new], stats)`` with stats as in
+    ``speculative_generate``.
+    """
+    from mpi_acx_tpu.models.speculative import (_greedy_hooks,
+                                                _make_run, _sample_hooks)
+
+    assert type(cfg) is tfm.TransformerConfig, (
+        "TP speculative decoding currently supports the GPT-2 family; "
+        f"got {type(cfg).__name__}")
+    assert type(draft_cfg) is tfm.TransformerConfig, type(draft_cfg)
+    assert draft_cfg.vocab == cfg.vocab, (draft_cfg.vocab, cfg.vocab)
+    assert k >= 2, k
+    tp = mesh.shape[axis]
+    t_ops = _tp_family_ops(cfg, tp, axis)
+    d_ops = _tp_family_ops(draft_cfg, tp, axis)
+    hooks = (_greedy_hooks(k) if temperature == 0.0
+             else _sample_hooks(k, float(temperature)))
+
+    def per_shard(dparams, params, prompt, key):
+        S = prompt.shape[1]        # static at trace time
+        run = _make_run(draft_cfg, cfg, S, n_new, k, *hooks,
+                        ops=(t_ops[0], t_ops[1], d_ops[0], d_ops[2]))
+        return run(dparams, params, prompt, key)
+
+    specs_t = tp_param_specs(axis)
+    specs_d = tp_param_specs(axis)
+    inner = shard_map(per_shard, mesh=mesh,
+                      in_specs=(specs_d, specs_t, P(), P()),
+                      out_specs=(P(), P(), P()), check_vma=False)
+
+    @jax.jit
+    def generate(draft_params, params, prompt, key):
+        assert prompt.shape[0] == 1, "TP speculative decode is B=1"
+        toks, rounds, acc = inner(
+            tp_shard_params(draft_params, draft_cfg),
+            tp_shard_params(params, cfg), prompt, key)
+        return toks, {"rounds": rounds, "drafted_accepted": acc}
 
     return generate

@@ -198,3 +198,65 @@ def test_tp_moe_expert_split_rejected():
     cfg = mtf.tiny_moe_config(n_heads=8, n_experts=6)
     with pytest.raises(AssertionError, match="6"):
         make_tp_generate_moe(cfg, mesh, 4)
+
+
+# -- TP speculative decoding ------------------------------------------------
+
+from mpi_acx_tpu.parallel.tp_inference import make_tp_speculative_generate
+from mpi_acx_tpu.models.speculative import speculative_generate
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_tp_speculative_greedy_matches_single_device(tp):
+    """Draft AND target Megatron-split over tp: emitted tokens equal
+    BOTH the single-device speculative run (same rounds, same
+    acceptance — the replicated logits drive identical control flow)
+    and the target-only greedy decode."""
+    mesh = mesh_from_devices({"tp": tp}, jax.devices()[:tp])
+    cfg = tfm.TransformerConfig(**{**tfm.tiny_config(
+        vocab=128, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=64).__dict__, "dtype": jnp.float32})
+    dcfg = tfm.TransformerConfig(**{**tfm.tiny_config(
+        vocab=128, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+        max_seq=64).__dict__, "dtype": jnp.float32})
+    params = tfm.init_params(jax.random.key(0), cfg)
+    dparams = tfm.init_params(jax.random.key(7), dcfg)
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab)
+    n_new, k = 16, 4
+
+    want, wstats = speculative_generate(dparams, dcfg, params, cfg,
+                                        prompt, n_new, k=k)
+    gen = make_tp_speculative_generate(dcfg, cfg, mesh, n_new, k=k)
+    got, stats = gen(dparams, params, prompt, jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert int(stats["rounds"]) == int(wstats["rounds"])
+    assert (int(stats["drafted_accepted"])
+            == int(wstats["drafted_accepted"]))
+    plain = tfm.generate(params, cfg, prompt, n_new,
+                         max_len=8 + n_new + k)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(plain))
+
+
+def test_tp_speculative_stochastic_valid_and_reproducible(tp=2):
+    """Stochastic TP speculation: tokens in range, prompt preserved,
+    same key -> same output (the replicated key drives identical draws
+    on every rank)."""
+    mesh = mesh_from_devices({"tp": tp}, jax.devices()[:tp])
+    cfg = tfm.TransformerConfig(**{**tfm.tiny_config(
+        vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=64).__dict__, "dtype": jnp.float32})
+    dcfg = tfm.TransformerConfig(**{**tfm.tiny_config(
+        vocab=64, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+        max_seq=64).__dict__, "dtype": jnp.float32})
+    params = tfm.init_params(jax.random.key(0), cfg)
+    dparams = tfm.init_params(jax.random.key(7), dcfg)
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab)
+
+    gen = make_tp_speculative_generate(dcfg, cfg, mesh, 12, k=3,
+                                       temperature=0.8)
+    a, _ = gen(dparams, params, prompt, jax.random.key(5))
+    b, _ = gen(dparams, params, prompt, jax.random.key(5))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    out = np.asarray(a)
+    np.testing.assert_array_equal(out[:, :8], np.asarray(prompt))
+    assert ((0 <= out) & (out < cfg.vocab)).all()
