@@ -362,17 +362,12 @@ def tp_param_specs_llama(axis: str = "tp"):
     }
 
 
-def make_tp_generate_llama(cfg: lm.LlamaConfig, mesh: Mesh, n_new: int,
-                           axis: str = "tp", temperature: float = 0.0,
-                           top_k: Optional[int] = None,
-                           top_p: Optional[float] = None):
-    """Tensor-parallel Llama generation: ``tp`` must divide
-    ``n_kv_heads``; each rank serves ``n_kv_heads/tp`` KV groups and their
-    query heads, so the local cache stays un-repeated (GQA's bandwidth
-    win per rank) and grouped-query decode runs exactly as the
-    single-device path (llama.decode_step), just on the group slice.
-    """
-    tp = mesh.shape[axis]
+def _llama_tp_layer_ops(cfg, tp: int, axis: str):
+    """Llama per-layer primitives for TP generation AND TP speculative
+    decoding, sharded by KV-HEAD GROUP: (local_qkv, out_proj, mlp,
+    n_rep). Each rank holds Hkv/tp K/V heads plus their n_rep query
+    heads, so the local cache stays un-repeated (GQA's bandwidth win
+    survives the split)."""
     Hq, Hkv, Dh, d = (cfg.n_heads, cfg.n_kv_heads, cfg.head_dim,
                       cfg.d_model)
     assert Hkv % tp == 0, (Hkv, tp)
@@ -404,6 +399,22 @@ def make_tp_generate_llama(cfg: lm.LlamaConfig, mesh: Mesh, n_new: int,
         part = o.reshape(B, S, Hq_l * Dh) @ lp["wo"].reshape(
             Hq_l * Dh, d).astype(x.dtype)
         return x + lax.psum(part, axis)
+
+    return local_qkv, out_proj, mlp, n_rep
+
+
+def make_tp_generate_llama(cfg: lm.LlamaConfig, mesh: Mesh, n_new: int,
+                           axis: str = "tp", temperature: float = 0.0,
+                           top_k: Optional[int] = None,
+                           top_p: Optional[float] = None):
+    """Tensor-parallel Llama generation: ``tp`` must divide
+    ``n_kv_heads``; each rank serves ``n_kv_heads/tp`` KV groups and their
+    query heads, so the local cache stays un-repeated (GQA's bandwidth
+    win per rank) and grouped-query decode runs exactly as the
+    single-device path (llama.decode_step), just on the group slice.
+    """
+    tp = mesh.shape[axis]
+    local_qkv, out_proj, mlp, n_rep = _llama_tp_layer_ops(cfg, tp, axis)
 
     def per_shard(params, prompt, key):
         assert prompt.shape[1] + n_new <= cfg.max_seq
@@ -522,6 +533,75 @@ def _tp_family_ops(cfg, tp: int, axis: str):
     return prefill, window, decode
 
 
+def _llama_tp_family_ops(cfg, tp: int, axis: str):
+    """Llama counterpart of :func:`_tp_family_ops` (speculative-core
+    signatures, KV-group-sharded): RoPE at absolute positions, grouped
+    decode/window attention against the un-repeated local cache."""
+    local_qkv, out_proj, mlp, n_rep = _llama_tp_layer_ops(cfg, tp, axis)
+
+    def embed(params, tokens):
+        return params["embed"][tokens].astype(cfg.dtype)
+
+    def finish(params, x):
+        x = lm.rmsnorm(x, params["final_norm"])
+        return jnp.einsum("bsd,vd->bsv", x,
+                          params["unembed"].astype(x.dtype),
+                          preferred_element_type=jnp.float32)
+
+    def make_attend(max_len):
+        def attend_fn(lp, x, q, kcl, vcl, pos):
+            o = grouped_decode_attend(q, kcl, vcl, pos, max_len, n_rep)
+            return mlp(lp, out_proj(lp, o, x))
+        return attend_fn
+
+    def prefill(params, _cfg, tokens, cap, last_only=True):
+        x = embed(params, tokens)
+        S = tokens.shape[1]
+
+        def pl(x, lp):
+            q, k_, v_ = local_qkv(lp, x, jnp.arange(S))
+            kr, vr = lm._repeat_kv(k_, n_rep), lm._repeat_kv(v_, n_rep)
+            o = select_attention(cfg.use_flash)(q, kr, vr)
+            return mlp(lp, out_proj(lp, o, x)), (k_, v_)
+
+        x, (ks, vs) = lax.scan(pl, x, params["layers"])
+        logits = finish(params, x[:, -1:] if last_only else x)
+        kc, vc = _init_kv_from_prefill(ks, vs, cap)
+        return logits, {"k": kc, "v": vc,
+                        "pos": jnp.asarray(S, jnp.int32)}
+
+    def decode(params, _cfg, cache, tok):
+        pos = cache["pos"]
+        max_len = cache["k"].shape[2]
+        x = params["embed"][tok][:, None, :].astype(cfg.dtype)
+
+        def qkv_fn(lp, x, pos):
+            return local_qkv(lp, x, jnp.full((1,), pos))
+
+        x, kc, vc = decode_layer_scan(
+            params["layers"], x, cache["k"], cache["v"], pos, qkv_fn,
+            make_attend(max_len))
+        logits = finish(params, x)[:, 0]
+        return logits, {"k": kc, "v": vc, "pos": pos + 1}
+
+    def window(params, _cfg, cache, tokens):
+        W = tokens.shape[1]
+        pos = cache["pos"]
+        max_len = cache["k"].shape[2]
+        x = params["embed"][tokens].astype(cfg.dtype)
+
+        def qkv_fn(lp, x, pos):
+            return local_qkv(lp, x, pos + jnp.arange(W))
+
+        x, kc, vc = decode_layer_scan(
+            params["layers"], x, cache["k"], cache["v"], pos, qkv_fn,
+            make_attend(max_len))
+        logits = finish(params, x)
+        return logits, {"k": kc, "v": vc, "pos": pos + W}
+
+    return prefill, window, decode
+
+
 def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
                                  k: int = 4, axis: str = "tp",
                                  temperature: float = 0.0):
@@ -533,9 +613,9 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
     draft step and each k-wide target window streams 1/tp of the
     weights per chip.
 
-    GPT-2 family only (TransformerConfig draft and target — the other
-    families' TP speculation composes the same way and can reuse
-    _tp_family_ops' pattern). ``temperature=0`` is greedy: output
+    GPT-2 and Llama families, freely mixed between draft and target
+    (config type selects each side's ops; vocabularies must match).
+    ``temperature=0`` is greedy: output
     tokens equal the single-device ``speculative_generate`` AND the
     target-only greedy decode (tests/test_tp_inference.py asserts both
     at tp=2/4); otherwise the stochastic accept/resample hooks run with
@@ -548,15 +628,20 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
     from mpi_acx_tpu.models.speculative import (_greedy_hooks,
                                                 _make_run, _sample_hooks)
 
-    assert type(cfg) is tfm.TransformerConfig, (
-        "TP speculative decoding currently supports the GPT-2 family; "
-        f"got {type(cfg).__name__}")
-    assert type(draft_cfg) is tfm.TransformerConfig, type(draft_cfg)
+    def fam_ops(c):
+        if type(c) is lm.LlamaConfig:
+            return _llama_tp_family_ops(c, tp, axis), lm
+        if type(c) is tfm.TransformerConfig:
+            return _tp_family_ops(c, tp, axis), tfm
+        raise TypeError(
+            "TP speculative decoding supports the GPT-2 and Llama "
+            f"families; got {type(c).__name__}")
+
     assert draft_cfg.vocab == cfg.vocab, (draft_cfg.vocab, cfg.vocab)
     assert k >= 2, k
     tp = mesh.shape[axis]
-    t_ops = _tp_family_ops(cfg, tp, axis)
-    d_ops = _tp_family_ops(draft_cfg, tp, axis)
+    t_ops, _ = fam_ops(cfg)
+    d_ops, _ = fam_ops(draft_cfg)
     hooks = (_greedy_hooks(k) if temperature == 0.0
              else _sample_hooks(k, float(temperature)))
 
@@ -566,8 +651,16 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
                         ops=(t_ops[0], t_ops[1], d_ops[0], d_ops[2]))
         return run(dparams, params, prompt, key)
 
-    specs_t = tp_param_specs(axis)
-    specs_d = tp_param_specs(axis)
+    def fam_specs(c):
+        return (tp_param_specs_llama(axis) if type(c) is lm.LlamaConfig
+                else tp_param_specs(axis))
+
+    def fam_shard(c):
+        return (tp_shard_params_llama if type(c) is lm.LlamaConfig
+                else tp_shard_params)
+
+    specs_t = fam_specs(cfg)
+    specs_d = fam_specs(draft_cfg)
     inner = shard_map(per_shard, mesh=mesh,
                       in_specs=(specs_d, specs_t, P(), P()),
                       out_specs=(P(), P(), P()), check_vma=False)
@@ -576,8 +669,8 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
     def generate(draft_params, params, prompt, key):
         assert prompt.shape[0] == 1, "TP speculative decode is B=1"
         toks, rounds, acc = inner(
-            tp_shard_params(draft_params, draft_cfg),
-            tp_shard_params(params, cfg), prompt, key)
+            fam_shard(draft_cfg)(draft_params, draft_cfg),
+            fam_shard(cfg)(params, cfg), prompt, key)
         return toks, {"rounds": rounds, "drafted_accepted": acc}
 
     return generate

@@ -260,3 +260,48 @@ def test_tp_speculative_stochastic_valid_and_reproducible(tp=2):
     out = np.asarray(a)
     np.testing.assert_array_equal(out[:, :8], np.asarray(prompt))
     assert ((0 <= out) & (out < cfg.vocab)).all()
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_tp_speculative_llama_matches_single_device(tp):
+    """Llama TP speculation (KV-group-sharded draft AND target): same
+    tokens and stats as the single-device speculative run."""
+    mesh = mesh_from_devices({"tp": tp}, jax.devices()[:tp])
+    c = lm.tiny_llama(vocab=128, d_model=32, n_heads=8, n_kv_heads=4,
+                      n_layers=2, d_ff=64, max_seq=64)
+    cfg = lm.LlamaConfig(**{**c.__dict__, "dtype": jnp.float32})
+    dc = lm.tiny_llama(vocab=128, d_model=32, n_heads=8, n_kv_heads=4,
+                       n_layers=1, d_ff=64, max_seq=64)
+    dcfg = lm.LlamaConfig(**{**dc.__dict__, "dtype": jnp.float32})
+    params = lm.init_params(jax.random.key(0), cfg)
+    dparams = lm.init_params(jax.random.key(7), dcfg)
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab)
+    n_new, k = 14, 4
+
+    want, wstats = speculative_generate(dparams, dcfg, params, cfg,
+                                        prompt, n_new, k=k)
+    gen = make_tp_speculative_generate(dcfg, cfg, mesh, n_new, k=k)
+    got, stats = gen(dparams, params, prompt, jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert int(stats["rounds"]) == int(wstats["rounds"])
+
+
+def test_tp_speculative_mixed_families():
+    """GPT-2 draft proposing for a Llama target, both TP-split — the
+    cross-family pairing the single-device matrix already supports."""
+    tp = 2
+    mesh = mesh_from_devices({"tp": tp}, jax.devices()[:tp])
+    c = lm.tiny_llama(vocab=96, d_model=32, n_heads=4, n_kv_heads=2,
+                      n_layers=2, d_ff=64, max_seq=64)
+    cfg = lm.LlamaConfig(**{**c.__dict__, "dtype": jnp.float32})
+    dcfg = tfm.TransformerConfig(**{**tfm.tiny_config(
+        vocab=96, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+        max_seq=64).__dict__, "dtype": jnp.float32})
+    params = lm.init_params(jax.random.key(0), cfg)
+    dparams = tfm.init_params(jax.random.key(7), dcfg)
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0, 96)
+    want, _ = speculative_generate(dparams, dcfg, params, cfg, prompt,
+                                   12, k=3)
+    gen = make_tp_speculative_generate(dcfg, cfg, mesh, 12, k=3)
+    got, _ = gen(dparams, params, prompt, jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
