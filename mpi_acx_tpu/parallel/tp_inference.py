@@ -470,15 +470,18 @@ def make_tp_generate_llama(cfg: lm.LlamaConfig, mesh: Mesh, n_new: int,
 # -- Tensor-parallel SPECULATIVE decoding ----------------------------------
 
 
-def _tp_family_ops(cfg, tp: int, axis: str):
-    """GPT-2-family ops with the speculative-core signatures
+def _tp_family_ops(cfg, tp: int, axis: str, ffn=None):
+    """GPT-2-scaffold ops with the speculative-core signatures
     (models.speculative._make_run ``ops``), tensor-parallel per shard:
     (prefill, window, decode). Each rank holds its Hl-head slice of the
     weights and KV cache; logits are assembled replicated by the
     per-layer psums, so the speculative accept/roll-back control flow —
     argmax chains, acceptance counts, while_loop conditions — computes
-    identically on every rank by construction."""
-    local_qkv, out_proj, mlp = _gpt2_tp_layer_ops(cfg, tp, axis)
+    identically on every rank by construction. ``ffn(lp, x) -> x``
+    overrides the feed-forward half (the MoE family plugs in its
+    replicated-EP routed FFN, exactly as on make_tp_generate)."""
+    local_qkv, out_proj, dense_mlp = _gpt2_tp_layer_ops(cfg, tp, axis)
+    mlp = ffn or dense_mlp
 
     embed = lambda params, tokens: _gpt2_embed(params, cfg, tokens)  # noqa: E731
     finish = lambda params, x: _gpt2_finish(params, cfg, x)  # noqa: E731
@@ -628,20 +631,35 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
     from mpi_acx_tpu.models.speculative import (_greedy_hooks,
                                                 _make_run, _sample_hooks)
 
+    from mpi_acx_tpu.models.moe_transformer import (MoeTransformerConfig,
+                                                    _moe_ffn)
+    from mpi_acx_tpu.models.speculative import _check_moe_target
+
     def fam_ops(c):
         if type(c) is lm.LlamaConfig:
-            return _llama_tp_family_ops(c, tp, axis), lm
+            return _llama_tp_family_ops(c, tp, axis)
+        if type(c) is MoeTransformerConfig:
+            assert c.n_experts % tp == 0, (c.n_experts, tp)
+
+            def moe_ffn(lp, x):
+                return _moe_ffn(c, lp, x, ep_axis=axis, replicated=True)
+
+            return _tp_family_ops(c, tp, axis, ffn=moe_ffn)
         if type(c) is tfm.TransformerConfig:
-            return _tp_family_ops(c, tp, axis), tfm
+            return _tp_family_ops(c, tp, axis)
         raise TypeError(
-            "TP speculative decoding supports the GPT-2 and Llama "
-            f"families; got {type(c).__name__}")
+            "TP speculative decoding supports the GPT-2, Llama, and "
+            f"MoE-transformer families; got {type(c).__name__}")
 
     assert draft_cfg.vocab == cfg.vocab, (draft_cfg.vocab, cfg.vocab)
     assert k >= 2, k
+    # An MoE TARGET must be drop-free so the k-wide verify window
+    # routes exactly like plain decode (same rule as the
+    # single-device speculative API).
+    _check_moe_target(cfg)
     tp = mesh.shape[axis]
-    t_ops, _ = fam_ops(cfg)
-    d_ops, _ = fam_ops(draft_cfg)
+    t_ops = fam_ops(cfg)
+    d_ops = fam_ops(draft_cfg)
     hooks = (_greedy_hooks(k) if temperature == 0.0
              else _sample_hooks(k, float(temperature)))
 
@@ -652,12 +670,16 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
         return run(dparams, params, prompt, key)
 
     def fam_specs(c):
-        return (tp_param_specs_llama(axis) if type(c) is lm.LlamaConfig
-                else tp_param_specs(axis))
+        if type(c) is lm.LlamaConfig:
+            return tp_param_specs_llama(axis)
+        if type(c) is MoeTransformerConfig:
+            return tp_param_specs_moe(axis)
+        return tp_param_specs(axis)
 
     def fam_shard(c):
-        return (tp_shard_params_llama if type(c) is lm.LlamaConfig
-                else tp_shard_params)
+        if type(c) is lm.LlamaConfig:
+            return tp_shard_params_llama
+        return tp_shard_params     # GPT-2 and MoE share the re-layout
 
     specs_t = fam_specs(cfg)
     specs_d = fam_specs(draft_cfg)

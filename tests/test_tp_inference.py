@@ -305,3 +305,40 @@ def test_tp_speculative_mixed_families():
     gen = make_tp_speculative_generate(dcfg, cfg, mesh, 12, k=3)
     got, _ = gen(dparams, params, prompt, jax.random.key(0))
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tp_speculative_moe_matches_single_device():
+    """MoE TP speculation (head-split attention + replicated-EP routed
+    FFN, drop-free capacity): same tokens and stats as the
+    single-device speculative run at tp=4."""
+    tp = 4
+    mesh = mesh_from_devices({"tp": tp}, jax.devices()[:tp])
+    cfg = mtf.tiny_moe_config(vocab=128, d_model=32, n_heads=4,
+                              n_layers=2, d_ff=64, n_experts=8, top_k=1,
+                              capacity_factor=8.0, max_seq=64)
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    dcfg = tfm.TransformerConfig(**{**tfm.tiny_config(
+        vocab=128, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+        max_seq=64).__dict__, "dtype": jnp.float32})
+    params = mtf.init_params(jax.random.key(0), cfg)
+    dparams = tfm.init_params(jax.random.key(7), dcfg)
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab)
+    n_new, k = 12, 3
+
+    want, wstats = speculative_generate(dparams, dcfg, params, cfg,
+                                        prompt, n_new, k=k)
+    gen = make_tp_speculative_generate(dcfg, cfg, mesh, n_new, k=k)
+    got, stats = gen(dparams, params, prompt, jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert int(stats["rounds"]) == int(wstats["rounds"])
+
+
+def test_tp_speculative_moe_tight_capacity_rejected():
+    """The drop-free guard fires for an MoE target with cf < E, exactly
+    as on the single-device API."""
+    mesh = mesh_from_devices({"tp": 2}, jax.devices()[:2])
+    cfg = mtf.tiny_moe_config(n_heads=4, n_experts=8,
+                              capacity_factor=2.0)
+    dcfg = tfm.tiny_config(vocab=cfg.vocab, n_heads=4, n_layers=1)
+    with pytest.raises(AssertionError, match="drop-free"):
+        make_tp_speculative_generate(dcfg, cfg, mesh, 8)
