@@ -28,6 +28,9 @@ def main():
                     help="0 = greedy argmax")
     ap.add_argument("--top-k", type=int, default=None)
     ap.add_argument("--top-p", type=float, default=None)
+    ap.add_argument("--speculative", action="store_true",
+                    help="draft-proposes / target-verifies decoding, "
+                         "draft and target both TP-split")
     args = ap.parse_args()
 
     import jax
@@ -63,6 +66,25 @@ def main():
                                top_k=args.top_k, top_p=args.top_p)
         single = lambda p, t: tfm.generate(  # noqa: E731
             p, cfg, t, args.n_new, max_len=t.shape[1] + args.n_new)
+
+    if args.speculative:
+        import dataclasses
+        from mpi_acx_tpu.parallel import make_tp_speculative_generate
+        dcfg = dataclasses.replace(cfg, n_layers=1)
+        dinit = (lm.init_params if args.family == "llama"
+                 else tfm.init_params)
+        dparams = dinit(jax.random.key(7), dcfg)
+        sgen = make_tp_speculative_generate(
+            dcfg, cfg, mesh, args.n_new, k=4,
+            temperature=args.temperature)
+        prompt = jax.random.randint(jax.random.key(1), (1, 8), 0,
+                                    cfg.vocab)
+        out, stats = sgen(dparams, params, prompt, jax.random.key(2))
+        print(f"family={args.family} tp={args.tp} speculative "
+              f"rounds={int(stats['rounds'])} "
+              f"accepted={int(stats['drafted_accepted'])}")
+        print("output :", out[:, prompt.shape[1]:].tolist())
+        return
 
     prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab)
     out = gen(params, prompt, jax.random.key(2))
