@@ -116,6 +116,8 @@ check: ctest itest tools
 	@for t in $(ITEST_BINS); do echo "== acxrun -np 4 $$t (shm, 4 ranks)"; $(BUILD)/acxrun -np 4 $$t || exit 1; done
 	@echo "== acxrun -np 2 fuzz (canary: corruption must be DETECTED)"
 	@ACX_FUZZ_CANARY=1 $(BUILD)/acxrun -np 2 $(BUILD)/itests/fuzz || exit 1
+	@echo "== acxrun -np 2 fuzz (second seed)"
+	@ACX_FUZZ_SEED=98761 $(BUILD)/acxrun -np 2 $(BUILD)/itests/fuzz || exit 1
 	@echo "ALL NATIVE TESTS PASSED"
 
 # Header dependency tracking (-MMD): a header edit rebuilds its users.
