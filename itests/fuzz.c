@@ -19,7 +19,7 @@
 
 #define MAX_PAIRS 8
 #define MAX_ELEMS 16384
-#define ROUNDS 24
+#define DEFAULT_ROUNDS 24   /* ACX_FUZZ_ROUNDS overrides (deep soaks) */
 
 static unsigned long long st;
 static unsigned rnd(void) {            /* xorshift64*, same on all ranks */
@@ -51,8 +51,11 @@ int main(int argc, char **argv) {
      * corruption, not just confirm clean runs. */
     const char *ce = getenv("ACX_FUZZ_CANARY");
     int canary = ce && atoi(ce);
+    const char *re = getenv("ACX_FUZZ_ROUNDS");
+    int rounds = re ? atoi(re) : DEFAULT_ROUNDS;
+    if (rounds < 1) rounds = DEFAULT_ROUNDS;
     if (rank == 0) printf("fuzz: seed=%u rounds=%d canary=%d\n",
-                          seed, ROUNDS, canary);
+                          seed, rounds, canary);
 
     const int right = (rank + 1) % size;
     const int left = (rank + size - 1) % size;
@@ -61,7 +64,7 @@ int main(int argc, char **argv) {
     cudaStream_t stream;
     cudaStreamCreate(&stream);
 
-    for (int round = 0; round < ROUNDS; round++) {
+    for (int round = 0; round < rounds; round++) {
         if (round % 4 == 3) {
             /* -- partitioned round: random partitions, random Pready order */
             int nparts = 1 + (int)(rnd() % 8);
