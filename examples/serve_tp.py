@@ -51,7 +51,9 @@ def main():
     mesh = mesh_from_devices({"tp": args.tp}, jax.devices()[:args.tp])
 
     if args.family == "llama":
-        cfg = lm.tiny_llama(n_layers=2)
+        # KV heads must split over tp: scale the toy config with it.
+        cfg = lm.tiny_llama(n_layers=2, n_heads=2 * args.tp,
+                            n_kv_heads=args.tp)
         params = lm.init_params(jax.random.key(0), cfg)
         gen = make_tp_generate_llama(cfg, mesh, args.n_new,
                                      temperature=args.temperature,
