@@ -635,18 +635,22 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
                                                     _moe_ffn)
     from mpi_acx_tpu.models.speculative import _check_moe_target
 
-    def fam_ops(c):
+    def fam(c):
+        """One dispatch per family: (speculative ops, specs, shard fn)."""
         if type(c) is lm.LlamaConfig:
-            return _llama_tp_family_ops(c, tp, axis)
+            return (_llama_tp_family_ops(c, tp, axis),
+                    tp_param_specs_llama(axis), tp_shard_params_llama)
         if type(c) is MoeTransformerConfig:
             assert c.n_experts % tp == 0, (c.n_experts, tp)
 
             def moe_ffn(lp, x):
                 return _moe_ffn(c, lp, x, ep_axis=axis, replicated=True)
 
-            return _tp_family_ops(c, tp, axis, ffn=moe_ffn)
+            return (_tp_family_ops(c, tp, axis, ffn=moe_ffn),
+                    tp_param_specs_moe(axis), tp_shard_params)
         if type(c) is tfm.TransformerConfig:
-            return _tp_family_ops(c, tp, axis)
+            return (_tp_family_ops(c, tp, axis), tp_param_specs(axis),
+                    tp_shard_params)
         raise TypeError(
             "TP speculative decoding supports the GPT-2, Llama, and "
             f"MoE-transformer families; got {type(c).__name__}")
@@ -658,8 +662,8 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
     # single-device speculative API).
     _check_moe_target(cfg)
     tp = mesh.shape[axis]
-    t_ops = fam_ops(cfg)
-    d_ops = fam_ops(draft_cfg)
+    t_ops, specs_t, shard_t = fam(cfg)
+    d_ops, specs_d, shard_d = fam(draft_cfg)
     hooks = (_greedy_hooks(k) if temperature == 0.0
              else _sample_hooks(k, float(temperature)))
 
@@ -669,20 +673,6 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
                         ops=(t_ops[0], t_ops[1], d_ops[0], d_ops[2]))
         return run(dparams, params, prompt, key)
 
-    def fam_specs(c):
-        if type(c) is lm.LlamaConfig:
-            return tp_param_specs_llama(axis)
-        if type(c) is MoeTransformerConfig:
-            return tp_param_specs_moe(axis)
-        return tp_param_specs(axis)
-
-    def fam_shard(c):
-        if type(c) is lm.LlamaConfig:
-            return tp_shard_params_llama
-        return tp_shard_params     # GPT-2 and MoE share the re-layout
-
-    specs_t = fam_specs(cfg)
-    specs_d = fam_specs(draft_cfg)
     inner = shard_map(per_shard, mesh=mesh,
                       in_specs=(specs_d, specs_t, P(), P()),
                       out_specs=(P(), P(), P()), check_vma=False)
@@ -691,8 +681,8 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
     def generate(draft_params, params, prompt, key):
         assert prompt.shape[0] == 1, "TP speculative decode is B=1"
         toks, rounds, acc = inner(
-            fam_shard(draft_cfg)(draft_params, draft_cfg),
-            fam_shard(cfg)(params, cfg), prompt, key)
+            shard_d(draft_params, draft_cfg),
+            shard_t(params, cfg), prompt, key)
         return toks, {"rounds": rounds, "drafted_accepted": acc}
 
     return generate

@@ -483,6 +483,7 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
         x_all = fam.embed(params, cfg, tokens)     # [M, mbl, S, d]
         mb_shape = x_all.shape[1:]
         zero_act = jnp.zeros(mb_shape, x_all.dtype)
+        zero_tail = jax.tree.map(jnp.zeros_like, tail)
 
         def embed_m(tailp, tok_m):
             return fam.embed(dict(tailp, layers=slayers), cfg, tok_m)
@@ -543,9 +544,6 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
             # tail_ll and embed_m are collective-free, so (unlike the
             # stage body) they may run under per-device lax.cond: only
             # the one stage that consumes each vjp pays for it.
-            zero_tail = jax.tree.map(
-                lambda p: jnp.zeros_like(p), tail)
-
             def loss_side(y_):
                 llsum, tail_vjp = jax.vjp(
                     lambda tp_, yy: tail_ll(tp_, yy, tgt_m), tail, y_)
