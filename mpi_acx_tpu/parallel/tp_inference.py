@@ -668,10 +668,20 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
              else _sample_hooks(k, float(temperature)))
 
     def per_shard(dparams, params, prompt, key):
-        S = prompt.shape[1]        # static at trace time
+        B, S = prompt.shape        # static at trace time
         run = _make_run(draft_cfg, cfg, S, n_new, k, *hooks,
                         ops=(t_ops[0], t_ops[1], d_ops[0], d_ops[2]))
-        return run(dparams, params, prompt, key)
+        if B == 1:
+            return run(dparams, params, prompt, key)
+        # Batched: vmap the single-sequence loop over rows INSIDE the
+        # shard (the same lift as models.speculative._build_batched).
+        # The per-layer psums batch elementwise across ranks, so each
+        # row's replicated-logits invariant — and therefore its
+        # independent pacing — survives the composition.
+        toks, rounds, acc = jax.vmap(
+            lambda row, kk: run(dparams, params, row[None], kk)
+        )(prompt, jax.random.split(key, B))
+        return toks[:, 0], rounds, acc
 
     inner = shard_map(per_shard, mesh=mesh,
                       in_specs=(specs_d, specs_t, P(), P()),
@@ -679,7 +689,6 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
 
     @jax.jit
     def generate(draft_params, params, prompt, key):
-        assert prompt.shape[0] == 1, "TP speculative decode is B=1"
         toks, rounds, acc = inner(
             shard_d(draft_params, draft_cfg),
             shard_t(params, cfg), prompt, key)
