@@ -6,11 +6,18 @@ wire), 2 processes under acxrun — BASELINE.md metric #2. Also reports
 partitioned-exchange bandwidth (host plane) and flagship-model forward
 throughput + MFU on the TPU chip.
 
-The TPU measurement runs in a SUBPROCESS with retries: the chip arrives
+The TPU measurement runs in SUBPROCESSES with retries: the chip arrives
 via the axon tunnel and its PJRT init can fail or hang transiently
 (round 2 lost all TPU evidence to exactly that). A hung child is killed
 by timeout and retried; after the last attempt the failure is reported
 LOUDLY as a "tpu_error" field in the JSON line instead of being dropped.
+
+Capture is INCREMENTAL (rounds 2-4 lost entire windows to all-or-nothing
+600 s children): a cheap probe child gates the expensive ones, each
+metric group runs in its OWN child with its own timeout, every child's
+rows are banked to BENCH_BANK.json the moment they land, and in --full
+mode BENCH_FULL.json is rewritten after EVERY child — a driver kill or
+tunnel drop mid-run keeps everything measured up to that point.
 
 `python bench.py --full` additionally re-measures the secondary
 BASELINE.md rows (flash-attention speedup @ S=4096, KV-cache decode
@@ -52,18 +59,40 @@ V5E_BF16_PEAK_FLOPS = 197e12
 GPT2_SMALL_PARAMS = 124e6
 
 
-def native_bench():
+def native_bench(msg_bytes: int | None = None):
     subprocess.run(["make", "-C", REPO, "lib", "tools"], check=True,
                    capture_output=True)
-    r = subprocess.run(
-        [os.path.join(REPO, "build", "acxrun"), "-np", "2", "-timeout",
-         "300", os.path.join(REPO, "build", "bench_pingpong")],
-        capture_output=True, text=True, timeout=400)
-    m = re.search(r"pingpong_p50_us=([\d.]+).*part_bw_gbps=([\d.]+)",
-                  r.stdout)
+    cmd = [os.path.join(REPO, "build", "acxrun"), "-np", "2", "-timeout",
+           "300", os.path.join(REPO, "build", "bench_pingpong")]
+    if msg_bytes is not None:
+        cmd.append(str(msg_bytes))
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=400)
+    m = re.search(r"pingpong_p50_us=([\d.]+) pingpong_p99_us=([\d.]+) "
+                  r"part_bw_gbps=([\d.]+)", r.stdout)
     if not m:
         raise RuntimeError(f"bench_pingpong failed: {r.stdout} {r.stderr}")
-    return float(m.group(1)), float(m.group(2))
+    return float(m.group(1)), float(m.group(2)), float(m.group(3))
+
+
+def _bank(rows: dict):
+    """Merge measured rows into BENCH_BANK.json IMMEDIATELY (checked-in,
+    append-only evidence: a 3-minute healthy tunnel window must survive a
+    later crash/outage — round-4 verdict item #1)."""
+    path = os.path.join(REPO, "BENCH_BANK.json")
+    try:
+        with open(path) as f:
+            bank = json.load(f)
+    except Exception:  # noqa: BLE001 — first run or corrupt file
+        bank = {}
+    ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    for k, v in rows.items():
+        if k != "device":
+            bank[k] = {"value": v, "ts": ts,
+                       "device": rows.get("device", "?")}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(bank, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
 
 
 def _run_tpu_child(mode: str, attempts: int = 3, timeout: int = 420,
@@ -150,25 +179,39 @@ def tpu_child_fwd():
     }))
 
 
-def tpu_child_full():
-    """Child process: secondary BASELINE.md rows — flash-attention speedup
-    vs dense at S=4096 (GPT-2 heads) and KV-cache greedy decode tok/s."""
+def tpu_child_probe():
+    """Child process: cheap tunnel-health probe. Gates the expensive
+    children — when the tunnel is down this fails in ONE short timeout
+    instead of burning 3x420 s per metric group (rounds 2-4 lost whole
+    windows to exactly that)."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((128, 128), jnp.bfloat16)
+    y = float(jax.jit(lambda a: (a @ a).sum())(x))   # real compile+run
+    print(json.dumps({"tpu_probe_ok": y > 0,
+                      "device": str(jax.devices()[0].platform)}))
+
+
+def _timeit(f, *a, reps=1):
+    """Best-of-3 wall time of one f(*a) call (fully synced)."""
+    import jax
+    jax.block_until_ready(f(*a))               # compile + warm
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = f(*a)
+        jax.block_until_ready(out)
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return best
+
+
+def tpu_child_flash():
+    """Child process: flash-attention speedup vs dense at S=4096 (GPT-2
+    head geometry), device-side rep loops."""
     import jax
     import jax.numpy as jnp
     from mpi_acx_tpu.ops.attention import attention_reference, flash_attention
-    from mpi_acx_tpu.models import transformer as tfm
-
-    def timeit(f, *a, reps=1):
-        """Best-of-3 wall time of one f(*a) call (fully synced)."""
-        jax.block_until_ready(f(*a))               # compile + warm
-        best = 1e9
-        for _ in range(3):
-            t0 = time.perf_counter()
-            for _ in range(reps):
-                out = f(*a)
-            jax.block_until_ready(out)
-            best = min(best, (time.perf_counter() - t0) / reps)
-        return best
 
     def timeit_device(fn, q, k, v, reps=20):
         """Device-side rep loop (lax.scan with an iteration-dependent
@@ -191,73 +234,128 @@ def tpu_child_full():
             best = min(best, (time.perf_counter() - t0) / reps)
         return best
 
-    # Flash vs dense, GPT-2 head geometry, S=4096, device-side loops.
     B, S, H, D = 1, 4096, 12, 64
     ks = jax.random.split(jax.random.key(0), 3)
     q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
                for kk in ks)
     t_dense = timeit_device(attention_reference, q, k, v)
     t_flash = timeit_device(flash_attention, q, k, v)
-    speedup = t_dense / t_flash
+    print(json.dumps({
+        "flash_speedup_s4096": round(t_dense / t_flash, 2),
+        "flash_ms": round(t_flash * 1e3, 3),
+        "dense_ms": round(t_dense * 1e3, 3),
+        "device": str(jax.devices()[0].platform),
+    }))
 
-    # KV-cache greedy decode, B=8, bf16 weights.
+
+def tpu_child_decode():
+    """Child process: KV-cache greedy decode tok/s (B=8, bf16 125M) plus
+    the HBM roofline bounding it. Decode is bandwidth-bound (see
+    parallel/tp_inference.py:3-8): every step re-streams the full weight
+    set (amortized over the batch) plus each row's padded KV cache, so
+    the per-step floor is bytes_moved / HBM_BW and roofline tok/s =
+    B / floor (round-4 verdict item #7)."""
+    import jax
+    import jax.numpy as jnp
+    from mpi_acx_tpu.models import transformer as tfm
+
+    cfg = tfm.gpt2_small()
+    params = tfm.cast_params(tfm.init_params(jax.random.key(0), cfg),
+                             jnp.bfloat16)
+    B, S_p, n_new, max_len = 8, 32, 64, 256
+    prompt = jax.random.randint(jax.random.key(1), (B, S_p), 0, cfg.vocab)
+    gen = jax.jit(lambda p, t: tfm.generate(p, cfg, t, n_new,
+                                            max_len=max_len))
+    decode_toks = B * n_new / _timeit(gen, params, prompt)
+
+    # Roofline: v5e HBM ~819 GB/s (public spec). Static shapes mean the
+    # kernels stream the PADDED (max_len) cache each step.
+    HBM_BW = 819e9
+    wbytes = sum(x.size * x.dtype.itemsize
+                 for x in jax.tree.leaves(params))
+    kvbytes = 2 * cfg.n_layers * max_len * cfg.d_model * 2 * B
+    roofline = B * HBM_BW / (wbytes + kvbytes)
+    print(json.dumps({
+        "decode_tokens_per_s": round(decode_toks, 1),
+        "decode_roofline_tokens_per_s": round(roofline, 1),
+        "decode_roofline_frac": round(decode_toks / roofline, 3),
+        "decode_weight_mb": round(wbytes / 1e6, 1),
+        "decode_kv_mb": round(kvbytes / 1e6, 1),
+        "device": str(jax.devices()[0].platform),
+    }))
+
+
+def tpu_child_train():
+    """Child process: single-chip AdamW train step (B=8, S=512), plain vs
+    chunked-vocab CE, plus a device-side segment breakdown (fwd / bwd /
+    optimizer) and train MFU at 6*N FLOPs per token (round-4 verdict
+    item #6). Rep loops are lax.scan ON DEVICE with params/opt-state as
+    the carry so every iteration is a dependent update XLA can't elide;
+    host per-call timing would fold the ~75 ms tunnel dispatch RTT in."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from mpi_acx_tpu.models import transformer as tfm
+
     cfg = tfm.gpt2_small()
     params_f32 = tfm.init_params(jax.random.key(0), cfg)
-    params = tfm.cast_params(params_f32, jnp.bfloat16)
-    B, S_p, n_new = 8, 32, 64
-    prompt = jax.random.randint(jax.random.key(1), (B, S_p), 0, cfg.vocab)
-    gen = jax.jit(lambda p, t: tfm.generate(p, cfg, t, n_new, max_len=256))
-    decode_toks = B * n_new / timeit(gen, params, prompt)
-    # Single-chip AdamW training step, B=8 S=512 (README's training row).
-    # The rep loop is a lax.scan of real optimizer steps ON DEVICE (host
-    # per-call timing would fold the tunnel dispatch RTT into a ~75 ms
-    # step); params/opt-state are the scan carry, so every iteration is a
-    # genuine dependent update XLA can't elide.
-    import optax
     opt = optax.adamw(1e-4)
     ostate = opt.init(params_f32)
     tok = jax.random.randint(jax.random.key(2), (8, 512), 0, cfg.vocab)
     tgt = jnp.roll(tok, -1, axis=-1)
     treps = 5
 
-    @jax.jit
-    def train_loop(p, s, tok, tgt):
-        def body(carry, _):
-            p, s = carry
-            loss, g = jax.value_and_grad(tfm.loss_fn)(p, cfg, tok, tgt)
-            upd, s = opt.update(g, s, p)
-            return (optax.apply_updates(p, upd), s), loss
-        (_, _), losses = jax.lax.scan(body, (p, s), None, length=treps)
-        return losses[-1]
+    def scan_loop(body):
+        @jax.jit
+        def loop(p, s, tok, tgt):
+            (_, _), losses = jax.lax.scan(
+                lambda c, _: body(c, tok, tgt), (p, s), None,
+                length=treps)
+            return losses[-1]
+        return loop
 
-    train_toks = tok.size / (
-        timeit(train_loop, params_f32, ostate, tok, tgt) / treps)
+    def step_full(carry, tok, tgt, chunk=None):
+        p, s = carry
+        loss, g = jax.value_and_grad(
+            lambda p: tfm.loss_fn(p, cfg, tok, tgt, xent_chunk=chunk))(p)
+        upd, s = opt.update(g, s, p)
+        return (optax.apply_updates(p, upd), s), loss
 
-    # A/B: the same step with chunked-vocab CE (ops/xent.py) — the
-    # [4096, 50257] logits tensor (~0.8 GB f32) never materializes;
-    # measures whether the saved HBM traffic beats the scan overhead.
-    @jax.jit
-    def train_loop_chunked(p, s, tok, tgt):
-        def body(carry, _):
-            p, s = carry
-            loss, g = jax.value_and_grad(
-                lambda p: tfm.loss_fn(p, cfg, tok, tgt,
-                                      xent_chunk=8192))(p)
-            upd, s = opt.update(g, s, p)
-            return (optax.apply_updates(p, upd), s), loss
-        (_, _), losses = jax.lax.scan(body, (p, s), None, length=treps)
-        return losses[-1]
+    # Segment isolates: fwd-only and fwd+bwd steps whose carries stay
+    # loss-dependent so the scan iterations remain sequential.
+    def step_fwd(carry, tok, tgt):
+        p, s = carry
+        loss = tfm.loss_fn(p, cfg, tok, tgt)
+        p = jax.tree.map(lambda x: x + (0 * loss).astype(x.dtype), p)
+        return (p, s), loss
 
-    train_toks_chunked = tok.size / (
-        timeit(train_loop_chunked, params_f32, ostate, tok, tgt) / treps)
+    def step_grad(carry, tok, tgt):
+        p, s = carry
+        loss, g = jax.value_and_grad(tfm.loss_fn)(p, cfg, tok, tgt)
+        p = jax.tree.map(lambda a, b: a - 0.0 * b, p, g)
+        return (p, s), loss
 
+    t_full = _timeit(scan_loop(step_full), params_f32, ostate, tok,
+                     tgt) / treps
+    t_chunk = _timeit(scan_loop(
+        lambda c, a, b: step_full(c, a, b, chunk=8192)),
+        params_f32, ostate, tok, tgt) / treps
+    t_fwd = _timeit(scan_loop(step_fwd), params_f32, ostate, tok,
+                    tgt) / treps
+    t_grad = _timeit(scan_loop(step_grad), params_f32, ostate, tok,
+                     tgt) / treps
+
+    toks = tok.size / t_full
+    # Train MFU: ~6 FLOPs per param per token (fwd 2 + bwd 4).
+    mfu = toks * 6 * GPT2_SMALL_PARAMS / V5E_BF16_PEAK_FLOPS
     print(json.dumps({
-        "flash_speedup_s4096": round(speedup, 2),
-        "flash_ms": round(t_flash * 1e3, 3),
-        "dense_ms": round(t_dense * 1e3, 3),
-        "decode_tokens_per_s": round(decode_toks, 1),
-        "train_step_tokens_per_s": round(train_toks, 1),
-        "train_step_xentchunk_tokens_per_s": round(train_toks_chunked, 1),
+        "train_step_tokens_per_s": round(toks, 1),
+        "train_step_xentchunk_tokens_per_s": round(tok.size / t_chunk, 1),
+        "train_step_mfu": round(mfu, 4),
+        "train_seg_fwd_ms": round(t_fwd * 1e3, 2),
+        "train_seg_bwd_ms": round((t_grad - t_fwd) * 1e3, 2),
+        "train_seg_opt_ms": round((t_full - t_grad) * 1e3, 2),
+        "train_seg_total_ms": round(t_full * 1e3, 2),
         "device": str(jax.devices()[0].platform),
     }))
 
@@ -409,13 +507,14 @@ def _run_cpu_child(mode: str, timeout: int = 300):
 
 
 def main(full: bool = False):
-    p50, bw = native_bench()
+    p50, p99, bw = native_bench()
     out = {
         "metric": "enqueued_pingpong_p50_latency",
         "value": p50,
         "unit": "us",
         # Latency: lower is better -> ratio >= 1 means at/above baseline.
         "vs_baseline": round(BASELINE_P50_US / p50, 3),
+        "pingpong_p99_us": p99,
         "partitioned_bw_gbps": bw,
         "partitioned_bw_vs_baseline": round(bw / BASELINE_PART_BW_GBPS, 3),
     }
@@ -426,14 +525,6 @@ def main(full: bool = False):
     provisional["tpu_error"] = "provisional line: TPU measurement pending"
     print(json.dumps(provisional), flush=True)
 
-    fwd, err = _run_tpu_child("fwd")
-    if fwd is not None:
-        out.update(fwd)
-        out["gpt2_fwd_vs_baseline"] = round(
-            fwd["gpt2_fwd_tokens_per_s"] / BASELINE_GPT2_FWD_TOKS, 3)
-    else:
-        out["tpu_error"] = err     # LOUD: never silently drop the metric
-
     # Deterministic, chip-independent design metric (CPU-compiled HLO).
     qb, qerr = _run_cpu_child("quant")
     if qb is not None:
@@ -441,31 +532,59 @@ def main(full: bool = False):
     else:
         out["quant_bytes_error"] = qerr
 
+    # --- TPU capture: probe-first, per-row children, bank-as-you-go ---
+    # A dead tunnel costs ONE ~150 s probe timeout (x2 attempts), not
+    # 3x420 s per group; each group's rows land in BENCH_BANK.json (and,
+    # in --full mode, a rewritten BENCH_FULL.json) the moment its child
+    # exits, so a mid-run kill preserves everything measured so far.
+    probe, perr = _run_tpu_child("probe", attempts=2, timeout=150)
+    errs = {}
+    results = {}
+    tunnel_dead = probe is None
+
+    def run_group(name, timeout, attempts=2):
+        nonlocal tunnel_dead
+        if tunnel_dead:
+            errs[name] = (f"probe failed: {perr}" if probe is None
+                          else "tunnel died mid-run (re-probe failed)")
+            return None
+        r, e = _run_tpu_child(name, attempts=attempts, timeout=timeout)
+        if r is not None:
+            results[name] = r
+            out.update(r)
+            _bank(r)
+        else:
+            errs[name] = e
+            # A group that exhausted its retries usually means the
+            # tunnel dropped mid-run. Re-probe CHEAPLY; if dead, later
+            # groups fail fast instead of burning attempts x timeout
+            # each (~1.5 h of guaranteed timeouts otherwise).
+            rp, _ = _run_tpu_child("probe", attempts=1, timeout=150)
+            tunnel_dead = rp is None
+        return r
+
+    fwd = run_group("fwd", timeout=420, attempts=3)
+    if fwd is not None and "gpt2_fwd_tokens_per_s" in fwd:
+        out["gpt2_fwd_vs_baseline"] = round(
+            fwd["gpt2_fwd_tokens_per_s"] / BASELINE_GPT2_FWD_TOKS, 3)
+    if probe is None:
+        out["tpu_error"] = f"probe failed: {perr}"  # LOUD, never dropped
+    elif fwd is None:
+        out["tpu_error"] = errs["fwd"]
+
     checks = []
-    if full:
-        # Don't burn another 3x600s if the tunnel just proved dead.
-        sec, err2 = _run_tpu_child(
-            "full", attempts=3 if fwd is not None else 1, timeout=600)
-        if sec is not None:
-            out.update(sec)
-        else:
-            out["tpu_full_error"] = err2
-        # Speculative decode wall-clock: informational, isolated in its
-        # own child so a failure cannot cost the gated rows above.
-        spec, err3 = _run_tpu_child(
-            "spec", attempts=2 if fwd is not None else 1, timeout=600)
-        if spec is not None:
-            out.update(spec)
-        else:
-            out["tpu_spec_error"] = err3
-        # Regression gate: every starred/TPU BASELINE.md row, 10%.
-        # An UNMEASURED row is recorded as skipped — loudly, with the
-        # outage reason — NOT as a regression: a red gate must mean the
-        # code got slower, never that the tunnel was down (round-3
-        # verdict weak #2). The skip requires a recorded child failure
-        # for THAT row's source: a metric that vanishes while its child
-        # succeeded (key drift), or a chip-INDEPENDENT child failing,
-        # still fails the gate.
+
+    def write_full(partial: bool):
+        """(Re)compute the gate over whatever has landed and write
+        BENCH_FULL.json NOW — called after every child in --full mode.
+        An UNMEASURED row is recorded as skipped — loudly, with the
+        outage reason — NOT as a regression: a red gate must mean the
+        code got slower, never that the tunnel was down. The skip
+        requires a recorded child failure for THAT row's source; a
+        metric missing from a successful child (key drift), or a
+        chip-INDEPENDENT child failing, still fails the gate."""
+        checks.clear()
+
         def gate(name, value, baseline, higher_is_better=True,
                  unmeasured_reason=None):
             if value is None:
@@ -481,31 +600,39 @@ def main(full: bool = False):
                 return
             if higher_is_better:
                 ok = value >= baseline * 0.9
-            else:                      # latency: at most 10% above baseline
+            else:                  # latency: at most 10% above baseline
                 ok = value <= baseline * 1.1
             checks.append({"metric": name, "value": value,
                            "baseline": baseline,
                            "ratio": round(value / baseline, 3), "ok": ok})
 
-        fwd_why = None if fwd is not None else f"TPU outage: {err}"
-        sec_why = None if sec is not None else f"TPU outage: {err2}"
-        gate("pingpong_p50_us", p50, BASELINE_P50_US, higher_is_better=False)
+        def why(name):
+            if name in errs:
+                return f"TPU outage: {errs[name]}"
+            if name not in results:
+                return "child not yet run (partial write)" if partial \
+                    else f"child not run: {errs.get(name, 'unknown')}"
+            return None
+
+        g = lambda n: results.get(n, {})  # noqa: E731
+        gate("pingpong_p50_us", p50, BASELINE_P50_US,
+             higher_is_better=False)
         gate("partitioned_bw_gbps", bw, BASELINE_PART_BW_GBPS)
         gate("gpt2_fwd_tokens_per_s",
-             (fwd or {}).get("gpt2_fwd_tokens_per_s"), BASELINE_GPT2_FWD_TOKS,
-             unmeasured_reason=fwd_why)
+             g("fwd").get("gpt2_fwd_tokens_per_s"),
+             BASELINE_GPT2_FWD_TOKS, unmeasured_reason=why("fwd"))
         gate("gpt2_fwd_b16s512_tokens_per_s",
-             (fwd or {}).get("gpt2_fwd_b16s512_tokens_per_s"),
-             BASELINE_GPT2_FWD_B16S512_TOKS, unmeasured_reason=fwd_why)
+             g("fwd").get("gpt2_fwd_b16s512_tokens_per_s"),
+             BASELINE_GPT2_FWD_B16S512_TOKS, unmeasured_reason=why("fwd"))
         gate("flash_speedup_s4096",
-             (sec or {}).get("flash_speedup_s4096"),
-             BASELINE_FLASH_SPEEDUP_4096, unmeasured_reason=sec_why)
+             g("flash").get("flash_speedup_s4096"),
+             BASELINE_FLASH_SPEEDUP_4096, unmeasured_reason=why("flash"))
         gate("decode_tokens_per_s",
-             (sec or {}).get("decode_tokens_per_s"), BASELINE_DECODE_TOKS,
-             unmeasured_reason=sec_why)
+             g("decode").get("decode_tokens_per_s"), BASELINE_DECODE_TOKS,
+             unmeasured_reason=why("decode"))
         gate("train_step_tokens_per_s",
-             (sec or {}).get("train_step_tokens_per_s"),
-             BASELINE_TRAIN_TOKS, unmeasured_reason=sec_why)
+             g("train").get("train_step_tokens_per_s"),
+             BASELINE_TRAIN_TOKS, unmeasured_reason=why("train"))
         # Chip-independent row: a failure here is NEVER an outage skip.
         gate("quant_allreduce_traffic_reduction",
              (qb or {}).get("quant_allreduce_traffic_reduction"),
@@ -514,8 +641,43 @@ def main(full: bool = False):
                               if c["ok"] is False]
         out["unmeasured"] = [c["metric"] for c in checks
                              if c.get("skipped")]
-        with open(os.path.join(REPO, "BENCH_FULL.json"), "w") as f:
-            json.dump({"checks": checks, "result": out}, f, indent=1)
+        doc = {"checks": checks, "result": out}
+        if partial:
+            doc["partial"] = True
+        tmp = os.path.join(REPO, "BENCH_FULL.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, os.path.join(REPO, "BENCH_FULL.json"))
+
+    if full:
+        write_full(partial=True)
+        # TPU groups FIRST and back-to-back: healthy-tunnel minutes are
+        # the scarce resource — no host-only work may sit between them.
+        for name, timeout in (("flash", 420), ("decode", 420),
+                              ("train", 480)):
+            run_group(name, timeout=timeout)
+            if name in errs:
+                out[f"tpu_{name}_error"] = errs[name]
+            write_full(partial=True)
+        # Speculative decode wall-clock: informational, isolated in its
+        # own child so a failure cannot cost the gated rows above.
+        spec = run_group("spec", timeout=600)
+        if spec is None and probe is not None:
+            out["tpu_spec_error"] = errs["spec"]
+        write_full(partial=True)
+        # Host-plane message-size sweep (p50/p99 per size) — native, no
+        # chip needed (round-4 verdict item #8); runs after the chip
+        # work on purpose.
+        sweep = []
+        for msg in (1, 1024, 65536, 1048576):
+            try:
+                sp50, sp99, _ = native_bench(msg_bytes=msg)
+                sweep.append({"msg_bytes": msg, "p50_us": sp50,
+                              "p99_us": sp99})
+            except Exception as e:  # noqa: BLE001 — report, don't crash
+                sweep.append({"msg_bytes": msg, "error": str(e)})
+        out["pingpong_sweep"] = sweep
+        write_full(partial=False)
 
     print(json.dumps(out))
     if full and any(c["ok"] is False for c in checks):
@@ -525,10 +687,16 @@ def main(full: bool = False):
 if __name__ == "__main__":
     if "--cpu-child-quant" in sys.argv:
         cpu_child_quant()
+    elif "--tpu-child-probe" in sys.argv:
+        tpu_child_probe()
     elif "--tpu-child-fwd" in sys.argv:
         tpu_child_fwd()
-    elif "--tpu-child-full" in sys.argv:
-        tpu_child_full()
+    elif "--tpu-child-flash" in sys.argv:
+        tpu_child_flash()
+    elif "--tpu-child-decode" in sys.argv:
+        tpu_child_decode()
+    elif "--tpu-child-train" in sys.argv:
+        tpu_child_train()
     elif "--tpu-child-spec" in sys.argv:
         tpu_child_spec()
     else:

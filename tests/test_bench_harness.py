@@ -1,0 +1,183 @@
+"""Tests for bench.py's incremental TPU-evidence capture (round-4
+verdict item #1: rounds 2-4 lost entire healthy-tunnel windows to
+all-or-nothing 600 s children; the harness itself must be tested).
+
+The TPU children are mocked — these tests verify the ORCHESTRATION:
+probe-first fast-fail, per-child banking to BENCH_BANK.json, the
+rewrite of BENCH_FULL.json after every child (so a mid-run kill keeps
+everything measured so far), and the unmeasured-vs-regression gate
+split. Reference: the reference repo has no benchmark harness at all
+(SURVEY.md §6) — this is our own obligation.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bench(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "REPO", str(tmp_path))
+    # Native rows are not under test here: pin them.
+    monkeypatch.setattr(mod, "native_bench",
+                        lambda msg_bytes=None: (25.0, 40.0, 1.5))
+    monkeypatch.setattr(
+        mod, "_run_cpu_child",
+        lambda mode, timeout=300: (
+            {"quant_allreduce_traffic_reduction": 3.88}, None))
+    return mod
+
+
+def _run_main(bench, full=True):
+    code = 0
+    try:
+        bench.main(full=full)
+    except SystemExit as e:
+        code = e.code or 0
+    return code
+
+
+def test_probe_down_fast_fails_and_skips(bench, capsys):
+    """Dead tunnel: ONE probe failure gates every TPU child; all TPU rows
+    are unmeasured (skipped loudly), not regressions; exit 0."""
+    calls = []
+
+    def fake_child(mode, attempts=3, timeout=420, **kw):
+        calls.append(mode)
+        return None, f"timeout after {timeout}s (attempt {attempts})"
+
+    bench._run_tpu_child = fake_child
+    assert _run_main(bench) == 0
+    assert calls == ["probe"], "expensive children must not run"
+    doc = json.load(open(os.path.join(bench.REPO, "BENCH_FULL.json")))
+    assert "partial" not in doc
+    skipped = {c["metric"] for c in doc["checks"] if c.get("skipped")}
+    assert "gpt2_fwd_tokens_per_s" in skipped
+    assert "train_step_tokens_per_s" in skipped
+    assert not doc["result"]["regressions"]
+    assert "probe failed" in doc["result"]["tpu_error"]
+    # Native + chip-independent rows still gated green.
+    ok = {c["metric"] for c in doc["checks"] if c.get("ok")}
+    assert {"pingpong_p50_us", "partitioned_bw_gbps",
+            "quant_allreduce_traffic_reduction"} <= ok
+
+
+def test_partial_failure_keeps_earlier_rows(bench):
+    """Tunnel dies mid-run (after flash): fwd+flash rows are banked and
+    in BENCH_FULL.json; later rows are outage-skips, exit 0."""
+    rows = {
+        "probe": {"tpu_probe_ok": True, "device": "tpu"},
+        "fwd": {"gpt2_fwd_tokens_per_s": 250000.0,
+                "gpt2_fwd_b16s512_tokens_per_s": 380000.0,
+                "device": "tpu"},
+        "flash": {"flash_speedup_s4096": 30.0, "device": "tpu"},
+    }
+
+    def fake_child(mode, attempts=3, timeout=420, **kw):
+        if mode in rows:
+            return rows[mode], None
+        return None, f"timeout after {timeout}s (attempt 1)"
+
+    bench._run_tpu_child = fake_child
+    assert _run_main(bench) == 0
+    doc = json.load(open(os.path.join(bench.REPO, "BENCH_FULL.json")))
+    by = {c["metric"]: c for c in doc["checks"]}
+    assert by["gpt2_fwd_tokens_per_s"]["ok"] is True
+    assert by["flash_speedup_s4096"]["ok"] is True
+    assert by["decode_tokens_per_s"]["skipped"]
+    assert "TPU outage" in by["decode_tokens_per_s"]["reason"]
+    assert not doc["result"]["regressions"]
+    # The measured rows were banked the moment they landed.
+    bank = json.load(open(os.path.join(bench.REPO, "BENCH_BANK.json")))
+    assert bank["gpt2_fwd_tokens_per_s"]["value"] == 250000.0
+    assert bank["flash_speedup_s4096"]["value"] == 30.0
+    assert "decode_tokens_per_s" not in bank
+
+
+def test_tunnel_death_mid_run_skips_remaining_groups(bench):
+    """Once a group exhausts retries AND the re-probe fails, later
+    groups must fail fast (no attempts x timeout burn) with a loud
+    mid-run error."""
+    calls = []
+    alive = {"probe": True}
+
+    def fake_child(mode, attempts=3, timeout=420, **kw):
+        calls.append(mode)
+        if mode == "probe":
+            if alive["probe"]:
+                alive["probe"] = False     # first probe green, re-probe dead
+                return {"tpu_probe_ok": True, "device": "tpu"}, None
+            return None, "timeout after 150s (attempt 1)"
+        if mode == "fwd":
+            return {"gpt2_fwd_tokens_per_s": 250000.0,
+                    "gpt2_fwd_b16s512_tokens_per_s": 380000.0,
+                    "device": "tpu"}, None
+        return None, f"timeout after {timeout}s"
+
+    bench._run_tpu_child = fake_child
+    assert _run_main(bench) == 0
+    # flash fails -> re-probe fails -> decode/train/spec never spawn.
+    assert calls.count("flash") == 1
+    assert "decode" not in calls and "train" not in calls \
+        and "spec" not in calls
+    doc = json.load(open(os.path.join(bench.REPO, "BENCH_FULL.json")))
+    by = {c["metric"]: c for c in doc["checks"]}
+    assert by["gpt2_fwd_tokens_per_s"]["ok"] is True
+    assert by["decode_tokens_per_s"]["skipped"]
+    assert "mid-run" in by["decode_tokens_per_s"]["reason"]
+
+
+def test_true_regression_still_fails_gate(bench):
+    """A measured row below 0.9x baseline exits nonzero — the
+    unmeasured split must not soften real regressions."""
+    def fake_child(mode, attempts=3, timeout=420, **kw):
+        if mode == "probe":
+            return {"tpu_probe_ok": True, "device": "tpu"}, None
+        if mode == "fwd":
+            return {"gpt2_fwd_tokens_per_s": 1000.0,   # way below baseline
+                    "gpt2_fwd_b16s512_tokens_per_s": 380000.0,
+                    "device": "tpu"}, None
+        return None, "timeout"
+
+    bench._run_tpu_child = fake_child
+    assert _run_main(bench) == 1
+    doc = json.load(open(os.path.join(bench.REPO, "BENCH_FULL.json")))
+    assert "gpt2_fwd_tokens_per_s" in doc["result"]["regressions"]
+
+
+def test_bank_merges_not_overwrites(bench):
+    """_bank appends/updates rows without dropping earlier evidence."""
+    bench._bank({"a": 1, "device": "tpu"})
+    bench._bank({"b": 2.5, "device": "tpu"})
+    bench._bank({"a": 3, "device": "tpu"})
+    bank = json.load(open(os.path.join(bench.REPO, "BENCH_BANK.json")))
+    assert bank["a"]["value"] == 3 and bank["b"]["value"] == 2.5
+    assert "device" not in bank
+    assert bank["a"]["device"] == "tpu"
+
+
+def test_key_drift_is_a_failure_not_a_skip(bench):
+    """A successful child whose expected metric key vanished must FAIL
+    the gate (key drift), never silently skip."""
+    def fake_child(mode, attempts=3, timeout=420, **kw):
+        if mode == "probe":
+            return {"tpu_probe_ok": True, "device": "tpu"}, None
+        if mode == "fwd":
+            return {"renamed_key": 1.0, "device": "tpu"}, None
+        return None, "timeout"
+
+    bench._run_tpu_child = fake_child
+    assert _run_main(bench) == 1
+    doc = json.load(open(os.path.join(bench.REPO, "BENCH_FULL.json")))
+    by = {c["metric"]: c for c in doc["checks"]}
+    assert by["gpt2_fwd_tokens_per_s"]["ok"] is False
+    assert "key drift" in by["gpt2_fwd_tokens_per_s"]["reason"]

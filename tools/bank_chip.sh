@@ -1,0 +1,99 @@
+#!/usr/bin/env bash
+# tools/bank_chip.sh — incremental TPU evidence banker.
+#
+# Rounds 2-4 lost every healthy-tunnel window to all-or-nothing capture
+# and a session-local /tmp banker that died with the session. This
+# script is the checked-in replacement: probe the axon tunnel cheaply;
+# on success run the bench suite + the on-chip trigger/bridge proof
+# tests, committing every green artifact IMMEDIATELY so even a
+# 3-minute window banks at least one TPU row.
+#
+# Usage:
+#   tools/bank_chip.sh            one probe+bank pass (rc 0 = banked)
+#   tools/bank_chip.sh --loop [s] retry every s seconds (default 420)
+#                                 until one pass banks, then exit 0
+#
+# Safe to run from cron or any session: commits touch ONLY the bench
+# artifacts (explicit pathspecs), never the working tree's other files.
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+LOG="${ACX_BANK_LOG:-$REPO/chip_bank.log}"
+ARTIFACTS="BENCH_BANK.json BENCH_FULL.json"
+cd "$REPO"
+
+log() { echo "[$(date -u +%FT%TZ)] $*" | tee -a "$LOG"; }
+
+probe() {
+  # jax.devices() HANGS (not errors) when the tunnel is down — always
+  # wrap in timeout. A matmul proves the chip executes, not just lists.
+  timeout 180 python -c \
+    "import jax, jax.numpy as jnp; \
+     print(jax.devices()); \
+     print(float(jax.jit(lambda a: (a@a).sum())(jnp.ones((64,64)))))" \
+    >>"$LOG" 2>&1
+}
+
+commit_artifacts() {
+  # Pathspec-limited commit: only the bench artifacts, regardless of
+  # whatever else is dirty or staged in the tree. add -f first: a
+  # freshly created BENCH_BANK.json is untracked, and `git commit --
+  # <pathspec>` errors on paths git doesn't know (verified).
+  if ! git status --porcelain -- $ARTIFACTS | grep -q .; then
+    return 0
+  fi
+  git add -f -- $ARTIFACTS >>"$LOG" 2>&1
+  git commit -m "$1" -- $ARTIFACTS >>"$LOG" 2>&1 \
+    && log "committed: $1" || log "commit FAILED: $1"
+}
+
+bank_fingerprint() { md5sum BENCH_BANK.json 2>/dev/null || echo none; }
+
+bank_once() {
+  log "probing tunnel..."
+  if ! probe; then
+    log "probe FAILED (tunnel down)"
+    return 1
+  fi
+  log "tunnel UP — banking evidence"
+  before="$(bank_fingerprint)"
+  # Each stage commits on its own so a mid-run tunnel drop keeps
+  # everything banked so far (bench.py itself also writes BENCH_BANK
+  # incrementally after every child).
+  timeout 2400 python bench.py >>"$LOG" 2>&1 \
+    && log "bench.py done" || log "bench.py FAILED/timeout"
+  commit_artifacts "Bank TPU bench rows (bench.py)"
+  timeout 3600 python bench.py --full >>"$LOG" 2>&1 \
+    && log "bench.py --full done (gate green)" \
+    || log "bench.py --full nonzero (gate red or outage)"
+  commit_artifacts "Bank TPU bench rows (bench.py --full)"
+  onchip_ok=0
+  if ACX_TPU_TESTS=1 timeout 1800 \
+      python -m pytest tests/test_tpu_onchip.py -q >>"$LOG" 2>&1; then
+    log "on-chip trigger/bridge proof PASSED"
+    python -c "import bench; bench._bank({'onchip_proof_passed': 1,
+                                          'device': 'tpu'})"
+    commit_artifacts "Bank on-chip trigger/bridge proof result"
+    onchip_ok=1
+  else
+    log "on-chip proof FAILED or timed out (see $LOG)"
+  fi
+  # Success = evidence actually landed, not merely a green probe: the
+  # tunnel can drop between the probe and the first bench child, and
+  # --loop must keep watching in that case.
+  if [ "$(bank_fingerprint)" = "$before" ] && [ "$onchip_ok" = 0 ]; then
+    log "bank pass banked NOTHING (tunnel dropped mid-run?) — will retry"
+    return 1
+  fi
+  log "bank pass complete (evidence banked)"
+  return 0
+}
+
+if [ "${1:-}" = "--loop" ]; then
+  interval="${2:-420}"
+  while true; do
+    bank_once && exit 0
+    sleep "$interval"
+  done
+else
+  bank_once
+fi
