@@ -41,9 +41,8 @@ def main():
                          "through mpi_acx_tpu.data with device prefetch); "
                          "default: synthetic ramp task")
     args = ap.parse_args()
-    if args.schedule == "1f1b" and args.virtual > 1:
-        ap.error("--schedule 1f1b is the non-interleaved schedule; "
-                 "drop --virtual")
+    # --schedule 1f1b composes with --virtual > 1: the interleaved 1F1B
+    # schedule (O(v*pp) activation residency AND bubble/v).
 
     import jax
     # Hosts with a pinned accelerator plugin (e.g. the axon tunnel) register

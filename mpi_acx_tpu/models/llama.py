@@ -154,10 +154,12 @@ def block(cfg: LlamaConfig, lp: Params, x: jax.Array,
     return _mlp(cfg, lp, x)
 
 
-def forward(params: Params, cfg: LlamaConfig,
+def _hidden(params: Params, cfg: LlamaConfig,
             tokens: jax.Array) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, vocab] f32."""
-    B, S = tokens.shape
+    """The model trunk: tokens [B, S] -> final-rmsnormed hidden states
+    [B, S, d]. Shared by :func:`forward` and the chunked-CE loss path so
+    dtype policy / block wiring can never diverge between them."""
+    S = tokens.shape[1]
     assert S <= cfg.max_seq, (S, cfg.max_seq)
     x = params["embed"][tokens].astype(cfg.dtype)
     positions = jnp.arange(S)
@@ -166,7 +168,13 @@ def forward(params: Params, cfg: LlamaConfig,
         return block(cfg, lp, x, positions), None
 
     x, _ = lax.scan(body, x, params["layers"])
-    x = rmsnorm(x, params["final_norm"])
+    return rmsnorm(x, params["final_norm"])
+
+
+def forward(params: Params, cfg: LlamaConfig,
+            tokens: jax.Array) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab] f32."""
+    x = _hidden(params, cfg, tokens)
     return jnp.einsum("bsd,vd->bsv", x, params["unembed"].astype(x.dtype),
                       preferred_element_type=jnp.float32)
 
@@ -181,14 +189,7 @@ def loss_fn(params: Params, cfg: LlamaConfig, tokens: jax.Array,
     if xent_chunk is not None:
         from mpi_acx_tpu.ops.xent import chunked_xent_ll
         B, S = tokens.shape
-        x = params["embed"][tokens].astype(cfg.dtype)
-        positions = jnp.arange(S)
-
-        def body(x, lp):
-            return block(cfg, lp, x, positions), None
-
-        x, _ = lax.scan(body, x, params["layers"])
-        x = rmsnorm(x, params["final_norm"])
+        x = _hidden(params, cfg, tokens)
         ll = chunked_xent_ll(x.reshape(B * S, -1), params["unembed"],
                              targets.reshape(-1), xent_chunk)
         return -jnp.mean(ll)

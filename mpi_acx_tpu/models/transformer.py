@@ -113,17 +113,25 @@ def block(cfg: TransformerConfig, lp: Params, x: jax.Array) -> jax.Array:
     return _mlp(cfg, lp, x)
 
 
-def forward(params: Params, cfg: TransformerConfig,
+def _hidden(params: Params, cfg: TransformerConfig,
             tokens: jax.Array) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, vocab] (f32)."""
-    B, S = tokens.shape
+    """The model trunk: tokens [B, S] -> final-layernormed hidden states
+    [B, S, d]. Shared by :func:`forward` and the chunked-CE loss path so
+    dtype policy / block wiring can never diverge between them."""
+    S = tokens.shape[1]
     x = (params["embed"][tokens] + params["pos"][:S]).astype(cfg.dtype)
 
     def body(x, lp):
         return block(cfg, lp, x), None
 
     x, _ = lax.scan(body, x, params["layers"])
-    x = layernorm(x, params["lnf_g"], params["lnf_b"])
+    return layernorm(x, params["lnf_g"], params["lnf_b"])
+
+
+def forward(params: Params, cfg: TransformerConfig,
+            tokens: jax.Array) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab] (f32)."""
+    x = _hidden(params, cfg, tokens)
     # Tied unembedding (GPT-2 style): bf16 operands, f32 accumulation —
     # this matmul is ~1/3 of forward FLOPs and must ride the MXU at full
     # rate (f32 operands here cost 1.45x whole-model latency on v5e).
@@ -145,13 +153,7 @@ def loss_fn(params: Params, cfg: TransformerConfig, tokens: jax.Array,
     if xent_chunk is not None:
         from mpi_acx_tpu.ops.xent import chunked_xent_ll
         B, S = tokens.shape
-        x = (params["embed"][tokens] + params["pos"][:S]).astype(cfg.dtype)
-
-        def body(x, lp):
-            return block(cfg, lp, x), None
-
-        x, _ = lax.scan(body, x, params["layers"])
-        x = layernorm(x, params["lnf_g"], params["lnf_b"])
+        x = _hidden(params, cfg, tokens)
         ll = chunked_xent_ll(x.reshape(B * S, -1), params["embed"],
                              targets.reshape(-1), xent_chunk)
         return -jnp.mean(ll)

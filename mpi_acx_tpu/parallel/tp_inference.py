@@ -275,11 +275,26 @@ def tp_param_specs_moe(axis: str = "tp"):
     }
 
 
+def _ep_dispatch_mode(mode: str, tokens: int, ep: int) -> str:
+    """Resolve the effective EP dispatch for ONE routed call moving
+    ``tokens`` tokens over an ``ep``-way axis. ``"auto"`` picks
+    ``"sharded"`` when the token count divides the axis — in the
+    drop-free serving regime the two paths are token-identical, so
+    divisibility is the only real constraint — and falls back to
+    ``"replicated"`` otherwise (B=1 latency decode, k-wide speculative
+    verify windows). Shapes are static under jit, so the choice is
+    made at trace time, per call site: a prefill can dispatch sharded
+    while the same program's decode runs replicated."""
+    if mode == "auto":
+        return "sharded" if tokens % ep == 0 else "replicated"
+    return mode
+
+
 def make_tp_generate_moe(cfg, mesh: Mesh, n_new: int, axis: str = "tp",
                          temperature: float = 0.0,
                          top_k: Optional[int] = None,
                          top_p: Optional[float] = None,
-                         ep_dispatch: str = "sharded"):
+                         ep_dispatch: str = "auto"):
     """Tensor-parallel MoE-transformer generation: the dense GPT-2
     builder with the expert-parallel routed FFN plugged into its ffn
     hook. Attention splits by head (two psums per layer); each rank
@@ -287,35 +302,41 @@ def make_tp_generate_moe(cfg, mesh: Mesh, n_new: int, axis: str = "tp",
 
     ``ep_dispatch`` selects how tokens reach their experts:
 
-    * ``"sharded"`` (default) — REAL expert-parallel dispatch
+    * ``"auto"`` (default) — per call site (trace-time, shapes are
+      static): ``"sharded"`` whenever the call's token count divides
+      tp, ``"replicated"`` otherwise. Prefill (B*S tokens) and
+      batch-serving decode get real EP scaling; B=1 latency decode
+      falls back to replicated instead of raising.
+    * ``"sharded"`` — REAL expert-parallel dispatch
       (moe.moe_layer_sharded_dispatch): each rank routes only its
       exclusive 1/tp token slice and the capacity-bounded
       ``all_to_all`` of the training EP path carries tokens to their
       expert's rank and back, then one all_gather re-replicates.
       Router + dispatch work per rank genuinely scales as 1/tp —
-      this is the path that scales past small tp. Requires the batch
-      to divide by tp (decode routes B tokens per step; asserted at
-      trace time).
+      this is the path that scales past small tp. Requires every
+      routed call's token count to divide tp (decode routes B tokens
+      per step; raises at trace time).
     * ``"replicated"`` — every rank routes ALL tokens, local expert
       block + one psum (moe.moe_layer_replicated_ep): only the expert
-      FLOPs shard, but any batch size works (B=1 latency serving) and
-      routing is bit-equal to the single-device dispatch at any
-      capacity.
+      FLOPs shard, but any batch size works and routing is bit-equal
+      to the single-device dispatch at any capacity.
 
     In the drop-free regime (``capacity_factor >= n_experts``, the
-    serving guard — see moe_transformer.decode_step) both paths emit
+    serving guard — see moe_transformer.decode_step) all paths emit
     tokens identical to the single-device ``generate``
-    (tests/test_tp_inference.py covers tp=4 and tp=8)."""
+    (tests/test_tp_inference.py covers tp=4 and tp=8, plus the auto
+    fallback at an indivisible batch)."""
     from mpi_acx_tpu.models.moe_transformer import _moe_ffn
 
-    assert cfg.n_experts % mesh.shape[axis] == 0, (
-        cfg.n_experts, mesh.shape[axis])
-    assert ep_dispatch in ("sharded", "replicated"), ep_dispatch
+    ep = mesh.shape[axis]
+    assert cfg.n_experts % ep == 0, (cfg.n_experts, ep)
+    assert ep_dispatch in ("auto", "sharded", "replicated"), ep_dispatch
 
     def moe_ffn(lp, x):
+        mode = _ep_dispatch_mode(ep_dispatch, x.shape[0] * x.shape[1], ep)
         return _moe_ffn(cfg, lp, x, ep_axis=axis,
-                        replicated=ep_dispatch == "replicated",
-                        sharded_dispatch=ep_dispatch == "sharded")
+                        replicated=mode == "replicated",
+                        sharded_dispatch=mode == "sharded")
 
     return make_tp_generate(cfg, mesh, n_new, axis=axis,
                             temperature=temperature, top_k=top_k,
@@ -607,7 +628,8 @@ def _llama_tp_family_ops(cfg, tp: int, axis: str):
 
 def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
                                  k: int = 4, axis: str = "tp",
-                                 temperature: float = 0.0):
+                                 temperature: float = 0.0,
+                                 ep_dispatch: str = "auto"):
     """Tensor-parallel SPECULATIVE decoding: draft proposes, target
     verifies k tokens per window pass — with BOTH models Megatron-split
     over the mesh's ``axis`` inside one shard_map program (per-rank
@@ -623,6 +645,23 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
     target-only greedy decode (tests/test_tp_inference.py asserts both
     at tp=2/4); otherwise the stochastic accept/resample hooks run with
     the replicated key, every rank drawing identical samples.
+
+    ``ep_dispatch`` (MoE sides only) follows make_tp_generate_moe's
+    contract: ``"auto"`` (default) resolves PER CALL SITE by
+    divisibility — the prompt prefill, and the k+1-wide verify window
+    when ``B*(k+1)`` happens to divide tp, dispatch sharded; calls
+    with indivisible token counts (single-token draft steps, most
+    window geometries) fall back to replicated EP instead of raising.
+    Parity exception: on an MoE side OUTSIDE the drop-free capacity
+    regime, ``"auto"`` resolves to replicated for EVERY call — sharded
+    dispatch forms different capacity groups than the single-device
+    run, and this builder's contract is exact equality with
+    ``speculative_generate`` (only the TARGET is required drop-free by
+    ``_check_moe_target``; a tight-capacity DRAFT is legal, so its
+    dispatch must stay bit-equal). Forcing ``"sharded"`` raises at
+    trace time when any call's token count is indivisible (same rule
+    as plain TP MoE serving); a compiled FLOP/wire comparison of the
+    modes is recorded in BASELINE.md.
 
     Returns a jitted ``generate(draft_params, params, prompt, key) ->
     (tokens [1, S+n_new], stats)`` with stats as in
@@ -642,9 +681,21 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
                     tp_param_specs_llama(axis), tp_shard_params_llama)
         if type(c) is MoeTransformerConfig:
             assert c.n_experts % tp == 0, (c.n_experts, tp)
+            # Outside the drop-free regime sharded dispatch forms
+            # different capacity groups than the single-device run;
+            # auto degrades to replicated (bit-equal at any capacity)
+            # so the exact-parity contract survives a tight-capacity
+            # draft. An EXPLICIT "sharded" request is honored as-is.
+            side = ep_dispatch
+            if side == "auto" and c.capacity_factor < c.n_experts:
+                side = "replicated"
 
-            def moe_ffn(lp, x):
-                return _moe_ffn(c, lp, x, ep_axis=axis, replicated=True)
+            def moe_ffn(lp, x, side=side):
+                mode = _ep_dispatch_mode(
+                    side, x.shape[0] * x.shape[1], tp)
+                return _moe_ffn(c, lp, x, ep_axis=axis,
+                                replicated=mode == "replicated",
+                                sharded_dispatch=mode == "sharded")
 
             return (_tp_family_ops(c, tp, axis, ffn=moe_ffn),
                     tp_param_specs_moe(axis), tp_shard_params)
@@ -657,6 +708,7 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
 
     assert draft_cfg.vocab == cfg.vocab, (draft_cfg.vocab, cfg.vocab)
     assert k >= 2, k
+    assert ep_dispatch in ("auto", "sharded", "replicated"), ep_dispatch
     # An MoE TARGET must be drop-free so the k-wide verify window
     # routes exactly like plain decode (same rule as the
     # single-device speculative API).

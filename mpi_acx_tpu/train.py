@@ -293,17 +293,19 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
     weights are ignored by the dense families.
 
     ``schedule="1f1b"`` swaps the autodiff-through-the-scan backward for
-    the memory-bounded 1F1B schedule (pipeline._schedule_1f1b): one slot
-    scan whose body runs the stage forward and an explicit ``jax.vjp``
-    backward from a pp-deep input ring buffer, so peak activation
-    residency is O(pp) instead of O(n_micro) scan residuals. Same loss
-    and gradients as the GPipe path (tests/test_train_1f1b.py asserts
-    exact parity at dp2 x pp2 x tp2 for all three families). Because
-    every rank must execute the stage collectives in lockstep, the slot
-    body computes both the forward and the backward unconditionally and
-    masks the accumulations (~2x the op count of the cond-based
-    pipeline-level schedule; the win is memory, not FLOPs). Requires
-    ``n_virtual == 1``.
+    the memory-bounded 1F1B schedule (pipeline._pipeline_1f1b_engine):
+    one slot scan whose body runs the stage forward and an explicit
+    ``jax.vjp`` backward from an interval-colored input buffer, so peak
+    activation residency is O(pp) (O(n_virtual * pp) interleaved)
+    instead of O(n_micro) scan residuals. Same loss and gradients as
+    the GPipe path (tests/test_train_1f1b.py asserts exact parity at
+    dp2 x pp2 x tp2 for all three families). Because every rank must
+    execute the stage collectives in lockstep, the slot body computes
+    both the forward and the backward unconditionally and masks the
+    accumulations (~2x the op count of the cond-based pipeline-level
+    schedule; the win is memory, not FLOPs). ``n_virtual > 1`` composes
+    1F1B with the interleaved schedule — memory win AND the bubble/v
+    win together (Megatron interleaved 1F1B; needs n_micro % pp == 0).
     """
     n_stages = mesh.shape["pp"]
     fam = _family(cfg)
@@ -313,8 +315,6 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
             f"n_experts ({cfg.n_experts}) must divide by the 'tp' mesh "
             f"axis ({mesh.shape['tp']}) — experts shard over tp")
     assert schedule in ("gpipe", "1f1b"), schedule
-    if schedule == "1f1b":
-        assert n_virtual == 1, "1F1B is the non-interleaved schedule"
 
     def ll_sum(head_mat, ys_blk, tg_blk):
         """Summed target log-likelihood of a rank's exclusive slice.
@@ -449,180 +449,92 @@ def make_loss_and_grads(cfg, mesh: Mesh, n_micro: int, n_virtual: int = 1,
         return loss, out
 
     def per_shard_1f1b(params, tokens, targets):
-        """The 1F1B counterpart of per_shard: manual backward, O(pp)
-        activation residency. See make_loss_and_grads docstring; the
-        schedule tables and correctness story live in
-        parallel.pipeline (_schedule_1f1b / pipeline_1f1b_loss_and_grads
-        — this is that construction with the flagship's tp collectives,
-        tail (final-norm + head) and embedding vjps, and MoE aux seeds
-        folded in). Collectives inside the stage force select-masked
-        (not cond-skipped) execution: every rank runs the forward and
-        the backward body each slot, in lockstep."""
-        from mpi_acx_tpu.parallel.pipeline import _schedule_1f1b
+        """The 1F1B counterpart of per_shard: a thin adapter over
+        pipeline._pipeline_1f1b_engine (the slot scan, timetable, and
+        ring buffers live THERE, once — round-4 verdict item #5). This
+        wires in the flagship specifics: ``lockstep=True`` because the
+        stage body contains tp collectives (every rank computes every
+        slot and masks accumulations), the tail (final-norm + head)
+        loss vjp, the embedding vjp at global stage 0, and the MoE
+        router-aux seeds gated to ti == 0 (exclusive-path rule).
+        ``n_virtual > 1`` runs the interleaved 1F1B schedule."""
+        from mpi_acx_tpu.parallel.pipeline import _pipeline_1f1b_engine
         M, mbl, S = tokens.shape
-        P_stages = n_stages
-        T, fwd_np, bwd_np, arr_np, K = _schedule_1f1b(P_stages, M)
-        fwd_tab = jnp.asarray(fwd_np)
-        bwd_tab = jnp.asarray(bwd_np)
-        arr_tab = jnp.asarray(arr_np)
-
-        stage = lax.axis_index("pp")
         tpn = lax.axis_size("tp")
         ti = lax.axis_index("tp")
-        last = P_stages - 1
         blk = S // tpn
         n_tok = M * mbl * S
         calls = cfg.n_layers * M
-        fwd_perm = [(i, i + 1) for i in range(P_stages - 1)]
-        bwd_perm = [(i, i - 1) for i in range(1, P_stages)]
 
         slayers = jax.tree.map(lambda p: p[0], params["layers"])
+        if n_virtual == 1:
+            slayers = jax.tree.map(lambda p: p[None], slayers)  # chunk axis
         tail = {k: v for k, v in params.items() if k != "layers"}
+        zero_tail = jax.tree.map(jnp.zeros_like, tail)
         stage_fn = make_stage_fn()
 
-        x_all = fam.embed(params, cfg, tokens)     # [M, mbl, S, d]
-        mb_shape = x_all.shape[1:]
-        zero_act = jnp.zeros(mb_shape, x_all.dtype)
-        zero_tail = jax.tree.map(jnp.zeros_like, tail)
+        # fam.embed/final/head only read the tail leaves; hand them a
+        # params dict without the layer stack (its layout differs
+        # between the chunked and flat cases and is never touched).
+        def with_tail(tailp):
+            return dict(tailp, layers=None)
 
-        def embed_m(tailp, tok_m):
-            return fam.embed(dict(tailp, layers=slayers), cfg, tok_m)
+        x_all = fam.embed(params, cfg, tokens)     # [M, mbl, S, d]
 
         def tail_ll(tailp, y, tgt_m):
             # This rank's EXCLUSIVE loss share for one microbatch: the
             # local tp sequence slice, collective-free (assembly is one
             # psum of the accumulated scalars after the scan).
-            full = dict(tailp, layers=slayers)
+            full = with_tail(tailp)
             ys = fam.final(full, y)
             ys_blk = lax.dynamic_slice_in_dim(ys, ti * blk, blk, axis=1)
             tg_blk = lax.dynamic_slice_in_dim(tgt_m, ti * blk, blk,
                                               axis=1)
             return ll_sum(fam.head(full), ys_blk, tg_blk)
 
-        def slot(carry, t):
-            ib, fmsg, bmsg, gl, gt, lacc, lbacc, rzacc = carry
-
-            # 1) Bank an arriving activation.
-            am = arr_tab[stage, t]
-            ib = jnp.where(
-                am >= 0,
-                lax.dynamic_update_index_in_dim(
-                    ib, fmsg, jnp.maximum(am, 0) % K, 0),
-                ib)
-
-            # 2) Forward (masked, never cond-skipped: lockstep
-            # collectives).
-            mf = fwd_tab[stage, t]
-            mfc = jnp.maximum(mf, 0)
-            fresh = lax.dynamic_index_in_dim(x_all, mfc, 0,
+        def loss_side(y_, m):
+            tgt_m = lax.dynamic_index_in_dim(targets, m, 0,
                                              keepdims=False)
-            x_f = jnp.where(stage == 0, fresh,
-                            lax.dynamic_index_in_dim(ib, mfc % K, 0,
-                                                     keepdims=False))
-            ib = jnp.where(
-                mf >= 0,
-                lax.dynamic_update_index_in_dim(ib, x_f, mfc % K, 0),
-                ib)
-            out_f = stage_fn(slayers, x_f)
-            y_f = out_f[0] if fam.has_aux else out_f
+            llsum, tail_vjp = jax.vjp(
+                lambda tp_, yy: tail_ll(tp_, yy, tgt_m), tail, y_)
+            d_tail, dy = tail_vjp(
+                jnp.asarray(-1.0 / n_tok, llsum.dtype))
+            return llsum, d_tail, dy.astype(y_.dtype)
 
-            # 3) Backward: recompute from the banked input (remat) and
-            # seed — the loss cotangent at the last stage, the
-            # neighbor's dx elsewhere; MoE aux seeds apply at EVERY
-            # stage (each owns its layers' routers), gated to ti == 0
-            # for the exclusive-path rule.
-            mb_ = bwd_tab[stage, t]
-            mbc = jnp.maximum(mb_, 0)
-            x_b = lax.dynamic_index_in_dim(ib, mbc % K, 0,
-                                           keepdims=False)
-            out_b, vjp_fn = jax.vjp(
-                lambda sl, x: stage_fn(sl, x), slayers, x_b)
-            y_b = out_b[0] if fam.has_aux else out_b
-            tgt_m = lax.dynamic_index_in_dim(targets, mbc, 0,
+        def embed_side(dx_, m):
+            tok_m = lax.dynamic_index_in_dim(tokens, m, 0,
                                              keepdims=False)
+            _, embed_vjp = jax.vjp(
+                lambda tp_: fam.embed(with_tail(tp_), cfg, tok_m), tail)
+            (d,) = embed_vjp(dx_.astype(x_all.dtype))
+            return d
 
-            # tail_ll and embed_m are collective-free, so (unlike the
-            # stage body) they may run under per-device lax.cond: only
-            # the one stage that consumes each vjp pays for it.
-            def loss_side(y_):
-                llsum, tail_vjp = jax.vjp(
-                    lambda tp_, yy: tail_ll(tp_, yy, tgt_m), tail, y_)
-                d_tail, dy = tail_vjp(
-                    jnp.asarray(-1.0 / n_tok, llsum.dtype))
-                return llsum, d_tail, dy.astype(y_.dtype)
+        if fam.has_aux:
+            gate = (ti == 0).astype(jnp.float32)
+            aux_seed = (aux_weight / calls * gate,
+                        z_weight / calls * gate)
+            aux_gate = ti == 0
+        else:
+            aux_seed = aux_gate = None
 
-            llsum, d_tail_loss, dy_loss = lax.cond(
-                stage == last, loss_side,
-                lambda y_: (jnp.zeros((), jnp.float32), zero_tail,
-                            jnp.zeros_like(y_)), y_b)
-            dy = jnp.where(stage == last, dy_loss,
-                           bmsg.astype(y_b.dtype))
-            if fam.has_aux:
-                gate = (ti == 0).astype(jnp.float32)
-                seed = (dy, (aux_weight / calls * gate,
-                             z_weight / calls * gate))
-            else:
-                seed = dy
-            d_layers, dx = vjp_fn(seed)
+        lacc, aux_acc, gl, gt = _pipeline_1f1b_engine(
+            stage_fn, slayers, x_all, "pp", n_virtual,
+            loss_side=loss_side, zero_head=zero_tail,
+            embed_side=embed_side, aux_seed=aux_seed,
+            aux_gate=aux_gate, lockstep=True)
 
-            bmask = mb_ >= 0
-            gl = jax.tree.map(
-                lambda a, d: a + jnp.where(bmask, d, 0), gl, d_layers)
-            lastmask = jnp.logical_and(bmask, stage == last)
-            gt = jax.tree.map(
-                lambda a, d: a + jnp.where(lastmask, d, 0), gt,
-                d_tail_loss)
-            # Embedding-side tail grads: exclusive to stage 0, where
-            # the pipeline consumed x_all.
-            tok_m = lax.dynamic_index_in_dim(tokens, mbc, 0,
-                                             keepdims=False)
-
-            def embed_side(dx_):
-                _, embed_vjp = jax.vjp(
-                    lambda tp_: embed_m(tp_, tok_m), tail)
-                (d,) = embed_vjp(dx_.astype(x_all.dtype))
-                return d
-
-            d_tail_embed = lax.cond(stage == 0, embed_side,
-                                    lambda dx_: zero_tail, dx)
-            emask = jnp.logical_and(bmask, stage == 0)
-            gt = jax.tree.map(
-                lambda a, d: a + jnp.where(emask, d, 0), gt,
-                d_tail_embed)
-            lacc = lacc + jnp.where(lastmask, llsum, 0.0)
-            if fam.has_aux:
-                g0 = jnp.logical_and(bmask, ti == 0)
-                lbacc = lbacc + jnp.where(g0, out_b[1][0], 0.0)
-                rzacc = rzacc + jnp.where(g0, out_b[1][1], 0.0)
-
-            # 4) Lockstep exchanges.
-            fmsg = lax.ppermute(jnp.where(mf >= 0, y_f, zero_act),
-                                "pp", perm=fwd_perm)
-            bmsg = lax.ppermute(
-                jnp.where(bmask, dx, jnp.zeros_like(dx)), "pp",
-                perm=bwd_perm)
-            return (ib, fmsg, bmsg, gl, gt, lacc, lbacc, rzacc), None
-
-        varying = lambda a: lax.pcast(a, "pp", to="varying")  # noqa: E731
-        init = (
-            varying(jnp.zeros((K,) + mb_shape, x_all.dtype)),
-            varying(zero_act), varying(zero_act),
-            jax.tree.map(lambda p: varying(jnp.zeros_like(p)), slayers),
-            jax.tree.map(lambda p: varying(jnp.zeros_like(p)), tail),
-            varying(jnp.zeros((), jnp.float32)),
-            varying(jnp.zeros((), jnp.float32)),
-            varying(jnp.zeros((), jnp.float32)),
-        )
-        (ib, fmsg, bmsg, gl, gt, lacc, lbacc, rzacc), _ = lax.scan(
-            slot, init, jnp.arange(T))
-
-        total_ll, lb_t, rz_t = lax.psum((lacc, lbacc, rzacc),
-                                        ("pp", "tp"))
+        if fam.has_aux:
+            total_ll, lb_t, rz_t = lax.psum(
+                (lacc, aux_acc[0], aux_acc[1]), ("pp", "tp"))
+        else:
+            total_ll = lax.psum(lacc, ("pp", "tp"))
         loss = -total_ll / n_tok
         if fam.has_aux:
             loss = loss + (aux_weight * lb_t + z_weight * rz_t) / calls
         loss = lax.pmean(loss, "dp")
 
+        if n_virtual == 1:
+            gl = jax.tree.map(lambda g: g[0], gl)  # drop chunk axis
         # These are TRUE local grads (manual vjp with exclusive seeds —
         # no autodiff loss-assembly psum to undo); reduce directly.
         out = {k: reduce_grad(gt[k], False, False) for k in gt}

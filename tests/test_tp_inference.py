@@ -182,13 +182,27 @@ def test_tp_moe_greedy_matches_single_device_sharded(tp):
 
 
 def test_tp_moe_sharded_rejects_indivisible_batch():
-    """Sharded dispatch routes B tokens per decode step: B=2 does not
-    divide tp=4, and the trace-time guard must say so (pointing at the
-    replicated path as the fallback)."""
+    """EXPLICIT sharded dispatch routes B tokens per decode step: B=2
+    does not divide tp=4, and the trace-time guard must say so
+    (pointing at the replicated path as the fallback)."""
     mesh, cfg, params, prompt = _setup_moe(4)
-    gen = make_tp_generate_moe(cfg, mesh, 4)
+    gen = make_tp_generate_moe(cfg, mesh, 4, ep_dispatch="sharded")
     with pytest.raises(ValueError, match="replicated"):
         gen(params, prompt, jax.random.key(2))
+
+
+def test_tp_moe_auto_falls_back_at_indivisible_batch():
+    """The DEFAULT dispatch is 'auto': the same B=2, tp=4 shape that
+    explicit sharded rejects must run (decode falls back to
+    replicated EP per call site) and still match the single-device
+    generate exactly."""
+    mesh, cfg, params, prompt = _setup_moe(4)
+    n_new = 8
+    want = mtf.generate(params, cfg, prompt, n_new,
+                        max_len=prompt.shape[1] + n_new)
+    gen = make_tp_generate_moe(cfg, mesh, n_new)   # auto default
+    got = gen(params, prompt, jax.random.key(2))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
 def test_tp_moe_expert_split_rejected():
@@ -308,9 +322,12 @@ def test_tp_speculative_mixed_families():
 
 
 def test_tp_speculative_moe_matches_single_device():
-    """MoE TP speculation (head-split attention + replicated-EP routed
-    FFN, drop-free capacity): same tokens and stats as the
-    single-device speculative run at tp=4."""
+    """MoE TP speculation, drop-free capacity, BOTH EP dispatch modes:
+    'auto' (default — here the S=8 prefill AND the k+1=4-wide verify
+    window both divide tp=4, so the MoE target's expert dispatch runs
+    GENUINELY SHARDED through the speculative loop) and explicit
+    'replicated' must each emit the same tokens and stats as the
+    single-device speculative run."""
     tp = 4
     mesh = mesh_from_devices({"tp": tp}, jax.devices()[:tp])
     cfg = mtf.tiny_moe_config(vocab=128, d_model=32, n_heads=4,
@@ -324,6 +341,74 @@ def test_tp_speculative_moe_matches_single_device():
     dparams = tfm.init_params(jax.random.key(7), dcfg)
     prompt = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab)
     n_new, k = 12, 3
+
+    want, wstats = speculative_generate(dparams, dcfg, params, cfg,
+                                        prompt, n_new, k=k)
+    for mode in ("auto", "replicated"):
+        gen = make_tp_speculative_generate(dcfg, cfg, mesh, n_new, k=k,
+                                           ep_dispatch=mode)
+        got, stats = gen(dparams, params, prompt, jax.random.key(0))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want),
+                                      err_msg=mode)
+        assert int(stats["rounds"]) == int(wstats["rounds"]), mode
+
+
+def test_tp_speculative_moe_draft_tight_capacity_auto_parity():
+    """A tight-capacity (cf < E) MoE DRAFT is legal (_check_moe_target
+    guards only the target) — and under the default 'auto' dispatch
+    its routing must stay BIT-EQUAL to the single-device run: auto
+    degrades to replicated EP for the whole non-drop-free side rather
+    than sharding the (divisible) prefill into different capacity
+    groups."""
+    tp = 4
+    mesh = mesh_from_devices({"tp": tp}, jax.devices()[:tp])
+    dcfg = mtf.tiny_moe_config(vocab=128, d_model=32, n_heads=4,
+                               n_layers=1, d_ff=64, n_experts=8,
+                               top_k=2, capacity_factor=2.0,  # cf < E
+                               max_seq=64)
+    dcfg = dataclasses.replace(dcfg, dtype=jnp.float32)
+    cfg = tfm.TransformerConfig(**{**tfm.tiny_config(
+        vocab=128, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=64).__dict__, "dtype": jnp.float32})
+    params = tfm.init_params(jax.random.key(0), cfg)
+    dparams = mtf.init_params(jax.random.key(7), dcfg)
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab)
+    n_new, k = 8, 3
+
+    want, wstats = speculative_generate(dparams, dcfg, params, cfg,
+                                        prompt, n_new, k=k)
+    gen = make_tp_speculative_generate(dcfg, cfg, mesh, n_new, k=k)
+    got, stats = gen(dparams, params, prompt, jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert int(stats["rounds"]) == int(wstats["rounds"])
+    assert int(stats["drafted_accepted"]) == int(
+        wstats["drafted_accepted"])
+
+
+def test_tp_speculative_moe_draft_auto_vs_forced_sharded():
+    """An MoE DRAFT decodes one token per step — 1 never divides tp=4,
+    so forcing ep_dispatch='sharded' must raise the loud trace-time
+    guard, while the default 'auto' resolves per call site (prefill
+    sharded, draft steps replicated) and matches the single-device
+    run exactly."""
+    tp = 4
+    mesh = mesh_from_devices({"tp": tp}, jax.devices()[:tp])
+    dcfg = mtf.tiny_moe_config(vocab=128, d_model=32, n_heads=4,
+                               n_layers=1, d_ff=64, n_experts=8,
+                               top_k=1, capacity_factor=8.0, max_seq=64)
+    dcfg = dataclasses.replace(dcfg, dtype=jnp.float32)
+    cfg = tfm.TransformerConfig(**{**tfm.tiny_config(
+        vocab=128, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=64).__dict__, "dtype": jnp.float32})
+    params = tfm.init_params(jax.random.key(0), cfg)
+    dparams = mtf.init_params(jax.random.key(7), dcfg)
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab)
+    n_new, k = 8, 3
+
+    gen = make_tp_speculative_generate(dcfg, cfg, mesh, n_new, k=k,
+                                       ep_dispatch="sharded")
+    with pytest.raises(ValueError, match="replicated"):
+        gen(dparams, params, prompt, jax.random.key(0))
 
     want, wstats = speculative_generate(dparams, dcfg, params, cfg,
                                         prompt, n_new, k=k)

@@ -56,12 +56,10 @@ bank_once() {
   fi
   log "tunnel UP — banking evidence"
   before="$(bank_fingerprint)"
-  # Each stage commits on its own so a mid-run tunnel drop keeps
-  # everything banked so far (bench.py itself also writes BENCH_BANK
-  # incrementally after every child).
-  timeout 2400 python bench.py >>"$LOG" 2>&1 \
-    && log "bench.py done" || log "bench.py FAILED/timeout"
-  commit_artifacts "Bank TPU bench rows (bench.py)"
+  # ONE --full pass: it supersets the plain run (same fwd group, plus
+  # flash/decode/train/spec) and banks BENCH_BANK/BENCH_FULL after
+  # every child, so a second plain pass would only burn healthy-tunnel
+  # minutes re-measuring the probe + fwd group.
   timeout 3600 python bench.py --full >>"$LOG" 2>&1 \
     && log "bench.py --full done (gate green)" \
     || log "bench.py --full nonzero (gate red or outage)"
