@@ -271,16 +271,33 @@ def tpu_child_decode():
     # Roofline: v5e HBM ~819 GB/s (public spec). Static shapes mean the
     # kernels stream the PADDED (max_len) cache each step.
     HBM_BW = 819e9
-    wbytes = sum(x.size * x.dtype.itemsize
-                 for x in jax.tree.leaves(params))
+    from mpi_acx_tpu.ops.wquant import (GPT2_WEIGHTS,
+                                        quantize_weights_int8,
+                                        weight_bytes)
+    wbytes = weight_bytes(params)
     kvbytes = 2 * cfg.n_layers * max_len * cfg.d_model * 2 * B
     roofline = B * HBM_BW / (wbytes + kvbytes)
+
+    # The roofline optimization attempt (round-4 verdict item #7):
+    # int8 weight-only quantization halves the dominant per-step
+    # stream (weights ~40x the KV bytes at this shape), so its
+    # roofline is ~2x — the row records how much of that the kernel
+    # actually realizes on chip.
+    qparams = quantize_weights_int8(params, GPT2_WEIGHTS)
+    decode_toks_q = B * n_new / _timeit(gen, qparams, prompt)
+    qbytes = weight_bytes(qparams)
+    roofline_q = B * HBM_BW / (qbytes + kvbytes)
     print(json.dumps({
         "decode_tokens_per_s": round(decode_toks, 1),
         "decode_roofline_tokens_per_s": round(roofline, 1),
         "decode_roofline_frac": round(decode_toks / roofline, 3),
         "decode_weight_mb": round(wbytes / 1e6, 1),
         "decode_kv_mb": round(kvbytes / 1e6, 1),
+        "decode_int8w_tokens_per_s": round(decode_toks_q, 1),
+        "decode_int8w_speedup": round(decode_toks_q / decode_toks, 2),
+        "decode_int8w_roofline_frac": round(decode_toks_q / roofline_q,
+                                            3),
+        "decode_int8w_weight_mb": round(qbytes / 1e6, 1),
         "device": str(jax.devices()[0].platform),
     }))
 

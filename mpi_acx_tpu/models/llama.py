@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from mpi_acx_tpu.ops.wquant import wread
+
 
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
@@ -118,11 +120,11 @@ def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
 def _qkv(cfg: LlamaConfig, lp: Params, x: jax.Array, positions: jax.Array):
     B, S, _ = x.shape
     h = rmsnorm(x, lp["attn_norm"])
-    q = (h @ lp["wq"].astype(x.dtype)).reshape(B, S, cfg.n_heads,
+    q = (h @ wread(lp, "wq", x.dtype)).reshape(B, S, cfg.n_heads,
                                                cfg.head_dim)
-    k = (h @ lp["wk"].astype(x.dtype)).reshape(B, S, cfg.n_kv_heads,
+    k = (h @ wread(lp, "wk", x.dtype)).reshape(B, S, cfg.n_kv_heads,
                                                cfg.head_dim)
-    v = (h @ lp["wv"].astype(x.dtype)).reshape(B, S, cfg.n_kv_heads,
+    v = (h @ wread(lp, "wv", x.dtype)).reshape(B, S, cfg.n_kv_heads,
                                                cfg.head_dim)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
@@ -142,15 +144,15 @@ def _attend(cfg: LlamaConfig, q, k, v):
 
 def _mlp(cfg: LlamaConfig, lp: Params, x: jax.Array):
     h = rmsnorm(x, lp["mlp_norm"])
-    gate = jax.nn.silu(h @ lp["w_gate"].astype(x.dtype))
-    up = h @ lp["w_up"].astype(x.dtype)
-    return x + (gate * up) @ lp["w_down"].astype(x.dtype)
+    gate = jax.nn.silu(h @ wread(lp, "w_gate", x.dtype))
+    up = h @ wread(lp, "w_up", x.dtype)
+    return x + (gate * up) @ wread(lp, "w_down", x.dtype)
 
 
 def block(cfg: LlamaConfig, lp: Params, x: jax.Array,
           positions: jax.Array) -> jax.Array:
     q, k, v = _qkv(cfg, lp, x, positions)
-    x = x + _attend(cfg, q, k, v) @ lp["wo"].astype(x.dtype)
+    x = x + _attend(cfg, q, k, v) @ wread(lp, "wo", x.dtype)
     return _mlp(cfg, lp, x)
 
 
@@ -221,7 +223,7 @@ def prefill(params: Params, cfg: LlamaConfig, tokens: jax.Array,
 
     def body(x, lp):
         q, k, v = _qkv(cfg, lp, x, positions)
-        x = x + _attend(cfg, q, k, v) @ lp["wo"].astype(x.dtype)
+        x = x + _attend(cfg, q, k, v) @ wread(lp, "wo", x.dtype)
         x = _mlp(cfg, lp, x)
         return x, (k, v)
 
@@ -264,7 +266,7 @@ def decode_step(params: Params, cfg: LlamaConfig, cache,
 
     def attend_fn(lp, x, q, kc, vc, pos):
         o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep)
-        return _mlp(cfg, lp, x + o @ lp["wo"].astype(x.dtype))
+        return _mlp(cfg, lp, x + o @ wread(lp, "wo", x.dtype))
 
     x, ks, vs = decode_layer_scan(params["layers"], x, cache["k"],
                                   cache["v"], pos, qkv_fn, attend_fn)

@@ -190,6 +190,12 @@ def _moe_ffn(cfg: MoeTransformerConfig, lp: Dict[str, Any], h: jax.Array,
     from mpi_acx_tpu.models.moe import moe_layer_and_aux, \
         moe_layer_replicated_ep_and_aux, moe_layer_sharded_dispatch
     assert not (replicated and sharded_dispatch)
+    # The expert einsums read w1/w2 directly (no ops.wquant.wread path
+    # yet) — reject int8 weight-only checkpoints loudly rather than
+    # multiply raw codes without their scales.
+    assert "w1_scale" not in lp and "w2_scale" not in lp, (
+        "MoE expert weights do not support int8 weight-only "
+        "quantization (ops/wquant.py is the dense serving path)")
     B, S, d = h.shape
     hn = tfm.layernorm(h, lp["ln2_g"], lp["ln2_b"])
     mp = {"gate": lp["gate"], "w1": lp["w1"], "w2": lp["w2"]}

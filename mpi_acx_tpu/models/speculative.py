@@ -43,6 +43,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from mpi_acx_tpu.ops.wquant import wread
+
 from mpi_acx_tpu.models import llama as lm
 from mpi_acx_tpu.models import transformer as tfm
 from mpi_acx_tpu.models.decoding import (decode_layer_scan,
@@ -66,7 +68,7 @@ def _window_pass_llama(params, cfg, cache, tokens):
 
     def attend_fn(lp, x, q, kc, vc, _pos):
         o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep)
-        return lm._mlp(cfg, lp, x + o @ lp["wo"].astype(x.dtype))
+        return lm._mlp(cfg, lp, x + o @ wread(lp, "wo", x.dtype))
 
     x, ks, vs = decode_layer_scan(params["layers"], x, cache["k"],
                                   cache["v"], pos, qkv_fn, attend_fn)
@@ -117,7 +119,7 @@ def _window_pass(params, cfg, cache, tokens, ffn=None):
 
     def attend_fn(lp, x, q, kc, vc, pos):
         o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep=1)
-        return ffn(cfg, lp, x + o @ lp["wo"].astype(x.dtype))
+        return ffn(cfg, lp, x + o @ wread(lp, "wo", x.dtype))
 
     x, ks, vs = decode_layer_scan(params["layers"], x, cache["k"],
                                   cache["v"], pos, qkv_fn, attend_fn)

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from mpi_acx_tpu.ops.wquant import wread
+
 from mpi_acx_tpu.models.decoding import grouped_decode_attend
 
 
@@ -109,7 +111,7 @@ def _attend(cfg: TransformerConfig, q, k, v):
 def block(cfg: TransformerConfig, lp: Params, x: jax.Array) -> jax.Array:
     """One transformer block; x [B, S, d] in compute dtype."""
     q, k, v = _qkv(cfg, lp, x)
-    x = x + _attend(cfg, q, k, v) @ lp["wo"].astype(x.dtype)
+    x = x + _attend(cfg, q, k, v) @ wread(lp, "wo", x.dtype)
     return _mlp(cfg, lp, x)
 
 
@@ -192,7 +194,7 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int):
 def _qkv(cfg: TransformerConfig, lp: Params, x: jax.Array):
     B, S, _ = x.shape
     h = layernorm(x, lp["ln1_g"], lp["ln1_b"])
-    qkv = h @ lp["wqkv"].astype(x.dtype)
+    qkv = h @ wread(lp, "wqkv", x.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     rs = lambda t: t.reshape(B, S, cfg.n_heads, cfg.head_dim)
     return rs(q), rs(k), rs(v)
@@ -200,8 +202,8 @@ def _qkv(cfg: TransformerConfig, lp: Params, x: jax.Array):
 
 def _mlp(cfg: TransformerConfig, lp: Params, x: jax.Array):
     h = layernorm(x, lp["ln2_g"], lp["ln2_b"])
-    y = jax.nn.gelu(h @ lp["w1"].astype(x.dtype) + lp["b1"].astype(x.dtype))
-    return x + y @ lp["w2"].astype(x.dtype) + lp["b2"].astype(x.dtype)
+    y = jax.nn.gelu(h @ wread(lp, "w1", x.dtype) + lp["b1"].astype(x.dtype))
+    return x + y @ wread(lp, "w2", x.dtype) + lp["b2"].astype(x.dtype)
 
 
 def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
@@ -226,7 +228,7 @@ def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
 
     def body(x, lp):
         q, k, v = _qkv(cfg, lp, x)
-        x = x + _attend(cfg, q, k, v) @ lp["wo"].astype(x.dtype)
+        x = x + _attend(cfg, q, k, v) @ wread(lp, "wo", x.dtype)
         x = ffn(cfg, lp, x)
         return x, (k, v)
 
@@ -266,7 +268,7 @@ def decode_step(params: Params, cfg: TransformerConfig, cache,
 
     def attend_fn(lp, x, q, kc, vc, pos):
         o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep=1)
-        return ffn(cfg, lp, x + o @ lp["wo"].astype(x.dtype))
+        return ffn(cfg, lp, x + o @ wread(lp, "wo", x.dtype))
 
     x, ks, vs = decode_layer_scan(params["layers"], x, cache["k"],
                                   cache["v"], pos, qkv_fn, attend_fn)

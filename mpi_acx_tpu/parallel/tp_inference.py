@@ -96,11 +96,25 @@ def _make_pick(temperature, top_k, top_p, out_dtype):
 # -- GPT-2 family ----------------------------------------------------------
 
 
+def _reject_quantized(params, where: str):
+    """TP serving reads weights directly (its own head re-layouts, not
+    ops.wquant.wread) — an int8 weight-only checkpoint here would cast
+    raw codes without their scales and emit plausible-looking garbage.
+    Fail LOUDLY instead; dequantize or shard-then-quantize upstream."""
+    bad = [k for k in params["layers"] if k.endswith("_scale")]
+    if bad:
+        raise ValueError(
+            f"{where} does not support int8 weight-only quantized "
+            f"checkpoints (found scale companions {bad}); int8 serving "
+            f"is the single-device path (ops/wquant.py)")
+
+
 def tp_shard_params(params, cfg: tfm.TransformerConfig):
     """Re-layout the stacked GPT-2 pytree for head/FFN sharding: wqkv
     [L, d, 3d] -> [L, d, 3, H, Dh] (the head axis becomes shardable
     without splitting the packed q/k/v thirds) and wo [L, d, d] ->
     [L, H, Dh, d] (row-parallel by head)."""
+    _reject_quantized(params, "tp_shard_params")
     L, d = cfg.n_layers, cfg.d_model
     H, Dh = cfg.n_heads, cfg.head_dim
     lay = params["layers"]
@@ -353,6 +367,7 @@ def tp_shard_params_llama(params, cfg: lm.LlamaConfig):
     [L, d, Hq, Dh], wk/wv -> [L, d, Hkv, Dh], wo -> [L, Hq, Dh, d].
     Contiguous head chunks keep each KV group's query heads on the same
     rank as their K/V head (query head h belongs to group h // n_rep)."""
+    _reject_quantized(params, "tp_shard_params_llama")
     L, d = cfg.n_layers, cfg.d_model
     Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     lay = params["layers"]

@@ -1,0 +1,149 @@
+"""Int8 weight-only quantization (ops/wquant.py): the decode-roofline
+optimization — weight bytes halve, so the bandwidth-bound decode floor
+drops ~2x (BASELINE.md decode row; measured on-chip via bench.py's
+decode child). These tests pin the quality and mechanics on CPU:
+
+* quantized logits stay close to bf16 logits (per-channel int8 bound),
+* greedy decode on a TRAINED model emits the same tokens (quantization
+  noise must not flip well-separated argmaxes),
+* the pytree keeps its structure (+_scale companions) so every decode
+  scaffold — prefill, decode_step, generate — runs unchanged,
+* weight_bytes reflects the ~2x storage cut (the roofline numerator).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from mpi_acx_tpu.models import llama as lm
+from mpi_acx_tpu.models import transformer as tfm
+from mpi_acx_tpu.ops.wquant import (GPT2_WEIGHTS, LLAMA_WEIGHTS,
+                                    quantize_weights_int8, weight_bytes,
+                                    wread)
+
+
+def test_wread_dequant_roundtrip_error_bound():
+    """Per-channel symmetric int8: reconstruction error per element is
+    bounded by scale/2 = amax/254 of its output channel."""
+    w = jax.random.normal(jax.random.key(0), (4, 64, 32)) * 0.3
+    lay = {"w": w}
+    q = quantize_weights_int8({"layers": lay}, ["w"])["layers"]
+    back = wread(q, "w", jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=-2, keepdims=True)
+    assert float(jnp.max(jnp.abs(back - w) / (amax / 127.0))) <= 0.5 + 1e-3
+
+
+def _trained_gpt2(steps=60):
+    cfg = tfm.TransformerConfig(**{**tfm.tiny_config(
+        vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_seq=32).__dict__, "dtype": jnp.float32})
+    params = tfm.init_params(jax.random.key(0), cfg)
+    tok = jax.random.randint(jax.random.key(1), (8, 16), 0, cfg.vocab)
+    opt = optax.adam(3e-3)
+    st = opt.init(params)
+
+    @jax.jit
+    def step(p, st):
+        loss, g = jax.value_and_grad(tfm.loss_fn)(p, cfg, tok, tok)
+        up, st = opt.update(g, st)
+        return optax.apply_updates(p, up), st, loss
+
+    for _ in range(steps):
+        params, st, loss = step(params, st)
+    return cfg, params, tok
+
+
+def test_int8_weights_logits_close_and_greedy_tokens_equal():
+    cfg, params, tok = _trained_gpt2()
+    qparams = quantize_weights_int8(params, GPT2_WEIGHTS)
+
+    logits = tfm.forward(params, cfg, tok[:2])
+    qlogits = tfm.forward(qparams, cfg, tok[:2])
+    # Quality bound: relative error of the logit vector, f32 reference.
+    rel = float(jnp.linalg.norm(qlogits - logits)
+                / jnp.linalg.norm(logits))
+    assert rel < 0.05, rel
+
+    # Greedy decode: same scaffold, same tokens on the trained task.
+    prompt = tok[:2, :8]
+    want = tfm.generate(params, cfg, prompt, 8, max_len=24)
+    got = tfm.generate(qparams, cfg, prompt, 8, max_len=24)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_int8_weights_llama_generate_runs_and_matches():
+    c = lm.tiny_llama(vocab=64, d_model=32, n_heads=4, n_kv_heads=2,
+                      n_layers=2, d_ff=64, max_seq=32)
+    cfg = lm.LlamaConfig(**{**c.__dict__, "dtype": jnp.float32})
+    params = lm.init_params(jax.random.key(0), cfg)
+    tok = jax.random.randint(jax.random.key(1), (8, 16), 0, cfg.vocab)
+    opt = optax.adam(3e-3)
+    st = opt.init(params)
+
+    @jax.jit
+    def step(p, st):
+        loss, g = jax.value_and_grad(lm.loss_fn)(p, cfg, tok, tok)
+        up, st = opt.update(g, st)
+        return optax.apply_updates(p, up), st, loss
+
+    for _ in range(60):
+        params, st, _ = step(params, st)
+
+    qparams = quantize_weights_int8(params, LLAMA_WEIGHTS)
+    prompt = tok[:2, :8]
+    want = lm.generate(params, cfg, prompt, 8, max_len=24)
+    got = lm.generate(qparams, cfg, prompt, 8, max_len=24)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_weight_bytes_roughly_halve():
+    """The roofline numerator: GPT-2's layer matmuls dominate its
+    parameter bytes, so int8 storage lands well under 60% of bf16."""
+    cfg = tfm.tiny_config(vocab=64, d_model=64, n_heads=4, n_layers=4,
+                          d_ff=256, max_seq=32)
+    params = tfm.cast_params(tfm.init_params(jax.random.key(0), cfg),
+                             jnp.bfloat16)
+    q = quantize_weights_int8(params, GPT2_WEIGHTS)
+    assert weight_bytes(q) < 0.6 * weight_bytes(params), (
+        weight_bytes(q), weight_bytes(params))
+
+
+def test_int8_weights_speculative_matches():
+    """Speculative decoding over quantized draft AND target (every
+    weight read goes through wread, including the W-wide window's wo)
+    must emit the same tokens as quantized target-only greedy."""
+    import dataclasses
+    from mpi_acx_tpu.models.speculative import speculative_generate
+
+    cfg, params, tok = _trained_gpt2()
+    dcfg = dataclasses.replace(cfg, n_layers=1)
+    dparams = tfm.init_params(jax.random.key(9), dcfg)
+    qp = quantize_weights_int8(params, GPT2_WEIGHTS)
+    qd = quantize_weights_int8(dparams, GPT2_WEIGHTS)
+    prompt = tok[:1, :8]
+    want = tfm.generate(qp, cfg, prompt, 8, max_len=24)
+    got, _ = speculative_generate(qd, dcfg, qp, cfg, prompt, 8, k=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tp_sharding_rejects_quantized_checkpoints():
+    """TP serving re-lays weights out itself (no wread path): it must
+    refuse int8 checkpoints loudly, never cast scale-less codes."""
+    from mpi_acx_tpu.parallel.tp_inference import tp_shard_params
+    cfg = tfm.tiny_config(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                          d_ff=64, max_seq=32)
+    q = quantize_weights_int8(tfm.init_params(jax.random.key(0), cfg),
+                              GPT2_WEIGHTS)
+    with pytest.raises(ValueError, match="quantized"):
+        tp_shard_params(q, cfg)
+
+
+def test_unquantized_path_untouched():
+    """wread without a _scale companion is exactly astype — the shared
+    read path must not perturb normal checkpoints."""
+    w = jax.random.normal(jax.random.key(0), (8, 8), jnp.float32)
+    out = wread({"w": w}, "w", jnp.bfloat16)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(w.astype(jnp.bfloat16)))
