@@ -1,5 +1,5 @@
 """Tensor-parallel serving example: Megatron-split generation over a
-device mesh, for the GPT-2 or Llama family.
+device mesh, for the GPT-2, Llama, or MoE family.
 
 Runs on real TPU chips or a virtual CPU mesh:
 
@@ -21,7 +21,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--family", choices=["gpt2", "llama"], default="gpt2")
+    ap.add_argument("--family", choices=["gpt2", "llama", "moe"],
+                default="gpt2")
     ap.add_argument("--tp", type=int, default=4)
     ap.add_argument("--n-new", type=int, default=24)
     ap.add_argument("--temperature", type=float, default=0.0,
@@ -31,6 +32,11 @@ def main():
     ap.add_argument("--speculative", action="store_true",
                     help="draft-proposes / target-verifies decoding, "
                          "draft and target both TP-split")
+    ap.add_argument("--ep-dispatch", default="auto",
+                    choices=["auto", "sharded", "replicated"],
+                    help="MoE family only: how tokens reach their "
+                         "experts (auto = sharded when the call's "
+                         "token count divides tp, else replicated)")
     args = ap.parse_args()
 
     import jax
@@ -38,9 +44,11 @@ def main():
         jax.config.update("jax_platforms", "cpu")  # wins over a pinned plugin
 
     from mpi_acx_tpu.models import llama as lm
+    from mpi_acx_tpu.models import moe_transformer as mtf
     from mpi_acx_tpu.models import transformer as tfm
     from mpi_acx_tpu.parallel import (make_tp_generate,
                                       make_tp_generate_llama,
+                                      make_tp_generate_moe,
                                       mesh_from_devices)
 
     n_dev = len(jax.devices())
@@ -60,6 +68,18 @@ def main():
                                      top_k=args.top_k, top_p=args.top_p)
         single = lambda p, t: lm.generate(  # noqa: E731
             p, cfg, t, args.n_new, max_len=t.shape[1] + args.n_new)
+    elif args.family == "moe":
+        # Experts split over tp: scale the expert count with it.
+        cfg = mtf.tiny_moe_config(n_layers=2, n_heads=2 * args.tp,
+                                  n_experts=2 * args.tp, top_k=2,
+                                  capacity_factor=2 * args.tp)
+        params = mtf.init_params(jax.random.key(0), cfg)
+        gen = make_tp_generate_moe(cfg, mesh, args.n_new,
+                                   temperature=args.temperature,
+                                   top_k=args.top_k, top_p=args.top_p,
+                                   ep_dispatch=args.ep_dispatch)
+        single = lambda p, t: mtf.generate(  # noqa: E731
+            p, cfg, t, args.n_new, max_len=t.shape[1] + args.n_new)
     else:
         cfg = tfm.tiny_config(n_layers=2)
         params = tfm.init_params(jax.random.key(0), cfg)
@@ -73,12 +93,13 @@ def main():
         import dataclasses
         from mpi_acx_tpu.parallel import make_tp_speculative_generate
         dcfg = dataclasses.replace(cfg, n_layers=1)
-        dinit = (lm.init_params if args.family == "llama"
-                 else tfm.init_params)
+        dinit = {"llama": lm.init_params, "moe": mtf.init_params,
+                 "gpt2": tfm.init_params}[args.family]
         dparams = dinit(jax.random.key(7), dcfg)
         sgen = make_tp_speculative_generate(
             dcfg, cfg, mesh, args.n_new, k=4,
-            temperature=args.temperature)
+            temperature=args.temperature,
+            ep_dispatch=args.ep_dispatch)
         prompt = jax.random.randint(jax.random.key(1), (1, 8), 0,
                                     cfg.vocab)
         out, stats = sgen(dparams, params, prompt, jax.random.key(2))

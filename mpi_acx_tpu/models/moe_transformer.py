@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from mpi_acx_tpu.ops.wquant import wread
+
 from mpi_acx_tpu.models import transformer as tfm
 from mpi_acx_tpu.models.moe import (MoeConfig, moe_layer,
                                     moe_layer_and_aux,
@@ -97,6 +99,17 @@ def init_params(key: jax.Array, cfg: MoeTransformerConfig) -> Dict[str, Any]:
     }
 
 
+def _reject_quantized_experts(lp: Dict[str, Any]):
+    """The expert einsums read w1/w2 directly (no ops.wquant.wread path
+    yet) — refuse int8 weight-only checkpoints LOUDLY at every MoE FFN
+    entry (block() and _moe_ffn) rather than multiply raw codes without
+    their scales. A raise, not an assert: python -O must not strip it."""
+    if "w1_scale" in lp or "w2_scale" in lp:
+        raise ValueError(
+            "MoE expert weights do not support int8 weight-only "
+            "quantization (ops/wquant.py is the dense serving path)")
+
+
 def block(cfg: MoeTransformerConfig, lp: Dict[str, Any], h: jax.Array,
           ep_axis: str | None = None):
     """One MoE-transformer block on h [B, S, d]; returns (h, aux) where
@@ -104,11 +117,12 @@ def block(cfg: MoeTransformerConfig, lp: Dict[str, Any], h: jax.Array,
     set (inside shard_map), lp's gate stays replicated and w1/w2 are the
     LOCAL expert slices; tokens flow through all_to_all."""
     B, S, d = h.shape
+    _reject_quantized_experts(lp)
 
     # The attention half IS a GPT-2 block half — share its single
     # definition (qkv packing + flash/dense policy) with the dense family.
     q, k, v = tfm._qkv(cfg, lp, h)
-    h = h + tfm._attend(cfg, q, k, v) @ lp["wo"].astype(h.dtype)
+    h = h + tfm._attend(cfg, q, k, v) @ wread(lp, "wo", h.dtype)
 
     hn = tfm.layernorm(h, lp["ln2_g"], lp["ln2_b"])
     mp = {"gate": lp["gate"], "w1": lp["w1"], "w2": lp["w2"]}
@@ -190,12 +204,7 @@ def _moe_ffn(cfg: MoeTransformerConfig, lp: Dict[str, Any], h: jax.Array,
     from mpi_acx_tpu.models.moe import moe_layer_and_aux, \
         moe_layer_replicated_ep_and_aux, moe_layer_sharded_dispatch
     assert not (replicated and sharded_dispatch)
-    # The expert einsums read w1/w2 directly (no ops.wquant.wread path
-    # yet) — reject int8 weight-only checkpoints loudly rather than
-    # multiply raw codes without their scales.
-    assert "w1_scale" not in lp and "w2_scale" not in lp, (
-        "MoE expert weights do not support int8 weight-only "
-        "quantization (ops/wquant.py is the dense serving path)")
+    _reject_quantized_experts(lp)
     B, S, d = h.shape
     hn = tfm.layernorm(h, lp["ln2_g"], lp["ln2_b"])
     mp = {"gate": lp["gate"], "w1": lp["w1"], "w2": lp["w2"]}

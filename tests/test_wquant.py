@@ -35,23 +35,38 @@ def test_wread_dequant_roundtrip_error_bound():
     assert float(jnp.max(jnp.abs(back - w) / (amax / 127.0))) <= 0.5 + 1e-3
 
 
-def _trained_gpt2(steps=60):
-    cfg = tfm.TransformerConfig(**{**tfm.tiny_config(
-        vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
-        max_seq=32).__dict__, "dtype": jnp.float32})
-    params = tfm.init_params(jax.random.key(0), cfg)
+def _train(mod, cfg, steps=60):
+    """Shared Adam scaffold: train `mod`'s model on the repetition task
+    so greedy argmaxes are well-separated before quantizing."""
+    params = mod.init_params(jax.random.key(0), cfg)
     tok = jax.random.randint(jax.random.key(1), (8, 16), 0, cfg.vocab)
     opt = optax.adam(3e-3)
     st = opt.init(params)
 
     @jax.jit
     def step(p, st):
-        loss, g = jax.value_and_grad(tfm.loss_fn)(p, cfg, tok, tok)
+        loss, g = jax.value_and_grad(mod.loss_fn)(p, cfg, tok, tok)
         up, st = opt.update(g, st)
         return optax.apply_updates(p, up), st, loss
 
     for _ in range(steps):
-        params, st, loss = step(params, st)
+        params, st, _ = step(params, st)
+    return params, tok
+
+
+def _trained_gpt2():
+    cfg = tfm.TransformerConfig(**{**tfm.tiny_config(
+        vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_seq=32).__dict__, "dtype": jnp.float32})
+    params, tok = _train(tfm, cfg)
+    return cfg, params, tok
+
+
+def _trained_llama():
+    c = lm.tiny_llama(vocab=64, d_model=32, n_heads=4, n_kv_heads=2,
+                      n_layers=2, d_ff=64, max_seq=32)
+    cfg = lm.LlamaConfig(**{**c.__dict__, "dtype": jnp.float32})
+    params, tok = _train(lm, cfg)
     return cfg, params, tok
 
 
@@ -74,23 +89,7 @@ def test_int8_weights_logits_close_and_greedy_tokens_equal():
 
 
 def test_int8_weights_llama_generate_runs_and_matches():
-    c = lm.tiny_llama(vocab=64, d_model=32, n_heads=4, n_kv_heads=2,
-                      n_layers=2, d_ff=64, max_seq=32)
-    cfg = lm.LlamaConfig(**{**c.__dict__, "dtype": jnp.float32})
-    params = lm.init_params(jax.random.key(0), cfg)
-    tok = jax.random.randint(jax.random.key(1), (8, 16), 0, cfg.vocab)
-    opt = optax.adam(3e-3)
-    st = opt.init(params)
-
-    @jax.jit
-    def step(p, st):
-        loss, g = jax.value_and_grad(lm.loss_fn)(p, cfg, tok, tok)
-        up, st = opt.update(g, st)
-        return optax.apply_updates(p, up), st, loss
-
-    for _ in range(60):
-        params, st, _ = step(params, st)
-
+    cfg, params, tok = _trained_llama()
     qparams = quantize_weights_int8(params, LLAMA_WEIGHTS)
     prompt = tok[:2, :8]
     want = lm.generate(params, cfg, prompt, 8, max_len=24)
@@ -110,22 +109,43 @@ def test_weight_bytes_roughly_halve():
         weight_bytes(q), weight_bytes(params))
 
 
-def test_int8_weights_speculative_matches():
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_int8_weights_speculative_matches(family):
     """Speculative decoding over quantized draft AND target (every
     weight read goes through wread, including the W-wide window's wo)
-    must emit the same tokens as quantized target-only greedy."""
+    must emit the same tokens as quantized target-only greedy — both
+    families, as the docs claim."""
     import dataclasses
     from mpi_acx_tpu.models.speculative import speculative_generate
 
-    cfg, params, tok = _trained_gpt2()
+    if family == "gpt2":
+        cfg, params, tok = _trained_gpt2()
+        mod, names = tfm, GPT2_WEIGHTS
+    else:
+        cfg, params, tok = _trained_llama()
+        mod, names = lm, LLAMA_WEIGHTS
     dcfg = dataclasses.replace(cfg, n_layers=1)
-    dparams = tfm.init_params(jax.random.key(9), dcfg)
-    qp = quantize_weights_int8(params, GPT2_WEIGHTS)
-    qd = quantize_weights_int8(dparams, GPT2_WEIGHTS)
+    dparams = mod.init_params(jax.random.key(9), dcfg)
+    qp = quantize_weights_int8(params, names)
+    qd = quantize_weights_int8(dparams, names)
     prompt = tok[:1, :8]
-    want = tfm.generate(qp, cfg, prompt, 8, max_len=24)
+    want = mod.generate(qp, cfg, prompt, 8, max_len=24)
     got, _ = speculative_generate(qd, dcfg, qp, cfg, prompt, 8, k=3)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_moe_forward_rejects_quantized_experts():
+    """block()/_hidden (the training+forward path) must refuse int8
+    expert weights loudly — not only the serving _moe_ffn scaffold."""
+    from mpi_acx_tpu.models import moe_transformer as mtf
+    cfg = mtf.tiny_moe_config(vocab=64, d_model=32, n_heads=2,
+                              n_layers=2, d_ff=64, n_experts=4, top_k=1,
+                              capacity_factor=4.0, max_seq=32)
+    params = mtf.init_params(jax.random.key(0), cfg)
+    q = quantize_weights_int8(params, ("w1", "w2"))
+    tok = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab)
+    with pytest.raises(ValueError, match="quantization"):
+        mtf.forward(q, cfg, tok)
 
 
 def test_tp_sharding_rejects_quantized_checkpoints():
