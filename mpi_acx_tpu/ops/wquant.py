@@ -21,13 +21,17 @@ traffic is the int8 bytes). Unquantized checkpoints hit the
 The embedding / unembedding stay bf16: the tied logits matmul sets
 output quality directly and is one tensor, not a per-layer stream.
 
-Supported entry points: the SINGLE-DEVICE serving stack — forward /
-prefill / decode_step / generate for GPT-2 and Llama, and speculative
-decoding over them (all weight reads go through :func:`wread`).
-Consumers that re-layout weights themselves reject quantized pytrees
-LOUDLY: TP serving (tp_inference._reject_quantized) and the MoE
-expert einsums (moe_transformer._moe_ffn) raise rather than cast raw
-int8 codes without their scales.
+Supported entry points: the single-device serving stack — forward /
+prefill / decode_step / generate for GPT-2 and Llama, speculative
+decoding over them — AND tensor-parallel serving for both dense
+families (plain and speculative): the TP shard fns re-layout each
+``_scale`` companion alongside its weight, the spec trees gain
+matching entries, and the TP layer ops read through :func:`wread`
+(tp_inference). The MoE expert einsums have no wread path and REJECT
+quantized expert weights loudly (moe_transformer
+._reject_quantized_experts) rather than cast raw int8 codes without
+their scales; MoE *attention* weights may be quantized (they ride the
+shared GPT-2 ops).
 
 The reference has no inference stack at all (SURVEY.md SS0); this
 module exists for the framework goal's serving-perf axis.

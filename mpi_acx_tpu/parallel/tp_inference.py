@@ -42,6 +42,7 @@ from mpi_acx_tpu.models.decoding import (decode_layer_scan,
                                          grouped_decode_attend,
                                          sample_logits)
 from mpi_acx_tpu.ops.attention import select_attention
+from mpi_acx_tpu.ops.wquant import wread
 
 
 def _run_generation(hooks, layers, prompt, key, n_new, *, pick):
@@ -96,25 +97,76 @@ def _make_pick(temperature, top_k, top_p, out_dtype):
 # -- GPT-2 family ----------------------------------------------------------
 
 
-def _reject_quantized(params, where: str):
-    """TP serving reads weights directly (its own head re-layouts, not
-    ops.wquant.wread) — an int8 weight-only checkpoint here would cast
-    raw codes without their scales and emit plausible-looking garbage.
-    Fail LOUDLY instead; dequantize or shard-then-quantize upstream."""
-    bad = [k for k in params["layers"] if k.endswith("_scale")]
-    if bad:
+def _scale_keys(params) -> frozenset:
+    """The int8 weight-only scale companions present in a checkpoint
+    (ops/wquant.py). TP serving supports them for the dense matmul
+    weights: the shard fns re-layout each companion alongside its
+    weight, the spec trees gain matching entries (_specs_with_scales),
+    and every weight read goes through ops.wquant.wread."""
+    return frozenset(k for k in params["layers"] if k.endswith("_scale"))
+
+
+def _specs_with_scales(specs, scale_keys: frozenset, scale_specs: dict,
+                       where: str):
+    """Extend a family's layer spec tree with entries for the scale
+    companions actually present. Unknown companions (e.g. quantized MoE
+    expert weights) raise LOUDLY — the alternative is multiplying raw
+    int8 codes without their scales."""
+    unknown = [k for k in scale_keys if k not in scale_specs]
+    if unknown:
         raise ValueError(
-            f"{where} does not support int8 weight-only quantized "
-            f"checkpoints (found scale companions {bad}); int8 serving "
-            f"is the single-device path (ops/wquant.py)")
+            f"{where} does not support int8 quantization of {unknown} "
+            f"(supported: {sorted(scale_specs)}); see ops/wquant.py")
+    if not scale_keys:
+        return specs
+    out = dict(specs)
+    out["layers"] = dict(specs["layers"],
+                         **{k: scale_specs[k] for k in scale_keys})
+    return out
+
+
+def _tp_program_cache(mesh, per_shard, param_slots, data_specs,
+                      out_specs):
+    """THE scale-keyed program cache every TP builder uses: one
+    compiled shard_map program per tuple of int8 scale-key sets, so
+    quantized and plain checkpoints (whose pytrees differ) share the
+    per-shard code but get matching spec trees.
+
+    ``param_slots``: one (base_specs, scale_specs, shard_fn, cfg,
+    where) per leading parameter-tree argument of ``per_shard``; the
+    remaining arguments use ``data_specs``. Returns a plain callable
+    ``fn(*param_trees, *data)``."""
+    n = len(param_slots)
+    cache: dict = {}
+
+    def call(*args):
+        key = tuple(_scale_keys(p) for p in args[:n])
+        fn = cache.get(key)
+        if fn is None:
+            in_specs = tuple(
+                _specs_with_scales(bs, sk, ss, where)
+                for (bs, ss, _, _, where), sk in zip(param_slots, key)
+            ) + tuple(data_specs)
+            inner = shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=False)
+
+            def run(*a, _inner=inner):
+                pt = tuple(slot[2](p, slot[3])
+                           for slot, p in zip(param_slots, a[:n]))
+                return _inner(*pt, *a[n:])
+
+            fn = cache[key] = jax.jit(run)
+        return fn(*args)
+
+    return call
 
 
 def tp_shard_params(params, cfg: tfm.TransformerConfig):
     """Re-layout the stacked GPT-2 pytree for head/FFN sharding: wqkv
     [L, d, 3d] -> [L, d, 3, H, Dh] (the head axis becomes shardable
     without splitting the packed q/k/v thirds) and wo [L, d, d] ->
-    [L, H, Dh, d] (row-parallel by head)."""
-    _reject_quantized(params, "tp_shard_params")
+    [L, H, Dh, d] (row-parallel by head). Int8 scale companions are
+    re-laid-out alongside their weights (w1/w2 scales broadcast as-is)."""
     L, d = cfg.n_layers, cfg.d_model
     H, Dh = cfg.n_heads, cfg.head_dim
     lay = params["layers"]
@@ -124,7 +176,34 @@ def tp_shard_params(params, cfg: tfm.TransformerConfig):
         wqkv=lay["wqkv"].reshape(L, d, 3, H, Dh),
         wo=lay["wo"].reshape(L, H, Dh, d),
     )
+    if "wqkv_scale" in lay:
+        out["layers"]["wqkv_scale"] = lay["wqkv_scale"].reshape(
+            L, 1, 3, H, Dh)
+    if "wo_scale" in lay:
+        out["layers"]["wo_scale"] = lay["wo_scale"].reshape(L, 1, 1, d)
     return out
+
+
+def _gpt2_scale_specs(axis: str):
+    """Spec entries for GPT-2 scale companions after tp_shard_params:
+    per-OUTPUT-channel scales shard with their weight's output axis
+    (wqkv: heads; w1: ffn) and replicate when the weight shards on its
+    input side (wo, w2)."""
+    return {
+        "wqkv_scale": P(None, None, None, axis, None),
+        "wo_scale": P(),
+        "w1_scale": P(None, None, axis),
+        "w2_scale": P(),
+    }
+
+
+def _moe_scale_specs(axis: str):
+    """MoE TP serving supports int8 on the ATTENTION weights only (they
+    ride the shared GPT-2 ops); expert-weight companions are absent
+    here so _specs_with_scales rejects them loudly. One definition for
+    plain AND speculative MoE TP serving."""
+    gs = _gpt2_scale_specs(axis)
+    return {k: gs[k] for k in ("wqkv_scale", "wo_scale")}
 
 
 def tp_param_specs(axis: str = "tp"):
@@ -178,20 +257,21 @@ def _gpt2_tp_layer_ops(cfg, tp: int, axis: str):
     def local_qkv(lp, x):
         B, S, _ = x.shape
         h = tfm.layernorm(x, lp["ln1_g"], lp["ln1_b"])
-        qkv = h @ lp["wqkv"].reshape(d, 3 * Hl * Dh).astype(x.dtype)
+        qkv = h @ wread(lp, "wqkv", x.dtype).reshape(d, 3 * Hl * Dh)
         return (t.reshape(B, S, Hl, Dh) for t in jnp.split(qkv, 3, -1))
 
     def out_proj(lp, o, x):
         B, S = o.shape[:2]
-        part = o.reshape(B, S, Hl * Dh) @ lp["wo"].reshape(
-            Hl * Dh, d).astype(x.dtype)
+        part = o.reshape(B, S, Hl * Dh) @ wread(lp, "wo",
+                                                x.dtype).reshape(
+            Hl * Dh, d)
         return x + lax.psum(part, axis)
 
     def dense_mlp(lp, x):
         h = tfm.layernorm(x, lp["ln2_g"], lp["ln2_b"])
-        y = jax.nn.gelu(h @ lp["w1"].astype(x.dtype)
+        y = jax.nn.gelu(h @ wread(lp, "w1", x.dtype)
                         + lp["b1"].astype(x.dtype))
-        part = y @ lp["w2"].astype(x.dtype)
+        part = y @ wread(lp, "w2", x.dtype)
         return x + lax.psum(part, axis) + lp["b2"].astype(x.dtype)
 
     return local_qkv, out_proj, dense_mlp
@@ -201,7 +281,8 @@ def make_tp_generate(cfg, mesh: Mesh, n_new: int,
                      axis: str = "tp", temperature: float = 0.0,
                      top_k: Optional[int] = None,
                      top_p: Optional[float] = None,
-                     ffn=None, specs=None, shard_params=None):
+                     ffn=None, specs=None, shard_params=None,
+                     scale_specs=None):
     """Builds a jitted tensor-parallel ``generate(params, prompt, key) ->
     tokens [B, S + n_new]`` over the mesh's ``axis``.
 
@@ -222,6 +303,8 @@ def make_tp_generate(cfg, mesh: Mesh, n_new: int,
     mlp = ffn or dense_mlp
     shard_params_fn = shard_params or tp_shard_params
     specs = specs or tp_param_specs(axis)
+    if scale_specs is None:
+        scale_specs = _gpt2_scale_specs(axis)
 
     def per_shard(params, prompt, key):
         assert prompt.shape[1] + n_new <= cfg.max_seq
@@ -257,15 +340,11 @@ def make_tp_generate(cfg, mesh: Mesh, n_new: int,
             hooks, params["layers"], prompt, key, n_new,
             pick=_make_pick(temperature, top_k, top_p, prompt.dtype))
 
-    inner = shard_map(per_shard, mesh=mesh,
-                      in_specs=(specs, P(), P()),
-                      out_specs=P(), check_vma=False)
-
-    @jax.jit
-    def generate(params, prompt, key):
-        return inner(shard_params_fn(params, cfg), prompt, key)
-
-    return generate
+    return _tp_program_cache(
+        mesh, per_shard,
+        [(specs, scale_specs, shard_params_fn, cfg,
+          "TP GPT-2/MoE serving")],
+        (P(), P()), P())
 
 
 # -- MoE family (attention by head, experts over the same axis) ------------
@@ -356,7 +435,8 @@ def make_tp_generate_moe(cfg, mesh: Mesh, n_new: int, axis: str = "tp",
                             temperature=temperature, top_k=top_k,
                             top_p=top_p, ffn=moe_ffn,
                             specs=tp_param_specs_moe(axis),
-                            shard_params=tp_shard_params_moe)
+                            shard_params=tp_shard_params_moe,
+                            scale_specs=_moe_scale_specs(axis))
 
 
 # -- Llama family (GQA: shard by KV-head group) ----------------------------
@@ -366,8 +446,8 @@ def tp_shard_params_llama(params, cfg: lm.LlamaConfig):
     """Head-axis re-layout for the Llama pytree: wq [L, d, Hq*Dh] ->
     [L, d, Hq, Dh], wk/wv -> [L, d, Hkv, Dh], wo -> [L, Hq, Dh, d].
     Contiguous head chunks keep each KV group's query heads on the same
-    rank as their K/V head (query head h belongs to group h // n_rep)."""
-    _reject_quantized(params, "tp_shard_params_llama")
+    rank as their K/V head (query head h belongs to group h // n_rep).
+    Int8 scale companions are re-laid-out alongside their weights."""
     L, d = cfg.n_layers, cfg.d_model
     Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     lay = params["layers"]
@@ -379,7 +459,27 @@ def tp_shard_params_llama(params, cfg: lm.LlamaConfig):
         wv=lay["wv"].reshape(L, d, Hkv, Dh),
         wo=lay["wo"].reshape(L, Hq, Dh, d),
     )
+    for name, shp in (("wq", (L, 1, Hq, Dh)), ("wk", (L, 1, Hkv, Dh)),
+                      ("wv", (L, 1, Hkv, Dh)), ("wo", (L, 1, 1, d))):
+        if name + "_scale" in lay:
+            out["layers"][name + "_scale"] = \
+                lay[name + "_scale"].reshape(shp)
     return out
+
+
+def _llama_scale_specs(axis: str):
+    """Spec entries for Llama scale companions after
+    tp_shard_params_llama (output-side scales shard with their heads /
+    ffn axis; input-side-sharded weights get replicated scales)."""
+    return {
+        "wq_scale": P(None, None, axis, None),
+        "wk_scale": P(None, None, axis, None),
+        "wv_scale": P(None, None, axis, None),
+        "wo_scale": P(),
+        "w_gate_scale": P(None, None, axis),
+        "w_up_scale": P(None, None, axis),
+        "w_down_scale": P(),
+    }
 
 
 def tp_param_specs_llama(axis: str = "tp"):
@@ -412,28 +512,29 @@ def _llama_tp_layer_ops(cfg, tp: int, axis: str):
 
     def mlp(lp, x):
         h = lm.rmsnorm(x, lp["mlp_norm"])
-        gate = jax.nn.silu(h @ lp["w_gate"].astype(x.dtype))
-        up = h @ lp["w_up"].astype(x.dtype)
-        part = (gate * up) @ lp["w_down"].astype(x.dtype)
+        gate = jax.nn.silu(h @ wread(lp, "w_gate", x.dtype))
+        up = h @ wread(lp, "w_up", x.dtype)
+        part = (gate * up) @ wread(lp, "w_down", x.dtype)
         return x + lax.psum(part, axis)
 
     def local_qkv(lp, x, positions):
         B, S, _ = x.shape
         h = lm.rmsnorm(x, lp["attn_norm"])
-        q = (h @ lp["wq"].reshape(d, Hq_l * Dh).astype(x.dtype)).reshape(
-            B, S, Hq_l, Dh)
-        k = (h @ lp["wk"].reshape(d, Hkv_l * Dh).astype(x.dtype)).reshape(
-            B, S, Hkv_l, Dh)
-        v = (h @ lp["wv"].reshape(d, Hkv_l * Dh).astype(x.dtype)).reshape(
-            B, S, Hkv_l, Dh)
+        q = (h @ wread(lp, "wq", x.dtype).reshape(
+            d, Hq_l * Dh)).reshape(B, S, Hq_l, Dh)
+        k = (h @ wread(lp, "wk", x.dtype).reshape(
+            d, Hkv_l * Dh)).reshape(B, S, Hkv_l, Dh)
+        v = (h @ wread(lp, "wv", x.dtype).reshape(
+            d, Hkv_l * Dh)).reshape(B, S, Hkv_l, Dh)
         q = lm.rope(q, positions, cfg.rope_theta)
         k = lm.rope(k, positions, cfg.rope_theta)
         return q, k, v
 
     def out_proj(lp, o, x):
         B, S = o.shape[:2]
-        part = o.reshape(B, S, Hq_l * Dh) @ lp["wo"].reshape(
-            Hq_l * Dh, d).astype(x.dtype)
+        part = o.reshape(B, S, Hq_l * Dh) @ wread(lp, "wo",
+                                                  x.dtype).reshape(
+            Hq_l * Dh, d)
         return x + lax.psum(part, axis)
 
     return local_qkv, out_proj, mlp, n_rep
@@ -491,16 +592,11 @@ def make_tp_generate_llama(cfg: lm.LlamaConfig, mesh: Mesh, n_new: int,
             hooks, params["layers"], prompt, key, n_new,
             pick=_make_pick(temperature, top_k, top_p, prompt.dtype))
 
-    specs = tp_param_specs_llama(axis)
-    inner = shard_map(per_shard, mesh=mesh,
-                      in_specs=(specs, P(), P()),
-                      out_specs=P(), check_vma=False)
-
-    @jax.jit
-    def generate(params, prompt, key):
-        return inner(tp_shard_params_llama(params, cfg), prompt, key)
-
-    return generate
+    return _tp_program_cache(
+        mesh, per_shard,
+        [(tp_param_specs_llama(axis), _llama_scale_specs(axis),
+          tp_shard_params_llama, cfg, "TP Llama serving")],
+        (P(), P()), P())
 
 
 # -- Tensor-parallel SPECULATIVE decoding ----------------------------------
@@ -690,10 +786,12 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
     from mpi_acx_tpu.models.speculative import _check_moe_target
 
     def fam(c):
-        """One dispatch per family: (speculative ops, specs, shard fn)."""
+        """One dispatch per family: (speculative ops, specs, shard fn,
+        scale_specs for int8 weight-only companions)."""
         if type(c) is lm.LlamaConfig:
             return (_llama_tp_family_ops(c, tp, axis),
-                    tp_param_specs_llama(axis), tp_shard_params_llama)
+                    tp_param_specs_llama(axis), tp_shard_params_llama,
+                    _llama_scale_specs(axis))
         if type(c) is MoeTransformerConfig:
             assert c.n_experts % tp == 0, (c.n_experts, tp)
             # Outside the drop-free regime sharded dispatch forms
@@ -713,10 +811,11 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
                                 sharded_dispatch=mode == "sharded")
 
             return (_tp_family_ops(c, tp, axis, ffn=moe_ffn),
-                    tp_param_specs_moe(axis), tp_shard_params)
+                    tp_param_specs_moe(axis), tp_shard_params,
+                    _moe_scale_specs(axis))
         if type(c) is tfm.TransformerConfig:
             return (_tp_family_ops(c, tp, axis), tp_param_specs(axis),
-                    tp_shard_params)
+                    tp_shard_params, _gpt2_scale_specs(axis))
         raise TypeError(
             "TP speculative decoding supports the GPT-2, Llama, and "
             f"MoE-transformer families; got {type(c).__name__}")
@@ -729,8 +828,8 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
     # single-device speculative API).
     _check_moe_target(cfg)
     tp = mesh.shape[axis]
-    t_ops, specs_t, shard_t = fam(cfg)
-    d_ops, specs_d, shard_d = fam(draft_cfg)
+    t_ops, specs_t, shard_t, sspecs_t = fam(cfg)
+    d_ops, specs_d, shard_d, sspecs_d = fam(draft_cfg)
     hooks = (_greedy_hooks(k) if temperature == 0.0
              else _sample_hooks(k, float(temperature)))
 
@@ -750,15 +849,15 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
         )(prompt, jax.random.split(key, B))
         return toks[:, 0], rounds, acc
 
-    inner = shard_map(per_shard, mesh=mesh,
-                      in_specs=(specs_d, specs_t, P(), P()),
-                      out_specs=(P(), P(), P()), check_vma=False)
+    run = _tp_program_cache(
+        mesh, per_shard,
+        [(specs_d, sspecs_d, shard_d, draft_cfg,
+          "TP speculative draft"),
+         (specs_t, sspecs_t, shard_t, cfg, "TP speculative target")],
+        (P(), P()), (P(), P(), P()))
 
-    @jax.jit
     def generate(draft_params, params, prompt, key):
-        toks, rounds, acc = inner(
-            shard_d(draft_params, draft_cfg),
-            shard_t(params, cfg), prompt, key)
+        toks, rounds, acc = run(draft_params, params, prompt, key)
         return toks, {"rounds": rounds, "drafted_accepted": acc}
 
     return generate

@@ -148,16 +148,101 @@ def test_moe_forward_rejects_quantized_experts():
         mtf.forward(q, cfg, tok)
 
 
-def test_tp_sharding_rejects_quantized_checkpoints():
-    """TP serving re-lays weights out itself (no wread path): it must
-    refuse int8 checkpoints loudly, never cast scale-less codes."""
-    from mpi_acx_tpu.parallel.tp_inference import tp_shard_params
-    cfg = tfm.tiny_config(vocab=64, d_model=32, n_heads=2, n_layers=2,
-                          d_ff=64, max_seq=32)
-    q = quantize_weights_int8(tfm.init_params(jax.random.key(0), cfg),
-                              GPT2_WEIGHTS)
-    with pytest.raises(ValueError, match="quantized"):
-        tp_shard_params(q, cfg)
+def test_tp_serving_int8_matches_single_device_gpt2():
+    """TP serving over an int8 checkpoint (scale companions sharded
+    alongside their weights, wread in the TP layer ops) must emit the
+    same tokens as the single-device quantized generate."""
+    from mpi_acx_tpu.parallel.mesh import mesh_from_devices
+    from mpi_acx_tpu.parallel.tp_inference import make_tp_generate
+    cfg, params, tok = _trained_gpt2()
+    q = quantize_weights_int8(params, GPT2_WEIGHTS)
+    mesh = mesh_from_devices({"tp": 2}, jax.devices()[:2])
+    prompt = tok[:2, :8]
+    want = tfm.generate(q, cfg, prompt, 8, max_len=24)
+    gen = make_tp_generate(cfg, mesh, 8)
+    got = gen(q, prompt, jax.random.key(2))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # The same builder still serves the PLAIN checkpoint (separate
+    # compiled program, same per-shard code).
+    want_p = tfm.generate(params, cfg, prompt, 8, max_len=24)
+    got_p = gen(params, prompt, jax.random.key(2))
+    np.testing.assert_array_equal(np.asarray(got_p), np.asarray(want_p))
+
+
+def test_tp_serving_int8_matches_single_device_llama():
+    from mpi_acx_tpu.parallel.mesh import mesh_from_devices
+    from mpi_acx_tpu.parallel.tp_inference import make_tp_generate_llama
+    cfg, params, tok = _trained_llama()
+    q = quantize_weights_int8(params, LLAMA_WEIGHTS)
+    mesh = mesh_from_devices({"tp": 2}, jax.devices()[:2])
+    prompt = tok[:2, :8]
+    want = lm.generate(q, cfg, prompt, 8, max_len=24)
+    gen = make_tp_generate_llama(cfg, mesh, 8)
+    got = gen(q, prompt, jax.random.key(2))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tp_speculative_int8_matches_single_device():
+    """TP speculative decoding over a quantized draft AND target must
+    emit the same tokens/stats as the single-device quantized run —
+    the (draft, target) scale-key cache and both families' scale
+    re-layouts compose with the speculative loop."""
+    import dataclasses
+    from mpi_acx_tpu.models.speculative import speculative_generate
+    from mpi_acx_tpu.parallel.mesh import mesh_from_devices
+    from mpi_acx_tpu.parallel.tp_inference import \
+        make_tp_speculative_generate
+    cfg, params, tok = _trained_gpt2()
+    dcfg = dataclasses.replace(cfg, n_layers=1)
+    dparams = tfm.init_params(jax.random.key(9), dcfg)
+    qp = quantize_weights_int8(params, GPT2_WEIGHTS)
+    qd = quantize_weights_int8(dparams, GPT2_WEIGHTS)
+    mesh = mesh_from_devices({"tp": 2}, jax.devices()[:2])
+    prompt = tok[:1, :8]
+    want, wstats = speculative_generate(qd, dcfg, qp, cfg, prompt, 8,
+                                        k=3)
+    gen = make_tp_speculative_generate(dcfg, cfg, mesh, 8, k=3)
+    got, stats = gen(qd, qp, prompt, jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert int(stats["rounds"]) == int(wstats["rounds"])
+
+
+def test_tp_moe_quantized_attention_matches_single_device():
+    """MoE TP serving with int8 ATTENTION weights (the supported
+    subset) matches the single-device quantized generate; experts stay
+    bf16."""
+    from mpi_acx_tpu.models import moe_transformer as mtf
+    from mpi_acx_tpu.parallel.mesh import mesh_from_devices
+    from mpi_acx_tpu.parallel.tp_inference import make_tp_generate_moe
+    cfg = mtf.tiny_moe_config(vocab=64, d_model=32, n_heads=2,
+                              n_layers=2, d_ff=64, n_experts=4, top_k=1,
+                              capacity_factor=4.0, max_seq=32)
+    params = mtf.init_params(jax.random.key(0), cfg)
+    q = quantize_weights_int8(params, ("wqkv", "wo"))
+    mesh = mesh_from_devices({"tp": 2}, jax.devices()[:2])
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab)
+    want = mtf.generate(q, cfg, prompt, 6, max_len=16)
+    gen = make_tp_generate_moe(cfg, mesh, 6)
+    got = gen(q, prompt, jax.random.key(2))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tp_serving_rejects_quantized_moe_experts():
+    """Quantized MoE EXPERT weights stay unsupported in TP serving:
+    the restricted scale-spec map must raise loudly."""
+    from mpi_acx_tpu.models import moe_transformer as mtf
+    from mpi_acx_tpu.parallel.mesh import mesh_from_devices
+    from mpi_acx_tpu.parallel.tp_inference import make_tp_generate_moe
+    cfg = mtf.tiny_moe_config(vocab=64, d_model=32, n_heads=2,
+                              n_layers=2, d_ff=64, n_experts=4, top_k=1,
+                              capacity_factor=4.0, max_seq=32)
+    params = mtf.init_params(jax.random.key(0), cfg)
+    q = quantize_weights_int8(params, ("w1", "w2"))
+    mesh = mesh_from_devices({"tp": 2}, jax.devices()[:2])
+    gen = make_tp_generate_moe(cfg, mesh, 4)
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab)
+    with pytest.raises(ValueError, match="w1_scale"):
+        gen(q, prompt, jax.random.key(2))
 
 
 def test_unquantized_path_untouched():
