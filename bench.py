@@ -287,6 +287,22 @@ def tpu_child_decode():
     decode_toks_q = B * n_new / _timeit(gen, qparams, prompt)
     qbytes = weight_bytes(qparams)
     roofline_q = B * HBM_BW / (qbytes + kvbytes)
+
+    # Long-context operating point (max_len=2048): the KV stream is
+    # now ~2.4x the int8 weight stream — the regime ops/kvquant.py
+    # targets. A/B the bf16 vs int8 cache at the same workload.
+    lc_max, lc_new = 2048, 32
+    lprompt = jax.random.randint(jax.random.key(3), (B, 32), 0,
+                                 cfg.vocab)
+    lgen = jax.jit(lambda p, t: tfm.generate(p, cfg, t, lc_new,
+                                             max_len=lc_max))
+    lgen8 = jax.jit(lambda p, t: tfm.generate(p, cfg, t, lc_new,
+                                              max_len=lc_max,
+                                              kv_int8=True))
+    lc_toks = B * lc_new / _timeit(lgen, qparams, lprompt)
+    lc_toks8 = B * lc_new / _timeit(lgen8, qparams, lprompt)
+    lc_kv = 2 * cfg.n_layers * lc_max * cfg.d_model * 2 * B
+    lc_kv8 = lc_kv // 2 + lc_kv // (2 * cfg.head_dim) * 4  # codes+scales
     print(json.dumps({
         "decode_tokens_per_s": round(decode_toks, 1),
         "decode_roofline_tokens_per_s": round(roofline, 1),
@@ -298,6 +314,15 @@ def tpu_child_decode():
         "decode_int8w_roofline_frac": round(decode_toks_q / roofline_q,
                                             3),
         "decode_int8w_weight_mb": round(qbytes / 1e6, 1),
+        "decode_longctx_tokens_per_s": round(lc_toks, 1),
+        "decode_longctx_int8kv_tokens_per_s": round(lc_toks8, 1),
+        "decode_longctx_int8kv_speedup": round(lc_toks8 / lc_toks, 2),
+        "decode_longctx_kv_mb": round(lc_kv / 1e6, 1),
+        "decode_longctx_int8kv_mb": round(lc_kv8 / 1e6, 1),
+        "decode_longctx_roofline_tokens_per_s": round(
+            B * HBM_BW / (qbytes + lc_kv), 1),
+        "decode_longctx_int8kv_roofline_tokens_per_s": round(
+            B * HBM_BW / (qbytes + lc_kv8), 1),
         "device": str(jax.devices()[0].platform),
     }))
 

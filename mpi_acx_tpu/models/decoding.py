@@ -41,7 +41,8 @@ def grouped_decode_attend(q, kc, vc, pos, max_len, n_rep):
         B, W, Hkv * n_rep * Dh)
 
 
-def decode_layer_scan(layers, x, kc_all, vc_all, pos, qkv_fn, attend_fn):
+def decode_layer_scan(layers, x, kc_all, vc_all, pos, qkv_fn, attend_fn,
+                      ksc_all=None, vsc_all=None):
     """The carry-scan decode layer loop shared by every decode path
     (transformer/llama decode_step, the TP generation loop).
 
@@ -55,25 +56,96 @@ def decode_layer_scan(layers, x, kc_all, vc_all, pos, qkv_fn, attend_fn):
     qkv_fn(lp, x, pos) -> (q, k [B,1,H,D], v); attend_fn(lp, x, q, kc_l,
     vc_l, pos) -> x consumes the layer's UPDATED cache slices. Returns
     (x, kc_all, vc_all).
+
+    With ``ksc_all``/``vsc_all`` ([L, B, max_len, H, 1] f32) the cache
+    is INT8 (ops/kvquant.py): the fresh K/V vectors are quantized on
+    write, the scale buffers ride the carry beside the code buffers,
+    and attend_fn receives dequantized layer slices — the attention
+    math never changes, only the HBM stream (the dequant fuses into the
+    einsum's operand read). Returns (x, kc, vc, ksc, vsc) then.
     """
+    from mpi_acx_tpu.ops.kvquant import kv_dequant, kv_quant
+
     n_layers = jax.tree.leaves(layers)[0].shape[0]
+    quant = ksc_all is not None
 
     def body(carry, i):
-        x, kc, vc = carry
+        if quant:
+            x, kc, vc, ksc, vsc = carry
+        else:
+            x, kc, vc = carry
         lp = jax.tree.map(
             lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
             layers)
         q, k, v = qkv_fn(lp, x, pos)
+        if quant:
+            k, ks = kv_quant(k)
+            v, vs = kv_quant(v)
+            ksc = lax.dynamic_update_slice(ksc, ks[None],
+                                           (i, 0, pos, 0, 0))
+            vsc = lax.dynamic_update_slice(vsc, vs[None],
+                                           (i, 0, pos, 0, 0))
         kc = lax.dynamic_update_slice(kc, k[None], (i, 0, pos, 0, 0))
         vc = lax.dynamic_update_slice(vc, v[None], (i, 0, pos, 0, 0))
         kc_l = lax.dynamic_index_in_dim(kc, i, 0, keepdims=False)
         vc_l = lax.dynamic_index_in_dim(vc, i, 0, keepdims=False)
+        if quant:
+            kc_l = kv_dequant(
+                kc_l, lax.dynamic_index_in_dim(ksc, i, 0,
+                                               keepdims=False), x.dtype)
+            vc_l = kv_dequant(
+                vc_l, lax.dynamic_index_in_dim(vsc, i, 0,
+                                               keepdims=False), x.dtype)
         x = attend_fn(lp, x, q, kc_l, vc_l, pos)
+        if quant:
+            return (x, kc, vc, ksc, vsc), None
         return (x, kc, vc), None
 
+    if quant:
+        (x, kc_all, vc_all, ksc_all, vsc_all), _ = lax.scan(
+            body, (x, kc_all, vc_all, ksc_all, vsc_all),
+            jnp.arange(n_layers))
+        return x, kc_all, vc_all, ksc_all, vsc_all
     (x, kc_all, vc_all), _ = lax.scan(body, (x, kc_all, vc_all),
                                       jnp.arange(n_layers))
     return x, kc_all, vc_all
+
+
+def fill_kv_cache(cache, ks, vs, pos):
+    """Land the prefill K/V ([L, B, S, H, D], compute dtype) into a
+    fresh cache from a family's ``init_kv_cache`` and set ``pos`` —
+    quantizing when the cache is int8 ('ks' present). The ONE
+    definition of the fill, so the int8 layout can't drift between
+    families."""
+    from mpi_acx_tpu.ops.kvquant import kv_quant
+    if "ks" in cache:
+        ks, kscale = kv_quant(ks)
+        vs, vscale = kv_quant(vs)
+        cache["ks"] = lax.dynamic_update_slice(cache["ks"], kscale,
+                                               (0,) * 5)
+        cache["vs"] = lax.dynamic_update_slice(cache["vs"], vscale,
+                                               (0,) * 5)
+    cache["k"] = lax.dynamic_update_slice(cache["k"], ks, (0,) * 5)
+    cache["v"] = lax.dynamic_update_slice(cache["v"], vs, (0,) * 5)
+    cache["pos"] = jnp.asarray(pos, jnp.int32)
+    return cache
+
+
+def run_decode_layers(layers, x, cache, qkv_fn, attend_fn,
+                      advance: int = 1):
+    """:func:`decode_layer_scan` dispatched on the cache layout (bf16
+    vs int8 — the ONE place 'ks' selects the quantized path), returning
+    ``(x, updated cache)`` with ``pos`` advanced."""
+    pos = cache["pos"]
+    if "ks" in cache:
+        x, kc, vc, ksc, vsc = decode_layer_scan(
+            layers, x, cache["k"], cache["v"], pos, qkv_fn, attend_fn,
+            ksc_all=cache["ks"], vsc_all=cache["vs"])
+        return x, {"k": kc, "v": vc, "ks": ksc, "vs": vsc,
+                   "pos": pos + advance}
+    x, kc, vc = decode_layer_scan(layers, x, cache["k"], cache["v"],
+                                  pos, qkv_fn, attend_fn)
+    return x, {"k": kc, "v": vc, "pos": pos + advance}
 
 
 def greedy_generate(prefill_fn: Callable, decode_fn: Callable,

@@ -204,18 +204,28 @@ def loss_fn(params: Params, cfg: LlamaConfig, tokens: jax.Array,
 # -- KV-cache decode (GQA: the cache stores only n_kv_heads) ---------------
 
 
-def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int):
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int,
+                  kv_int8: bool = False):
+    """GQA cache (n_kv_heads, the memory win); ``kv_int8=True`` stores
+    int8 codes + per-(position, head) f32 scales (ops/kvquant.py)."""
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
-    return {
-        "k": jnp.zeros(shape, cfg.dtype),
-        "v": jnp.zeros(shape, cfg.dtype),
+    cache = {
+        "k": jnp.zeros(shape, jnp.int8 if kv_int8 else cfg.dtype),
+        "v": jnp.zeros(shape, jnp.int8 if kv_int8 else cfg.dtype),
         "pos": jnp.zeros((), jnp.int32),
     }
+    if kv_int8:
+        cache["ks"] = jnp.zeros(shape[:-1] + (1,), jnp.float32)
+        cache["vs"] = jnp.zeros(shape[:-1] + (1,), jnp.float32)
+    return cache
 
 
 def prefill(params: Params, cfg: LlamaConfig, tokens: jax.Array,
-            max_len: int, last_only: bool = False):
-    """Prompt pass filling a fresh KV cache (layout: init_kv_cache)."""
+            max_len: int, last_only: bool = False,
+            kv_int8: bool = False):
+    """Prompt pass filling a fresh KV cache (layout: init_kv_cache).
+    Prefill attention runs on the exact bf16 K/V; with ``kv_int8`` only
+    the CACHE entries are quantized."""
     B, S = tokens.shape
     assert S <= max_len and S <= cfg.max_seq, (S, max_len, cfg.max_seq)
     x = params["embed"][tokens].astype(cfg.dtype)
@@ -233,10 +243,9 @@ def prefill(params: Params, cfg: LlamaConfig, tokens: jax.Array,
         x = x[:, -1:]
     logits = jnp.einsum("bsd,vd->bsv", x, params["unembed"].astype(x.dtype),
                         preferred_element_type=jnp.float32)
-    cache = init_kv_cache(cfg, B, max_len)
-    cache["k"] = lax.dynamic_update_slice(cache["k"], ks, (0,) * 5)
-    cache["v"] = lax.dynamic_update_slice(cache["v"], vs, (0,) * 5)
-    cache["pos"] = jnp.asarray(S, jnp.int32)
+    from mpi_acx_tpu.models.decoding import fill_kv_cache
+    cache = fill_kv_cache(init_kv_cache(cfg, B, max_len,
+                                        kv_int8=kv_int8), ks, vs, S)
     return logits, cache
 
 
@@ -253,8 +262,6 @@ def decode_step(params: Params, cfg: LlamaConfig, cache,
     The cache update runs through the shared carry-scan
     (decoding.decode_layer_scan): in-place updates, 1.9x faster decode
     on v5e than scan-ys stacking."""
-    from mpi_acx_tpu.models.decoding import decode_layer_scan
-
     pos = cache["pos"]
     max_len = cache["k"].shape[2]
     n_rep = cfg.n_heads // cfg.n_kv_heads
@@ -268,20 +275,24 @@ def decode_step(params: Params, cfg: LlamaConfig, cache,
         o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep)
         return _mlp(cfg, lp, x + o @ wread(lp, "wo", x.dtype))
 
-    x, ks, vs = decode_layer_scan(params["layers"], x, cache["k"],
-                                  cache["v"], pos, qkv_fn, attend_fn)
+    from mpi_acx_tpu.models.decoding import run_decode_layers
+    x, out_cache = run_decode_layers(params["layers"], x, cache,
+                                     qkv_fn, attend_fn)
     x = rmsnorm(x, params["final_norm"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["unembed"].astype(x.dtype),
                         preferred_element_type=jnp.float32)[:, 0]
-    return logits, {"k": ks, "v": vs, "pos": pos + 1}
+    return logits, out_cache
 
 
 def generate(params: Params, cfg: LlamaConfig, prompt: jax.Array,
-             n_new: int, max_len: Optional[int] = None) -> jax.Array:
-    """Greedy decode: prompt [B, S] -> [B, S + n_new]."""
+             n_new: int, max_len: Optional[int] = None,
+             kv_int8: bool = False) -> jax.Array:
+    """Greedy decode: prompt [B, S] -> [B, S + n_new]. ``kv_int8``
+    selects the quantized KV cache (ops/kvquant.py)."""
     from mpi_acx_tpu.models.decoding import greedy_generate
     return greedy_generate(
-        lambda t, ml, lo: prefill(params, cfg, t, ml, last_only=lo),
+        lambda t, ml, lo: prefill(params, cfg, t, ml, last_only=lo,
+                                  kv_int8=kv_int8),
         lambda c, t: decode_step(params, cfg, c, t),
         prompt, n_new, cfg.max_seq, max_len)
 

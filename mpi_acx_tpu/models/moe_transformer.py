@@ -228,19 +228,22 @@ def _moe_ffn(cfg: MoeTransformerConfig, lp: Dict[str, Any], h: jax.Array,
     return out
 
 
-def init_kv_cache(cfg: MoeTransformerConfig, batch: int, max_len: int):
-    """Same cache layout as the dense family (cfg duck-types)."""
-    return tfm.init_kv_cache(cfg, batch, max_len)
+def init_kv_cache(cfg: MoeTransformerConfig, batch: int, max_len: int,
+                  kv_int8: bool = False):
+    """Same cache layout as the dense family (cfg duck-types),
+    including the int8 variant."""
+    return tfm.init_kv_cache(cfg, batch, max_len, kv_int8=kv_int8)
 
 
 def prefill(params: Dict[str, Any], cfg: MoeTransformerConfig,
-            tokens: jax.Array, max_len: int, last_only: bool = False):
+            tokens: jax.Array, max_len: int, last_only: bool = False,
+            kv_int8: bool = False):
     """Prompt pass filling a fresh KV cache — the dense family's scaffold
     with the routed FFN plugged in (tfm.prefill's ``ffn`` hook). Routing
     capacity during prefill is per (B*S)-token batch, exactly as in
     forward."""
     return tfm.prefill(params, cfg, tokens, max_len, last_only,
-                       ffn=_moe_ffn)
+                       ffn=_moe_ffn, kv_int8=kv_int8)
 
 
 def decode_step(params: Dict[str, Any], cfg: MoeTransformerConfig, cache,
@@ -257,11 +260,15 @@ def decode_step(params: Dict[str, Any], cfg: MoeTransformerConfig, cache,
 
 def generate(params: Dict[str, Any], cfg: MoeTransformerConfig,
              prompt: jax.Array, n_new: int,
-             max_len: Optional[int] = None) -> jax.Array:
-    """Greedy decode: prompt [B, S] -> [B, S + n_new]."""
+             max_len: Optional[int] = None,
+             kv_int8: bool = False) -> jax.Array:
+    """Greedy decode: prompt [B, S] -> [B, S + n_new]. ``kv_int8``
+    selects the quantized KV cache (shared scaffold; experts
+    untouched)."""
     from mpi_acx_tpu.models.decoding import greedy_generate
     return greedy_generate(
-        lambda t, ml, lo: prefill(params, cfg, t, ml, last_only=lo),
+        lambda t, ml, lo: prefill(params, cfg, t, ml, last_only=lo,
+                                  kv_int8=kv_int8),
         lambda c, t: decode_step(params, cfg, c, t),
         prompt, n_new, cfg.max_seq, max_len)
 

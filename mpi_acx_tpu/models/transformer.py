@@ -181,14 +181,22 @@ def cast_params(params: Params, dtype=jnp.bfloat16) -> Params:
 # generation (no recompiles, MXU-friendly).
 
 
-def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int):
-    """Zeroed cache pytree: {'k','v': [L, B, max_len, H, Dh], 'pos': int32}."""
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
+                  kv_int8: bool = False):
+    """Zeroed cache pytree: {'k','v': [L, B, max_len, H, Dh], 'pos':
+    int32}. ``kv_int8=True`` stores int8 codes plus per-(position,
+    head) f32 scale buffers 'ks'/'vs' (ops/kvquant.py) — half the
+    cache-read bandwidth, the binding term at long max_len."""
     shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
-    return {
-        "k": jnp.zeros(shape, cfg.dtype),
-        "v": jnp.zeros(shape, cfg.dtype),
+    cache = {
+        "k": jnp.zeros(shape, jnp.int8 if kv_int8 else cfg.dtype),
+        "v": jnp.zeros(shape, jnp.int8 if kv_int8 else cfg.dtype),
         "pos": jnp.zeros((), jnp.int32),
     }
+    if kv_int8:
+        cache["ks"] = jnp.zeros(shape[:-1] + (1,), jnp.float32)
+        cache["vs"] = jnp.zeros(shape[:-1] + (1,), jnp.float32)
+    return cache
 
 
 def _qkv(cfg: TransformerConfig, lp: Params, x: jax.Array):
@@ -207,7 +215,8 @@ def _mlp(cfg: TransformerConfig, lp: Params, x: jax.Array):
 
 
 def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
-            max_len: int, last_only: bool = False, ffn=None):
+            max_len: int, last_only: bool = False, ffn=None,
+            kv_int8: bool = False):
     """Run the prompt through the model, filling a fresh KV cache.
 
     tokens [B, S] -> (logits [B, S, vocab] f32, cache with pos=S).
@@ -218,7 +227,9 @@ def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
     ``ffn(cfg, lp, x) -> x`` overrides the block's feed-forward half
     (default :func:`_mlp`); the MoE family reuses this whole scaffold
     with its routed FFN (models/moe_transformer.py) — the cache layout,
-    scan wiring, and guards live only here.
+    scan wiring, and guards live only here. ``kv_int8`` selects the
+    quantized cache (init_kv_cache); prefill attention itself runs on
+    the exact bf16 K/V — only the CACHE entries are quantized.
     """
     ffn = ffn or _mlp
     B, S = tokens.shape
@@ -238,11 +249,11 @@ def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
         x = x[:, -1:]
     logits = jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(x.dtype),
                         preferred_element_type=jnp.float32)
-    # One cache-layout definition: init_kv_cache allocates, prefill fills.
-    cache = init_kv_cache(cfg, B, max_len)
-    cache["k"] = lax.dynamic_update_slice(cache["k"], ks, (0,) * 5)
-    cache["v"] = lax.dynamic_update_slice(cache["v"], vs, (0,) * 5)
-    cache["pos"] = jnp.asarray(S, jnp.int32)
+    # One cache-layout definition: init_kv_cache allocates,
+    # decoding.fill_kv_cache fills (quantizing when int8).
+    from mpi_acx_tpu.models.decoding import fill_kv_cache
+    cache = fill_kv_cache(init_kv_cache(cfg, B, max_len,
+                                        kv_int8=kv_int8), ks, vs, S)
     return logits, cache
 
 
@@ -255,8 +266,6 @@ def decode_step(params: Params, cfg: TransformerConfig, cache,
     The cache update runs through the shared carry-scan
     (decoding.decode_layer_scan) so XLA updates it in place — 1.9x
     faster decode on v5e than the scan-xs/ys structure."""
-    from mpi_acx_tpu.models.decoding import decode_layer_scan
-
     ffn = ffn or _mlp
     pos = cache["pos"]
     max_len = cache["k"].shape[2]
@@ -270,21 +279,26 @@ def decode_step(params: Params, cfg: TransformerConfig, cache,
         o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep=1)
         return ffn(cfg, lp, x + o @ wread(lp, "wo", x.dtype))
 
-    x, ks, vs = decode_layer_scan(params["layers"], x, cache["k"],
-                                  cache["v"], pos, qkv_fn, attend_fn)
+    from mpi_acx_tpu.models.decoding import run_decode_layers
+    x, out_cache = run_decode_layers(params["layers"], x, cache,
+                                     qkv_fn, attend_fn)
     x = layernorm(x, params["lnf_g"], params["lnf_b"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(x.dtype),
                         preferred_element_type=jnp.float32)[:, 0]
-    return logits, {"k": ks, "v": vs, "pos": pos + 1}
+    return logits, out_cache
 
 
 def generate(params: Params, cfg: TransformerConfig, prompt: jax.Array,
-             n_new: int, max_len: Optional[int] = None) -> jax.Array:
+             n_new: int, max_len: Optional[int] = None,
+             kv_int8: bool = False) -> jax.Array:
     """Greedy decode: prompt [B, S] -> [B, S + n_new] (jit-compatible;
-    the decode loop is a lax.scan of n_new fixed-shape steps)."""
+    the decode loop is a lax.scan of n_new fixed-shape steps).
+    ``kv_int8`` selects the quantized KV cache (ops/kvquant.py) — half
+    the cache bandwidth, the binding stream at long max_len."""
     from mpi_acx_tpu.models.decoding import greedy_generate
     return greedy_generate(
-        lambda t, ml, lo: prefill(params, cfg, t, ml, last_only=lo),
+        lambda t, ml, lo: prefill(params, cfg, t, ml, last_only=lo,
+                                  kv_int8=kv_int8),
         lambda c, t: decode_step(params, cfg, c, t),
         prompt, n_new, cfg.max_seq, max_len)
 

@@ -1,0 +1,43 @@
+"""Int8 KV-cache quantization for long-context decode.
+
+The decode step streams two tensors from HBM every token: the weights
+(halved by ops/wquant.py) and the KV cache. At short max_len the
+weights dominate, but the cache grows linearly with context — at
+GPT-2-125M geometry, B=8 x max_len=4096 is ~1.2 GB bf16, several times
+the weight stream — so long-context serving is KV-bandwidth-bound and
+int8 codes halve the dominant term.
+
+Scheme: symmetric per-(position, head) scales — each cached K/V vector
+[head_dim] gets one f32 scale (amax/127), stored in a parallel
+[..., 1] buffer. Quantization happens at WRITE time (one new vector
+per step; the prompt bulk at prefill), dequantization at READ time
+inside the decode layer scan, where XLA fuses the int8->f32 convert +
+scale multiply into the attention einsum's operand read — HBM traffic
+is the int8 bytes plus the tiny scale vector.
+
+Integration: decoding.decode_layer_scan carries the scale buffers and
+the per-family caches gain "ks"/"vs" entries (transformer.init_kv_cache
+/ llama.init_kv_cache with ``kv_int8=True``); attention math is
+unchanged — it sees dequantized slices. The reference has no serving
+stack (SURVEY.md SS0); this serves the framework goal's perf axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def kv_quant(x: jax.Array):
+    """[..., D] -> (int8 codes [..., D], f32 scales [..., 1]):
+    symmetric per-vector quantization over the feature axis."""
+    a = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    s = jnp.maximum(a, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s), -127, 127)
+    return q.astype(jnp.int8), s.astype(jnp.float32)
+
+
+def kv_dequant(q: jax.Array, s: jax.Array, dtype) -> jax.Array:
+    """Reconstruct [..., D] in compute dtype; fused into the consuming
+    einsum's operand read under jit."""
+    return (q.astype(jnp.float32) * s).astype(dtype)
