@@ -29,6 +29,14 @@ def main():
     ap.add_argument("--batch", type=int, default=1,
                     help="rows decode together; each row's output and "
                          "round count equal its own solo run")
+    ap.add_argument("--int8-weights", action="store_true",
+                    help="int8 weight-only quantization (ops/wquant.py):"
+                         " halves the weight stream decode re-reads "
+                         "every token")
+    ap.add_argument("--int8-kv", action="store_true",
+                    help="int8 KV cache (ops/kvquant.py): halves the "
+                         "cache stream, the binding term at long "
+                         "context")
     args = ap.parse_args()
 
     import jax
@@ -47,6 +55,16 @@ def main():
         cfg = tfm.tiny_config(n_layers=2)
         params = tfm.init_params(jax.random.key(0), cfg)
         gen, gen_s = tfm.generate, tfm.generate_sample
+    if args.speculative and args.int8_kv:
+        ap.error("--int8-kv does not apply to the speculative path "
+                 "(its verify windows manage their own cache); "
+                 "--int8-weights composes with --speculative fine")
+    if args.int8_weights:
+        from mpi_acx_tpu.ops.wquant import (GPT2_WEIGHTS, LLAMA_WEIGHTS,
+                                            quantize_weights_int8)
+        wnames = (LLAMA_WEIGHTS if args.family == "llama"
+                  else GPT2_WEIGHTS)
+        params = quantize_weights_int8(params, wnames)
 
     base = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
     prompt = jnp.tile(base, (args.batch, 1)).at[:, -1].add(
@@ -70,11 +88,13 @@ def main():
         import numpy as np
         print("rounds per row:", np.asarray(stats["rounds"]).tolist())
     elif args.temperature == 0.0 and args.top_k is None and args.top_p is None:
-        out = gen(params, cfg, prompt, n_new=args.n_new)
+        out = gen(params, cfg, prompt, n_new=args.n_new,
+                  kv_int8=args.int8_kv)
     else:
         out = gen_s(params, cfg, prompt, n_new=args.n_new,
                     key=jax.random.key(42), temperature=args.temperature,
-                    top_k=args.top_k, top_p=args.top_p)
+                    top_k=args.top_k, top_p=args.top_p,
+                    kv_int8=args.int8_kv)
     for b in range(args.batch):
         print(f"{args.family} row {b}: ",
               out[b, prompt.shape[1]:].tolist())

@@ -131,21 +131,21 @@ def fill_kv_cache(cache, ks, vs, pos):
     return cache
 
 
-def run_decode_layers(layers, x, cache, qkv_fn, attend_fn,
-                      advance: int = 1):
+def run_decode_layers(layers, x, cache, qkv_fn, attend_fn):
     """:func:`decode_layer_scan` dispatched on the cache layout (bf16
     vs int8 — the ONE place 'ks' selects the quantized path), returning
-    ``(x, updated cache)`` with ``pos`` advanced."""
+    ``(x, updated cache)`` with ``pos`` advanced by the one decoded
+    token."""
     pos = cache["pos"]
     if "ks" in cache:
         x, kc, vc, ksc, vsc = decode_layer_scan(
             layers, x, cache["k"], cache["v"], pos, qkv_fn, attend_fn,
             ksc_all=cache["ks"], vsc_all=cache["vs"])
         return x, {"k": kc, "v": vc, "ks": ksc, "vs": vsc,
-                   "pos": pos + advance}
+                   "pos": pos + 1}
     x, kc, vc = decode_layer_scan(layers, x, cache["k"], cache["v"],
                                   pos, qkv_fn, attend_fn)
-    return x, {"k": kc, "v": vc, "pos": pos + advance}
+    return x, {"k": kc, "v": vc, "pos": pos + 1}
 
 
 def greedy_generate(prefill_fn: Callable, decode_fn: Callable,

@@ -277,11 +277,13 @@ def generate_sample(params: Dict[str, Any], cfg: MoeTransformerConfig,
                     prompt: jax.Array, n_new: int, key: jax.Array,
                     temperature: float = 1.0, top_k: Optional[int] = None,
                     top_p: Optional[float] = None,
-                    max_len: Optional[int] = None) -> jax.Array:
+                    max_len: Optional[int] = None,
+                    kv_int8: bool = False) -> jax.Array:
     """Stochastic decode (temperature / top-k / top-p nucleus)."""
     from mpi_acx_tpu.models.decoding import sample_generate
     return sample_generate(
-        lambda t, ml, lo: prefill(params, cfg, t, ml, last_only=lo),
+        lambda t, ml, lo: prefill(params, cfg, t, ml, last_only=lo,
+                                  kv_int8=kv_int8),
         lambda c, t: decode_step(params, cfg, c, t),
         prompt, n_new, cfg.max_seq, key, temperature, top_k, top_p, max_len)
 
