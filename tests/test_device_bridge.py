@@ -27,8 +27,8 @@ def _run_worker(worker, extra_env=None):
     import sys
     return subprocess.run(
         [os.path.join(REPO, "build", "acxrun"), "-np", "2", "-timeout",
-         "240", sys.executable, worker],
-        env=env, capture_output=True, text=True, timeout=300)
+         "480", sys.executable, worker],
+        env=env, capture_output=True, text=True, timeout=540)
 
 
 def test_kernel_pready_drives_wire_transfer():

@@ -30,10 +30,19 @@ if RANK == 0:
         os.environ["JAX_PLATFORMS"] = plat
 else:
     os.environ["JAX_PLATFORMS"] = "cpu"
-    # The axon sitecustomize pins the tunnel chip through PYTHONPATH;
-    # the launching test strips it for us.
 
 import jax  # noqa: E402
+
+if RANK != 0 or os.environ.get("ACX_RANK0_PLATFORM", "cpu") == "cpu":
+    # In tpu mode the test must keep PYTHONPATH so rank 0 reaches the
+    # tunnel — but then the axon sitecustomize runs in THIS process too
+    # and its register() does jax.config.update("jax_platforms",
+    # "axon,cpu"), which OVERRIDES the env var above. Left alone, rank
+    # 1's first jax.devices() would try to build a second axon client
+    # against the single-session tunnel and deadlock both ranks (r05:
+    # both ranks stuck in make_c_api_client until acxrun's kill).
+    # Forcing the config back AFTER import wins over the sitecustomize.
+    jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 from jax import lax  # noqa: E402

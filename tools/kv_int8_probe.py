@@ -1,0 +1,125 @@
+"""Rank int8-KV decode-attention variants on the live chip.
+
+BENCH_BANK r05 showed the naive int8-KV path at 0.73x the bf16
+baseline (decode_longctx_int8kv_speedup): the kv_dequant of the full
+[B, S, H, D] cache slice materializes a bf16 tensor in HBM before the
+attention einsums, so the step pays int8-read + bf16-write + bf16-read
+— MORE traffic than the bf16 cache it was meant to halve.
+
+This probe times one decode-attention step (single layer, full-cache
+attend, the bandwidth-bound regime) for four variants:
+
+  bf16      — plain bf16 cache (the baseline the int8 path must beat)
+  dequant   — current ops/kvquant.py path: dequantize, then einsum
+  scaleskv  — int8 codes are the einsum operands; the per-(pos, head)
+              scales are applied to the SMALL tensors (scores and
+              probabilities), so no [B, S, H, D] dequant tensor ever
+              exists: scores = (q @ Kq^T) * sK ; out = (p * sV) @ Vq
+  int8mxu   — additionally quantize q per (B, H) vector and use a
+              native int8 x int8 -> int32 dot for the score matmul
+
+Usage: python tools/kv_int8_probe.py [S] (default 4096)
+Prints one JSON line per variant: {"variant", "ms", "x_vs_bf16"}.
+"""
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+B, H, D = 8, 12, 64
+
+
+def attend_bf16(q, kc, vc, sk, sv, mask):
+    # q [B,H,D]; kc/vc [B,S,H,D]; mask [S]
+    scores = jnp.einsum("bhd,bshd->bhs", q, kc) / (D ** 0.5)
+    scores = jnp.where(mask[None, None, :], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhs,bshd->bhd", p, vc)
+
+
+def attend_dequant(q, kc, vc, sk, sv, mask):
+    k = (kc.astype(jnp.float32) * sk).astype(q.dtype)
+    v = (vc.astype(jnp.float32) * sv).astype(q.dtype)
+    return attend_bf16(q, k, v, None, None, mask)
+
+
+def attend_scaleskv(q, kc, vc, sk, sv, mask):
+    # scores_ij = sum_d q_d * Kq_sd * sK_s  ->  (q @ Kq) * sK
+    scores = jnp.einsum("bhd,bshd->bhs", q, kc.astype(q.dtype))
+    scores = scores * sk[..., 0].transpose(0, 2, 1) / (D ** 0.5)
+    scores = jnp.where(mask[None, None, :], scores, -1e30)
+    p = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    # out_d = sum_s p_s * sV_s * Vq_sd  ->  (p * sV) @ Vq
+    pv = (p * sv[..., 0].transpose(0, 2, 1)).astype(q.dtype)
+    return jnp.einsum("bhs,bshd->bhd", pv, vc.astype(q.dtype))
+
+
+def attend_int8mxu(q, kc, vc, sk, sv, mask):
+    aq = jnp.max(jnp.abs(q.astype(jnp.float32)), axis=-1, keepdims=True)
+    sq = jnp.maximum(aq, 1e-12) / 127.0
+    qq = jnp.clip(jnp.round(q.astype(jnp.float32) / sq),
+                  -127, 127).astype(jnp.int8)
+    scores = lax.dot_general(
+        qq, kc, (((2,), (3,)), ((0, 1), (0, 2))),
+        preferred_element_type=jnp.int32)  # [B,H,S] int32
+    scores = (scores.astype(jnp.float32) * sq
+              * sk[..., 0].transpose(0, 2, 1)) / (D ** 0.5)
+    scores = jnp.where(mask[None, None, :], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    pv = (p * sv[..., 0].transpose(0, 2, 1)).astype(jnp.bfloat16)
+    return jnp.einsum("bhs,bshd->bhd", pv, vc.astype(jnp.bfloat16))
+
+
+def main():
+    S = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    key = jax.random.key(0)
+    kf = jax.random.normal(key, (B, S, H, D), jnp.float32)
+    vf = jax.random.normal(jax.random.key(1), (B, S, H, D), jnp.float32)
+    q = jax.random.normal(jax.random.key(2), (B, H, D)).astype(jnp.bfloat16)
+    mask = jnp.ones((S,), bool)
+
+    a = jnp.max(jnp.abs(kf), axis=-1, keepdims=True)
+    sk = jnp.maximum(a, 1e-12) / 127.0
+    kq = jnp.clip(jnp.round(kf / sk), -127, 127).astype(jnp.int8)
+    a = jnp.max(jnp.abs(vf), axis=-1, keepdims=True)
+    sv = jnp.maximum(a, 1e-12) / 127.0
+    vq = jnp.clip(jnp.round(vf / sv), -127, 127).astype(jnp.int8)
+    kb, vb = kf.astype(jnp.bfloat16), vf.astype(jnp.bfloat16)
+
+    reps = 50
+    variants = {
+        "bf16": (attend_bf16, kb, vb, None, None),
+        "dequant": (attend_dequant, kq, vq, sk, sv),
+        "scaleskv": (attend_scaleskv, kq, vq, sk, sv),
+        "int8mxu": (attend_int8mxu, kq, vq, sk, sv),
+    }
+    ref = None
+    base = None
+    for name, (fn, kc, vc, s1, s2) in variants.items():
+        @jax.jit
+        def loop(q, kc, vc, s1, s2, fn=fn):
+            def body(c, _):
+                o = fn(c, kc, vc, s1, s2, mask)
+                return (q + 0.001 * o.astype(q.dtype)), o
+            c, os_ = lax.scan(body, q, None, length=reps)
+            return c, os_[-1]
+
+        c, out = jax.block_until_ready(loop(q, kc, vc, s1, s2))
+        t0 = time.perf_counter()
+        c, out = jax.block_until_ready(loop(q, kc, vc, s1, s2))
+        ms = (time.perf_counter() - t0) / reps * 1e3
+        if ref is None:
+            ref, base = out.astype(jnp.float32), ms
+        err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+        print(json.dumps({"variant": name, "ms": round(ms, 3),
+                          "x_vs_bf16": round(base / ms, 2),
+                          "max_err_vs_bf16": round(err, 4)}))
+
+
+if __name__ == "__main__":
+    main()
