@@ -74,6 +74,30 @@ def native_bench(msg_bytes: int | None = None):
     return float(m.group(1)), float(m.group(2)), float(m.group(3))
 
 
+def _code_rev():
+    """Fingerprint of the MEASURED code: tree hashes of the source
+    paths plus any uncommitted diff to them. Deliberately excludes the
+    bench artifacts, so the banker's own artifact commits don't shift
+    it — but ANY code change (committed or not) does, which is what
+    lets _bank_reuse refuse rows measured on code that no longer
+    exists (r05 review: the decode group's 0.73x int8-KV row predated
+    the scale-on-scores fix and would otherwise have been reused as
+    evidence for it)."""
+    paths = ["mpi_acx_tpu", "src", "include", "bench.py"]
+    try:
+        h = subprocess.run(
+            ["git", "-C", REPO, "rev-parse"] +
+            [f"HEAD:{p}" for p in paths],
+            capture_output=True, text=True, timeout=30).stdout
+        d = subprocess.run(
+            ["git", "-C", REPO, "diff", "HEAD", "--"] + paths,
+            capture_output=True, text=True, timeout=30).stdout
+        import hashlib
+        return hashlib.sha1((h + d).encode()).hexdigest()[:12]
+    except Exception:  # noqa: BLE001 — no git: disable reuse, not bench
+        return "unknown"
+
+
 def _bank(rows: dict, group: str | None = None):
     """Merge measured rows into BENCH_BANK.json IMMEDIATELY (checked-in,
     append-only evidence: a 3-minute healthy tunnel window must survive a
@@ -85,9 +109,10 @@ def _bank(rows: dict, group: str | None = None):
     except Exception:  # noqa: BLE001 — first run or corrupt file
         bank = {}
     ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    rev = _code_rev()
     for k, v in rows.items():
         if k != "device":
-            bank[k] = {"value": v, "ts": ts,
+            bank[k] = {"value": v, "ts": ts, "rev": rev,
                        "device": rows.get("device", "?")}
             if group is not None:
                 bank[k]["group"] = group
@@ -119,8 +144,13 @@ def _bank_reuse(group: str):
         return None
     import calendar
     cutoff = time.time() - hours * 3600
+    rev = _code_rev()
     for v in rows.values():
         if v.get("device") != "tpu":
+            return None
+        # Only rows measured on EXACTLY this code may stand in for a
+        # fresh measurement ("unknown" never matches itself safely).
+        if rev == "unknown" or v.get("rev") != rev:
             return None
         try:
             # Bank timestamps are UTC ("...Z"); timegm parses as UTC.
@@ -415,14 +445,11 @@ def _train_setup():
         p = jax.tree.map(lambda a, b: a - 0.0 * b, p, g)
         return (p, s), loss
 
-    class NS:
-        pass
-
-    ns = NS()
-    ns.jax, ns.tok, ns.tgt, ns.treps = jax, tok, tgt, treps
-    ns.params, ns.ostate, ns.scan_loop = params_f32, ostate, scan_loop
-    ns.step_full, ns.step_fwd, ns.step_grad = step_full, step_fwd, step_grad
-    return ns
+    from types import SimpleNamespace
+    return SimpleNamespace(
+        jax=jax, tok=tok, tgt=tgt, treps=treps, params=params_f32,
+        ostate=ostate, scan_loop=scan_loop, step_full=step_full,
+        step_fwd=step_fwd, step_grad=step_grad)
 
 
 def tpu_child_train():

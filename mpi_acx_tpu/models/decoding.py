@@ -26,18 +26,44 @@ def grouped_decode_attend(q, kc, vc, pos, max_len, n_rep):
     multi-head attention and with W=1 the ordinary decode step, so every
     decode path — the three families' steps, the tensor-parallel loops,
     and the speculative window passes — shares this single definition of
-    the scale/mask/softmax math."""
+    the scale/mask/softmax math.
+
+    ``kc``/``vc`` may each be an ``(int8 codes, f32 scales [B, max_len,
+    Hkv, 1])`` tuple (ops/kvquant.py layout). The per-position scales
+    are then applied to the SMALL tensors — K's to the logits, V's to
+    the probabilities — never to the cache itself: the r05 chip A/B
+    showed the obvious dequantize-then-attend path at 0.73x the bf16
+    baseline because XLA materializes the dequantized [B, max_len, H,
+    D] tensor in HBM (int8 read + bf16 write + bf16 read — MORE
+    traffic than the bf16 cache the codes were meant to halve). With
+    the factoring, the full-cache operands stay int8 end-to-end.
+    Algebraically identical: sum_d q_d*(K_kd*s_k) == (sum_d q_d*K_kd)
+    * s_k, and the f32 logits/probs multiply is if anything MORE
+    precise than rounding each dequantized element to bf16."""
+    ks = vs = None
+    if isinstance(kc, tuple):
+        kc, ks = kc
+    if isinstance(vc, tuple):
+        vc, vs = vc
     B, W = q.shape[:2]
     Hkv, Dh = kc.shape[2], kc.shape[3]
     qg = q.reshape(B, W, Hkv, n_rep, Dh)
-    logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, kc).astype(jnp.float32)
+    kin = kc if ks is None else kc.astype(q.dtype)  # int8 exact in bf16
+    logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, kin).astype(jnp.float32)
+    if ks is not None:
+        # [B, max_len, Hkv, 1] -> [B, g, 1, 1, k] against bgrqk.
+        logits = logits * ks[..., 0].transpose(0, 2, 1)[:, :, None, None]
     logits = logits / jnp.sqrt(Dh)
     rows = pos + jnp.arange(W)[:, None]                # [W, 1]
     cols = jnp.arange(max_len)[None, :]                # [1, max_len]
     logits = jnp.where((cols <= rows)[None, None, None], logits,
                        jnp.finfo(jnp.float32).min)
-    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bgrqk,bkgd->bqgrd", p, vc).reshape(
+    p = jax.nn.softmax(logits, axis=-1)
+    if vs is not None:
+        p = p * vs[..., 0].transpose(0, 2, 1)[:, :, None, None]
+    p = p.astype(q.dtype)
+    vin = vc if vs is None else vc.astype(q.dtype)
+    return jnp.einsum("bgrqk,bkgd->bqgrd", p, vin).reshape(
         B, W, Hkv * n_rep * Dh)
 
 
@@ -60,11 +86,13 @@ def decode_layer_scan(layers, x, kc_all, vc_all, pos, qkv_fn, attend_fn,
     With ``ksc_all``/``vsc_all`` ([L, B, max_len, H, 1] f32) the cache
     is INT8 (ops/kvquant.py): the fresh K/V vectors are quantized on
     write, the scale buffers ride the carry beside the code buffers,
-    and attend_fn receives dequantized layer slices — the attention
-    math never changes, only the HBM stream (the dequant fuses into the
-    einsum's operand read). Returns (x, kc, vc, ksc, vsc) then.
+    and attend_fn receives ``(codes, scales)`` tuples that
+    :func:`grouped_decode_attend` consumes without ever materializing
+    a dequantized cache (scale-on-scores factoring — see its
+    docstring for the r05 chip A/B that killed the dequant-first
+    design). Returns (x, kc, vc, ksc, vsc) then.
     """
-    from mpi_acx_tpu.ops.kvquant import kv_dequant, kv_quant
+    from mpi_acx_tpu.ops.kvquant import kv_quant
 
     n_layers = jax.tree.leaves(layers)[0].shape[0]
     quant = ksc_all is not None
@@ -90,12 +118,10 @@ def decode_layer_scan(layers, x, kc_all, vc_all, pos, qkv_fn, attend_fn,
         kc_l = lax.dynamic_index_in_dim(kc, i, 0, keepdims=False)
         vc_l = lax.dynamic_index_in_dim(vc, i, 0, keepdims=False)
         if quant:
-            kc_l = kv_dequant(
-                kc_l, lax.dynamic_index_in_dim(ksc, i, 0,
-                                               keepdims=False), x.dtype)
-            vc_l = kv_dequant(
-                vc_l, lax.dynamic_index_in_dim(vsc, i, 0,
-                                               keepdims=False), x.dtype)
+            kc_l = (kc_l, lax.dynamic_index_in_dim(ksc, i, 0,
+                                                   keepdims=False))
+            vc_l = (vc_l, lax.dynamic_index_in_dim(vsc, i, 0,
+                                                   keepdims=False))
         x = attend_fn(lp, x, q, kc_l, vc_l, pos)
         if quant:
             return (x, kc, vc, ksc, vsc), None

@@ -10,16 +10,23 @@ int8 codes halve the dominant term.
 Scheme: symmetric per-(position, head) scales — each cached K/V vector
 [head_dim] gets one f32 scale (amax/127), stored in a parallel
 [..., 1] buffer. Quantization happens at WRITE time (one new vector
-per step; the prompt bulk at prefill), dequantization at READ time
-inside the decode layer scan, where XLA fuses the int8->f32 convert +
-scale multiply into the attention einsum's operand read — HBM traffic
-is the int8 bytes plus the tiny scale vector.
+per step; the prompt bulk at prefill). At READ time the codes are NOT
+dequantized: decoding.grouped_decode_attend keeps the int8 buffers as
+the attention einsums' operands and applies K's scales to the logits
+and V's to the probabilities (scale-on-scores factoring). The first
+design dequantized the full cache slice before attending, betting XLA
+would fuse the convert+mul into the einsum's operand read the way it
+does for int8 weights (wquant.py) — the r05 chip A/B measured that at
+0.73x the bf16 baseline (XLA materializes the dequantized [B, S, H, D]
+tensor in HBM: int8 read + bf16 write + bf16 read), which is why the
+factored form is the only read path.
 
 Integration: decoding.decode_layer_scan carries the scale buffers and
 the per-family caches gain "ks"/"vs" entries (transformer.init_kv_cache
-/ llama.init_kv_cache with ``kv_int8=True``); attention math is
-unchanged — it sees dequantized slices. The reference has no serving
-stack (SURVEY.md SS0); this serves the framework goal's perf axis.
+/ llama.init_kv_cache with ``kv_int8=True``); attend_fns receive
+``(codes, scales)`` tuples that grouped_decode_attend consumes. The
+reference has no serving stack (SURVEY.md SS0); this serves the
+framework goal's perf axis.
 """
 
 from __future__ import annotations
@@ -38,6 +45,8 @@ def kv_quant(x: jax.Array):
 
 
 def kv_dequant(q: jax.Array, s: jax.Array, dtype) -> jax.Array:
-    """Reconstruct [..., D] in compute dtype; fused into the consuming
-    einsum's operand read under jit."""
+    """Reconstruct [..., D] in compute dtype. NOT on the decode hot
+    path (see module docstring — materializing this tensor was the
+    0.73x regression); kept as the scheme's reference reconstruction
+    for tests and offline use."""
     return (q.astype(jnp.float32) * s).astype(dtype)

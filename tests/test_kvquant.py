@@ -126,3 +126,26 @@ def test_int8_cache_halves_storage():
                    if k != "pos")
 
     assert nbytes(c8) < 0.6 * nbytes(c16), (nbytes(c8), nbytes(c16))
+
+
+def test_scale_on_scores_matches_dequant_attend():
+    """grouped_decode_attend with (codes, scales) tuples must compute
+    the same attention as explicit dequantize-then-attend — the tuple
+    path only re-factors the scale multiplies onto the logits/probs
+    (the r05 chip A/B showed materializing the dequantized cache is a
+    0.73x regression, so the factored path is the production one)."""
+    from mpi_acx_tpu.models.decoding import grouped_decode_attend
+
+    key = jax.random.key(3)
+    B, W, Hkv, n_rep, D, L = 2, 3, 2, 2, 16, 12
+    q = jax.random.normal(key, (B, W, Hkv * n_rep, D), jnp.float32)
+    kf = jax.random.normal(jax.random.key(4), (B, L, Hkv, D))
+    vf = jax.random.normal(jax.random.key(5), (B, L, Hkv, D))
+    kq, ks = kv_quant(kf)
+    vq, vs = kv_quant(vf)
+
+    want = grouped_decode_attend(q, kv_dequant(kq, ks, q.dtype),
+                                 kv_dequant(vq, vs, q.dtype), 4, L, n_rep)
+    got = grouped_decode_attend(q, (kq, ks), (vq, vs), 4, L, n_rep)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
