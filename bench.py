@@ -773,6 +773,23 @@ def main(full: bool = False):
         out["tpu_error"] = f"probe failed: {perr}"  # LOUD, never dropped
     elif fwd is None:
         out["tpu_error"] = errs["fwd"]
+    if "tpu_error" in out:
+        # Outage fallback: attach the committed BENCH_BANK.json rows,
+        # clearly labeled with when and on what code they were
+        # measured. Rounds 2-4 each ended with a tpu_error-only
+        # artifact while chip-measured evidence existed in the repo —
+        # the artifact should carry it rather than pretend none exists.
+        try:
+            with open(os.path.join(REPO, "BENCH_BANK.json")) as f:
+                _bankrows = json.load(f)
+        except Exception:  # noqa: BLE001 — no bank, nothing to attach
+            _bankrows = {}
+        rows = {k: {"value": v.get("value"), "ts": v.get("ts"),
+                    "rev": v.get("rev", "unrecorded")}
+                for k, v in _bankrows.items()
+                if isinstance(v, dict) and v.get("device") == "tpu"}
+        if rows:
+            out["banked_tpu_rows"] = rows
 
     checks = []
 
@@ -866,8 +883,8 @@ def main(full: bool = False):
         # (spec = B=1 + the trainings; specb = the batched while_loop,
         # reusing spec's cached trained params when warm).
         for name in ("spec", "specb"):
-            r = run_group(name, timeout=900)
-            if r is None and probe is not None:
+            run_group(name, timeout=900)
+            if name in errs:     # same convention as the gated groups
                 out[f"tpu_{name}_error"] = errs[name]
             write_full(partial=True)
         # Host-plane message-size sweep (p50/p99 per size) — native, no
