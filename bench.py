@@ -98,16 +98,22 @@ def _code_rev():
         return "unknown"
 
 
+def _load_bank() -> dict:
+    """BENCH_BANK.json as a dict; {} when absent or corrupt. The one
+    read path for the bank (banking, reuse, outage fallback)."""
+    try:
+        with open(os.path.join(REPO, "BENCH_BANK.json")) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001 — first run or corrupt file
+        return {}
+
+
 def _bank(rows: dict, group: str | None = None):
     """Merge measured rows into BENCH_BANK.json IMMEDIATELY (checked-in,
     append-only evidence: a 3-minute healthy tunnel window must survive a
     later crash/outage — round-4 verdict item #1)."""
     path = os.path.join(REPO, "BENCH_BANK.json")
-    try:
-        with open(path) as f:
-            bank = json.load(f)
-    except Exception:  # noqa: BLE001 — first run or corrupt file
-        bank = {}
+    bank = _load_bank()
     ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     rev = _code_rev()
     for k, v in rows.items():
@@ -134,12 +140,9 @@ def _bank_reuse(group: str):
     hours = float(os.environ.get("ACX_BANK_REUSE_H", "0") or 0)
     if hours <= 0:
         return None
-    try:
-        with open(os.path.join(REPO, "BENCH_BANK.json")) as f:
-            bank = json.load(f)
-    except Exception:  # noqa: BLE001 — no bank yet
-        return None
-    rows = {k: v for k, v in bank.items() if v.get("group") == group}
+    bank = _load_bank()
+    rows = {k: v for k, v in bank.items()
+            if isinstance(v, dict) and v.get("group") == group}
     if not rows:
         return None
     import calendar
@@ -773,23 +776,22 @@ def main(full: bool = False):
         out["tpu_error"] = f"probe failed: {perr}"  # LOUD, never dropped
     elif fwd is None:
         out["tpu_error"] = errs["fwd"]
-    if "tpu_error" in out:
-        # Outage fallback: attach the committed BENCH_BANK.json rows,
-        # clearly labeled with when and on what code they were
-        # measured. Rounds 2-4 each ended with a tpu_error-only
-        # artifact while chip-measured evidence existed in the repo —
-        # the artifact should carry it rather than pretend none exists.
-        try:
-            with open(os.path.join(REPO, "BENCH_BANK.json")) as f:
-                _bankrows = json.load(f)
-        except Exception:  # noqa: BLE001 — no bank, nothing to attach
-            _bankrows = {}
+    def attach_banked_rows():
+        """Outage fallback: attach the committed BENCH_BANK.json rows,
+        clearly labeled with when and on what code they were measured.
+        Rounds 2-4 each ended with a tpu_error-only artifact while
+        chip-measured evidence existed in the repo — the artifact
+        should carry it rather than pretend none exists. Called on ANY
+        recorded outage (probe-dead OR mid---full tunnel death)."""
         rows = {k: {"value": v.get("value"), "ts": v.get("ts"),
                     "rev": v.get("rev", "unrecorded")}
-                for k, v in _bankrows.items()
+                for k, v in _load_bank().items()
                 if isinstance(v, dict) and v.get("device") == "tpu"}
         if rows:
             out["banked_tpu_rows"] = rows
+
+    if "tpu_error" in out:
+        attach_banked_rows()
 
     checks = []
 
@@ -901,6 +903,8 @@ def main(full: bool = False):
         out["pingpong_sweep"] = sweep
         write_full(partial=False)
 
+    if errs:    # a mid-run outage is still an outage (review r05)
+        attach_banked_rows()
     print(json.dumps(out))
     if full and any(c["ok"] is False for c in checks):
         sys.exit(1)

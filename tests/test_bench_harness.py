@@ -181,3 +181,49 @@ def test_key_drift_is_a_failure_not_a_skip(bench):
     by = {c["metric"]: c for c in doc["checks"]}
     assert by["gpt2_fwd_tokens_per_s"]["ok"] is False
     assert "key drift" in by["gpt2_fwd_tokens_per_s"]["reason"]
+
+
+def test_bank_reuse_requires_same_code_rev(bench, monkeypatch):
+    """Reuse may stand in for a fresh measurement ONLY when the banked
+    rows carry the CURRENT code fingerprint — rows from older code
+    (or rows with none, e.g. pre-r05 banks) must re-measure."""
+    monkeypatch.setattr(bench, "_code_rev", lambda: "rev-live")
+    bench._bank({"decode_tokens_per_s": 5000.0, "device": "tpu"},
+                group="decode")
+    monkeypatch.setenv("ACX_BANK_REUSE_H", "18")
+    assert bench._bank_reuse("decode") == {"decode_tokens_per_s": 5000.0}
+
+    # Code changed since the row was banked -> refuse.
+    monkeypatch.setattr(bench, "_code_rev", lambda: "rev-changed")
+    assert bench._bank_reuse("decode") is None
+
+    # No fingerprint at all (legacy row) -> refuse.
+    bank_path = os.path.join(bench.REPO, "BENCH_BANK.json")
+    bank = json.load(open(bank_path))
+    del bank["decode_tokens_per_s"]["rev"]
+    json.dump(bank, open(bank_path, "w"))
+    monkeypatch.setattr(bench, "_code_rev", lambda: "rev-live")
+    assert bench._bank_reuse("decode") is None
+
+    # Reuse is opt-in: without the env the fresh row is never reused.
+    monkeypatch.delenv("ACX_BANK_REUSE_H")
+    bench._bank({"decode_tokens_per_s": 5000.0, "device": "tpu"},
+                group="decode")
+    assert bench._bank_reuse("decode") is None
+
+
+def test_outage_attaches_banked_rows(bench, capsys):
+    """A dead-tunnel run must still surface committed chip evidence:
+    the final JSON line carries every banked TPU row with provenance
+    instead of a tpu_error-only artifact (rounds 2-4 failure mode)."""
+    bench._bank({"gpt2_fwd_tokens_per_s": 250000.0, "device": "tpu"},
+                group="fwd")
+    bench._run_tpu_child = lambda mode, **kw: (None, "timeout (probe)")
+    assert _run_main(bench, full=False) == 0
+    last = [ln for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith("{")][-1]
+    out = json.loads(last)
+    assert "tpu_error" in out
+    row = out["banked_tpu_rows"]["gpt2_fwd_tokens_per_s"]
+    assert row["value"] == 250000.0
+    assert row["ts"] and row["rev"]
