@@ -103,7 +103,8 @@ def _load_bank() -> dict:
     read path for the bank (banking, reuse, outage fallback)."""
     try:
         with open(os.path.join(REPO, "BENCH_BANK.json")) as f:
-            return json.load(f)
+            bank = json.load(f)
+        return bank if isinstance(bank, dict) else {}
     except Exception:  # noqa: BLE001 — first run or corrupt file
         return {}
 
@@ -804,6 +805,11 @@ def main(full: bool = False):
         requires a recorded child failure for THAT row's source; a
         metric missing from a successful child (key drift), or a
         chip-INDEPENDENT child failing, still fails the gate."""
+        if "tpu_error" in out or errs:
+            # Keep the artifact self-contained on ANY outage shape:
+            # the banked evidence must be in BENCH_FULL.json itself,
+            # not only the stdout line (review r05).
+            attach_banked_rows()
         checks.clear()
 
         def gate(name, value, baseline, higher_is_better=True,
@@ -903,8 +909,6 @@ def main(full: bool = False):
         out["pingpong_sweep"] = sweep
         write_full(partial=False)
 
-    if errs:    # a mid-run outage is still an outage (review r05)
-        attach_banked_rows()
     print(json.dumps(out))
     if full and any(c["ok"] is False for c in checks):
         sys.exit(1)

@@ -1,0 +1,122 @@
+/* tpu-acx integration test: flag-table exhaustion end to end.
+ *
+ * SURVEY.md §4 lists "no slot-exhaustion test" among the reference's
+ * coverage gaps (its allocator FIXME at triggered.cpp:40-44 was never
+ * exercised at the API boundary). Here the table is shrunk to 8 slots
+ * (ACX_NFLAGS, set before MPIX_Init reads it), filled with pending
+ * receives, and the 9th enqueue must fail CLEANLY: nonzero return,
+ * request handed back as MPIX_REQUEST_NULL, no crash, no corruption of
+ * the 8 live ops. After the live ops complete, their slots must be
+ * reclaimed — a fresh enqueue succeeds and delivers.
+ *
+ * Ranks 2+ (the np=4 matrix row) idle through the barriers.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <unistd.h>
+#include <mpi.h>
+#include <mpi-acx.h>
+
+#define NSLOTS 8
+
+int main(int argc, char **argv) {
+    int provided, rank, size, errs = 0, i;
+
+    /* Must precede MPIX_Init, which sizes the table from the env. */
+    setenv("ACX_NFLAGS", "8", 1);
+
+    MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    if (MPIX_Init()) MPI_Abort(MPI_COMM_WORLD, 2);
+
+    if (rank == 0) {
+        int buf[NSLOTS + 1];
+        MPIX_Request req[NSLOTS + 1];
+        MPI_Status st;
+        cudaStream_t stream;
+        cudaStreamCreate(&stream);
+
+        /* Fill every slot with a pending receive from rank 1. */
+        for (i = 0; i < NSLOTS; i++) {
+            buf[i] = -1;
+            if (MPIX_Irecv_enqueue(&buf[i], 1, MPI_INT, 1, 30 + i,
+                                   MPI_COMM_WORLD, &req[i],
+                                   MPIX_QUEUE_XLA_STREAM,
+                                   &stream) != MPI_SUCCESS) {
+                printf("[0] enqueue %d failed with table not full\n", i);
+                errs++;
+            }
+        }
+
+        /* Table full: the next enqueue must fail loudly-but-cleanly. */
+        buf[NSLOTS] = -1;
+        req[NSLOTS] = (MPIX_Request)&errs;   /* poison: must be reset */
+        if (MPIX_Irecv_enqueue(&buf[NSLOTS], 1, MPI_INT, 1, 30 + NSLOTS,
+                               MPI_COMM_WORLD, &req[NSLOTS],
+                               MPIX_QUEUE_XLA_STREAM,
+                               &stream) == MPI_SUCCESS) {
+            printf("[0] enqueue past ACX_NFLAGS unexpectedly succeeded\n");
+            errs++;
+        }
+        if (req[NSLOTS] != MPIX_REQUEST_NULL) {
+            printf("[0] failed enqueue left a non-NULL request\n");
+            errs++;
+        }
+
+        MPI_Barrier(MPI_COMM_WORLD);        /* rank 1 sends the 8 */
+
+        for (i = 0; i < NSLOTS; i++) {
+            if (MPIX_Wait(&req[i], &st) != MPI_SUCCESS) errs++;
+            if (buf[i] != 100 + i) {
+                printf("[0] recv %d: got %d want %d\n", i, buf[i], 100 + i);
+                errs++;
+            }
+        }
+
+        /* Slots reclaimed: a fresh enqueue must succeed. Reclamation
+         * may ride the proxy sweep, so allow it a few milliseconds. */
+        {
+            int tries = 0, rc;
+            do {
+                rc = MPIX_Irecv_enqueue(&buf[NSLOTS], 1, MPI_INT, 1,
+                                        30 + NSLOTS, MPI_COMM_WORLD,
+                                        &req[NSLOTS],
+                                        MPIX_QUEUE_XLA_STREAM, &stream);
+                if (rc != MPI_SUCCESS) usleep(1000);
+            } while (rc != MPI_SUCCESS && ++tries < 2000);
+            if (rc != MPI_SUCCESS) {
+                printf("[0] enqueue after reclamation never succeeded\n");
+                errs++;
+            }
+        }
+        MPI_Barrier(MPI_COMM_WORLD);        /* rank 1 sends the last */
+        if (MPIX_Wait(&req[NSLOTS], &st) != MPI_SUCCESS) errs++;
+        if (buf[NSLOTS] != 100 + NSLOTS) {
+            printf("[0] post-reclaim recv: got %d want %d\n", buf[NSLOTS],
+                   100 + NSLOTS);
+            errs++;
+        }
+        cudaStreamDestroy(stream);
+    } else if (rank == 1) {
+        MPI_Barrier(MPI_COMM_WORLD);
+        for (i = 0; i < NSLOTS; i++) {
+            int v = 100 + i;
+            MPI_Send(&v, 1, MPI_INT, 0, 30 + i, MPI_COMM_WORLD);
+        }
+        MPI_Barrier(MPI_COMM_WORLD);
+        {
+            int v = 100 + NSLOTS;
+            MPI_Send(&v, 1, MPI_INT, 0, 30 + NSLOTS, MPI_COMM_WORLD);
+        }
+    } else {
+        MPI_Barrier(MPI_COMM_WORLD);
+        MPI_Barrier(MPI_COMM_WORLD);
+    }
+
+    MPI_Allreduce(MPI_IN_PLACE, &errs, 1, MPI_INT, MPI_MAX, MPI_COMM_WORLD);
+    MPIX_Finalize();
+    MPI_Finalize();
+    if (rank == 0 && errs == 0) printf("slot-exhaustion: OK\n");
+    return errs != 0;
+}

@@ -227,3 +227,27 @@ def test_outage_attaches_banked_rows(bench, capsys):
     row = out["banked_tpu_rows"]["gpt2_fwd_tokens_per_s"]
     assert row["value"] == 250000.0
     assert row["ts"] and row["rev"]
+
+
+def test_midrun_outage_artifact_carries_banked_rows(bench):
+    """Tunnel dies mid --full run: BENCH_FULL.json itself (not just the
+    stdout line) must carry the banked evidence."""
+    bench._bank({"decode_tokens_per_s": 6000.0, "device": "tpu"},
+                group="decode")
+    rows = {
+        "probe": {"tpu_probe_ok": True, "device": "tpu"},
+        "fwd": {"gpt2_fwd_tokens_per_s": 250000.0,
+                "gpt2_fwd_b16s512_tokens_per_s": 380000.0,
+                "device": "tpu"},
+    }
+
+    def fake_child(mode, attempts=3, timeout=420, **kw):
+        if mode in rows:
+            return rows[mode], None
+        return None, f"timeout after {timeout}s (attempt 1)"
+
+    bench._run_tpu_child = fake_child
+    assert _run_main(bench) == 0
+    doc = json.load(open(os.path.join(bench.REPO, "BENCH_FULL.json")))
+    banked = doc["result"]["banked_tpu_rows"]
+    assert banked["decode_tokens_per_s"]["value"] == 6000.0
