@@ -644,6 +644,64 @@ def tpu_child_specb():
     }))
 
 
+def tpu_child_serve():
+    """Child process: continuous batching (models/serving.py) vs static
+    batches on a mixed-output-length workload — the scheduling win the
+    serving tier exists for. 16 requests (prompt 32, n_new cycling
+    16/96/32/128) through 8 slots with chunk=32, against the same
+    requests run as two static B=8 generate() batches that each must
+    decode to their LONGEST member. Throughput counts only REQUESTED
+    tokens, so the static row pays for its padding honestly.
+    Informational — never regression-gated (the ratio depends on the
+    length mix)."""
+    import jax
+    import jax.numpy as jnp
+    from mpi_acx_tpu.models import serving
+    from mpi_acx_tpu.models import transformer as tfm
+
+    cfg = tfm.gpt2_small()
+    params = tfm.cast_params(tfm.init_params(jax.random.key(0), cfg),
+                             jnp.bfloat16)
+    S, chunk, n_slots = 32, 32, 8
+    lens = [16, 96, 32, 128] * 4                       # 16 requests
+    max_len = S + max(lens) + chunk
+    keys = jax.random.split(jax.random.key(1), len(lens))
+    prompts = [jax.random.randint(k, (S,), 0, cfg.vocab) for k in keys]
+
+    # Warm both compile caches outside the timed region — the serve
+    # warmup must run through the SAME server_fns the timed call uses
+    # (a bare serve_greedy call builds fresh jit closures every time).
+    fns = serving.make_server_fns(params, cfg, tfm, chunk=chunk)
+    serving.serve_greedy(params, cfg, prompts[:2], [chunk, chunk],
+                         n_slots=n_slots, max_len=max_len, family=tfm,
+                         chunk=chunk, server_fns=fns)
+    gen = jax.jit(lambda p, t: tfm.generate(p, cfg, t, max(lens),
+                                            max_len=max_len))
+    batch = jnp.stack(prompts[:n_slots])
+    jax.block_until_ready(gen(params, batch))
+
+    t0 = time.perf_counter()
+    serving.serve_greedy(params, cfg, prompts, lens, n_slots=n_slots,
+                         max_len=max_len, family=tfm, chunk=chunk,
+                         server_fns=fns)
+    t_cont = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for i in range(0, len(prompts), n_slots):
+        jax.block_until_ready(
+            gen(params, jnp.stack(prompts[i:i + n_slots])))
+    t_static = time.perf_counter() - t0
+
+    requested = sum(lens)
+    print(json.dumps({
+        "serve_cont_tokens_per_s": round(requested / t_cont, 1),
+        "serve_static_tokens_per_s": round(requested / t_static, 1),
+        "serve_speedup": round(t_static / t_cont, 2),
+        "serve_requests": len(lens),
+        "device": str(jax.devices()[0].platform),
+    }))
+
+
 def cpu_child_quant():
     """Child process (forced CPU, 8 virtual devices): wire-byte ratio of
     the int8-quantized ring all-reduce vs an f32 ring with the identical
@@ -890,7 +948,7 @@ def main(full: bool = False):
         # own children so a failure cannot cost the gated rows above
         # (spec = B=1 + the trainings; specb = the batched while_loop,
         # reusing spec's cached trained params when warm).
-        for name in ("spec", "specb"):
+        for name in ("spec", "specb", "serve"):
             run_group(name, timeout=900)
             if name in errs:     # same convention as the gated groups
                 out[f"tpu_{name}_error"] = errs[name]
@@ -929,6 +987,8 @@ if __name__ == "__main__":
         tpu_child_trainseg()
     elif "--tpu-child-train" in sys.argv:
         tpu_child_train()
+    elif "--tpu-child-serve" in sys.argv:
+        tpu_child_serve()
     elif "--tpu-child-specb" in sys.argv:
         tpu_child_specb()
     elif "--tpu-child-spec" in sys.argv:

@@ -1,0 +1,86 @@
+"""Continuous-batching serving demo (models/serving.py).
+
+Requests with different prompt and output lengths stream through a
+fixed pool of cache slots; finished requests are swapped out and queued
+prompts swapped in mid-stream, so the device never drains to wait for
+the longest request in a batch. Every output is bit-equal to the same
+request's solo generate() run (per-slot positions).
+
+Run (CPU):
+  JAX_PLATFORMS=cpu python examples/serve_continuous.py \
+      --requests 8 --slots 3 --chunk 4
+
+The reference has no serving stack (SURVEY.md §0) — this demonstrates
+framework-goal surface above it.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--family", choices=["gpt2", "llama"], default="gpt2")
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--slots", type=int, default=3)
+    ap.add_argument("--chunk", type=int, default=4,
+                    help="decode steps per host dispatch")
+    ap.add_argument("--verify", action="store_true",
+                    help="check every output against its solo run")
+    args = ap.parse_args()
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")  # wins over a pinned plugin
+
+    from mpi_acx_tpu.models import serving
+    if args.family == "gpt2":
+        from mpi_acx_tpu.models import transformer as mod
+        cfg = mod.tiny_config(vocab=96, d_model=64, n_heads=4,
+                              n_layers=3, d_ff=128, max_seq=128)
+    else:
+        from mpi_acx_tpu.models import llama as mod
+        cfg = mod.tiny_llama(vocab=96, d_model=64, n_heads=4,
+                             n_kv_heads=2, n_layers=3, d_ff=128,
+                             max_seq=128)
+    params = mod.init_params(jax.random.key(0), cfg)
+
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, cfg.vocab, rng.integers(3, 14),
+                            dtype=np.int32)
+               for _ in range(args.requests)]
+    n_new = [int(rng.integers(2, 12)) for _ in range(args.requests)]
+    max_len = 14 + max(n_new) + args.chunk + 1
+
+    t0 = time.perf_counter()
+    outs = serving.serve_greedy(params, cfg, prompts, n_new,
+                                n_slots=args.slots, max_len=max_len,
+                                family=mod, chunk=args.chunk)
+    dt = time.perf_counter() - t0
+    total = sum(n_new)
+    print(f"{args.requests} requests (lens "
+          f"{[len(p) for p in prompts]} -> +{n_new}) through "
+          f"{args.slots} slots, chunk={args.chunk}: "
+          f"{total} tokens in {dt:.2f}s")
+    for i, o in enumerate(outs[:3]):
+        print(f"req {i}: {o.tolist()}")
+
+    if args.verify:
+        for p, g, n in zip(prompts, outs, n_new):
+            want = mod.generate(params, cfg, jnp.asarray(p)[None], n,
+                                max_len=max_len)
+            np.testing.assert_array_equal(np.asarray(g),
+                                          np.asarray(want)[0])
+        print("all outputs equal their solo runs")
+    print("example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -36,3 +36,4 @@ from mpi_acx_tpu.models.speculative import (  # noqa: F401
     speculative_generate,
     speculative_sample,
 )
+from mpi_acx_tpu.models.serving import serve_greedy  # noqa: F401

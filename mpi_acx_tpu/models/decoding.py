@@ -54,10 +54,18 @@ def grouped_decode_attend(q, kc, vc, pos, max_len, n_rep):
         # [B, max_len, Hkv, 1] -> [B, g, 1, 1, k] against bgrqk.
         logits = logits * ks[..., 0].transpose(0, 2, 1)[:, :, None, None]
     logits = logits / jnp.sqrt(Dh)
-    rows = pos + jnp.arange(W)[:, None]                # [W, 1]
-    cols = jnp.arange(max_len)[None, :]                # [1, max_len]
-    logits = jnp.where((cols <= rows)[None, None, None], logits,
-                       jnp.finfo(jnp.float32).min)
+    pos = jnp.asarray(pos)
+    if pos.ndim == 0:
+        rows = pos + jnp.arange(W)[:, None]            # [W, 1]
+        cols = jnp.arange(max_len)[None, :]            # [1, max_len]
+        mask = (cols <= rows)[None, None, None]        # [1,1,1,W,max_len]
+    else:
+        # Per-slot positions (continuous-batching serving): slot b's
+        # window row w attends cache entries <= pos[b] + w.
+        rows = pos[:, None, None] + jnp.arange(W)[None, :, None]
+        cols = jnp.arange(max_len)[None, None, :]
+        mask = (cols <= rows)[:, None, None]       # [B,1,1,W,max_len]
+    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
     p = jax.nn.softmax(logits, axis=-1)
     if vs is not None:
         p = p * vs[..., 0].transpose(0, 2, 1)[:, :, None, None]
@@ -81,7 +89,10 @@ def decode_layer_scan(layers, x, kc_all, vc_all, pos, qkv_fn, attend_fn,
 
     qkv_fn(lp, x, pos) -> (q, k [B,1,H,D], v); attend_fn(lp, x, q, kc_l,
     vc_l, pos) -> x consumes the layer's UPDATED cache slices. Returns
-    (x, kc_all, vc_all).
+    (x, kc_all, vc_all). ``pos`` may be a scalar (every row at the same
+    position — the generate paths) or [B] (each slot at its own
+    position — continuous-batching serving, models/serving.py), in
+    which case the cache writes vmap per slot.
 
     With ``ksc_all``/``vsc_all`` ([L, B, max_len, H, 1] f32) the cache
     is INT8 (ops/kvquant.py): the fresh K/V vectors are quantized on
@@ -96,6 +107,21 @@ def decode_layer_scan(layers, x, kc_all, vc_all, pos, qkv_fn, attend_fn,
 
     n_layers = jax.tree.leaves(layers)[0].shape[0]
     quant = ksc_all is not None
+    pos = jnp.asarray(pos)
+    slotwise = pos.ndim == 1   # per-slot positions (serving.py)
+
+    def write(cache, fresh, i):
+        """Land fresh [B, 1, H, D] at this layer's write position(s):
+        one slice write at scalar pos, a vmapped per-slot write when
+        each slot sits at its own position."""
+        if not slotwise:
+            return lax.dynamic_update_slice(cache, fresh[None],
+                                            (i, 0, pos, 0, 0))
+        layer = lax.dynamic_index_in_dim(cache, i, 0, keepdims=False)
+        layer = jax.vmap(
+            lambda c, f, p: lax.dynamic_update_slice(c, f, (p, 0, 0)))(
+            layer, fresh, pos)
+        return lax.dynamic_update_index_in_dim(cache, layer, i, 0)
 
     def body(carry, i):
         if quant:
@@ -109,12 +135,10 @@ def decode_layer_scan(layers, x, kc_all, vc_all, pos, qkv_fn, attend_fn,
         if quant:
             k, ks = kv_quant(k)
             v, vs = kv_quant(v)
-            ksc = lax.dynamic_update_slice(ksc, ks[None],
-                                           (i, 0, pos, 0, 0))
-            vsc = lax.dynamic_update_slice(vsc, vs[None],
-                                           (i, 0, pos, 0, 0))
-        kc = lax.dynamic_update_slice(kc, k[None], (i, 0, pos, 0, 0))
-        vc = lax.dynamic_update_slice(vc, v[None], (i, 0, pos, 0, 0))
+            ksc = write(ksc, ks, i)
+            vsc = write(vsc, vs, i)
+        kc = write(kc, k, i)
+        vc = write(vc, v, i)
         kc_l = lax.dynamic_index_in_dim(kc, i, 0, keepdims=False)
         vc_l = lax.dynamic_index_in_dim(vc, i, 0, keepdims=False)
         if quant:

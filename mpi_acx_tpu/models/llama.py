@@ -262,11 +262,13 @@ def decode_step(params: Params, cfg: LlamaConfig, cache,
     The cache update runs through the shared carry-scan
     (decoding.decode_layer_scan): in-place updates, 1.9x faster decode
     on v5e than scan-ys stacking."""
-    pos = cache["pos"]
+    pos = jnp.asarray(cache["pos"])
     max_len = cache["k"].shape[2]
     n_rep = cfg.n_heads // cfg.n_kv_heads
     x = params["embed"][token][:, None, :].astype(cfg.dtype)
-    positions = jnp.full((1,), pos)
+    # Scalar pos -> shared position [1]; per-slot pos [B] (serving) ->
+    # [B, 1] so each slot's RoPE rotates by its own position.
+    positions = pos[:, None] if pos.ndim else jnp.full((1,), pos)
 
     def qkv_fn(lp, x, pos):
         return _qkv(cfg, lp, x, positions)               # k,v [B,1,Hkv,D]

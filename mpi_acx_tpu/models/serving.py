@@ -1,0 +1,219 @@
+"""Continuous-batching serving loop (single device).
+
+The reference has no serving stack at all (SURVEY.md §0); this module
+is the framework-goal tier above models/decoding.py. A static-batch
+server leaves slots idle from the moment their request finishes until
+the whole batch drains — at B slots and mixed output lengths that is a
+bubble of up to (B-1)/B of the work. Here B cache slots decode in
+lockstep as ONE jitted step while a host-side scheduler swaps finished
+requests out and queued prompts in mid-stream, so the device never
+waits for the slowest request.
+
+The mechanism is per-slot positions: decode_layer_scan's vector-pos
+mode writes each slot's fresh K/V at its own ``pos[b]`` and
+grouped_decode_attend masks each slot at ``cols <= pos[b]`` — every
+slot's math is exactly its solo run's (no left-padding, no shared
+clock), so greedy outputs are bit-equal to per-request generate()
+(tested). Prompts are right-padded to a power-of-two bucket for the
+prefill compile cache; pad rows are never attended (they sit past
+``pos[b]`` until overwritten by decode writes).
+
+Static shapes throughout: one compiled prefill per bucket length, one
+compiled decode step, one compiled slot-scatter — the host loop only
+schedules.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _bucket(n: int, lo: int = 8) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def make_server_fns(params, cfg, family, chunk: int = 1):
+    """Compile-once closures for the serve loop: (prefill_fn, step_fn,
+    scatter_fn). ``family`` is the model module (models.transformer,
+    models.llama, or models.moe_transformer — anything exposing
+    prefill/decode_step/init_kv_cache with the shared cache layout).
+
+    ``chunk`` > 1 runs that many greedy decode steps per host call as
+    one jitted lax.scan returning the [chunk, B] token block — the
+    scheduler then reacts every chunk tokens instead of every token,
+    amortizing the host->device dispatch (through a tunneled chip that
+    round trip is ~75 ms, dwarfing the ~2 ms step; even host-local it
+    is the difference between a driver-bound and a device-bound
+    server). The tokens are bit-identical to stepwise decoding; the
+    cost is scheduling granularity — a finished slot idles until the
+    chunk boundary."""
+    prefill_cache: Dict[int, object] = {}
+
+    def prefill_fn(tokens):          # [1, S_bucket] -> (logits, cache)
+        S = tokens.shape[1]
+        if S not in prefill_cache:
+            prefill_cache[S] = jax.jit(
+                lambda t, S=S: family.prefill(params, cfg, t, S,
+                                              last_only=False))
+        return prefill_cache[S](tokens)
+
+    # Donated carries: the loop always proceeds with the returned
+    # cache, so XLA may update the slot buffers in place (on CPU the
+    # donation is ignored, harmlessly).
+    if chunk == 1:
+        def step_fn(cache, tok):
+            logits, cache = family.decode_step(params, cfg, cache, tok)
+            return cache, jnp.argmax(logits, axis=-1)[None].astype(
+                jnp.int32)
+        step_fn = jax.jit(step_fn, donate_argnums=(0,))
+    else:
+        @partial(jax.jit, donate_argnums=(0,))
+        def step_fn(cache, tok):
+            def one(carry, _):
+                cache, tok = carry
+                logits, cache = family.decode_step(params, cfg, cache,
+                                                   tok)
+                nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+                return (cache, nxt), nxt
+            (cache, _), toks = lax.scan(one, (cache, tok), None,
+                                        length=chunk)
+            return cache, toks                       # [chunk, B]
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def scatter_fn(slots, one, slot_idx, new_pos):
+        """Land a freshly prefilled single-request cache (``one``, B=1,
+        bucket-length max_len) into slot ``slot_idx`` of the slot
+        cache; rows past the bucket keep the slot's old contents (never
+        attended: they lie beyond ``new_pos`` until decode overwrites
+        them)."""
+        for key in ("k", "v"):
+            src = one[key][:, 0]                    # [L, S_bucket, H, D]
+            dst = lax.dynamic_index_in_dim(
+                slots[key], slot_idx, 1, keepdims=False)  # [L, max_len,...]
+            dst = lax.dynamic_update_slice(
+                dst, src, (0, 0, 0, 0))
+            slots[key] = lax.dynamic_update_index_in_dim(
+                slots[key], dst, slot_idx, 1)
+        slots["pos"] = slots["pos"].at[slot_idx].set(new_pos)
+        return slots
+
+    return prefill_fn, step_fn, scatter_fn
+
+
+def serve_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
+                 n_slots: int, max_len: int, family=None,
+                 eos: Optional[int] = None, chunk: int = 1,
+                 server_fns=None) -> List[np.ndarray]:
+    """Serve ``prompts`` (1-D int arrays, any lengths) through
+    ``n_slots`` continuously-batched cache slots; each request decodes
+    greedily for ``n_new`` tokens (an int, or one per request — the
+    mixed-output-length workload is where continuous batching beats a
+    static batch) or until ``eos``. Returns, per request, ``prompt +
+    generated`` — bit-equal to that request's solo ``family.generate``
+    run (per-slot positions, see module docstring). ``chunk`` trades
+    scheduling granularity for host-dispatch amortization (see
+    make_server_fns); outputs are identical for any chunk. Pass
+    ``server_fns`` (a make_server_fns result for the same
+    params/cfg/family/chunk) to reuse compiled programs across calls —
+    a fresh call otherwise rebuilds its jit closures and re-traces.
+    """
+    if family is None:
+        from mpi_acx_tpu.models import transformer as family  # noqa: N813
+    assert prompts, "no requests"
+    n_new = ([int(n_new)] * len(prompts) if np.ndim(n_new) == 0
+             else [int(n) for n in n_new])
+    assert len(n_new) == len(prompts), (len(n_new), len(prompts))
+    assert all(n >= 1 for n in n_new), \
+        "n_new >= 1 per request (the prefill itself emits the first token)"
+    assert all(len(p) + n + chunk <= max_len
+               for p, n in zip(prompts, n_new)), \
+        "request (+ chunk overrun) exceeds max_len"
+    assert all(len(p) + n + chunk <= cfg.max_seq
+               for p, n in zip(prompts, n_new)), \
+        "request (+ chunk overrun) exceeds the model's position ceiling"
+
+    if server_fns is None:
+        server_fns = make_server_fns(params, cfg, family, chunk=chunk)
+    prefill_fn, step_fn, scatter_fn = server_fns
+
+    slots = family.init_kv_cache(cfg, n_slots, max_len)
+    assert "ks" not in slots, "int8 slot caches: not yet supported"
+    slots["pos"] = jnp.zeros((n_slots,), jnp.int32)
+
+    queue = deque(enumerate(np.asarray(p, np.int32) for p in prompts))
+    owner = [-1] * n_slots              # request id per slot (-1 idle)
+    emitted: List[List[int]] = [[] for _ in prompts]
+    done: List[Optional[np.ndarray]] = [None] * len(prompts)
+    last_tok = np.zeros((n_slots,), np.int32)
+
+    def refill(b):
+        rid, prompt = queue.popleft()
+        S = len(prompt)
+        # Bucket for the prefill compile cache, capped at max_len so
+        # the scatter's update always fits the slot buffer, and at the
+        # model's position ceiling (prefill asserts padded S <= max_seq).
+        padded = np.zeros((1, min(_bucket(S), max_len, cfg.max_seq)),
+                          np.int32)
+        padded[0, :S] = prompt
+        logits, one = prefill_fn(jnp.asarray(padded))
+        first = int(jnp.argmax(logits[0, S - 1]))
+        nonlocal slots
+        slots = scatter_fn(slots, one, b, S)
+        owner[b] = rid
+        emitted[rid].append(first)
+        last_tok[b] = first
+
+    def retire(b):
+        rid = owner[b]
+        done[rid] = np.concatenate(
+            [np.asarray(prompts[rid], np.int32),
+             np.asarray(emitted[rid], np.int32)])
+        owner[b] = -1
+
+    def slot_finished(b):
+        rid = owner[b]
+        return (len(emitted[rid]) >= n_new[rid]
+                or (eos is not None and emitted[rid]
+                    and emitted[rid][-1] == eos))
+
+    # Seed the slots, retiring 1-token requests on the spot so a slot
+    # never enters the decode loop already finished.
+    while queue and any(o < 0 for o in owner):
+        b = owner.index(-1)
+        refill(b)
+        if slot_finished(b):
+            retire(b)
+
+    while any(o >= 0 for o in owner):
+        slots, toks = step_fn(slots, jnp.asarray(last_tok))
+        block = np.asarray(toks, np.int32)           # [chunk, B]
+        for b in range(n_slots):
+            last_tok[b] = block[-1, b]
+            if owner[b] < 0:
+                continue
+            for c in range(block.shape[0]):
+                # A slot that finishes mid-chunk idles (its further
+                # tokens are valid continuations past the request's
+                # end — dropped); retire/refill happens only at chunk
+                # boundaries, the granularity ``chunk`` buys.
+                if slot_finished(b):
+                    break
+                emitted[owner[b]].append(int(block[c, b]))
+        for b in range(n_slots):
+            while owner[b] >= 0 and slot_finished(b):
+                retire(b)
+                if queue:
+                    refill(b)
+
+    assert all(d is not None for d in done)
+    return done

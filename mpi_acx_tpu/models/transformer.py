@@ -267,10 +267,13 @@ def decode_step(params: Params, cfg: TransformerConfig, cache,
     (decoding.decode_layer_scan) so XLA updates it in place — 1.9x
     faster decode on v5e than the scan-xs/ys structure."""
     ffn = ffn or _mlp
-    pos = cache["pos"]
+    pos = jnp.asarray(cache["pos"])
     max_len = cache["k"].shape[2]
-    x = (params["embed"][token][:, None, :]
-         + params["pos"][pos][None, None, :]).astype(cfg.dtype)
+    # Scalar pos: one learned position row for the whole batch; [B]
+    # pos (continuous-batching serving): each slot reads its own row.
+    pe = (params["pos"][pos][:, None, :] if pos.ndim
+          else params["pos"][pos][None, None, :])
+    x = (params["embed"][token][:, None, :] + pe).astype(cfg.dtype)
 
     def qkv_fn(lp, x, pos):
         return _qkv(cfg, lp, x)                        # [B, 1, H, Dh]
