@@ -33,6 +33,8 @@ def main():
     ap.add_argument("--slots", type=int, default=3)
     ap.add_argument("--chunk", type=int, default=4,
                     help="decode steps per host dispatch")
+    ap.add_argument("--int8-kv", action="store_true",
+                    help="serve from int8 slot caches (ops/kvquant.py)")
     ap.add_argument("--verify", action="store_true",
                     help="check every output against its solo run")
     args = ap.parse_args()
@@ -62,7 +64,8 @@ def main():
     t0 = time.perf_counter()
     outs = serving.serve_greedy(params, cfg, prompts, n_new,
                                 n_slots=args.slots, max_len=max_len,
-                                family=mod, chunk=args.chunk)
+                                family=mod, chunk=args.chunk,
+                                kv_int8=args.int8_kv)
     dt = time.perf_counter() - t0
     total = sum(n_new)
     print(f"{args.requests} requests (lens "
@@ -75,7 +78,7 @@ def main():
     if args.verify:
         for p, g, n in zip(prompts, outs, n_new):
             want = mod.generate(params, cfg, jnp.asarray(p)[None], n,
-                                max_len=max_len)
+                                max_len=max_len, kv_int8=args.int8_kv)
             np.testing.assert_array_equal(np.asarray(g),
                                           np.asarray(want)[0])
         print("all outputs equal their solo runs")

@@ -222,10 +222,12 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int,
 
 def prefill(params: Params, cfg: LlamaConfig, tokens: jax.Array,
             max_len: int, last_only: bool = False,
-            kv_int8: bool = False):
+            kv_int8: bool = False, last_index=None):
     """Prompt pass filling a fresh KV cache (layout: init_kv_cache).
     Prefill attention runs on the exact bf16 K/V; with ``kv_int8`` only
-    the CACHE entries are quantized."""
+    the CACHE entries are quantized. ``last_index`` (traced scalar):
+    unembed position ``last_index`` alone — bucket-padded serving
+    prompts (see transformer.prefill)."""
     B, S = tokens.shape
     assert S <= max_len and S <= cfg.max_seq, (S, max_len, cfg.max_seq)
     x = params["embed"][tokens].astype(cfg.dtype)
@@ -239,7 +241,9 @@ def prefill(params: Params, cfg: LlamaConfig, tokens: jax.Array,
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])
     x = rmsnorm(x, params["final_norm"])
-    if last_only:
+    if last_index is not None:
+        x = lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)
+    elif last_only:
         x = x[:, -1:]
     logits = jnp.einsum("bsd,vd->bsv", x, params["unembed"].astype(x.dtype),
                         preferred_element_type=jnp.float32)

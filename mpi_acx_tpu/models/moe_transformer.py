@@ -237,13 +237,14 @@ def init_kv_cache(cfg: MoeTransformerConfig, batch: int, max_len: int,
 
 def prefill(params: Dict[str, Any], cfg: MoeTransformerConfig,
             tokens: jax.Array, max_len: int, last_only: bool = False,
-            kv_int8: bool = False):
+            kv_int8: bool = False, last_index=None):
     """Prompt pass filling a fresh KV cache — the dense family's scaffold
     with the routed FFN plugged in (tfm.prefill's ``ffn`` hook). Routing
     capacity during prefill is per (B*S)-token batch, exactly as in
     forward."""
     return tfm.prefill(params, cfg, tokens, max_len, last_only,
-                       ffn=_moe_ffn, kv_int8=kv_int8)
+                       ffn=_moe_ffn, kv_int8=kv_int8,
+                       last_index=last_index)
 
 
 def decode_step(params: Dict[str, Any], cfg: MoeTransformerConfig, cache,

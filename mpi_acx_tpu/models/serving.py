@@ -42,7 +42,8 @@ def _bucket(n: int, lo: int = 8) -> int:
     return b
 
 
-def make_server_fns(params, cfg, family, chunk: int = 1):
+def make_server_fns(params, cfg, family, chunk: int = 1,
+                    kv_int8: bool = False):
     """Compile-once closures for the serve loop: (prefill_fn, step_fn,
     scatter_fn). ``family`` is the model module (models.transformer,
     models.llama, or models.moe_transformer — anything exposing
@@ -59,13 +60,18 @@ def make_server_fns(params, cfg, family, chunk: int = 1):
     chunk boundary."""
     prefill_cache: Dict[int, object] = {}
 
-    def prefill_fn(tokens):          # [1, S_bucket] -> (logits, cache)
+    def prefill_fn(tokens, last):
+        """[1, S_bucket], traced last index -> (logits [1,1,vocab],
+        cache). The unembedding runs on the real prompt's final row
+        alone (``last_index``): the full-bucket [1, S, vocab] logits —
+        ~1/3 of prefill FLOPs at GPT-2 vocab — are never computed."""
         S = tokens.shape[1]
         if S not in prefill_cache:
             prefill_cache[S] = jax.jit(
-                lambda t, S=S: family.prefill(params, cfg, t, S,
-                                              last_only=False))
-        return prefill_cache[S](tokens)
+                lambda t, li, S=S: family.prefill(params, cfg, t, S,
+                                                  kv_int8=kv_int8,
+                                                  last_index=li))
+        return prefill_cache[S](tokens, last)
 
     # Donated carries: the loop always proceeds with the returned
     # cache, so XLA may update the slot buffers in place (on CPU the
@@ -95,8 +101,9 @@ def make_server_fns(params, cfg, family, chunk: int = 1):
         bucket-length max_len) into slot ``slot_idx`` of the slot
         cache; rows past the bucket keep the slot's old contents (never
         attended: they lie beyond ``new_pos`` until decode overwrites
-        them)."""
-        for key in ("k", "v"):
+        them). Int8 slot caches carry their scale buffers ('ks'/'vs')
+        through the same per-key scatter."""
+        for key in [k for k in ("k", "v", "ks", "vs") if k in slots]:
             src = one[key][:, 0]                    # [L, S_bucket, H, D]
             dst = lax.dynamic_index_in_dim(
                 slots[key], slot_idx, 1, keepdims=False)  # [L, max_len,...]
@@ -107,13 +114,16 @@ def make_server_fns(params, cfg, family, chunk: int = 1):
         slots["pos"] = slots["pos"].at[slot_idx].set(new_pos)
         return slots
 
-    return prefill_fn, step_fn, scatter_fn
+    # kv_int8 rides along so serve_greedy can reject a mismatched
+    # reuse (int8 slots + bf16-prefill closures fail deep in a trace).
+    return prefill_fn, step_fn, scatter_fn, kv_int8
 
 
 def serve_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
                  n_slots: int, max_len: int, family=None,
                  eos: Optional[int] = None, chunk: int = 1,
-                 server_fns=None) -> List[np.ndarray]:
+                 server_fns=None,
+                 kv_int8: bool = False) -> List[np.ndarray]:
     """Serve ``prompts`` (1-D int arrays, any lengths) through
     ``n_slots`` continuously-batched cache slots; each request decodes
     greedily for ``n_new`` tokens (an int, or one per request — the
@@ -124,8 +134,13 @@ def serve_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
     scheduling granularity for host-dispatch amortization (see
     make_server_fns); outputs are identical for any chunk. Pass
     ``server_fns`` (a make_server_fns result for the same
-    params/cfg/family/chunk) to reuse compiled programs across calls —
-    a fresh call otherwise rebuilds its jit closures and re-traces.
+    params/cfg/family/chunk/kv_int8 — the int8 flag is checked) to
+    reuse compiled programs across calls — a fresh call otherwise
+    rebuilds its jit closures and re-traces.
+    ``kv_int8`` serves from int8 slot caches (ops/kvquant.py) — the
+    long-context regime where the cache stream dominates; outputs then
+    equal the solo ``generate(..., kv_int8=True)`` runs bit for bit
+    (same codes, same scales, same scale-on-scores read).
     """
     if family is None:
         from mpi_acx_tpu.models import transformer as family  # noqa: N813
@@ -143,11 +158,13 @@ def serve_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
         "request (+ chunk overrun) exceeds the model's position ceiling"
 
     if server_fns is None:
-        server_fns = make_server_fns(params, cfg, family, chunk=chunk)
-    prefill_fn, step_fn, scatter_fn = server_fns
+        server_fns = make_server_fns(params, cfg, family, chunk=chunk,
+                                     kv_int8=kv_int8)
+    prefill_fn, step_fn, scatter_fn, fns_int8 = server_fns
+    assert fns_int8 == kv_int8, \
+        "server_fns built with a different kv_int8 than this call"
 
-    slots = family.init_kv_cache(cfg, n_slots, max_len)
-    assert "ks" not in slots, "int8 slot caches: not yet supported"
+    slots = family.init_kv_cache(cfg, n_slots, max_len, kv_int8=kv_int8)
     slots["pos"] = jnp.zeros((n_slots,), jnp.int32)
 
     queue = deque(enumerate(np.asarray(p, np.int32) for p in prompts))
@@ -165,8 +182,8 @@ def serve_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
         padded = np.zeros((1, min(_bucket(S), max_len, cfg.max_seq)),
                           np.int32)
         padded[0, :S] = prompt
-        logits, one = prefill_fn(jnp.asarray(padded))
-        first = int(jnp.argmax(logits[0, S - 1]))
+        logits, one = prefill_fn(jnp.asarray(padded), S - 1)
+        first = int(jnp.argmax(logits[0, 0]))
         nonlocal slots
         slots = scatter_fn(slots, one, b, S)
         owner[b] = rid

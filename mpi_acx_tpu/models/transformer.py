@@ -216,13 +216,16 @@ def _mlp(cfg: TransformerConfig, lp: Params, x: jax.Array):
 
 def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
             max_len: int, last_only: bool = False, ffn=None,
-            kv_int8: bool = False):
+            kv_int8: bool = False, last_index=None):
     """Run the prompt through the model, filling a fresh KV cache.
 
     tokens [B, S] -> (logits [B, S, vocab] f32, cache with pos=S).
     With ``last_only`` the unembedding runs on the final position alone
     (logits [B, 1, vocab]) — for generation, which discards the rest,
     this skips ~1/3 of prefill FLOPs and the [B, S, vocab] materialization.
+    ``last_index`` (traced scalar) generalizes it to "the unembedding
+    runs on position ``last_index`` alone" — for bucket-padded prompts
+    (models/serving.py) whose real last token is not the last row.
 
     ``ffn(cfg, lp, x) -> x`` overrides the block's feed-forward half
     (default :func:`_mlp`); the MoE family reuses this whole scaffold
@@ -245,7 +248,9 @@ def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])
     x = layernorm(x, params["lnf_g"], params["lnf_b"])
-    if last_only:
+    if last_index is not None:
+        x = lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)
+    elif last_only:
         x = x[:, -1:]
     logits = jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(x.dtype),
                         preferred_element_type=jnp.float32)

@@ -154,3 +154,21 @@ def test_per_request_n_new():
         want = mod.generate(params, cfg, jnp.asarray(p)[None], n,
                             max_len=max_len)
         np.testing.assert_array_equal(np.asarray(g), np.asarray(want)[0])
+
+
+@pytest.mark.parametrize("fam", [_gpt2, _llama, _moe],
+                         ids=["gpt2", "llama", "moe"])
+def test_int8_slots_equal_int8_solo(fam):
+    """Continuous batching over int8 slot caches: same codes, same
+    scales, same scale-on-scores read as the solo kv_int8 run — so
+    outputs must be bit-equal to generate(..., kv_int8=True)."""
+    cfg, params, mod = fam()
+    n_new, max_len = 5, 32
+    prompts = _prompts(jax.random.key(10), 5, cfg.vocab, lens=[4, 9, 6])
+    got = serving.serve_greedy(params, cfg, prompts, n_new, n_slots=2,
+                               max_len=max_len, family=mod, chunk=2,
+                               kv_int8=True)
+    for p, g in zip(prompts, got):
+        want = mod.generate(params, cfg, jnp.asarray(p)[None], n_new,
+                            max_len=max_len, kv_int8=True)
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(want)[0])
