@@ -36,4 +36,7 @@ from mpi_acx_tpu.models.speculative import (  # noqa: F401
     speculative_generate,
     speculative_sample,
 )
-from mpi_acx_tpu.models.serving import serve_greedy  # noqa: F401
+from mpi_acx_tpu.models.serving import (  # noqa: F401
+    serve_greedy,
+    serve_sample,
+)

@@ -43,21 +43,30 @@ def _bucket(n: int, lo: int = 8) -> int:
 
 
 def make_server_fns(params, cfg, family, chunk: int = 1,
-                    kv_int8: bool = False):
-    """Compile-once closures for the serve loop: (prefill_fn, step_fn,
-    scatter_fn). ``family`` is the model module (models.transformer,
-    models.llama, or models.moe_transformer — anything exposing
+                    kv_int8: bool = False, sample_cfg=None):
+    """Compile-once closures for the serve loop: returns (prefill_fn,
+    step_fn, scatter_fn, kv_int8, sample_cfg) — the trailing flags let
+    serve_greedy/serve_sample verify a reused tuple matches the call.
+    ``family`` is the model module (models.transformer, models.llama,
+    or models.moe_transformer — anything exposing
     prefill/decode_step/init_kv_cache with the shared cache layout).
 
-    ``chunk`` > 1 runs that many greedy decode steps per host call as
-    one jitted lax.scan returning the [chunk, B] token block — the
+    ``chunk`` > 1 runs that many decode steps per host call as one
+    jitted lax.scan returning the [chunk, B] token block — the
     scheduler then reacts every chunk tokens instead of every token,
     amortizing the host->device dispatch (through a tunneled chip that
     round trip is ~75 ms, dwarfing the ~2 ms step; even host-local it
     is the difference between a driver-bound and a device-bound
     server). The tokens are bit-identical to stepwise decoding; the
     cost is scheduling granularity — a finished slot idles until the
-    chunk boundary."""
+    chunk boundary.
+
+    ``sample_cfg`` = (temperature, top_k, top_p) switches the step from
+    greedy argmax to stochastic sampling: the step then carries a [B]
+    per-slot key array and each slot draws with ITS OWN key per step,
+    split exactly as decoding.sample_generate splits its single key —
+    that discipline is what makes serve_sample's outputs equal the solo
+    sampled runs."""
     prefill_cache: Dict[int, object] = {}
 
     def prefill_fn(tokens, last):
@@ -73,27 +82,37 @@ def make_server_fns(params, cfg, family, chunk: int = 1,
                                                   last_index=li))
         return prefill_cache[S](tokens, last)
 
+    if sample_cfg is None:
+        def pick(logits, keys):      # greedy: keys unused, pass-through
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), keys
+    else:
+        from mpi_acx_tpu.models.decoding import sample_logits
+        temperature, top_k, top_p = sample_cfg
+
+        def pick(logits, keys):
+            # Mirror sample_generate: key, sub = split(key); draw with
+            # sub — per slot, so slot b's stream equals the solo run's.
+            splits = jax.vmap(jax.random.split)(keys)
+            keys, subs = splits[:, 0], splits[:, 1]
+            tok = jax.vmap(
+                lambda lg, k: sample_logits(lg[None].astype(jnp.float32),
+                                            k, temperature, top_k,
+                                            top_p)[0])(logits, subs)
+            return tok.astype(jnp.int32), keys
+
     # Donated carries: the loop always proceeds with the returned
     # cache, so XLA may update the slot buffers in place (on CPU the
     # donation is ignored, harmlessly).
-    if chunk == 1:
-        def step_fn(cache, tok):
+    @partial(jax.jit, donate_argnums=(0,))
+    def step_fn(cache, tok, keys):
+        def one(carry, _):
+            cache, tok, keys = carry
             logits, cache = family.decode_step(params, cfg, cache, tok)
-            return cache, jnp.argmax(logits, axis=-1)[None].astype(
-                jnp.int32)
-        step_fn = jax.jit(step_fn, donate_argnums=(0,))
-    else:
-        @partial(jax.jit, donate_argnums=(0,))
-        def step_fn(cache, tok):
-            def one(carry, _):
-                cache, tok = carry
-                logits, cache = family.decode_step(params, cfg, cache,
-                                                   tok)
-                nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
-                return (cache, nxt), nxt
-            (cache, _), toks = lax.scan(one, (cache, tok), None,
-                                        length=chunk)
-            return cache, toks                       # [chunk, B]
+            nxt, keys = pick(logits, keys)
+            return (cache, nxt, keys), nxt
+        (cache, _, keys), toks = lax.scan(one, (cache, tok, keys), None,
+                                          length=chunk)
+        return cache, toks, keys                     # toks [chunk, B]
 
     @partial(jax.jit, donate_argnums=(0,))
     def scatter_fn(slots, one, slot_idx, new_pos):
@@ -114,34 +133,21 @@ def make_server_fns(params, cfg, family, chunk: int = 1,
         slots["pos"] = slots["pos"].at[slot_idx].set(new_pos)
         return slots
 
-    # kv_int8 rides along so serve_greedy can reject a mismatched
-    # reuse (int8 slots + bf16-prefill closures fail deep in a trace).
-    return prefill_fn, step_fn, scatter_fn, kv_int8
+    # kv_int8/sample_cfg ride along so the serve entry points can
+    # reject a mismatched reuse (e.g. int8 slots + bf16-prefill
+    # closures, or a step jitted with different sampling params, fail
+    # deep in a trace — or worse, silently — otherwise).
+    return prefill_fn, step_fn, scatter_fn, kv_int8, sample_cfg
 
 
-def serve_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
-                 n_slots: int, max_len: int, family=None,
-                 eos: Optional[int] = None, chunk: int = 1,
-                 server_fns=None,
-                 kv_int8: bool = False) -> List[np.ndarray]:
-    """Serve ``prompts`` (1-D int arrays, any lengths) through
-    ``n_slots`` continuously-batched cache slots; each request decodes
-    greedily for ``n_new`` tokens (an int, or one per request — the
-    mixed-output-length workload is where continuous batching beats a
-    static batch) or until ``eos``. Returns, per request, ``prompt +
-    generated`` — bit-equal to that request's solo ``family.generate``
-    run (per-slot positions, see module docstring). ``chunk`` trades
-    scheduling granularity for host-dispatch amortization (see
-    make_server_fns); outputs are identical for any chunk. Pass
-    ``server_fns`` (a make_server_fns result for the same
-    params/cfg/family/chunk/kv_int8 — the int8 flag is checked) to
-    reuse compiled programs across calls — a fresh call otherwise
-    rebuilds its jit closures and re-traces.
-    ``kv_int8`` serves from int8 slot caches (ops/kvquant.py) — the
-    long-context regime where the cache stream dominates; outputs then
-    equal the solo ``generate(..., kv_int8=True)`` runs bit for bit
-    (same codes, same scales, same scale-on-scores read).
-    """
+def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
+           chunk, server_fns, kv_int8, sample_cfg, key):
+    """The scheduler shared by serve_greedy and serve_sample — queue,
+    slot ownership, chunk-block consumption, retire/refill. Sampling
+    only changes (a) how the step picks tokens (make_server_fns
+    sample_cfg) and (b) the first token at refill, drawn on the host
+    with request rid's own key stream fold_in(key, rid), split exactly
+    as decoding.sample_generate splits."""
     if family is None:
         from mpi_acx_tpu.models import transformer as family  # noqa: N813
     assert prompts, "no requests"
@@ -159,10 +165,14 @@ def serve_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
 
     if server_fns is None:
         server_fns = make_server_fns(params, cfg, family, chunk=chunk,
-                                     kv_int8=kv_int8)
-    prefill_fn, step_fn, scatter_fn, fns_int8 = server_fns
+                                     kv_int8=kv_int8,
+                                     sample_cfg=sample_cfg)
+    prefill_fn, step_fn, scatter_fn, fns_int8, fns_sample = server_fns
     assert fns_int8 == kv_int8, \
         "server_fns built with a different kv_int8 than this call"
+    assert fns_sample == sample_cfg, \
+        ("server_fns built for different sampling settings "
+         f"({fns_sample} vs {sample_cfg})")
 
     slots = family.init_kv_cache(cfg, n_slots, max_len, kv_int8=kv_int8)
     slots["pos"] = jnp.zeros((n_slots,), jnp.int32)
@@ -172,8 +182,12 @@ def serve_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
     emitted: List[List[int]] = [[] for _ in prompts]
     done: List[Optional[np.ndarray]] = [None] * len(prompts)
     last_tok = np.zeros((n_slots,), np.int32)
+    # Per-slot key streams (greedy: dummies the step passes through).
+    keys = jax.random.split(key if key is not None else jax.random.key(0),
+                            n_slots)
 
     def refill(b):
+        nonlocal slots, keys
         rid, prompt = queue.popleft()
         S = len(prompt)
         # Bucket for the prefill compile cache, capped at max_len so
@@ -183,8 +197,15 @@ def serve_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
                           np.int32)
         padded[0, :S] = prompt
         logits, one = prefill_fn(jnp.asarray(padded), S - 1)
-        first = int(jnp.argmax(logits[0, 0]))
-        nonlocal slots
+        if sample_cfg is None:
+            first = int(jnp.argmax(logits[0, 0]))
+        else:
+            from mpi_acx_tpu.models.decoding import sample_logits
+            rkey, sub = jax.random.split(jax.random.fold_in(key, rid))
+            first = int(sample_logits(
+                logits[0, 0][None].astype(jnp.float32), sub,
+                *sample_cfg)[0])
+            keys = keys.at[b].set(rkey)
         slots = scatter_fn(slots, one, b, S)
         owner[b] = rid
         emitted[rid].append(first)
@@ -212,7 +233,7 @@ def serve_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
             retire(b)
 
     while any(o >= 0 for o in owner):
-        slots, toks = step_fn(slots, jnp.asarray(last_tok))
+        slots, toks, keys = step_fn(slots, jnp.asarray(last_tok), keys)
         block = np.asarray(toks, np.int32)           # [chunk, B]
         for b in range(n_slots):
             last_tok[b] = block[-1, b]
@@ -234,3 +255,52 @@ def serve_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
 
     assert all(d is not None for d in done)
     return done
+
+
+def serve_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
+                 n_slots: int, max_len: int, family=None,
+                 eos: Optional[int] = None, chunk: int = 1,
+                 server_fns=None,
+                 kv_int8: bool = False) -> List[np.ndarray]:
+    """Serve ``prompts`` (1-D int arrays, any lengths) through
+    ``n_slots`` continuously-batched cache slots; each request decodes
+    greedily for ``n_new`` tokens (an int, or one per request — the
+    mixed-output-length workload is where continuous batching beats a
+    static batch) or until ``eos``. Returns, per request, ``prompt +
+    generated`` — bit-equal to that request's solo ``family.generate``
+    run (per-slot positions, see module docstring). ``chunk`` trades
+    scheduling granularity for host-dispatch amortization (see
+    make_server_fns); outputs are identical for any chunk. Pass
+    ``server_fns`` (a make_server_fns result for the same
+    params/cfg/family/chunk/kv_int8 — the flags are checked) to reuse
+    compiled programs across calls — a fresh call otherwise rebuilds
+    its jit closures and re-traces.
+    ``kv_int8`` serves from int8 slot caches (ops/kvquant.py) — the
+    long-context regime where the cache stream dominates; outputs then
+    equal the solo ``generate(..., kv_int8=True)`` runs bit for bit
+    (same codes, same scales, same scale-on-scores read).
+    """
+    return _serve(params, cfg, prompts, n_new, n_slots, max_len, family,
+                  eos, chunk, server_fns, kv_int8, None, None)
+
+
+def serve_sample(params, cfg, prompts: Sequence[np.ndarray], n_new,
+                 n_slots: int, max_len: int, key, family=None,
+                 temperature: float = 1.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 eos: Optional[int] = None, chunk: int = 1,
+                 server_fns=None,
+                 kv_int8: bool = False) -> List[np.ndarray]:
+    """Stochastic continuous batching (temperature / top-k / top-p).
+
+    Request ``rid`` draws from its own key stream
+    ``jax.random.fold_in(key, rid)`` with exactly
+    decoding.sample_generate's split discipline, so each output equals
+    the solo ``family.generate_sample(prompt, n,
+    key=jax.random.fold_in(key, rid), ...)`` run bit for bit — the
+    scheduler (slot assignment, refill order, chunking) cannot perturb
+    any request's sample path. All other parameters as serve_greedy.
+    """
+    return _serve(params, cfg, prompts, n_new, n_slots, max_len, family,
+                  eos, chunk, server_fns, kv_int8,
+                  (temperature, top_k, top_p), key)

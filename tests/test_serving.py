@@ -172,3 +172,40 @@ def test_int8_slots_equal_int8_solo(fam):
         want = mod.generate(params, cfg, jnp.asarray(p)[None], n_new,
                             max_len=max_len, kv_int8=True)
         np.testing.assert_array_equal(np.asarray(g), np.asarray(want)[0])
+
+
+def test_int8_weight_checkpoint_serves():
+    """Weight-only int8 checkpoints (ops/wquant.py) flow through the
+    serving tier transparently — every slot op reads weights via
+    wread — and outputs equal the solo quantized runs."""
+    from mpi_acx_tpu.ops.wquant import GPT2_WEIGHTS, quantize_weights_int8
+    cfg, params, mod = _gpt2()
+    qparams = quantize_weights_int8(params, GPT2_WEIGHTS)
+    prompts = _prompts(jax.random.key(11), 4, cfg.vocab, lens=[5, 8])
+    got = serving.serve_greedy(qparams, cfg, prompts, 4, n_slots=2,
+                               max_len=24, family=mod, chunk=2)
+    for p, g in zip(prompts, got):
+        want = mod.generate(qparams, cfg, jnp.asarray(p)[None], 4,
+                            max_len=24)
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(want)[0])
+
+
+def test_serve_sample_equals_solo_sampled_runs():
+    """Stochastic serving: request rid's key stream is
+    fold_in(key, rid) with sample_generate's split discipline, so each
+    output must equal the solo generate_sample run under that key —
+    regardless of slot assignment, refill order, or chunking."""
+    cfg, params, mod = _gpt2()
+    n_new, max_len = 5, 40
+    base = jax.random.key(42)
+    prompts = _prompts(jax.random.key(12), 6, cfg.vocab, lens=[4, 7, 10])
+    got = serving.serve_sample(params, cfg, prompts, n_new, n_slots=2,
+                               max_len=max_len, key=base, family=mod,
+                               temperature=0.9, top_k=17, chunk=3)
+    for rid, (p, g) in enumerate(zip(prompts, got)):
+        want = mod.generate_sample(params, cfg, jnp.asarray(p)[None],
+                                   n_new, jax.random.fold_in(base, rid),
+                                   temperature=0.9, top_k=17,
+                                   max_len=max_len)
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(want)[0],
+                                      err_msg=f"request {rid}")
