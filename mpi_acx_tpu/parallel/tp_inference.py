@@ -126,7 +126,7 @@ def _specs_with_scales(specs, scale_keys: frozenset, scale_specs: dict,
 
 
 def _tp_program_cache(mesh, per_shard, param_slots, data_specs,
-                      out_specs):
+                      out_specs, donate_argnums=()):
     """THE scale-keyed program cache every TP builder uses: one
     compiled shard_map program per tuple of int8 scale-key sets, so
     quantized and plain checkpoints (whose pytrees differ) share the
@@ -134,8 +134,11 @@ def _tp_program_cache(mesh, per_shard, param_slots, data_specs,
 
     ``param_slots``: one (base_specs, scale_specs, shard_fn, cfg,
     where) per leading parameter-tree argument of ``per_shard``; the
-    remaining arguments use ``data_specs``. Returns a plain callable
-    ``fn(*param_trees, *data)``."""
+    remaining arguments use ``data_specs``. ``donate_argnums`` (indices
+    into the combined ``(*param_trees, *data)`` argument list) lets a
+    carry-style caller donate its buffers — TP serving donates the slot
+    caches so each chunk updates them in place. Returns a plain
+    callable ``fn(*param_trees, *data)``."""
     n = len(param_slots)
     cache: dict = {}
 
@@ -155,7 +158,8 @@ def _tp_program_cache(mesh, per_shard, param_slots, data_specs,
                            for slot, p in zip(param_slots, a[:n]))
                 return _inner(*pt, *a[n:])
 
-            fn = cache[key] = jax.jit(run)
+            fn = cache[key] = jax.jit(run,
+                                      donate_argnums=donate_argnums)
         return fn(*args)
 
     return call
@@ -627,7 +631,8 @@ def _tp_family_ops(cfg, tp: int, axis: str, ffn=None):
             return mlp(lp, out_proj(lp, o, x))
         return attend_fn
 
-    def prefill(params, _cfg, tokens, cap, last_only=True):
+    def prefill(params, _cfg, tokens, cap, last_only=True,
+                last_index=None):
         x = embed(params, tokens)
 
         def pl(x, lp):
@@ -636,16 +641,24 @@ def _tp_family_ops(cfg, tp: int, axis: str, ffn=None):
             return mlp(lp, out_proj(lp, o, x)), (k_, v_)
 
         x, (ks, vs) = lax.scan(pl, x, params["layers"])
-        logits = finish(params, x[:, -1:] if last_only else x)
+        if last_index is not None:     # traced: bucket-padded serving
+            x = lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)
+        elif last_only:
+            x = x[:, -1:]
+        logits = finish(params, x)
         kc, vc = _init_kv_from_prefill(ks, vs, cap)
         return logits, {"k": kc, "v": vc,
                         "pos": jnp.asarray(tokens.shape[1], jnp.int32)}
 
     def decode(params, _cfg, cache, tok):
-        pos = cache["pos"]
+        pos = jnp.asarray(cache["pos"])
         max_len = cache["k"].shape[2]
+        # Scalar pos (generation/speculative) or [B] per-slot positions
+        # (continuous-batching serving) — as transformer.decode_step.
+        pe = params["pos"][pos]
         x = (params["embed"][tok][:, None, :]
-             + params["pos"][pos][None, None, :]).astype(cfg.dtype)
+             + (pe[:, None, :] if pos.ndim else pe[None, None, :])
+             ).astype(cfg.dtype)
         x, kc, vc = decode_layer_scan(
             params["layers"], x, cache["k"], cache["v"], pos, qkv_fn,
             make_attend(max_len))
@@ -861,3 +874,108 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
         return toks, {"rounds": rounds, "drafted_accepted": acc}
 
     return generate
+
+
+# -- Tensor-parallel CONTINUOUS BATCHING (models/serving.py contract) ------
+
+
+def make_tp_server_fns(params, cfg, mesh: Mesh, max_len: int,
+                       chunk: int = 1, axis: str = "tp"):
+    """Server-fns tuple for models.serving._serve whose three programs
+    run tensor-parallel over the mesh: continuous batching composes
+    with the Megatron weight split. Each slot's KV cache shards by
+    attention head (the same [L, B, max_len, H, D] layout with H on
+    ``axis``); per-slot positions ride the shared decode scaffold's
+    vector-pos mode unchanged, so outputs remain bit-equal to the
+    single-device serve_greedy's (and hence to solo generate) while
+    every decode step streams 1/tp of the weights per rank.
+
+    GPT-2 dense family (MoE rides the same scaffold via
+    _tp_family_ops' ffn hook if needed), greedy, bf16 caches (the TP
+    cache layout has no int8 variant yet). Use::
+
+        fns = make_tp_server_fns(params, cfg, mesh, max_len, chunk=8)
+        outs = serving.serve_greedy(params, cfg, prompts, n_new,
+                                    n_slots, max_len, family=tfm,
+                                    chunk=8, server_fns=fns)
+
+    int8 WEIGHT checkpoints work (the scale-keyed program cache +
+    wread, exactly as make_tp_generate).
+    """
+    tp = mesh.shape[axis]
+    # Reuse the speculative core's per-shard family ops — prefill with
+    # a traced last_index, decode with vector pos — so the TP layer
+    # wiring lives once (_tp_family_ops), not per builder.
+    ops_prefill, _, ops_decode = _tp_family_ops(cfg, tp, axis)
+    specs = tp_param_specs(axis)
+    scale_specs = _gpt2_scale_specs(axis)
+    cspec = P(None, None, None, axis, None)
+
+    def per_shard_prefill(params, tokens, last):
+        # The 'one' cache is bucket-length: the scatter lands rows
+        # [0, S_bucket) into the slot (serving.scatter_fn contract).
+        logits, cache = ops_prefill(params, cfg, tokens,
+                                    cap=tokens.shape[1],
+                                    last_index=last)
+        return logits, cache["k"], cache["v"]
+
+    prefill_prog = _tp_program_cache(
+        mesh, per_shard_prefill,
+        [(specs, scale_specs, tp_shard_params, cfg, "TP serving")],
+        (P(), P()), (P(), cspec, cspec))
+
+    def per_shard_step(params, kc, vc, pos, tok):
+        def one(carry, _):
+            kc, vc, pos, tok = carry
+            logits, cache = ops_decode(params, cfg,
+                                       {"k": kc, "v": vc, "pos": pos},
+                                       tok)
+            nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+            return (cache["k"], cache["v"], cache["pos"], nxt), nxt
+
+        (kc, vc, pos, _), toks = lax.scan(one, (kc, vc, pos, tok),
+                                          None, length=chunk)
+        return kc, vc, pos, toks
+
+    # Donate the slot caches (run's args 1-3 after the params tree):
+    # the host loop always proceeds with the returned slots, and a
+    # non-donated [L, B, max_len, H, D] pair would cost a full-cache
+    # copy per chunk on top of doubled peak memory.
+    step_prog = _tp_program_cache(
+        mesh, per_shard_step,
+        [(specs, scale_specs, tp_shard_params, cfg, "TP serving")],
+        (cspec, cspec, P(), P()), (cspec, cspec, P(), P()),
+        donate_argnums=(1, 2, 3))
+
+    def per_shard_scatter(kc, vc, one_k, one_v, slot_idx, new_pos, pos):
+        def land(cache, src):
+            dst = lax.dynamic_index_in_dim(cache, slot_idx, 1,
+                                           keepdims=False)
+            dst = lax.dynamic_update_slice(dst, src[:, 0], (0, 0, 0, 0))
+            return lax.dynamic_update_index_in_dim(cache, dst,
+                                                   slot_idx, 1)
+        return (land(kc, one_k), land(vc, one_v),
+                pos.at[slot_idx].set(new_pos))
+
+    scatter_prog = jax.jit(shard_map(
+        per_shard_scatter, mesh=mesh,
+        in_specs=(cspec, cspec, cspec, cspec, P(), P(), P()),
+        out_specs=(cspec, cspec, P()), check_vma=False),
+        donate_argnums=(0, 1, 6))
+
+    def prefill_fn(tokens, last):
+        logits, kc, vc = prefill_prog(params, tokens, last)
+        return logits, {"k": kc, "v": vc}
+
+    def step_fn(slots, tok, keys):
+        kc, vc, pos, toks = step_prog(params, slots["k"], slots["v"],
+                                      slots["pos"], tok)
+        return {"k": kc, "v": vc, "pos": pos}, toks, keys
+
+    def scatter_fn(slots, one, slot_idx, new_pos):
+        kc, vc, pos = scatter_prog(slots["k"], slots["v"], one["k"],
+                                   one["v"], slot_idx, new_pos,
+                                   slots["pos"])
+        return {"k": kc, "v": vc, "pos": pos}
+
+    return prefill_fn, step_fn, scatter_fn, False, None
