@@ -702,7 +702,8 @@ def _llama_tp_family_ops(cfg, tp: int, axis: str):
             return mlp(lp, out_proj(lp, o, x))
         return attend_fn
 
-    def prefill(params, _cfg, tokens, cap, last_only=True):
+    def prefill(params, _cfg, tokens, cap, last_only=True,
+                last_index=None):
         x = embed(params, tokens)
         S = tokens.shape[1]
 
@@ -713,18 +714,25 @@ def _llama_tp_family_ops(cfg, tp: int, axis: str):
             return mlp(lp, out_proj(lp, o, x)), (k_, v_)
 
         x, (ks, vs) = lax.scan(pl, x, params["layers"])
-        logits = finish(params, x[:, -1:] if last_only else x)
+        if last_index is not None:     # traced: bucket-padded serving
+            x = lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)
+        elif last_only:
+            x = x[:, -1:]
+        logits = finish(params, x)
         kc, vc = _init_kv_from_prefill(ks, vs, cap)
         return logits, {"k": kc, "v": vc,
                         "pos": jnp.asarray(S, jnp.int32)}
 
     def decode(params, _cfg, cache, tok):
-        pos = cache["pos"]
+        pos = jnp.asarray(cache["pos"])
         max_len = cache["k"].shape[2]
         x = params["embed"][tok][:, None, :].astype(cfg.dtype)
 
         def qkv_fn(lp, x, pos):
-            return local_qkv(lp, x, jnp.full((1,), pos))
+            # Scalar pos -> shared position [1]; [B] per-slot pos
+            # (serving) -> [B, 1] so RoPE rotates per slot.
+            p = pos[:, None] if pos.ndim else jnp.full((1,), pos)
+            return local_qkv(lp, x, p)
 
         x, kc, vc = decode_layer_scan(
             params["layers"], x, cache["k"], cache["v"], pos, qkv_fn,
@@ -879,37 +887,64 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
 # -- Tensor-parallel CONTINUOUS BATCHING (models/serving.py contract) ------
 
 
-def make_tp_server_fns(params, cfg, mesh: Mesh, max_len: int,
-                       chunk: int = 1, axis: str = "tp"):
+def make_tp_server_fns(params, cfg, mesh: Mesh, chunk: int = 1,
+                       axis: str = "tp", family: str = "gpt2"):
     """Server-fns tuple for models.serving._serve whose three programs
     run tensor-parallel over the mesh: continuous batching composes
     with the Megatron weight split. Each slot's KV cache shards by
     attention head (the same [L, B, max_len, H, D] layout with H on
     ``axis``); per-slot positions ride the shared decode scaffold's
-    vector-pos mode unchanged, so outputs remain bit-equal to the
-    single-device serve_greedy's (and hence to solo generate) while
-    every decode step streams 1/tp of the weights per rank.
+    vector-pos mode unchanged, so outputs equal the single-device
+    serve_greedy's token for token up to the matmul split's summation
+    reorder (exact in f32; bf16 can flip near-tied argmaxes — the same
+    caveat as every TP-vs-single-device comparison here, see
+    tests/test_tp_inference.py), while every decode step streams 1/tp
+    of the weights per rank.
 
-    GPT-2 dense family (MoE rides the same scaffold via
-    _tp_family_ops' ffn hook if needed), greedy, bf16 caches (the TP
-    cache layout has no int8 variant yet). Use::
+    ``family``: "gpt2" (dense; MoE rides the same scaffold via
+    _tp_family_ops' ffn hook if needed) or "llama" (GQA: slots hold
+    the un-repeated KV-head-group cache, sharded by group). Greedy,
+    bf16 caches (the TP cache layout has no int8 variant yet). Use::
 
-        fns = make_tp_server_fns(params, cfg, mesh, max_len, chunk=8)
+        fns = make_tp_server_fns(params, cfg, mesh, chunk=8)
         outs = serving.serve_greedy(params, cfg, prompts, n_new,
                                     n_slots, max_len, family=tfm,
                                     chunk=8, server_fns=fns)
 
-    int8 WEIGHT checkpoints work (the scale-keyed program cache +
-    wread, exactly as make_tp_generate).
+    int8 WEIGHT checkpoints work (wread + the sharded scale
+    companions, exactly as make_tp_generate). The weight tree is
+    re-laid-out and sharded ONCE here — the serve loop dispatches
+    step programs every chunk, and re-sharding the full tree per
+    dispatch (the one-shot generate builders' pattern) would double
+    weight traffic in the latency-bound hot loop.
     """
     tp = mesh.shape[axis]
     # Reuse the speculative core's per-shard family ops — prefill with
     # a traced last_index, decode with vector pos — so the TP layer
-    # wiring lives once (_tp_family_ops), not per builder.
-    ops_prefill, _, ops_decode = _tp_family_ops(cfg, tp, axis)
-    specs = tp_param_specs(axis)
-    scale_specs = _gpt2_scale_specs(axis)
+    # wiring lives once per family (_tp_family_ops /
+    # _llama_tp_family_ops), not per builder.
+    if family == "gpt2":
+        ops_prefill, _, ops_decode = _tp_family_ops(cfg, tp, axis)
+        specs = tp_param_specs(axis)
+        scale_specs = _gpt2_scale_specs(axis)
+        shard_fn = tp_shard_params
+    elif family == "llama":
+        ops_prefill, _, ops_decode = _llama_tp_family_ops(cfg, tp, axis)
+        specs = tp_param_specs_llama(axis)
+        scale_specs = _llama_scale_specs(axis)
+        shard_fn = tp_shard_params_llama
+    else:
+        raise ValueError(f"unknown family {family!r}")
     cspec = P(None, None, None, axis, None)
+
+    # Pre-shard the weights eagerly (once per server, not per call).
+    sspecs = _specs_with_scales(specs, _scale_keys(params), scale_specs,
+                                "TP serving")
+    shardings = jax.tree.map(
+        lambda sp: jax.sharding.NamedSharding(mesh, sp), sspecs,
+        is_leaf=lambda x: isinstance(x, P))
+    sharded = jax.jit(lambda p: shard_fn(p, cfg),
+                      out_shardings=shardings)(params)
 
     def per_shard_prefill(params, tokens, last):
         # The 'one' cache is bucket-length: the scatter lands rows
@@ -919,10 +954,9 @@ def make_tp_server_fns(params, cfg, mesh: Mesh, max_len: int,
                                     last_index=last)
         return logits, cache["k"], cache["v"]
 
-    prefill_prog = _tp_program_cache(
-        mesh, per_shard_prefill,
-        [(specs, scale_specs, tp_shard_params, cfg, "TP serving")],
-        (P(), P()), (P(), cspec, cspec))
+    prefill_prog = jax.jit(shard_map(
+        per_shard_prefill, mesh=mesh, in_specs=(sspecs, P(), P()),
+        out_specs=(P(), cspec, cspec), check_vma=False))
 
     def per_shard_step(params, kc, vc, pos, tok):
         def one(carry, _):
@@ -937,14 +971,14 @@ def make_tp_server_fns(params, cfg, mesh: Mesh, max_len: int,
                                           None, length=chunk)
         return kc, vc, pos, toks
 
-    # Donate the slot caches (run's args 1-3 after the params tree):
-    # the host loop always proceeds with the returned slots, and a
+    # Donate the slot caches (args 1-3 after the params tree): the
+    # host loop always proceeds with the returned slots, and a
     # non-donated [L, B, max_len, H, D] pair would cost a full-cache
     # copy per chunk on top of doubled peak memory.
-    step_prog = _tp_program_cache(
-        mesh, per_shard_step,
-        [(specs, scale_specs, tp_shard_params, cfg, "TP serving")],
-        (cspec, cspec, P(), P()), (cspec, cspec, P(), P()),
+    step_prog = jax.jit(shard_map(
+        per_shard_step, mesh=mesh,
+        in_specs=(sspecs, cspec, cspec, P(), P()),
+        out_specs=(cspec, cspec, P(), P()), check_vma=False),
         donate_argnums=(1, 2, 3))
 
     def per_shard_scatter(kc, vc, one_k, one_v, slot_idx, new_pos, pos):
@@ -964,11 +998,11 @@ def make_tp_server_fns(params, cfg, mesh: Mesh, max_len: int,
         donate_argnums=(0, 1, 6))
 
     def prefill_fn(tokens, last):
-        logits, kc, vc = prefill_prog(params, tokens, last)
+        logits, kc, vc = prefill_prog(sharded, tokens, last)
         return logits, {"k": kc, "v": vc}
 
     def step_fn(slots, tok, keys):
-        kc, vc, pos, toks = step_prog(params, slots["k"], slots["v"],
+        kc, vc, pos, toks = step_prog(sharded, slots["k"], slots["v"],
                                       slots["pos"], tok)
         return {"k": kc, "v": vc, "pos": pos}, toks, keys
 

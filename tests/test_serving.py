@@ -216,15 +216,19 @@ def test_tp_continuous_batching_equals_solo(tp):
     """Continuous batching composed with tensor parallelism: the same
     host scheduler drives shard_map programs (make_tp_server_fns) whose
     KV slots shard by attention head — outputs must equal the solo
-    single-device generate runs bit for bit at any tp."""
+    single-device generate runs bit for bit at any tp (f32, the
+    test_tp_inference convention: the matmul split reorders summation,
+    and bf16 near-ties on a random-init model would flip argmaxes)."""
+    import dataclasses
     from mpi_acx_tpu.parallel.mesh import mesh_from_devices
     from mpi_acx_tpu.parallel.tp_inference import make_tp_server_fns
 
     cfg, params, mod = _gpt2()
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
     mesh = mesh_from_devices({"tp": tp}, jax.devices()[:tp])
     n_new, max_len, chunk = 5, 32, 3
     prompts = _prompts(jax.random.key(13), 5, cfg.vocab, lens=[4, 9, 6])
-    fns = make_tp_server_fns(params, cfg, mesh, max_len, chunk=chunk)
+    fns = make_tp_server_fns(params, cfg, mesh, chunk=chunk)
     got = serving.serve_greedy(params, cfg, prompts, n_new, n_slots=2,
                                max_len=max_len, family=mod, chunk=chunk,
                                server_fns=fns)
@@ -237,20 +241,47 @@ def test_tp_continuous_batching_equals_solo(tp):
 def test_tp_serving_int8_weight_checkpoint():
     """The full composition: continuous batching x tensor parallelism x
     int8 weight-only checkpoint (scale-keyed TP program cache + wread)
-    — outputs equal the solo single-device quantized runs."""
+    — outputs equal the solo single-device quantized runs (f32 per the
+    test_tp_inference convention)."""
+    import dataclasses
     from mpi_acx_tpu.ops.wquant import GPT2_WEIGHTS, quantize_weights_int8
     from mpi_acx_tpu.parallel.mesh import mesh_from_devices
     from mpi_acx_tpu.parallel.tp_inference import make_tp_server_fns
 
     cfg, params, mod = _gpt2()
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
     qparams = quantize_weights_int8(params, GPT2_WEIGHTS)
     mesh = mesh_from_devices({"tp": 2}, jax.devices()[:2])
     prompts = _prompts(jax.random.key(14), 4, cfg.vocab, lens=[5, 8])
-    fns = make_tp_server_fns(qparams, cfg, mesh, 24, chunk=2)
+    fns = make_tp_server_fns(qparams, cfg, mesh, chunk=2)
     got = serving.serve_greedy(qparams, cfg, prompts, 4, n_slots=2,
                                max_len=24, family=mod, chunk=2,
                                server_fns=fns)
     for p, g in zip(prompts, got):
         want = mod.generate(qparams, cfg, jnp.asarray(p)[None], 4,
                             max_len=24)
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(want)[0])
+
+
+def test_tp_llama_continuous_batching_equals_solo():
+    """Llama TP serving: GQA slot caches shard by KV-head group,
+    per-slot RoPE positions — outputs equal the solo runs at tp=2
+    (f32 per the test_tp_inference convention)."""
+    import dataclasses
+    from mpi_acx_tpu.parallel.mesh import mesh_from_devices
+    from mpi_acx_tpu.parallel.tp_inference import make_tp_server_fns
+
+    cfg, params, mod = _llama()
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    mesh = mesh_from_devices({"tp": 2}, jax.devices()[:2])
+    n_new, max_len, chunk = 5, 32, 3
+    prompts = _prompts(jax.random.key(15), 5, cfg.vocab, lens=[4, 9, 6])
+    fns = make_tp_server_fns(params, cfg, mesh, chunk=chunk,
+                             family="llama")
+    got = serving.serve_greedy(params, cfg, prompts, n_new, n_slots=2,
+                               max_len=max_len, family=mod, chunk=chunk,
+                               server_fns=fns)
+    for p, g in zip(prompts, got):
+        want = mod.generate(params, cfg, jnp.asarray(p)[None], n_new,
+                            max_len=max_len)
         np.testing.assert_array_equal(np.asarray(g), np.asarray(want)[0])
