@@ -35,24 +35,50 @@ def main():
                     help="decode steps per host dispatch")
     ap.add_argument("--int8-kv", action="store_true",
                     help="serve from int8 slot caches (ops/kvquant.py)")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="tensor-parallel serving over N mesh ranks "
+                         "(0 = single device; on CPU set "
+                         "XLA_FLAGS=--xla_force_host_platform_device_"
+                         "count=N); the toy config's head counts scale "
+                         "with N, and f32 is forced so TP outputs "
+                         "match the single-device verify exactly")
     ap.add_argument("--verify", action="store_true",
                     help="check every output against its solo run")
     args = ap.parse_args()
+    if args.tp and args.int8_kv:
+        ap.error("--tp serving has no int8 KV cache variant yet")
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")  # wins over a pinned plugin
 
     from mpi_acx_tpu.models import serving
+    # Under --tp the toy geometry scales with the mesh (the TP split
+    # needs heads % tp == 0 — same pattern as examples/serve_tp.py).
+    heads = max(4, args.tp)
     if args.family == "gpt2":
         from mpi_acx_tpu.models import transformer as mod
-        cfg = mod.tiny_config(vocab=96, d_model=64, n_heads=4,
-                              n_layers=3, d_ff=128, max_seq=128)
+        cfg = mod.tiny_config(vocab=96, d_model=16 * heads,
+                              n_heads=heads, n_layers=3, d_ff=128,
+                              max_seq=128)
     else:
         from mpi_acx_tpu.models import llama as mod
-        cfg = mod.tiny_llama(vocab=96, d_model=64, n_heads=4,
-                             n_kv_heads=2, n_layers=3, d_ff=128,
-                             max_seq=128)
+        cfg = mod.tiny_llama(vocab=96, d_model=16 * heads,
+                             n_heads=heads,
+                             n_kv_heads=max(2, args.tp), n_layers=3,
+                             d_ff=128, max_seq=128)
+    server_fns = None
+    if args.tp:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, dtype=jnp.float32)
     params = mod.init_params(jax.random.key(0), cfg)
+    if args.tp:
+        from mpi_acx_tpu.parallel.mesh import mesh_from_devices
+        from mpi_acx_tpu.parallel.tp_inference import make_tp_server_fns
+        mesh = mesh_from_devices({"tp": args.tp},
+                                 jax.devices()[:args.tp])
+        server_fns = make_tp_server_fns(params, cfg, mesh,
+                                        chunk=args.chunk,
+                                        family=args.family)
 
     rng = np.random.default_rng(7)
     prompts = [rng.integers(0, cfg.vocab, rng.integers(3, 14),
@@ -65,7 +91,8 @@ def main():
     outs = serving.serve_greedy(params, cfg, prompts, n_new,
                                 n_slots=args.slots, max_len=max_len,
                                 family=mod, chunk=args.chunk,
-                                kv_int8=args.int8_kv)
+                                kv_int8=args.int8_kv,
+                                server_fns=server_fns)
     dt = time.perf_counter() - t0
     total = sum(n_new)
     print(f"{args.requests} requests (lens "
