@@ -52,20 +52,20 @@ def main():
         jax.config.update("jax_platforms", "cpu")  # wins over a pinned plugin
 
     from mpi_acx_tpu.models import serving
-    # Under --tp the toy geometry scales with the mesh (the TP split
-    # needs heads % tp == 0 — same pattern as examples/serve_tp.py).
-    heads = max(4, args.tp)
+    # Under --tp the toy geometry scales with the mesh so the TP
+    # split's divisibility always holds (serve_tp.py's 2*tp pattern).
+    heads = 2 * args.tp if args.tp else 4
     if args.family == "gpt2":
         from mpi_acx_tpu.models import transformer as mod
         cfg = mod.tiny_config(vocab=96, d_model=16 * heads,
-                              n_heads=heads, n_layers=3, d_ff=128,
-                              max_seq=128)
+                              n_heads=heads, n_layers=3,
+                              d_ff=32 * heads, max_seq=128)
     else:
         from mpi_acx_tpu.models import llama as mod
         cfg = mod.tiny_llama(vocab=96, d_model=16 * heads,
                              n_heads=heads,
-                             n_kv_heads=max(2, args.tp), n_layers=3,
-                             d_ff=128, max_seq=128)
+                             n_kv_heads=args.tp if args.tp else 2,
+                             n_layers=3, d_ff=32 * heads, max_seq=128)
     server_fns = None
     if args.tp:
         import dataclasses

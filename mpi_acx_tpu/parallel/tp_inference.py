@@ -387,6 +387,32 @@ def _ep_dispatch_mode(mode: str, tokens: int, ep: int) -> str:
     return mode
 
 
+def _make_moe_ffn(cfg, tp: int, axis: str, ep_dispatch: str):
+    """THE MoE expert-FFN hook every TP builder shares (generate,
+    speculative, serving): validates the expert split, resolves
+    ``ep_dispatch`` per call site (_ep_dispatch_mode), and applies the
+    drop-free degrade — outside ``capacity_factor >= n_experts``,
+    sharded dispatch forms different capacity groups than the
+    single-device run, so "auto" degrades to replicated (bit-equal at
+    any capacity) to keep the exact-parity contract; an EXPLICIT
+    "sharded" request is honored as-is."""
+    from mpi_acx_tpu.models.moe_transformer import _moe_ffn
+
+    assert cfg.n_experts % tp == 0, (cfg.n_experts, tp)
+    assert ep_dispatch in ("auto", "sharded", "replicated"), ep_dispatch
+    side = ep_dispatch
+    if side == "auto" and cfg.capacity_factor < cfg.n_experts:
+        side = "replicated"
+
+    def moe_ffn(lp, x):
+        mode = _ep_dispatch_mode(side, x.shape[0] * x.shape[1], tp)
+        return _moe_ffn(cfg, lp, x, ep_axis=axis,
+                        replicated=mode == "replicated",
+                        sharded_dispatch=mode == "sharded")
+
+    return moe_ffn
+
+
 def make_tp_generate_moe(cfg, mesh: Mesh, n_new: int, axis: str = "tp",
                          temperature: float = 0.0,
                          top_k: Optional[int] = None,
@@ -403,7 +429,10 @@ def make_tp_generate_moe(cfg, mesh: Mesh, n_new: int, axis: str = "tp",
       static): ``"sharded"`` whenever the call's token count divides
       tp, ``"replicated"`` otherwise. Prefill (B*S tokens) and
       batch-serving decode get real EP scaling; B=1 latency decode
-      falls back to replicated instead of raising.
+      falls back to replicated instead of raising. Outside the
+      drop-free regime (capacity_factor < n_experts) auto degrades to
+      replicated entirely — sharded capacity groups differ from the
+      single-device run's there (_make_moe_ffn holds the rule).
     * ``"sharded"`` — REAL expert-parallel dispatch
       (moe.moe_layer_sharded_dispatch): each rank routes only its
       exclusive 1/tp token slice and the capacity-bounded
@@ -423,17 +452,7 @@ def make_tp_generate_moe(cfg, mesh: Mesh, n_new: int, axis: str = "tp",
     tokens identical to the single-device ``generate``
     (tests/test_tp_inference.py covers tp=4 and tp=8, plus the auto
     fallback at an indivisible batch)."""
-    from mpi_acx_tpu.models.moe_transformer import _moe_ffn
-
-    ep = mesh.shape[axis]
-    assert cfg.n_experts % ep == 0, (cfg.n_experts, ep)
-    assert ep_dispatch in ("auto", "sharded", "replicated"), ep_dispatch
-
-    def moe_ffn(lp, x):
-        mode = _ep_dispatch_mode(ep_dispatch, x.shape[0] * x.shape[1], ep)
-        return _moe_ffn(cfg, lp, x, ep_axis=axis,
-                        replicated=mode == "replicated",
-                        sharded_dispatch=mode == "sharded")
+    moe_ffn = _make_moe_ffn(cfg, mesh.shape[axis], axis, ep_dispatch)
 
     return make_tp_generate(cfg, mesh, n_new, axis=axis,
                             temperature=temperature, top_k=top_k,
@@ -814,23 +833,7 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
                     tp_param_specs_llama(axis), tp_shard_params_llama,
                     _llama_scale_specs(axis))
         if type(c) is MoeTransformerConfig:
-            assert c.n_experts % tp == 0, (c.n_experts, tp)
-            # Outside the drop-free regime sharded dispatch forms
-            # different capacity groups than the single-device run;
-            # auto degrades to replicated (bit-equal at any capacity)
-            # so the exact-parity contract survives a tight-capacity
-            # draft. An EXPLICIT "sharded" request is honored as-is.
-            side = ep_dispatch
-            if side == "auto" and c.capacity_factor < c.n_experts:
-                side = "replicated"
-
-            def moe_ffn(lp, x, side=side):
-                mode = _ep_dispatch_mode(
-                    side, x.shape[0] * x.shape[1], tp)
-                return _moe_ffn(c, lp, x, ep_axis=axis,
-                                replicated=mode == "replicated",
-                                sharded_dispatch=mode == "sharded")
-
+            moe_ffn = _make_moe_ffn(c, tp, axis, ep_dispatch)
             return (_tp_family_ops(c, tp, axis, ffn=moe_ffn),
                     tp_param_specs_moe(axis), tp_shard_params,
                     _moe_scale_specs(axis))
@@ -888,7 +891,8 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
 
 
 def make_tp_server_fns(params, cfg, mesh: Mesh, chunk: int = 1,
-                       axis: str = "tp", family: str = "gpt2"):
+                       axis: str = "tp", family: str = "gpt2",
+                       ep_dispatch: str = "auto"):
     """Server-fns tuple for models.serving._serve whose three programs
     run tensor-parallel over the mesh: continuous batching composes
     with the Megatron weight split. Each slot's KV cache shards by
@@ -901,10 +905,14 @@ def make_tp_server_fns(params, cfg, mesh: Mesh, chunk: int = 1,
     tests/test_tp_inference.py), while every decode step streams 1/tp
     of the weights per rank.
 
-    ``family``: "gpt2" (dense; MoE rides the same scaffold via
-    _tp_family_ops' ffn hook if needed) or "llama" (GQA: slots hold
-    the un-repeated KV-head-group cache, sharded by group). Greedy,
-    bf16 caches (the TP cache layout has no int8 variant yet). Use::
+    ``family``: "gpt2" (dense), "moe" (GPT-2 attention + the routed
+    expert FFN through _tp_family_ops' ffn hook; each rank hosts
+    n_experts/tp experts, ``ep_dispatch`` as make_tp_generate_moe —
+    "auto" gives batch-serving decode the sharded all_to_all path and
+    falls back per call site when the token count doesn't divide tp),
+    or "llama" (GQA: slots hold the un-repeated KV-head-group cache,
+    sharded by group). Greedy, bf16 caches (the TP cache layout has no
+    int8 variant yet). Use::
 
         fns = make_tp_server_fns(params, cfg, mesh, chunk=8)
         outs = serving.serve_greedy(params, cfg, prompts, n_new,
@@ -928,6 +936,13 @@ def make_tp_server_fns(params, cfg, mesh: Mesh, chunk: int = 1,
         specs = tp_param_specs(axis)
         scale_specs = _gpt2_scale_specs(axis)
         shard_fn = tp_shard_params
+    elif family == "moe":
+        moe_ffn = _make_moe_ffn(cfg, tp, axis, ep_dispatch)
+        ops_prefill, _, ops_decode = _tp_family_ops(cfg, tp, axis,
+                                                    ffn=moe_ffn)
+        specs = tp_param_specs_moe(axis)
+        scale_specs = _moe_scale_specs(axis)
+        shard_fn = tp_shard_params_moe
     elif family == "llama":
         ops_prefill, _, ops_decode = _llama_tp_family_ops(cfg, tp, axis)
         specs = tp_param_specs_llama(axis)

@@ -285,3 +285,29 @@ def test_tp_llama_continuous_batching_equals_solo():
         want = mod.generate(params, cfg, jnp.asarray(p)[None], n_new,
                             max_len=max_len)
         np.testing.assert_array_equal(np.asarray(g), np.asarray(want)[0])
+
+
+def test_tp_moe_continuous_batching_equals_solo():
+    """MoE TP serving: routed expert FFN through the ffn hook, experts
+    sharded n_experts/tp per rank, auto EP dispatch — outputs equal
+    the solo runs at tp=2 (f32 per the test_tp_inference convention;
+    drop-free capacity so routing is batch-invariant)."""
+    import dataclasses
+    from mpi_acx_tpu.parallel.mesh import mesh_from_devices
+    from mpi_acx_tpu.parallel.tp_inference import make_tp_server_fns
+
+    cfg, params, mod = _moe()
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32,
+                              capacity_factor=float(cfg.n_experts))
+    mesh = mesh_from_devices({"tp": 2}, jax.devices()[:2])
+    n_new, max_len, chunk = 5, 32, 3
+    prompts = _prompts(jax.random.key(16), 5, cfg.vocab, lens=[4, 9, 6])
+    fns = make_tp_server_fns(params, cfg, mesh, chunk=chunk,
+                             family="moe")
+    got = serving.serve_greedy(params, cfg, prompts, n_new, n_slots=2,
+                               max_len=max_len, family=mod, chunk=chunk,
+                               server_fns=fns)
+    for p, g in zip(prompts, got):
+        want = mod.generate(params, cfg, jnp.asarray(p)[None], n_new,
+                            max_len=max_len)
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(want)[0])
