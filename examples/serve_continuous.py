@@ -28,7 +28,8 @@ import numpy as np
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--family", choices=["gpt2", "llama"], default="gpt2")
+    ap.add_argument("--family", choices=["gpt2", "llama", "moe"],
+                    default="gpt2")
     ap.add_argument("--requests", type=int, default=8)
     ap.add_argument("--slots", type=int, default=3)
     ap.add_argument("--chunk", type=int, default=4,
@@ -60,6 +61,13 @@ def main():
         cfg = mod.tiny_config(vocab=96, d_model=16 * heads,
                               n_heads=heads, n_layers=3,
                               d_ff=32 * heads, max_seq=128)
+    elif args.family == "moe":
+        from mpi_acx_tpu.models import moe_transformer as mod
+        cfg = mod.tiny_moe_config(vocab=96, d_model=16 * heads,
+                                  n_heads=heads, n_layers=3,
+                                  d_ff=32 * heads, max_seq=128,
+                                  n_experts=2 * args.tp if args.tp
+                                  else 4)
     else:
         from mpi_acx_tpu.models import llama as mod
         cfg = mod.tiny_llama(vocab=96, d_model=16 * heads,
