@@ -18,3 +18,18 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+
+# The suite compiles thousands of XLA executables in ONE process; past
+# ~250 tests the accumulated jit cache segfaults jaxlib's CPU compiler
+# (r05: three suite runs died at three different late-suite points, all
+# inside backend_compile, after the serving tests pushed the count up).
+# Dropping the caches at module boundaries bounds the accumulation; the
+# next module recompiles what it needs.
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    yield
+    jax.clear_caches()
