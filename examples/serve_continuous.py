@@ -46,8 +46,8 @@ def main():
     ap.add_argument("--verify", action="store_true",
                     help="check every output against its solo run")
     args = ap.parse_args()
-    if args.tp and args.int8_kv:
-        ap.error("--tp serving has no int8 KV cache variant yet")
+    if args.tp and args.int8_kv and args.family == "moe":
+        ap.error("--tp --int8-kv: gpt2/llama only for now")
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")  # wins over a pinned plugin
@@ -86,7 +86,8 @@ def main():
                                  jax.devices()[:args.tp])
         server_fns = make_tp_server_fns(params, cfg, mesh,
                                         chunk=args.chunk,
-                                        family=args.family)
+                                        family=args.family,
+                                        kv_int8=args.int8_kv)
 
     rng = np.random.default_rng(7)
     prompts = [rng.integers(0, cfg.vocab, rng.integers(3, 14),

@@ -625,7 +625,27 @@ def make_tp_generate_llama(cfg: lm.LlamaConfig, mesh: Mesh, n_new: int,
 # -- Tensor-parallel SPECULATIVE decoding ----------------------------------
 
 
-def _tp_family_ops(cfg, tp: int, axis: str, ffn=None):
+def _pack_prefill_cache(ks, vs, cap, kv_int8):
+    """Allocate a cap-length per-shard cache and land the prefill K/V
+    through decoding.fill_kv_cache — the single definition of the
+    (int8) cache layout, so the TP serving path cannot drift from the
+    single-device one."""
+    from mpi_acx_tpu.models.decoding import fill_kv_cache
+    L, B = ks.shape[:2]
+    H, D = ks.shape[3], ks.shape[4]
+    if kv_int8:
+        cache = {"k": jnp.zeros((L, B, cap, H, D), jnp.int8),
+                 "v": jnp.zeros((L, B, cap, H, D), jnp.int8),
+                 "ks": jnp.zeros((L, B, cap, H, 1), jnp.float32),
+                 "vs": jnp.zeros((L, B, cap, H, 1), jnp.float32)}
+    else:
+        cache = {"k": jnp.zeros((L, B, cap, H, D), ks.dtype),
+                 "v": jnp.zeros((L, B, cap, H, D), vs.dtype)}
+    return fill_kv_cache(cache, ks, vs, ks.shape[2])
+
+
+def _tp_family_ops(cfg, tp: int, axis: str, ffn=None,
+                   kv_int8: bool = False):
     """GPT-2-scaffold ops with the speculative-core signatures
     (models.speculative._make_run ``ops``), tensor-parallel per shard:
     (prefill, window, decode). Each rank holds its Hl-head slice of the
@@ -665,9 +685,9 @@ def _tp_family_ops(cfg, tp: int, axis: str, ffn=None):
         elif last_only:
             x = x[:, -1:]
         logits = finish(params, x)
-        kc, vc = _init_kv_from_prefill(ks, vs, cap)
-        return logits, {"k": kc, "v": vc,
-                        "pos": jnp.asarray(tokens.shape[1], jnp.int32)}
+        # Per-(position, local-head) int8 when enabled: each rank
+        # quantizes its own head slice — no cross-shard state.
+        return logits, _pack_prefill_cache(ks, vs, cap, kv_int8)
 
     def decode(params, _cfg, cache, tok):
         pos = jnp.asarray(cache["pos"])
@@ -678,11 +698,10 @@ def _tp_family_ops(cfg, tp: int, axis: str, ffn=None):
         x = (params["embed"][tok][:, None, :]
              + (pe[:, None, :] if pos.ndim else pe[None, None, :])
              ).astype(cfg.dtype)
-        x, kc, vc = decode_layer_scan(
-            params["layers"], x, cache["k"], cache["v"], pos, qkv_fn,
-            make_attend(max_len))
-        logits = finish(params, x)[:, 0]                  # [B, vocab]
-        return logits, {"k": kc, "v": vc, "pos": pos + 1}
+        from mpi_acx_tpu.models.decoding import run_decode_layers
+        x, out_cache = run_decode_layers(params["layers"], x, cache,
+                                         qkv_fn, make_attend(max_len))
+        return finish(params, x)[:, 0], out_cache
 
     def window(params, _cfg, cache, tokens):
         W = tokens.shape[1]
@@ -700,7 +719,8 @@ def _tp_family_ops(cfg, tp: int, axis: str, ffn=None):
     return prefill, window, decode
 
 
-def _llama_tp_family_ops(cfg, tp: int, axis: str):
+def _llama_tp_family_ops(cfg, tp: int, axis: str,
+                         kv_int8: bool = False):
     """Llama counterpart of :func:`_tp_family_ops` (speculative-core
     signatures, KV-group-sharded): RoPE at absolute positions, grouped
     decode/window attention against the un-repeated local cache."""
@@ -738,9 +758,7 @@ def _llama_tp_family_ops(cfg, tp: int, axis: str):
         elif last_only:
             x = x[:, -1:]
         logits = finish(params, x)
-        kc, vc = _init_kv_from_prefill(ks, vs, cap)
-        return logits, {"k": kc, "v": vc,
-                        "pos": jnp.asarray(S, jnp.int32)}
+        return logits, _pack_prefill_cache(ks, vs, cap, kv_int8)
 
     def decode(params, _cfg, cache, tok):
         pos = jnp.asarray(cache["pos"])
@@ -753,11 +771,10 @@ def _llama_tp_family_ops(cfg, tp: int, axis: str):
             p = pos[:, None] if pos.ndim else jnp.full((1,), pos)
             return local_qkv(lp, x, p)
 
-        x, kc, vc = decode_layer_scan(
-            params["layers"], x, cache["k"], cache["v"], pos, qkv_fn,
-            make_attend(max_len))
-        logits = finish(params, x)[:, 0]
-        return logits, {"k": kc, "v": vc, "pos": pos + 1}
+        from mpi_acx_tpu.models.decoding import run_decode_layers
+        x, out_cache = run_decode_layers(params["layers"], x, cache,
+                                         qkv_fn, make_attend(max_len))
+        return finish(params, x)[:, 0], out_cache
 
     def window(params, _cfg, cache, tokens):
         W = tokens.shape[1]
@@ -892,7 +909,8 @@ def make_tp_speculative_generate(draft_cfg, cfg, mesh: Mesh, n_new: int,
 
 def make_tp_server_fns(params, cfg, mesh: Mesh, chunk: int = 1,
                        axis: str = "tp", family: str = "gpt2",
-                       ep_dispatch: str = "auto"):
+                       ep_dispatch: str = "auto",
+                       kv_int8: bool = False):
     """Server-fns tuple for models.serving._serve whose three programs
     run tensor-parallel over the mesh: continuous batching composes
     with the Megatron weight split. Each slot's KV cache shards by
@@ -911,8 +929,11 @@ def make_tp_server_fns(params, cfg, mesh: Mesh, chunk: int = 1,
     "auto" gives batch-serving decode the sharded all_to_all path and
     falls back per call site when the token count doesn't divide tp),
     or "llama" (GQA: slots hold the un-repeated KV-head-group cache,
-    sharded by group). Greedy, bf16 caches (the TP cache layout has no
-    int8 variant yet). Use::
+    sharded by group). Greedy. ``kv_int8`` serves from int8 slot
+    caches (gpt2/llama): each rank quantizes its own head slice on
+    write and the shared scale-on-scores read keeps the codes as the
+    attention operands — the long-context composition where cache
+    bytes dominate even after the 1/tp weight split. Use::
 
         fns = make_tp_server_fns(params, cfg, mesh, chunk=8)
         outs = serving.serve_greedy(params, cfg, prompts, n_new,
@@ -932,11 +953,15 @@ def make_tp_server_fns(params, cfg, mesh: Mesh, chunk: int = 1,
     # wiring lives once per family (_tp_family_ops /
     # _llama_tp_family_ops), not per builder.
     if family == "gpt2":
-        ops_prefill, _, ops_decode = _tp_family_ops(cfg, tp, axis)
+        ops_prefill, _, ops_decode = _tp_family_ops(cfg, tp, axis,
+                                                    kv_int8=kv_int8)
         specs = tp_param_specs(axis)
         scale_specs = _gpt2_scale_specs(axis)
         shard_fn = tp_shard_params
     elif family == "moe":
+        if kv_int8:
+            raise ValueError(
+                "int8 KV slot caches: gpt2/llama only for now")
         moe_ffn = _make_moe_ffn(cfg, tp, axis, ep_dispatch)
         ops_prefill, _, ops_decode = _tp_family_ops(cfg, tp, axis,
                                                     ffn=moe_ffn)
@@ -944,13 +969,17 @@ def make_tp_server_fns(params, cfg, mesh: Mesh, chunk: int = 1,
         scale_specs = _moe_scale_specs(axis)
         shard_fn = tp_shard_params_moe
     elif family == "llama":
-        ops_prefill, _, ops_decode = _llama_tp_family_ops(cfg, tp, axis)
+        ops_prefill, _, ops_decode = _llama_tp_family_ops(
+            cfg, tp, axis, kv_int8=kv_int8)
         specs = tp_param_specs_llama(axis)
         scale_specs = _llama_scale_specs(axis)
         shard_fn = tp_shard_params_llama
     else:
         raise ValueError(f"unknown family {family!r}")
     cspec = P(None, None, None, axis, None)
+    cache_spec = {"k": cspec, "v": cspec, "pos": P()}
+    if kv_int8:
+        cache_spec.update(ks=cspec, vs=cspec)   # scales shard by head
 
     # Pre-shard the weights eagerly (once per server, not per call).
     sspecs = _specs_with_scales(specs, _scale_keys(params), scale_specs,
@@ -963,68 +992,67 @@ def make_tp_server_fns(params, cfg, mesh: Mesh, chunk: int = 1,
 
     def per_shard_prefill(params, tokens, last):
         # The 'one' cache is bucket-length: the scatter lands rows
-        # [0, S_bucket) into the slot (serving.scatter_fn contract).
+        # [0, S_bucket) into the slot (serving.scatter_fn contract);
+        # its pos entry is dropped (the scatter sets the slot's).
         logits, cache = ops_prefill(params, cfg, tokens,
                                     cap=tokens.shape[1],
                                     last_index=last)
-        return logits, cache["k"], cache["v"]
+        cache.pop("pos")
+        return logits, cache
 
+    one_spec = dict(cache_spec)
+    one_spec.pop("pos")
     prefill_prog = jax.jit(shard_map(
         per_shard_prefill, mesh=mesh, in_specs=(sspecs, P(), P()),
-        out_specs=(P(), cspec, cspec), check_vma=False))
+        out_specs=(P(), one_spec), check_vma=False))
 
-    def per_shard_step(params, kc, vc, pos, tok):
+    def per_shard_step(params, cache, tok):
         def one(carry, _):
-            kc, vc, pos, tok = carry
-            logits, cache = ops_decode(params, cfg,
-                                       {"k": kc, "v": vc, "pos": pos},
-                                       tok)
+            cache, tok = carry
+            logits, cache = ops_decode(params, cfg, cache, tok)
             nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
-            return (cache["k"], cache["v"], cache["pos"], nxt), nxt
+            return (cache, nxt), nxt
 
-        (kc, vc, pos, _), toks = lax.scan(one, (kc, vc, pos, tok),
-                                          None, length=chunk)
-        return kc, vc, pos, toks
+        (cache, _), toks = lax.scan(one, (cache, tok), None,
+                                    length=chunk)
+        return cache, toks
 
-    # Donate the slot caches (args 1-3 after the params tree): the
-    # host loop always proceeds with the returned slots, and a
-    # non-donated [L, B, max_len, H, D] pair would cost a full-cache
-    # copy per chunk on top of doubled peak memory.
+    # Donate the slot caches: the host loop always proceeds with the
+    # returned slots, and a non-donated [L, B, max_len, H, D] pair
+    # would cost a full-cache copy per chunk on top of doubled peak
+    # memory.
     step_prog = jax.jit(shard_map(
         per_shard_step, mesh=mesh,
-        in_specs=(sspecs, cspec, cspec, P(), P()),
-        out_specs=(cspec, cspec, P(), P()), check_vma=False),
-        donate_argnums=(1, 2, 3))
+        in_specs=(sspecs, cache_spec, P()),
+        out_specs=(cache_spec, P()), check_vma=False),
+        donate_argnums=(1,))
 
-    def per_shard_scatter(kc, vc, one_k, one_v, slot_idx, new_pos, pos):
+    def per_shard_scatter(slots, one, slot_idx, new_pos):
         def land(cache, src):
             dst = lax.dynamic_index_in_dim(cache, slot_idx, 1,
                                            keepdims=False)
-            dst = lax.dynamic_update_slice(dst, src[:, 0], (0, 0, 0, 0))
+            dst = lax.dynamic_update_slice(
+                dst, src[:, 0], (0,) * dst.ndim)
             return lax.dynamic_update_index_in_dim(cache, dst,
                                                    slot_idx, 1)
-        return (land(kc, one_k), land(vc, one_v),
-                pos.at[slot_idx].set(new_pos))
+        out = {k: land(slots[k], one[k]) for k in one}
+        out["pos"] = slots["pos"].at[slot_idx].set(new_pos)
+        return out
 
     scatter_prog = jax.jit(shard_map(
         per_shard_scatter, mesh=mesh,
-        in_specs=(cspec, cspec, cspec, cspec, P(), P(), P()),
-        out_specs=(cspec, cspec, P()), check_vma=False),
-        donate_argnums=(0, 1, 6))
+        in_specs=(cache_spec, one_spec, P(), P()),
+        out_specs=cache_spec, check_vma=False),
+        donate_argnums=(0,))
 
     def prefill_fn(tokens, last):
-        logits, kc, vc = prefill_prog(sharded, tokens, last)
-        return logits, {"k": kc, "v": vc}
+        return prefill_prog(sharded, tokens, last)
 
     def step_fn(slots, tok, keys):
-        kc, vc, pos, toks = step_prog(sharded, slots["k"], slots["v"],
-                                      slots["pos"], tok)
-        return {"k": kc, "v": vc, "pos": pos}, toks, keys
+        slots, toks = step_prog(sharded, slots, tok)
+        return slots, toks, keys
 
     def scatter_fn(slots, one, slot_idx, new_pos):
-        kc, vc, pos = scatter_prog(slots["k"], slots["v"], one["k"],
-                                   one["v"], slot_idx, new_pos,
-                                   slots["pos"])
-        return {"k": kc, "v": vc, "pos": pos}
+        return scatter_prog(slots, one, slot_idx, new_pos)
 
-    return prefill_fn, step_fn, scatter_fn, False, None
+    return prefill_fn, step_fn, scatter_fn, kv_int8, None

@@ -311,3 +311,31 @@ def test_tp_moe_continuous_batching_equals_solo():
         want = mod.generate(params, cfg, jnp.asarray(p)[None], n_new,
                             max_len=max_len)
         np.testing.assert_array_equal(np.asarray(g), np.asarray(want)[0])
+
+
+@pytest.mark.parametrize("fam,name", [(_gpt2, "gpt2"), (_llama, "llama")])
+def test_tp_int8_kv_slots_equal_solo_int8(fam, name):
+    """The last serving composition: continuous batching x tensor
+    parallelism x int8 KV slot caches. Each rank quantizes its own
+    head slice; outputs equal the solo single-device kv_int8 runs
+    (f32 compute per the TP convention — the int8 codes/scales are
+    identical per head regardless of the split, so quantization adds
+    no TP-specific divergence)."""
+    import dataclasses
+    from mpi_acx_tpu.parallel.mesh import mesh_from_devices
+    from mpi_acx_tpu.parallel.tp_inference import make_tp_server_fns
+
+    cfg, params, mod = fam()
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    mesh = mesh_from_devices({"tp": 2}, jax.devices()[:2])
+    n_new, max_len, chunk = 5, 32, 3
+    prompts = _prompts(jax.random.key(17), 5, cfg.vocab, lens=[4, 9, 6])
+    fns = make_tp_server_fns(params, cfg, mesh, chunk=chunk,
+                             family=name, kv_int8=True)
+    got = serving.serve_greedy(params, cfg, prompts, n_new, n_slots=2,
+                               max_len=max_len, family=mod, chunk=chunk,
+                               server_fns=fns, kv_int8=True)
+    for p, g in zip(prompts, got):
+        want = mod.generate(params, cfg, jnp.asarray(p)[None], n_new,
+                            max_len=max_len, kv_int8=True)
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(want)[0])
