@@ -339,3 +339,21 @@ def test_tp_int8_kv_slots_equal_solo_int8(fam, name):
         want = mod.generate(params, cfg, jnp.asarray(p)[None], n_new,
                             max_len=max_len, kv_int8=True)
         np.testing.assert_array_equal(np.asarray(g), np.asarray(want)[0])
+
+
+def test_serve_sample_int8_kv_equals_solo():
+    """Sampling and the int8 KV cache are orthogonal serving axes —
+    together they must still equal the solo sampled int8 runs."""
+    cfg, params, mod = _gpt2()
+    base = jax.random.key(21)
+    prompts = _prompts(jax.random.key(20), 4, cfg.vocab, lens=[5, 8])
+    got = serving.serve_sample(params, cfg, prompts, 4, n_slots=2,
+                               max_len=24, key=base, family=mod,
+                               temperature=0.8, top_k=13, chunk=2,
+                               kv_int8=True)
+    for rid, (p, g) in enumerate(zip(prompts, got)):
+        want = mod.generate_sample(params, cfg, jnp.asarray(p)[None], 4,
+                                   jax.random.fold_in(base, rid),
+                                   temperature=0.8, top_k=13,
+                                   max_len=24, kv_int8=True)
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(want)[0])
