@@ -118,6 +118,10 @@ check: ctest itest tools
 	@ACX_FUZZ_CANARY=1 $(BUILD)/acxrun -np 2 $(BUILD)/itests/fuzz || exit 1
 	@echo "== acxrun -np 2 fuzz (second seed)"
 	@ACX_FUZZ_SEED=98761 $(BUILD)/acxrun -np 2 $(BUILD)/itests/fuzz || exit 1
+	@echo "== acxrun -np 2 ring (fault: transient send drop -> retry -> OK)"
+	@$(BUILD)/acxrun -np 2 -fault drop:rank=0:kind=send:nth=1 $(BUILD)/itests/ring || exit 1
+	@echo "== acxrun -np 2 ring (fault: 5ms delay on rank 1's first recv)"
+	@$(BUILD)/acxrun -np 2 -fault delay:rank=1:kind=recv:nth=1:us=5000 $(BUILD)/itests/ring || exit 1
 	@echo "ALL NATIVE TESTS PASSED"
 
 # Header dependency tracking (-MMD): a header edit rebuilds its users.

@@ -85,6 +85,7 @@ def _code_rev():
     evidence for it)."""
     paths = ["mpi_acx_tpu", "src", "include", "bench.py"]
     try:
+        import hashlib
         h = subprocess.run(
             ["git", "-C", REPO, "rev-parse"] +
             [f"HEAD:{p}" for p in paths],
@@ -92,8 +93,21 @@ def _code_rev():
         d = subprocess.run(
             ["git", "-C", REPO, "diff", "HEAD", "--"] + paths,
             capture_output=True, text=True, timeout=30).stdout
-        import hashlib
-        return hashlib.sha1((h + d).encode()).hexdigest()[:12]
+        # Untracked sources are invisible to both rev-parse and diff —
+        # a brand-new module measured before its first commit would
+        # otherwise share a fingerprint with the tree that lacks it.
+        u = subprocess.run(
+            ["git", "-C", REPO, "ls-files", "--others",
+             "--exclude-standard", "--"] + paths,
+            capture_output=True, text=True, timeout=30).stdout
+        parts = [h.encode(), d.encode()]
+        for name in sorted(u.split()):
+            try:
+                with open(os.path.join(REPO, name), "rb") as f:
+                    parts.append(name.encode() + b"\0" + f.read())
+            except OSError:  # racing delete: name alone still shifts it
+                parts.append(name.encode() + b"\0?")
+        return hashlib.sha1(b"".join(parts)).hexdigest()[:12]
     except Exception:  # noqa: BLE001 — no git: disable reuse, not bench
         return "unknown"
 

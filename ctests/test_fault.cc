@@ -1,0 +1,306 @@
+// Unit tests for the resilience plane: fault-spec parsing, deterministic
+// injection windows, the proxy's drop->retry->success path, per-op
+// deadlines, retry exhaustion, and dead-peer detection on both wire planes
+// (EOF on sockets, heartbeat loss on shm rings — which have no EOF).
+//
+// Everything runs in-process with real transports (the test_transport.cc
+// two-ranks-in-one-process shape), so the acceptance path "injected
+// transient drop is retried with backoff and the op completes" is checked
+// at the C layer before the Python tests drive it end to end.
+
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <memory>
+#include <thread>
+
+#include "acx/fault.h"
+#include "acx/net.h"
+#include "acx/proxy.h"
+#include "acx/state.h"
+#include "src/net/link.h"
+
+extern "C" {
+int MPIX_Set_deadline(double timeout_ms);
+int MPIX_Get_deadline(double* timeout_ms);
+int MPIX_Op_status(void* request, int* state, int* error, int* attempts);
+}
+
+#define CHECK(cond)                                                        \
+  do {                                                                     \
+    if (!(cond)) {                                                         \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      std::exit(1);                                                        \
+    }                                                                      \
+  } while (0)
+
+using namespace acx;
+
+namespace {
+
+uint64_t ElapsedMs(uint64_t t0) { return (NowNs() - t0) / 1000000; }
+
+void RestorePolicy() {
+  Policy().timeout_ns.store(0);
+  Policy().backoff_us.store(200);
+  Policy().max_retries.store(8);
+  fault::Configure(fault::Config{});  // disarm
+}
+
+void test_parse_spec() {
+  fault::Config c;
+  CHECK(fault::ParseSpec("drop", &c));
+  CHECK(c.action == fault::Action::kDrop);
+  CHECK(c.rank == -1 && c.kind == 0 && c.peer == -1);
+  CHECK(c.nth == 1 && c.count == 1);
+
+  CHECK(fault::ParseSpec("drop:rank=1:kind=send:nth=3:count=2", &c));
+  CHECK(c.action == fault::Action::kDrop);
+  CHECK(c.rank == 1 && c.kind == 1 && c.nth == 3 && c.count == 2);
+
+  CHECK(fault::ParseSpec("delay:us=2500:kind=recv:peer=2", &c));
+  CHECK(c.action == fault::Action::kDelay);
+  CHECK(c.delay_us == 2500 && c.kind == 2 && c.peer == 2);
+
+  CHECK(fault::ParseSpec("fail:err=21:kind=any", &c));
+  CHECK(c.action == fault::Action::kFail);
+  CHECK(c.err == 21 && c.kind == 0);
+
+  CHECK(fault::ParseSpec("none", &c));
+  CHECK(c.action == fault::Action::kNone);
+
+  // Malformed specs must be rejected, not half-parsed.
+  CHECK(!fault::ParseSpec("", &c));
+  CHECK(!fault::ParseSpec(nullptr, &c));
+  CHECK(!fault::ParseSpec("explode", &c));
+  CHECK(!fault::ParseSpec("drop:bogus=1", &c));
+  CHECK(!fault::ParseSpec("drop:rank", &c));
+  CHECK(!fault::ParseSpec("drop:kind=sideways", &c));
+  CHECK(!fault::ParseSpec("drop:nth=0", &c));
+  CHECK(!fault::ParseSpec("drop:count=0", &c));
+  std::printf("parse_spec: OK\n");
+}
+
+void test_on_issue_window() {
+  fault::Config c;
+  CHECK(fault::ParseSpec("fail:rank=0:kind=send:nth=2:count=2", &c));
+  fault::Configure(c);
+  uint64_t us = 0;
+  int err = 0;
+  // Filtered out: wrong rank / wrong kind never consume the window.
+  CHECK(fault::OnIssue(1, true, 0, &us, &err) == fault::Action::kNone);
+  CHECK(fault::OnIssue(0, false, 0, &us, &err) == fault::Action::kNone);
+  // Matching attempts 1..4: window [2, 4) hits.
+  CHECK(fault::OnIssue(0, true, 0, &us, &err) == fault::Action::kNone);
+  CHECK(fault::OnIssue(0, true, 0, &us, &err) == fault::Action::kFail);
+  CHECK(err == kErrInjected);  // err=0 in spec -> default code
+  CHECK(fault::OnIssue(0, true, 0, &us, &err) == fault::Action::kFail);
+  CHECK(fault::OnIssue(0, true, 0, &us, &err) == fault::Action::kNone);
+  CHECK(fault::stats().fails >= 2);
+  RestorePolicy();
+  std::printf("on_issue_window: OK\n");
+}
+
+// Post one enqueued op through a real FlagTable+Proxy and wait for COMPLETED.
+int RunOpThroughProxy(Transport* t, uint32_t max_retries, uint64_t backoff_us,
+                      uint64_t timeout_ms, Proxy::Stats* out_stats,
+                      OpKind kind = OpKind::kIsend) {
+  Policy().max_retries.store(max_retries);
+  Policy().backoff_us.store(backoff_us);
+  Policy().timeout_ns.store(timeout_ms * 1000000);
+  FlagTable table(8);
+  Proxy proxy(&table, t);
+  proxy.Start();
+  static int payload = 777;
+  const int idx = table.Allocate();
+  CHECK(idx >= 0);
+  Op& op = table.op(idx);
+  op.kind = kind;
+  op.sbuf = &payload;
+  op.rbuf = &payload;
+  op.bytes = sizeof payload;
+  op.peer = 0;  // self
+  op.tag = 5;
+  op.ctx = 0;
+  table.Store(idx, kPending);
+  proxy.Kick();
+  const uint64_t t0 = NowNs();
+  while (table.Load(idx) != kCompleted) {
+    CHECK(ElapsedMs(t0) < 10000);  // the whole point: bounded time
+    std::this_thread::yield();
+  }
+  const int err = op.status.error;
+  if (out_stats != nullptr) *out_stats = proxy.stats();
+  proxy.Stop();
+  return err;
+}
+
+void test_drop_retry_success() {
+  std::unique_ptr<Transport> t(CreateSelfTransport());
+  fault::Config c;
+  // Drop the first send issue attempt; the retry (2nd attempt) goes clean.
+  CHECK(fault::ParseSpec("drop:kind=send:nth=1", &c));
+  const uint64_t drops_before = fault::stats().drops;
+  fault::Configure(c);
+  Proxy::Stats s{};
+  const int err = RunOpThroughProxy(t.get(), 8, 100, 0, &s);
+  CHECK(err == 0);  // op completed successfully after the retry
+  CHECK(s.retries >= 1);
+  CHECK(s.timeouts == 0);
+  CHECK(fault::stats().drops == drops_before + 1);
+  RestorePolicy();
+  std::printf("drop_retry_success: OK\n");
+}
+
+void test_injected_fail() {
+  std::unique_ptr<Transport> t(CreateSelfTransport());
+  fault::Config c;
+  CHECK(fault::ParseSpec("fail:kind=send:nth=1", &c));
+  fault::Configure(c);
+  Proxy::Stats s{};
+  const int err = RunOpThroughProxy(t.get(), 8, 100, 0, &s);
+  CHECK(err == kErrInjected);
+  RestorePolicy();
+  std::printf("injected_fail: OK\n");
+}
+
+void test_injected_delay() {
+  std::unique_ptr<Transport> t(CreateSelfTransport());
+  fault::Config c;
+  CHECK(fault::ParseSpec("delay:kind=send:nth=1:us=30000", &c));
+  fault::Configure(c);
+  const uint64_t t0 = NowNs();
+  Proxy::Stats s{};
+  const int err = RunOpThroughProxy(t.get(), 8, 100, 0, &s);
+  CHECK(err == 0);
+  CHECK(ElapsedMs(t0) >= 25);  // the 30ms gate actually held the op
+  RestorePolicy();
+  std::printf("injected_delay: OK\n");
+}
+
+void test_retries_exhausted() {
+  std::unique_ptr<Transport> t(CreateSelfTransport());
+  fault::Config c;
+  // Every attempt dropped; with max_retries=2 the op must fail kErrTimeout
+  // after 3 attempts instead of retrying forever.
+  CHECK(fault::ParseSpec("drop:kind=send:count=1000000", &c));
+  fault::Configure(c);
+  Proxy::Stats s{};
+  const int err = RunOpThroughProxy(t.get(), 2, 1, 0, &s);
+  CHECK(err == kErrTimeout);
+  CHECK(s.timeouts >= 1);
+  RestorePolicy();
+  std::printf("retries_exhausted: OK\n");
+}
+
+void test_deadline_timeout() {
+  std::unique_ptr<Transport> t(CreateSelfTransport());
+  // A recv nothing ever matches: must complete with kErrTimeout within the
+  // 50ms deadline, not hang.
+  const uint64_t t0 = NowNs();
+  Proxy::Stats s{};
+  const int err = RunOpThroughProxy(t.get(), 8, 100, 50, &s, OpKind::kIrecv);
+  CHECK(err == kErrTimeout);
+  CHECK(s.timeouts >= 1);
+  CHECK(ElapsedMs(t0) >= 45);
+  RestorePolicy();
+  std::printf("deadline_timeout: OK\n");
+}
+
+void test_eof_dead_peer() {
+  int a[2];
+  CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, a) == 0);
+  std::unique_ptr<Transport> t0(CreateSocketTransport(0, 2, {-1, a[0]}));
+  std::unique_ptr<Transport> t1(CreateSocketTransport(1, 2, {a[1], -1}));
+  t1.reset();  // rank 1 dies: its end of the socketpair closes
+  int v = 0;
+  std::unique_ptr<Ticket> r(t0->Irecv(&v, sizeof v, 1, 7, 0));
+  Status st;
+  const uint64_t start = NowNs();
+  while (!r->Test(&st)) {
+    CHECK(ElapsedMs(start) < 5000);
+    std::this_thread::yield();
+  }
+  CHECK(st.error == kErrPeerDead);
+  // Once latched, new ops against the dead peer error immediately.
+  std::unique_ptr<Ticket> s(t0->Isend(&v, sizeof v, 1, 7, 0));
+  CHECK(s->Test(&st));
+  CHECK(st.error == kErrPeerDead);
+  CHECK(t0->net_stats().peers_dead == 1);
+  CHECK(t0->net_stats().failed_ops >= 1);
+  std::printf("eof_dead_peer: OK\n");
+}
+
+void test_heartbeat_dead_peer() {
+  // Shm rings have no EOF: death is only observable via heartbeat silence.
+  setenv("ACX_HEARTBEAT_MS", "20", 1);
+  setenv("ACX_PEER_TIMEOUT_MS", "200", 1);
+  setenv("ACX_PEER_GRACE_MS", "100", 1);
+  const size_t ring_bytes = 4096;
+  const size_t len = ShmSegmentBytes(2, ring_bytes);
+  void* shm = mmap(nullptr, len, PROT_READ | PROT_WRITE,
+                   MAP_SHARED | MAP_ANONYMOUS, -1, 0);
+  CHECK(shm != MAP_FAILED);
+  {
+    std::unique_ptr<Transport> t0(CreateShmTransport(0, 2, shm, ring_bytes));
+    // Rank 1's transport exists but is NEVER progressed — a wedged peer.
+    std::unique_ptr<Transport> t1(CreateShmTransport(1, 2, shm, ring_bytes));
+    int v = 0;
+    std::unique_ptr<Ticket> r(t0->Irecv(&v, sizeof v, 1, 7, 0));
+    Status st;
+    const uint64_t start = NowNs();
+    while (!r->Test(&st)) {
+      CHECK(ElapsedMs(start) < 5000);
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+    CHECK(st.error == kErrPeerDead);
+    CHECK(ElapsedMs(start) >= 100);  // grace window held
+    const NetStats ns = t0->net_stats();
+    CHECK(ns.hb_sent >= 1);
+    CHECK(ns.peers_dead == 1);
+  }
+  munmap(shm, len);
+  unsetenv("ACX_HEARTBEAT_MS");
+  unsetenv("ACX_PEER_TIMEOUT_MS");
+  unsetenv("ACX_PEER_GRACE_MS");
+  std::printf("heartbeat_dead_peer: OK\n");
+}
+
+void test_deadline_api() {
+  double ms = -1;
+  CHECK(MPIX_Set_deadline(1234.5) == 0);
+  CHECK(MPIX_Get_deadline(&ms) == 0);
+  CHECK(ms > 1234.4 && ms < 1234.6);
+  CHECK(MPIX_Set_deadline(-1) != 0);  // rejected, value unchanged
+  CHECK(MPIX_Get_deadline(&ms) == 0);
+  CHECK(ms > 1234.4 && ms < 1234.6);
+  CHECK(MPIX_Get_deadline(nullptr) != 0);
+  CHECK(MPIX_Set_deadline(0) == 0);  // disarm
+  // Bad handles are rejected, not dereferenced.
+  int st = 0, err = 0, att = 0;
+  CHECK(MPIX_Op_status(nullptr, &st, &err, &att) != 0);
+  RestorePolicy();
+  std::printf("deadline_api: OK\n");
+}
+
+}  // namespace
+
+int main() {
+  test_parse_spec();
+  test_on_issue_window();
+  test_drop_retry_success();
+  test_injected_fail();
+  test_injected_delay();
+  test_retries_exhausted();
+  test_deadline_timeout();
+  test_eof_dead_peer();
+  test_heartbeat_dead_peer();
+  test_deadline_api();
+  std::printf("test_fault: ALL OK\n");
+  return 0;
+}

@@ -1,0 +1,97 @@
+// tpu-acx: resilience plane — deterministic fault injection plus the
+// retry/deadline policy shared by the proxy engine and the API surface.
+//
+// The reference's failure story is MPI_ERRORS_ARE_FATAL (SURVEY.md §5.3):
+// a lost message or dead peer wedges every rank silently. This layer makes
+// failure paths first-class AND testable: the proxy consults OnIssue() at
+// every post attempt, so "drop the 2nd send issued on rank 1" is a
+// one-line env spec (ACX_FAULT, propagated by `acxrun -fault`) instead of
+// a heisenbug. Actions:
+//   * drop  — the issue attempt is swallowed (nothing reaches the wire);
+//             the op sits ISSUED with no ticket until the proxy's
+//             retry/backoff ladder re-posts it — the transient-loss path.
+//   * delay — the issue is postponed by `us` microseconds.
+//   * fail  — the op completes immediately with an error status (default
+//             kErrInjected) — the permanent-failure path.
+//
+// Spec grammar: action[:key=value]...
+//   rank=R   inject only on rank R               (default: every rank)
+//   kind=K   send | recv | any                   (default: any)
+//   peer=P   only ops to/from peer P             (default: any)
+//   nth=N    first matching issue attempt hit, 1-based   (default 1)
+//   count=C  how many consecutive matches are hit        (default 1)
+//   us=U     delay microseconds (delay action)           (default 1000)
+//   err=E    status error code (fail action)     (default kErrInjected)
+// Example: ACX_FAULT=drop:rank=0:kind=send:nth=1
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+
+namespace acx {
+
+// Steady-clock nanoseconds; the one clock the resilience plane keys on
+// (deadlines, backoff timers, heartbeats must never jump with wall time).
+inline uint64_t NowNs() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
+
+namespace fault {
+
+enum class Action : int32_t { kNone = 0, kDrop = 1, kDelay = 2, kFail = 3 };
+
+struct Config {
+  Action action = Action::kNone;
+  int rank = -1;   // -1 = any rank
+  int kind = 0;    // 0 = any, 1 = send, 2 = recv
+  int peer = -1;   // -1 = any peer
+  int nth = 1;     // 1-based index of the first matching attempt hit
+  int count = 1;   // how many consecutive matches are hit
+  uint64_t delay_us = 1000;
+  int err = 0;     // 0 = kErrInjected
+};
+
+// True iff a fault spec is armed (ACX_FAULT at first use, or Configure()).
+// One relaxed load on the armed path; the proxy gates all fault work on it.
+bool Enabled();
+
+// Parse an ACX_FAULT-style spec. Returns false (out untouched) on a
+// malformed spec.
+bool ParseSpec(const char* spec, Config* out);
+
+// Install a config programmatically (tests). Action::kNone disarms.
+// Resets the matched-attempt counter. Not safe against a concurrently
+// sweeping proxy — configure before ops are in flight.
+void Configure(const Config& cfg);
+
+// Consult the plane for one issue attempt; counts matching attempts and
+// returns the armed action when this attempt falls in [nth, nth+count).
+// kDelay fills *delay_us; kFail fills *err.
+Action OnIssue(int rank, bool is_send, int peer, uint64_t* delay_us,
+               int* err);
+
+struct Stats {
+  uint64_t drops = 0;
+  uint64_t delays = 0;
+  uint64_t fails = 0;
+};
+Stats stats();
+
+}  // namespace fault
+
+// Process-wide retry/deadline policy for enqueued ops. Env-seeded at first
+// use (ACX_OP_TIMEOUT_MS: per-op deadline, 0 = none; ACX_RETRY_BACKOFF_US:
+// initial re-post backoff; ACX_MAX_RETRIES: re-post budget for an op whose
+// issue was lost), mutable at runtime through MPIX_Set_deadline.
+struct RetryPolicy {
+  std::atomic<uint64_t> timeout_ns{0};
+  std::atomic<uint64_t> backoff_us{200};
+  std::atomic<uint32_t> max_retries{8};
+};
+RetryPolicy& Policy();
+
+}  // namespace acx

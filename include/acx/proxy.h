@@ -49,6 +49,8 @@ class Proxy {
     uint64_t ops_issued = 0;
     uint64_t ops_completed = 0;
     uint64_t slots_reclaimed = 0;
+    uint64_t retries = 0;   // re-posts of ops whose issue was lost
+    uint64_t timeouts = 0;  // ops failed by deadline or retry exhaustion
   };
   Stats stats() const;
 
@@ -58,6 +60,11 @@ class Proxy {
   // Callers must hold sweep_mu_ (one sweeper at a time: the PENDING->ISSUED
   // and CLEANUP->AVAILABLE transitions are plain stores).
   bool Sweep();
+  // Post (or fault-gate) one op attempt. from_pending distinguishes a fresh
+  // PENDING trigger from a retry of an ISSUED op whose post was lost.
+  bool IssueOp(size_t i, Op& op, Stats& local, bool from_pending);
+  // Deadline/retry policing for an ISSUED-but-incomplete op.
+  bool CheckStalled(size_t i, Op& op, Stats& local);
 
   FlagTable* table_;
   Transport* transport_;
@@ -74,6 +81,8 @@ class Proxy {
   std::atomic<uint64_t> ops_issued_{0};
   std::atomic<uint64_t> ops_completed_{0};
   std::atomic<uint64_t> slots_reclaimed_{0};
+  std::atomic<uint64_t> retries_{0};
+  std::atomic<uint64_t> timeouts_{0};
 };
 
 }  // namespace acx

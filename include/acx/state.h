@@ -63,6 +63,12 @@ enum class OpKind : int32_t {
 // substrate for free).
 constexpr int kErrTruncate = 17;
 
+// Resilience-plane error codes (tpu-acx extension; the reference's only
+// failure story is MPI_ERRORS_ARE_FATAL abort, SURVEY.md §5.3).
+constexpr int kErrTimeout = 19;   // per-op deadline expired / retries exhausted
+constexpr int kErrPeerDead = 20;  // peer declared dead (EOF or heartbeat loss)
+constexpr int kErrInjected = 21;  // ACX_FAULT fail action (default code)
+
 // Transfer completion status (maps onto MPI_Status in the compat layer).
 struct Status {
   int source = -1;
@@ -98,6 +104,13 @@ struct Op {
   // -- partitioned --
   PartitionedChan* chan = nullptr;
   int partition = -1;
+
+  // -- resilience bookkeeping (proxy-private; reset with the op) --
+  uint64_t deadline_ns = 0;    // absolute op deadline, 0 = none
+  uint64_t retry_at_ns = 0;    // earliest re-post time for a lost issue
+  uint64_t not_before_ns = 0;  // injected-delay gate on a PENDING op
+  uint32_t attempts = 0;       // issue attempts (incl. dropped ones)
+  uint32_t backoff_us = 0;     // current backoff step (doubles per retry)
 
   void Reset() { *this = Op{}; }
 };

@@ -46,6 +46,15 @@ struct PartitionedChan {
   bool is_send = false;
 };
 
+// Transport-level resilience counters (heartbeats + dead-peer detection;
+// zero on transports without a failure model, e.g. self/loopback).
+struct NetStats {
+  uint64_t hb_sent = 0;
+  uint64_t hb_recv = 0;
+  uint64_t peers_dead = 0;
+  uint64_t failed_ops = 0;  // in-flight ops failed by dead-peer teardown
+};
+
 class Transport {
  public:
   virtual ~Transport() = default;
@@ -73,6 +82,12 @@ class Transport {
   virtual void AllreduceInt(int32_t* data, int count, int op, int ctx) = 0;
 
   virtual void Abort(int code) = 0;
+
+  // Drive background protocol work (heartbeats, dead-peer checks) when no
+  // Ticket::Test is pumping the transport. The proxy calls this from its
+  // idle branches; transports without background work ignore it.
+  virtual void Tick() {}
+  virtual NetStats net_stats() const { return NetStats{}; }
 };
 
 }  // namespace acx

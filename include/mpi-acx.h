@@ -82,6 +82,29 @@ int MPIX_Request_free(MPIX_Request *request);
 int MPIX_Pready(int partition, void *request);
 int MPIX_Parrived(void *request, int partition, int *flag);
 
+/* RESILIENCE (tpu-acx extension, no reference counterpart — the reference's
+ * failure story is MPI_ERRORS_ARE_FATAL). Op-level deadlines and failure
+ * codes surfaced by the proxy's retry/timeout machinery and the transport's
+ * dead-peer detection; see docs/DESIGN.md "Failure model". */
+
+#define MPIX_ERR_TIMEOUT   19  /* per-op deadline expired / retries exhausted */
+#define MPIX_ERR_PEER_DEAD 20  /* peer declared dead (EOF / heartbeat loss) */
+#define MPIX_ERR_INJECTED  21  /* ACX_FAULT fail action */
+
+/* Process-wide per-op deadline in milliseconds (0 disables; initial value
+ * comes from ACX_OP_TIMEOUT_MS). Applies to ops issued after the call. */
+int MPIX_Set_deadline(double timeout_ms);
+int MPIX_Get_deadline(double *timeout_ms);
+
+/* Nonblocking introspection of a request: *state is the acx flag value
+ * (0 AVAILABLE .. 5 CLEANUP), *error the op's status code once COMPLETED
+ * (0 before), *attempts the issue-attempt count (retries show up here).
+ * For partitioned requests: min state, first error, max attempts across
+ * partitions. Any out-pointer may be NULL. Returns nonzero on a bad
+ * handle. */
+int MPIX_Op_status(MPIX_Request request, int *state, int *error,
+                   int *attempts);
+
 #ifdef __cplusplus
 }
 #endif

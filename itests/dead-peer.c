@@ -1,0 +1,79 @@
+/* tpu-acx integration test: dead-peer detection bounds a wedged recv.
+ *
+ * Ranks != 0 exit right after init WITHOUT finalizing — simulated crashed
+ * peers. Rank 0 then posts a recv from rank 1 that can never be satisfied
+ * and must get a PEER_DEAD (or, failsafe, TIMEOUT) status in bounded time
+ * instead of hanging forever — the reference's behavior in this scenario
+ * is an indefinite wedge (its only failure story is MPI_ERRORS_ARE_FATAL).
+ * Detection is EOF on the socket plane and heartbeat loss on the shm plane
+ * (rings have no EOF), so this test is meaningful in every `make check`
+ * transport config. Run under `acxrun -np N`.
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <unistd.h>
+
+#include <mpi.h>
+#include <mpi-acx.h>
+
+#ifdef __cplusplus
+extern "C"
+#endif
+void acx_resilience_stats(uint64_t *out);
+
+int main(int argc, char **argv) {
+    /* Heartbeat knobs must be armed before the transport is created. */
+    setenv("ACX_HEARTBEAT_MS", "25", 1);
+    setenv("ACX_PEER_TIMEOUT_MS", "150", 1);
+    setenv("ACX_PEER_GRACE_MS", "500", 1);
+
+    int provided, rank, size, errs = 0;
+    MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided);
+    if (provided < MPI_THREAD_MULTIPLE) MPI_Abort(MPI_COMM_WORLD, 1);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    if (size < 2) {
+        printf("dead-peer: needs >= 2 ranks\n");
+        MPI_Abort(MPI_COMM_WORLD, 1);
+    }
+
+    if (MPIX_Init()) MPI_Abort(MPI_COMM_WORLD, 2);
+
+    if (rank != 0) _exit(0); /* crash: no finalize, no goodbye */
+
+    /* Failsafe: even if detection somehow missed, the per-op deadline
+     * bounds the wait well under acxrun's job timeout. */
+    MPIX_Set_deadline(5000);
+
+    int v = -1;
+    MPIX_Request req;
+    MPI_Status st;
+    cudaStream_t stream = 0;
+    MPIX_Irecv_enqueue(&v, 1, MPI_INT, 1, 0, MPI_COMM_WORLD, &req,
+                       MPIX_QUEUE_XLA_STREAM, &stream);
+    MPIX_Wait(&req, &st);
+
+    if (st.MPI_ERROR != MPIX_ERR_PEER_DEAD &&
+        st.MPI_ERROR != MPIX_ERR_TIMEOUT) {
+        printf("[0] expected PEER_DEAD/TIMEOUT status, got %d\n",
+               st.MPI_ERROR);
+        errs++;
+    }
+
+    /* The failure must be visible in the resilience counters, not just in
+     * the one status (acceptance: counters in proxy statistics). */
+    uint64_t rs[8];
+    acx_resilience_stats(rs);
+    if (rs[7] < 1 && rs[1] < 1) {
+        printf("[0] no peer-dead (%llu) or timeout (%llu) counted\n",
+               (unsigned long long)rs[7], (unsigned long long)rs[1]);
+        errs++;
+    }
+
+    MPIX_Set_deadline(0);
+    MPIX_Finalize();
+    MPI_Finalize(); /* barrier against dead peers must not hang */
+    if (errs == 0) printf("dead-peer: OK\n");
+    return errs != 0;
+}

@@ -45,8 +45,10 @@ def _bucket(n: int, lo: int = 8) -> int:
 def make_server_fns(params, cfg, family, chunk: int = 1,
                     kv_int8: bool = False, sample_cfg=None):
     """Compile-once closures for the serve loop: returns (prefill_fn,
-    step_fn, scatter_fn, kv_int8, sample_cfg) — the trailing flags let
-    serve_greedy/serve_sample verify a reused tuple matches the call.
+    step_fn, scatter_fn, chunk, kv_int8, sample_cfg) — the trailing
+    values let serve_greedy/serve_sample verify a reused tuple matches
+    the call (chunk is baked into step_fn's scan length, so a tuple
+    built for chunk=8 silently mis-serves a chunk=1 call otherwise).
     ``family`` is the model module (models.transformer, models.llama,
     or models.moe_transformer — anything exposing
     prefill/decode_step/init_kv_cache with the shared cache layout).
@@ -133,24 +135,35 @@ def make_server_fns(params, cfg, family, chunk: int = 1,
         slots["pos"] = slots["pos"].at[slot_idx].set(new_pos)
         return slots
 
-    # kv_int8/sample_cfg ride along so the serve entry points can
+    # chunk/kv_int8/sample_cfg ride along so the serve entry points can
     # reject a mismatched reuse (e.g. int8 slots + bf16-prefill
-    # closures, or a step jitted with different sampling params, fail
-    # deep in a trace — or worse, silently — otherwise).
-    return prefill_fn, step_fn, scatter_fn, kv_int8, sample_cfg
+    # closures, a step scanning a different chunk length, or a step
+    # jitted with different sampling params, fail deep in a trace — or
+    # worse, silently — otherwise).
+    return prefill_fn, step_fn, scatter_fn, chunk, kv_int8, sample_cfg
 
 
 def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
-           chunk, server_fns, kv_int8, sample_cfg, key):
+           chunk, server_fns, kv_int8, sample_cfg, key,
+           max_request_retries=2):
     """The scheduler shared by serve_greedy and serve_sample — queue,
     slot ownership, chunk-block consumption, retire/refill. Sampling
     only changes (a) how the step picks tokens (make_server_fns
     sample_cfg) and (b) the first token at refill, drawn on the host
     with request rid's own key stream fold_in(key, rid), split exactly
-    as decoding.sample_generate splits."""
+    as decoding.sample_generate splits.
+
+    Degrades gracefully under step/prefill failure (the serving face of
+    the runtime's retry plane): a request whose device step raised is
+    re-queued from scratch — emitted tokens discarded, so the restart
+    replays the same greedy/sampled path bit for bit — up to
+    ``max_request_retries`` times before the failure is re-raised with
+    the request id attached."""
     if family is None:
         from mpi_acx_tpu.models import transformer as family  # noqa: N813
     assert prompts, "no requests"
+    assert all(len(p) > 0 for p in prompts), \
+        "zero-length prompt (prefill needs at least one token to attend)"
     n_new = ([int(n_new)] * len(prompts) if np.ndim(n_new) == 0
              else [int(n) for n in n_new])
     assert len(n_new) == len(prompts), (len(n_new), len(prompts))
@@ -167,7 +180,11 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
         server_fns = make_server_fns(params, cfg, family, chunk=chunk,
                                      kv_int8=kv_int8,
                                      sample_cfg=sample_cfg)
-    prefill_fn, step_fn, scatter_fn, fns_int8, fns_sample = server_fns
+    (prefill_fn, step_fn, scatter_fn, fns_chunk, fns_int8,
+     fns_sample) = server_fns
+    assert fns_chunk == chunk, \
+        (f"server_fns built for chunk={fns_chunk}, this call uses "
+         f"chunk={chunk} (the scan length is baked into step_fn)")
     assert fns_int8 == kv_int8, \
         "server_fns built with a different kv_int8 than this call"
     assert fns_sample == sample_cfg, \
@@ -186,7 +203,26 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
     keys = jax.random.split(key if key is not None else jax.random.key(0),
                             n_slots)
 
+    # Per-request failure budget: a request whose prefill or decode step
+    # raised restarts from scratch this many times before the error
+    # propagates (0 = fail fast).
+    attempts = [0] * len(prompts)
+
+    def _requeue(rid, prompt, exc):
+        """Put a failed request back on the queue for a bit-equal
+        restart (emitted tokens discarded; refill replays the same
+        greedy/per-rid-key path), or re-raise past the retry budget."""
+        attempts[rid] += 1
+        if attempts[rid] > max_request_retries:
+            raise RuntimeError(
+                f"request {rid} failed {attempts[rid]} time(s), past "
+                f"max_request_retries={max_request_retries}") from exc
+        emitted[rid] = []
+        queue.append((rid, prompt))
+
     def refill(b):
+        """Returns True iff slot b now owns a request; a failed prefill
+        re-queues the request instead of killing the server."""
         nonlocal slots, keys
         rid, prompt = queue.popleft()
         S = len(prompt)
@@ -196,27 +232,37 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
         padded = np.zeros((1, min(_bucket(S), max_len, cfg.max_seq)),
                           np.int32)
         padded[0, :S] = prompt
-        logits, one = prefill_fn(jnp.asarray(padded), S - 1)
-        if sample_cfg is None:
-            first = int(jnp.argmax(logits[0, 0]))
-        else:
-            from mpi_acx_tpu.models.decoding import sample_logits
-            rkey, sub = jax.random.split(jax.random.fold_in(key, rid))
-            first = int(sample_logits(
-                logits[0, 0][None].astype(jnp.float32), sub,
-                *sample_cfg)[0])
-            keys = keys.at[b].set(rkey)
-        slots = scatter_fn(slots, one, b, S)
+        try:
+            logits, one = prefill_fn(jnp.asarray(padded), S - 1)
+            if sample_cfg is None:
+                first = int(jnp.argmax(logits[0, 0]))
+            else:
+                from mpi_acx_tpu.models.decoding import sample_logits
+                rkey, sub = jax.random.split(jax.random.fold_in(key, rid))
+                first = int(sample_logits(
+                    logits[0, 0][None].astype(jnp.float32), sub,
+                    *sample_cfg)[0])
+                keys = keys.at[b].set(rkey)
+            slots = scatter_fn(slots, one, b, S)
+        except Exception as exc:  # noqa: BLE001 — any device failure
+            _requeue(rid, prompt, exc)
+            return False
         owner[b] = rid
         emitted[rid].append(first)
         last_tok[b] = first
+        return True
 
     def retire(b):
+        nonlocal slots
         rid = owner[b]
         done[rid] = np.concatenate(
             [np.asarray(prompts[rid], np.int32),
              np.asarray(emitted[rid], np.int32)])
         owner[b] = -1
+        # Park the freed slot at pos 0: an idle slot keeps stepping in
+        # the batch, and a stale pos walks toward max_len where the
+        # decode write would land out of bounds on a long-idle slot.
+        slots["pos"] = slots["pos"].at[b].set(0)
 
     def slot_finished(b):
         rid = owner[b]
@@ -228,12 +274,38 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
     # never enters the decode loop already finished.
     while queue and any(o < 0 for o in owner):
         b = owner.index(-1)
-        refill(b)
-        if slot_finished(b):
+        if refill(b) and slot_finished(b):
             retire(b)
 
-    while any(o >= 0 for o in owner):
-        slots, toks, keys = step_fn(slots, jnp.asarray(last_tok), keys)
+    while any(o >= 0 for o in owner) or queue:
+        if not any(o >= 0 for o in owner):
+            # All slots idle with requests still queued: only reachable
+            # after a failure re-queued them — reseed and keep serving.
+            while queue and any(o < 0 for o in owner):
+                b = owner.index(-1)
+                if refill(b) and slot_finished(b):
+                    retire(b)
+            continue
+        try:
+            slots, toks, keys = step_fn(slots, jnp.asarray(last_tok), keys)
+        except Exception as exc:  # noqa: BLE001 — any device failure
+            # step_fn donates the slot cache, so after a failed dispatch
+            # its buffers cannot be trusted. Re-queue every active
+            # request (bit-equal restart, bounded per request by
+            # max_request_retries), rebuild the cache, and continue —
+            # the queued-but-unstarted requests are unaffected.
+            for b in range(n_slots):
+                if owner[b] >= 0:
+                    rid = owner[b]
+                    owner[b] = -1
+                    _requeue(rid, np.asarray(prompts[rid], np.int32), exc)
+            slots = family.init_kv_cache(cfg, n_slots, max_len,
+                                         kv_int8=kv_int8)
+            slots["pos"] = jnp.zeros((n_slots,), jnp.int32)
+            keys = jax.random.split(
+                key if key is not None else jax.random.key(0), n_slots)
+            last_tok = np.zeros((n_slots,), np.int32)
+            continue
         block = np.asarray(toks, np.int32)           # [chunk, B]
         for b in range(n_slots):
             last_tok[b] = block[-1, b]
@@ -261,7 +333,8 @@ def serve_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
                  n_slots: int, max_len: int, family=None,
                  eos: Optional[int] = None, chunk: int = 1,
                  server_fns=None,
-                 kv_int8: bool = False) -> List[np.ndarray]:
+                 kv_int8: bool = False,
+                 max_request_retries: int = 2) -> List[np.ndarray]:
     """Serve ``prompts`` (1-D int arrays, any lengths) through
     ``n_slots`` continuously-batched cache slots; each request decodes
     greedily for ``n_new`` tokens (an int, or one per request — the
@@ -279,9 +352,13 @@ def serve_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
     long-context regime where the cache stream dominates; outputs then
     equal the solo ``generate(..., kv_int8=True)`` runs bit for bit
     (same codes, same scales, same scale-on-scores read).
+    ``max_request_retries`` bounds per-request restarts after a failed
+    prefill/step (see _serve) — a transient device fault costs the
+    failed requests a replay, not the server.
     """
     return _serve(params, cfg, prompts, n_new, n_slots, max_len, family,
-                  eos, chunk, server_fns, kv_int8, None, None)
+                  eos, chunk, server_fns, kv_int8, None, None,
+                  max_request_retries=max_request_retries)
 
 
 def serve_sample(params, cfg, prompts: Sequence[np.ndarray], n_new,
@@ -290,7 +367,8 @@ def serve_sample(params, cfg, prompts: Sequence[np.ndarray], n_new,
                  top_p: Optional[float] = None,
                  eos: Optional[int] = None, chunk: int = 1,
                  server_fns=None,
-                 kv_int8: bool = False) -> List[np.ndarray]:
+                 kv_int8: bool = False,
+                 max_request_retries: int = 2) -> List[np.ndarray]:
     """Stochastic continuous batching (temperature / top-k / top-p).
 
     Request ``rid`` draws from its own key stream
@@ -303,4 +381,5 @@ def serve_sample(params, cfg, prompts: Sequence[np.ndarray], n_new,
     """
     return _serve(params, cfg, prompts, n_new, n_slots, max_len, family,
                   eos, chunk, server_fns, kv_int8,
-                  (temperature, top_k, top_p), key)
+                  (temperature, top_k, top_p), key,
+                  max_request_retries=max_request_retries)

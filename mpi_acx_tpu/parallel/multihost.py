@@ -25,13 +25,21 @@ _initialized = False
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
-               process_id: Optional[int] = None) -> None:
+               process_id: Optional[int] = None,
+               timeout_s: Optional[float] = None) -> None:
     """Bootstrap the distributed runtime (MPIX_Init's process-level half).
 
     Arguments fall back to ACX_COORDINATOR / ACX_NPROCS / ACX_PROC_ID, so
     a launcher exports three env vars and workers call ``initialize()``
     bare. Single-process (no coordinator configured) is a no-op, letting
     the same worker script run standalone. Idempotent.
+
+    ``timeout_s`` (fallback: ACX_INIT_TIMEOUT_S) bounds the coordinator
+    rendezvous where the JAX build supports it — a dead coordinator or a
+    peer that never starts then raises instead of hanging the job, the
+    process-bootstrap face of the runtime's op deadlines. Failures raise
+    RuntimeError naming the coordinator/nprocs/proc triple so the
+    launcher log says WHICH rank failed to join, not just "init failed".
     """
     global _initialized
     if _initialized:
@@ -65,9 +73,26 @@ def initialize(coordinator_address: Optional[str] = None,
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     except Exception:
         pass
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    if timeout_s is None:
+        e = os.environ.get("ACX_INIT_TIMEOUT_S")
+        timeout_s = float(e) if e else None
+    kwargs = {}
+    if timeout_s is not None:
+        # Older jax.distributed.initialize has no timeout kwarg; a bounded
+        # init is best-effort there rather than a hard version floor.
+        import inspect
+        sig = inspect.signature(jax.distributed.initialize)
+        if "initialization_timeout" in sig.parameters:
+            kwargs["initialization_timeout"] = int(timeout_s)
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id, **kwargs)
+    except Exception as e:
+        raise RuntimeError(
+            f"tpu-acx: multihost initialize failed (coordinator="
+            f"{coordinator_address}, nprocs={num_processes}, "
+            f"proc={process_id}): {e}") from e
     _initialized = True
 
 

@@ -1055,4 +1055,4 @@ def make_tp_server_fns(params, cfg, mesh: Mesh, chunk: int = 1,
     def scatter_fn(slots, one, slot_idx, new_pos):
         return scatter_prog(slots, one, slot_idx, new_pos)
 
-    return prefill_fn, step_fn, scatter_fn, kv_int8, None
+    return prefill_fn, step_fn, scatter_fn, chunk, kv_int8, None

@@ -25,6 +25,32 @@ _LIB_PATH = os.path.join(_REPO_ROOT, "build", "libtpuacx.so")
 
 _lib: Optional[ctypes.CDLL] = None
 
+# Status.error values surfaced by the resilience plane (include/acx/state.h).
+# ERR_TRUNCATE stays a Status-level condition (MPI semantics); the three
+# below are raised as typed exceptions by wait().
+ERR_TRUNCATE = 17
+ERR_TIMEOUT = 19
+ERR_PEER_DEAD = 20
+ERR_INJECTED = 21
+
+
+class AcxError(RuntimeError):
+    """A host-plane operation completed with a resilience-plane error."""
+
+    def __init__(self, message: str, error: int, source: int, tag: int):
+        super().__init__(message)
+        self.error = error
+        self.source = source
+        self.tag = tag
+
+
+class AcxTimeoutError(AcxError):
+    """Op deadline expired or retries exhausted (MPIX_ERR_TIMEOUT)."""
+
+
+class AcxPeerDeadError(AcxError):
+    """Peer declared dead — EOF or heartbeat timeout (MPIX_ERR_PEER_DEAD)."""
+
 
 def _build_lib() -> None:
     subprocess.run(["make", "-C", _REPO_ROOT, "lib", "tools"], check=True,
@@ -52,6 +78,15 @@ def lib() -> ctypes.CDLL:
         _lib.acx_request_partition_slots.restype = ctypes.c_int
         _lib.acx_request_partition_slots.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+        _lib.acx_resilience_stats.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+        _lib.MPIX_Set_deadline.restype = ctypes.c_int
+        _lib.MPIX_Set_deadline.argtypes = [ctypes.c_double]
+        _lib.MPIX_Get_deadline.restype = ctypes.c_int
+        _lib.MPIX_Get_deadline.argtypes = [ctypes.POINTER(ctypes.c_double)]
+        _lib.MPIX_Op_status.restype = ctypes.c_int
+        _lib.MPIX_Op_status.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
     return _lib
 
 
@@ -135,10 +170,25 @@ class Runtime:
         return req
 
     def wait(self, req) -> Status:
+        """Block until the request completes. Resilience-plane failures
+        (op deadline expired / retries exhausted / peer dead / injected
+        fault) surface as typed exceptions; ERR_TRUNCATE stays in the
+        returned Status (MPI semantics)."""
         st = Status()
         rc = self._lib.MPIX_Wait(ctypes.byref(req), ctypes.byref(st))
         if rc != 0:
             raise RuntimeError("MPIX_Wait failed")
+        err = st.MPI_ERROR
+        if err in (ERR_TIMEOUT, ERR_PEER_DEAD, ERR_INJECTED):
+            cls = {ERR_TIMEOUT: AcxTimeoutError,
+                   ERR_PEER_DEAD: AcxPeerDeadError,
+                   ERR_INJECTED: AcxError}[err]
+            name = {ERR_TIMEOUT: "op timed out",
+                    ERR_PEER_DEAD: "peer dead",
+                    ERR_INJECTED: "injected fault"}[err]
+            raise cls(f"tpu-acx: {name} (error={err}, "
+                      f"source={st.MPI_SOURCE}, tag={st.MPI_TAG})",
+                      err, st.MPI_SOURCE, st.MPI_TAG)
         return st
 
     def stream_sync(self) -> None:
@@ -261,11 +311,58 @@ class Runtime:
     def proxy_stats(self) -> dict:
         out = (ctypes.c_uint64 * 4)()
         self._lib.acx_proxy_stats(out)
-        return {
+        stats = {
             "sweeps": out[0],
             "ops_issued": out[1],
             "ops_completed": out[2],
             "slots_reclaimed": out[3],
+        }
+        stats.update(self.resilience_stats())
+        return stats
+
+    # -- resilience plane ---------------------------------------------------
+
+    def set_deadline(self, timeout_ms: float) -> None:
+        """Per-op deadline for every subsequently issued op (0 disables).
+        An op past its deadline completes with ERR_TIMEOUT instead of
+        blocking forever — the bound that keeps wait() from hanging on a
+        dead or wedged peer."""
+        if self._lib.MPIX_Set_deadline(float(timeout_ms)) != 0:
+            raise ValueError(f"bad deadline {timeout_ms!r} (must be >= 0)")
+
+    def get_deadline(self) -> float:
+        out = ctypes.c_double(0.0)
+        if self._lib.MPIX_Get_deadline(ctypes.byref(out)) != 0:
+            raise RuntimeError("MPIX_Get_deadline failed")
+        return out.value
+
+    def op_status(self, req) -> dict:
+        """Nonblocking probe of a request: lifecycle state (the Flag
+        enum value), first error, and issue attempts (> 1 means the
+        retry path fired)."""
+        st = ctypes.c_int(0)
+        err = ctypes.c_int(0)
+        att = ctypes.c_int(0)
+        if self._lib.MPIX_Op_status(req, ctypes.byref(st), ctypes.byref(err),
+                                    ctypes.byref(att)) != 0:
+            raise RuntimeError("MPIX_Op_status: not a live request")
+        return {"state": st.value, "error": err.value,
+                "attempts": att.value}
+
+    def resilience_stats(self) -> dict:
+        """Process-wide resilience counters: proxy retries/timeouts,
+        injected-fault hits, and transport heartbeat/dead-peer state."""
+        out = (ctypes.c_uint64 * 8)()
+        self._lib.acx_resilience_stats(out)
+        return {
+            "retries": out[0],
+            "timeouts": out[1],
+            "fault_drops": out[2],
+            "fault_delays": out[3],
+            "fault_fails": out[4],
+            "hb_sent": out[5],
+            "hb_recv": out[6],
+            "peers_dead": out[7],
         }
 
     def finalize(self) -> None:

@@ -9,6 +9,7 @@
 #include <cstdint>
 
 #include "acx/api_internal.h"
+#include "acx/fault.h"
 
 extern "C" {
 
@@ -24,6 +25,83 @@ void acx_proxy_stats(uint64_t* out) {
   out[1] = s.ops_issued;
   out[2] = s.ops_completed;
   out[3] = s.slots_reclaimed;
+}
+
+// ---- resilience plane ----------------------------------------------------
+
+// Fills out[8] = {retries, timeouts, fault_drops, fault_delays, fault_fails,
+// hb_sent, hb_recv, peers_dead}. Safe before init (zeros).
+void acx_resilience_stats(uint64_t* out) {
+  acx::ApiState& g = acx::GS();
+  if (g.proxy != nullptr) {
+    acx::Proxy::Stats s = g.proxy->stats();
+    out[0] = s.retries;
+    out[1] = s.timeouts;
+  } else {
+    out[0] = out[1] = 0;
+  }
+  acx::fault::Stats f = acx::fault::stats();
+  out[2] = f.drops;
+  out[3] = f.delays;
+  out[4] = f.fails;
+  if (g.transport != nullptr) {
+    acx::NetStats n = g.transport->net_stats();
+    out[5] = n.hb_sent;
+    out[6] = n.hb_recv;
+    out[7] = n.peers_dead;
+  } else {
+    out[5] = out[6] = out[7] = 0;
+  }
+}
+
+int MPIX_Set_deadline(double timeout_ms) {
+  if (timeout_ms < 0) return 1;
+  acx::Policy().timeout_ns.store(
+      static_cast<uint64_t>(timeout_ms * 1e6), std::memory_order_relaxed);
+  return 0;
+}
+
+int MPIX_Get_deadline(double* timeout_ms) {
+  if (timeout_ms == nullptr) return 1;
+  *timeout_ms =
+      static_cast<double>(
+          acx::Policy().timeout_ns.load(std::memory_order_relaxed)) /
+      1e6;
+  return 0;
+}
+
+int MPIX_Op_status(void* request, int* state, int* error, int* attempts) {
+  auto* req = static_cast<acx::MpixRequest*>(request);
+  acx::ApiState& g = acx::GS();
+  if (req == nullptr || req->magic != acx::kReqMagic || g.table == nullptr)
+    return 1;
+  const auto probe = [&g](int idx, int* st, int* err, int* att) {
+    *st = static_cast<int>(g.table->Load(idx));
+    const acx::Op& op = g.table->op(idx);
+    // The op's status is only coherent once the proxy's release store of
+    // COMPLETED has been acquired (same contract as the wait paths).
+    *err = *st >= acx::kCompleted ? op.status.error : 0;
+    *att = static_cast<int>(op.attempts);
+  };
+  int st = 0, err = 0, att = 0;
+  if (req->kind == acx::ReqKind::kBasic) {
+    if (req->flag_idx < 0) return 1;
+    probe(req->flag_idx, &st, &err, &att);
+  } else {
+    if (req->partitions <= 0 || req->part_idx == nullptr) return 1;
+    st = acx::kCleanup;
+    for (int p = 0; p < req->partitions; p++) {
+      int pst = 0, perr = 0, patt = 0;
+      probe(req->part_idx[p], &pst, &perr, &patt);
+      if (pst < st) st = pst;
+      if (err == 0 && perr != 0) err = perr;
+      if (patt > att) att = patt;
+    }
+  }
+  if (state != nullptr) *state = st;
+  if (error != nullptr) *error = err;
+  if (attempts != nullptr) *attempts = att;
+  return 0;
 }
 
 int acx_rank(void) {

@@ -1,0 +1,164 @@
+// tpu-acx: fault-injection + retry-policy state (see include/acx/fault.h).
+
+#include "acx/fault.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include "acx/state.h"
+
+namespace acx {
+
+RetryPolicy& Policy() {
+  // Leaked on purpose (process-lifetime; atexit-ordering-proof, same
+  // pattern as the trace ring).
+  static RetryPolicy* p = [] {
+    auto* pp = new RetryPolicy();
+    if (const char* e = getenv("ACX_OP_TIMEOUT_MS")) {
+      const double ms = atof(e);
+      if (ms > 0) pp->timeout_ns.store(static_cast<uint64_t>(ms * 1e6));
+    }
+    if (const char* e = getenv("ACX_RETRY_BACKOFF_US")) {
+      const unsigned long long us = strtoull(e, nullptr, 10);
+      if (us > 0) pp->backoff_us.store(us);
+    }
+    if (const char* e = getenv("ACX_MAX_RETRIES"))
+      pp->max_retries.store(static_cast<uint32_t>(atoi(e)));
+    return pp;
+  }();
+  return *p;
+}
+
+namespace fault {
+namespace {
+
+struct State {
+  Config cfg;
+  std::atomic<bool> enabled{false};
+  std::atomic<uint64_t> matched{0};
+  std::atomic<uint64_t> drops{0};
+  std::atomic<uint64_t> delays{0};
+  std::atomic<uint64_t> fails{0};
+};
+
+State& S() {
+  static State* s = [] {
+    auto* st = new State();
+    if (const char* e = getenv("ACX_FAULT")) {
+      Config c;
+      if (ParseSpec(e, &c)) {
+        st->cfg = c;
+        st->enabled.store(c.action != Action::kNone,
+                          std::memory_order_release);
+      } else {
+        std::fprintf(stderr, "tpu-acx: bad ACX_FAULT spec '%s' (ignored)\n",
+                     e);
+      }
+    }
+    return st;
+  }();
+  return *s;
+}
+
+}  // namespace
+
+bool Enabled() { return S().enabled.load(std::memory_order_acquire); }
+
+bool ParseSpec(const char* spec, Config* out) {
+  if (spec == nullptr || *spec == '\0') return false;
+  Config c;
+  const char* p = spec;
+  char tok[64];
+  const auto next = [&p](char* buf, size_t cap) -> bool {
+    if (*p == '\0') return false;
+    size_t i = 0;
+    while (*p != '\0' && *p != ':') {
+      if (i + 1 >= cap) return false;
+      buf[i++] = *p++;
+    }
+    buf[i] = '\0';
+    if (*p == ':') p++;
+    return i > 0;
+  };
+  if (!next(tok, sizeof tok)) return false;
+  if (strcmp(tok, "drop") == 0) c.action = Action::kDrop;
+  else if (strcmp(tok, "delay") == 0) c.action = Action::kDelay;
+  else if (strcmp(tok, "fail") == 0) c.action = Action::kFail;
+  else if (strcmp(tok, "none") == 0) c.action = Action::kNone;
+  else return false;
+  while (*p != '\0') {
+    if (!next(tok, sizeof tok)) return false;
+    char* eq = strchr(tok, '=');
+    if (eq == nullptr) return false;
+    *eq = '\0';
+    const char* val = eq + 1;
+    if (strcmp(tok, "rank") == 0) c.rank = atoi(val);
+    else if (strcmp(tok, "peer") == 0) c.peer = atoi(val);
+    else if (strcmp(tok, "nth") == 0) c.nth = atoi(val);
+    else if (strcmp(tok, "count") == 0) c.count = atoi(val);
+    else if (strcmp(tok, "us") == 0) c.delay_us = strtoull(val, nullptr, 10);
+    else if (strcmp(tok, "err") == 0) c.err = atoi(val);
+    else if (strcmp(tok, "kind") == 0) {
+      if (strcmp(val, "send") == 0) c.kind = 1;
+      else if (strcmp(val, "recv") == 0) c.kind = 2;
+      else if (strcmp(val, "any") == 0) c.kind = 0;
+      else return false;
+    } else {
+      return false;
+    }
+  }
+  if (c.nth < 1 || c.count < 1) return false;
+  *out = c;
+  return true;
+}
+
+void Configure(const Config& cfg) {
+  State& s = S();
+  s.cfg = cfg;
+  s.matched.store(0, std::memory_order_relaxed);
+  s.enabled.store(cfg.action != Action::kNone, std::memory_order_release);
+}
+
+Action OnIssue(int rank, bool is_send, int peer, uint64_t* delay_us,
+               int* err) {
+  State& s = S();
+  const Config& c = s.cfg;
+  if (c.action == Action::kNone) return Action::kNone;
+  if (c.rank >= 0 && rank != c.rank) return Action::kNone;
+  if (c.kind == 1 && !is_send) return Action::kNone;
+  if (c.kind == 2 && is_send) return Action::kNone;
+  if (c.peer >= 0 && peer != c.peer) return Action::kNone;
+  const uint64_t m = s.matched.fetch_add(1, std::memory_order_relaxed) + 1;
+  if (m < static_cast<uint64_t>(c.nth) ||
+      m >= static_cast<uint64_t>(c.nth) + static_cast<uint64_t>(c.count))
+    return Action::kNone;
+  switch (c.action) {
+    case Action::kDrop:
+      s.drops.fetch_add(1, std::memory_order_relaxed);
+      break;
+    case Action::kDelay:
+      s.delays.fetch_add(1, std::memory_order_relaxed);
+      if (delay_us != nullptr) *delay_us = c.delay_us;
+      break;
+    case Action::kFail:
+      s.fails.fetch_add(1, std::memory_order_relaxed);
+      if (err != nullptr) *err = c.err != 0 ? c.err : kErrInjected;
+      break;
+    default:
+      break;
+  }
+  return c.action;
+}
+
+Stats stats() {
+  State& s = S();
+  Stats out;
+  out.drops = s.drops.load(std::memory_order_relaxed);
+  out.delays = s.delays.load(std::memory_order_relaxed);
+  out.fails = s.fails.load(std::memory_order_relaxed);
+  return out;
+}
+
+}  // namespace fault
+}  // namespace acx

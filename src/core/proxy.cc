@@ -5,6 +5,7 @@
 #include <cstdlib>
 
 #include "acx/debug.h"
+#include "acx/fault.h"
 #include "acx/trace.h"
 
 namespace acx {
@@ -47,7 +48,142 @@ Proxy::Stats Proxy::stats() const {
   s.ops_issued = ops_issued_.load(std::memory_order_relaxed);
   s.ops_completed = ops_completed_.load(std::memory_order_relaxed);
   s.slots_reclaimed = slots_reclaimed_.load(std::memory_order_relaxed);
+  s.retries = retries_.load(std::memory_order_relaxed);
+  s.timeouts = timeouts_.load(std::memory_order_relaxed);
   return s;
+}
+
+namespace {
+
+// Arm the per-op deadline once, at the FIRST issue attempt — including a
+// dropped one, so an op whose every post is swallowed still times out.
+void ArmDeadlineFirstAttempt(Op& op) {
+  if (op.attempts != 0 || op.deadline_ns != 0) return;
+  const uint64_t t = Policy().timeout_ns.load(std::memory_order_relaxed);
+  if (t != 0) op.deadline_ns = NowNs() + t;
+}
+
+// Exponential backoff: policy seed on the first retry, doubling after,
+// capped at 1s per step.
+void ArmRetryBackoff(Op& op) {
+  constexpr uint32_t kCapUs = 1000000;
+  if (op.backoff_us == 0) {
+    uint64_t b = Policy().backoff_us.load(std::memory_order_relaxed);
+    if (b < 1) b = 1;
+    if (b > kCapUs) b = kCapUs;
+    op.backoff_us = static_cast<uint32_t>(b);
+  } else if (op.backoff_us < kCapUs) {
+    op.backoff_us = op.backoff_us * 2 < kCapUs ? op.backoff_us * 2 : kCapUs;
+  }
+  op.retry_at_ns = NowNs() + static_cast<uint64_t>(op.backoff_us) * 1000;
+}
+
+}  // namespace
+
+bool Proxy::IssueOp(size_t i, Op& op, Stats& local, bool from_pending) {
+  const bool is_send = op.kind == OpKind::kIsend;
+  bool consult = true;
+  if (from_pending) {
+    if (op.not_before_ns != 0) {
+      // Injected-delay gate: hold the op in PENDING until it opens, then
+      // post WITHOUT re-consulting the fault plane (one fault, one delay).
+      if (NowNs() < op.not_before_ns) return false;
+      op.not_before_ns = 0;
+      consult = false;
+    } else {
+      // Fresh trigger (first launch or graph re-fire): reset bookkeeping so
+      // a re-fired graph op gets a fresh deadline and retry budget.
+      op.attempts = 0;
+      op.deadline_ns = 0;
+      op.retry_at_ns = 0;
+      op.backoff_us = 0;
+    }
+  }
+  if (consult && fault::Enabled()) {
+    uint64_t delay_us = 0;
+    int err = 0;
+    switch (fault::OnIssue(transport_->rank(), is_send, op.peer, &delay_us,
+                           &err)) {
+      case fault::Action::kDelay:
+        if (from_pending)
+          op.not_before_ns = NowNs() + delay_us * 1000;
+        else
+          op.retry_at_ns = NowNs() + delay_us * 1000;
+        ACX_TRACE_EVENT("fault_delay", i);
+        return true;
+      case fault::Action::kFail:
+        op.status = Status{op.peer, op.tag, err, 0};
+        table_->Store(i, kCompleted);
+        ACX_TRACE_EVENT("fault_fail", i);
+        local.ops_completed++;
+        return true;
+      case fault::Action::kDrop:
+        // The post is swallowed: the op sits ISSUED with no ticket until
+        // CheckStalled's backoff timer re-posts it. Not counted in
+        // ops_issued — nothing reached the wire.
+        ArmDeadlineFirstAttempt(op);
+        op.attempts++;
+        ArmRetryBackoff(op);
+        delete op.ticket;
+        op.ticket = nullptr;
+        if (from_pending) table_->Store(i, kIssued);
+        ACX_TRACE_EVENT("fault_drop", i);
+        return true;
+      default:
+        break;
+    }
+  }
+  ArmDeadlineFirstAttempt(op);
+  op.attempts++;
+  // Graph re-fire: a relaunch moves COMPLETED->PENDING with the previous
+  // launch's ticket still attached; reclaim it first.
+  delete op.ticket;
+  if (is_send) {
+    ACX_DLOG("slot %zu: isend %zuB -> peer %d tag %d", i, op.bytes, op.peer,
+             op.tag);
+    op.ticket = transport_->Isend(op.sbuf, op.bytes, op.peer, op.tag, op.ctx);
+    if (from_pending) table_->Store(i, kIssued);
+    ACX_TRACE_EVENT("isend_issued", i);
+  } else {
+    ACX_DLOG("slot %zu: irecv %zuB <- peer %d tag %d", i, op.bytes, op.peer,
+             op.tag);
+    op.ticket = transport_->Irecv(op.rbuf, op.bytes, op.peer, op.tag, op.ctx);
+    if (from_pending) table_->Store(i, kIssued);
+    ACX_TRACE_EVENT("irecv_issued", i);
+  }
+  local.ops_issued++;
+  return true;
+}
+
+bool Proxy::CheckStalled(size_t i, Op& op, Stats& local) {
+  // Hot path: a posted op with no deadline has nothing to police — return
+  // without reading the clock.
+  const bool unposted = op.ticket == nullptr;
+  if (!unposted && op.deadline_ns == 0) return false;
+  const uint64_t now = NowNs();
+  if (op.deadline_ns != 0 && now >= op.deadline_ns) {
+    op.status = Status{op.peer, op.tag, kErrTimeout, 0};
+    table_->Store(i, kCompleted);
+    ACX_TRACE_EVENT("op_timeout", i);
+    local.timeouts++;
+    local.ops_completed++;
+    return true;
+  }
+  // Only an op whose post was LOST (no ticket) may be re-issued; a posted
+  // op is already live on a reliable transport — re-posting would
+  // double-send. Posted ops are governed by the deadline alone.
+  if (!unposted || now < op.retry_at_ns) return false;
+  if (op.attempts > Policy().max_retries.load(std::memory_order_relaxed)) {
+    op.status = Status{op.peer, op.tag, kErrTimeout, 0};
+    table_->Store(i, kCompleted);
+    ACX_TRACE_EVENT("op_timeout", i);
+    local.timeouts++;
+    local.ops_completed++;
+    return true;
+  }
+  local.retries++;
+  ACX_TRACE_EVENT("op_retry", i);
+  return IssueOp(i, op, local, false);
 }
 
 bool Proxy::Sweep() {
@@ -63,28 +199,8 @@ bool Proxy::Sweep() {
       case kPending: {
         switch (op.kind) {
           case OpKind::kIsend:
-            ACX_DLOG("slot %zu: isend %zuB -> peer %d tag %d", i, op.bytes,
-                     op.peer, op.tag);
-            // Graph re-fire: a relaunch moves COMPLETED->PENDING with the
-            // previous launch's ticket still attached; reclaim it first.
-            delete op.ticket;
-            op.ticket = transport_->Isend(op.sbuf, op.bytes, op.peer, op.tag,
-                                          op.ctx);
-            table_->Store(i, kIssued);
-            ACX_TRACE_EVENT("isend_issued", i);
-            local.ops_issued++;
-            progressed = true;
-            break;
           case OpKind::kIrecv:
-            ACX_DLOG("slot %zu: irecv %zuB <- peer %d tag %d", i, op.bytes,
-                     op.peer, op.tag);
-            delete op.ticket;
-            op.ticket = transport_->Irecv(op.rbuf, op.bytes, op.peer, op.tag,
-                                          op.ctx);
-            table_->Store(i, kIssued);
-            ACX_TRACE_EVENT("irecv_issued", i);
-            local.ops_issued++;
-            progressed = true;
+            progressed |= IssueOp(i, op, local, /*from_pending=*/true);
             break;
           case OpKind::kPready:
             // Send-side partition became ready (host call or device-mirrored
@@ -114,6 +230,8 @@ bool Proxy::Sweep() {
               table_->Store(i, kCompleted);
               ACX_TRACE_EVENT("op_completed", i);
               local.ops_completed++;
+              progressed = true;
+            } else if (CheckStalled(i, op, local)) {
               progressed = true;
             }
             break;
@@ -150,6 +268,8 @@ bool Proxy::Sweep() {
   if (local.ops_issued) ops_issued_.fetch_add(local.ops_issued, std::memory_order_relaxed);
   if (local.ops_completed) ops_completed_.fetch_add(local.ops_completed, std::memory_order_relaxed);
   if (local.slots_reclaimed) slots_reclaimed_.fetch_add(local.slots_reclaimed, std::memory_order_relaxed);
+  if (local.retries) retries_.fetch_add(local.retries, std::memory_order_relaxed);
+  if (local.timeouts) timeouts_.fetch_add(local.timeouts, std::memory_order_relaxed);
   return progressed;
 }
 
@@ -172,7 +292,10 @@ void Proxy::Run() {
     }
     idle_sweeps++;
     if (table_->active.load(std::memory_order_relaxed) == 0) {
-      // Nothing in flight: park until someone enqueues work.
+      // Nothing in flight: keep the transport's background protocol alive
+      // (heartbeats, dead-peer checks), then park until work arrives. The
+      // 50ms wait bound doubles as the heartbeat cadence floor.
+      transport_->Tick();
       std::unique_lock<std::mutex> lk(idle_mu_);
       idle_cv_.wait_for(lk, std::chrono::milliseconds(50), [&] {
         return exit_.load(std::memory_order_acquire) ||
@@ -183,6 +306,7 @@ void Proxy::Run() {
     } else if (idle_sweeps < 64) {
       std::this_thread::yield();
     } else {
+      transport_->Tick();
       const int exp = idle_sweeps - 64 < 8 ? idle_sweeps - 64 : 8;
       std::this_thread::sleep_for(std::chrono::microseconds(1 << exp));
     }

@@ -14,6 +14,7 @@
 
 #include <errno.h>
 #include <string.h>
+#include <sys/socket.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -29,9 +30,16 @@ class Link {
   virtual ~Link() = default;
   // Nonblocking; return bytes moved (0 = would block / nothing available).
   // Fatal wire errors terminate the process (matching the abort-style error
-  // handling of the reference library, its internal.h CHECK macros).
+  // handling of the reference library, its internal.h CHECK macros) —
+  // EXCEPT peer-death errors (EOF, EPIPE, ECONNRESET), which latch
+  // alive()=false so the transport can fail the peer's ops in bounded time
+  // instead of the whole job dying.
   virtual size_t WriteSome(const char* p, size_t n) = 0;
   virtual size_t ReadSome(char* p, size_t n) = 0;
+  // False once the wire itself reported the peer gone. Shm rings have no
+  // EOF concept, so ShmLink stays alive forever — dead-peer detection there
+  // rides on heartbeats (ACX_HEARTBEAT_MS) instead.
+  virtual bool alive() const { return true; }
 };
 
 class SockLink : public Link {
@@ -43,9 +51,16 @@ class SockLink : public Link {
   }
 
   size_t WriteSome(const char* p, size_t n) override {
-    ssize_t r = write(fd_, p, n);
+    if (!alive_) return 0;
+    // MSG_NOSIGNAL: a write to a closed peer must surface as EPIPE, not a
+    // process-killing SIGPIPE — peer death is a recoverable event here.
+    ssize_t r = send(fd_, p, n, MSG_NOSIGNAL);
     if (r < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+      if (errno == EPIPE || errno == ECONNRESET) {
+        alive_ = false;
+        return 0;
+      }
       std::fprintf(stderr, "tpu-acx[%d]: write to %d failed: %s\n", rank_,
                    peer_, strerror(errno));
       _exit(14);
@@ -54,21 +69,34 @@ class SockLink : public Link {
   }
 
   size_t ReadSome(char* p, size_t n) override {
+    if (!alive_) return 0;
     ssize_t r = read(fd_, p, n);
     if (r < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+      if (errno == ECONNRESET) {
+        alive_ = false;
+        return 0;
+      }
       std::fprintf(stderr, "tpu-acx[%d]: read from %d failed: %s\n", rank_,
                    peer_, strerror(errno));
       _exit(14);
     }
-    // r == 0 (peer closed): treated as "nothing available"; any data the
-    // peer sent before exiting was already drained by earlier reads.
+    if (r == 0 && n > 0) {
+      // EOF: the peer closed its end. Any data it sent before exiting was
+      // already drained by earlier reads; latch so the transport can fail
+      // this peer's pending ops instead of waiting forever.
+      alive_ = false;
+      return 0;
+    }
     return static_cast<size_t>(r);
   }
+
+  bool alive() const override { return alive_; }
 
  private:
   int fd_;
   int rank_, peer_;
+  bool alive_ = true;
 };
 
 // -- Shared-memory SPSC ring ------------------------------------------------

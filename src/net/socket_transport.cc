@@ -31,6 +31,7 @@
 #include <climits>
 #include <unordered_map>
 
+#include <atomic>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -39,6 +40,8 @@
 #include <mutex>
 #include <vector>
 
+#include "acx/fault.h"
+#include "acx/trace.h"
 #include "src/net/link.h"
 
 namespace acx {
@@ -53,6 +56,10 @@ constexpr uint32_t kMagic = 0xAC0C0101u;
 // re-send the payload as a normal copy frame on a private (seq, ctx) key.
 constexpr uint32_t kMagicRts = 0xAC0C0102u;
 constexpr uint32_t kMagicAck = 0xAC0C0103u;
+// Heartbeat: a zero-payload keepalive frame. Any inbound bytes refresh the
+// peer's liveness clock, so heartbeats only need to flow when the wire is
+// otherwise quiet. Essential on the shm plane, which has no EOF concept.
+constexpr uint32_t kMagicHb = 0xAC0C0104u;
 
 // Internal context ids. User contexts are >= 0; the control plane and the
 // partitioned layer get their own namespaces so they can never match user
@@ -96,6 +103,7 @@ struct SendReq {
   size_t off = 0;  // progress over [header | wire payload]
   bool rv = false;  // rendezvous: wire completion != user completion
   bool done = false;
+  int dst = -1;   // destination rank (dead-peer teardown scans rv_pending_)
   char desc[16];  // storage for RTS/ACK wire payloads
   Status st;
 };
@@ -164,6 +172,27 @@ class StreamTransport : public Transport {
     // path (the behavior on ptrace-hardened kernels) gets exercised.
     const char* ff = getenv("ACX_RV_FORCE_FALLBACK");
     rv_force_fallback_ = ff != nullptr && atoi(ff) != 0;
+    // Resilience: heartbeats are opt-in (ACX_HEARTBEAT_MS > 0); EOF-based
+    // dead-peer detection on socket links is always on. The grace window
+    // keeps slow-starting peers (module import, JIT warmup) from being
+    // declared dead before they ever speak.
+    last_rx_ns_.assign(size_, 0);
+    peer_dead_.assign(size_, false);
+    if (size_ > 1) {
+      if (const char* hb = getenv("ACX_HEARTBEAT_MS")) {
+        const double ms = atof(hb);
+        if (ms > 0) hb_interval_ns_ = static_cast<uint64_t>(ms * 1e6);
+      }
+      if (hb_interval_ns_ != 0) {
+        double to_ms = 0;
+        if (const char* t = getenv("ACX_PEER_TIMEOUT_MS")) to_ms = atof(t);
+        peer_timeout_ns_ = to_ms > 0 ? static_cast<uint64_t>(to_ms * 1e6)
+                                     : 5 * hb_interval_ns_;
+        double grace_ms = 5000;
+        if (const char* g = getenv("ACX_PEER_GRACE_MS")) grace_ms = atof(g);
+        grace_deadline_ns_ = NowNs() + static_cast<uint64_t>(grace_ms * 1e6);
+      }
+    }
 #ifdef PR_SET_PTRACER
     // Let sibling ranks process_vm_readv our send buffers even under
     // Yama ptrace_scope=1 (no-op where Yama is absent; nack path covers
@@ -249,6 +278,23 @@ class StreamTransport : public Transport {
     _exit(code);
   }
 
+  // Background protocol work (heartbeats, dead-peer checks) when no
+  // Ticket::Test is pumping progress; called from the proxy's idle branches.
+  void Tick() override {
+    if (size_ <= 1) return;
+    std::lock_guard<std::mutex> lk(mu_);
+    ProgressLocked();
+  }
+
+  NetStats net_stats() const override {
+    NetStats ns;
+    ns.hb_sent = hb_sent_.load(std::memory_order_relaxed);
+    ns.hb_recv = hb_recv_.load(std::memory_order_relaxed);
+    ns.peers_dead = peers_dead_n_.load(std::memory_order_relaxed);
+    ns.failed_ops = failed_ops_.load(std::memory_order_relaxed);
+    return ns;
+  }
+
   // Called from SockTicket::Test.
   bool TestReq(const std::shared_ptr<SendReq>& s,
                const std::shared_ptr<RecvReq>& r, Status* st) {
@@ -279,8 +325,17 @@ class StreamTransport : public Transport {
       std::fprintf(stderr, "tpu-acx[%d]: no wire to peer %d\n", rank_, dst);
       _exit(14);
     }
+    if (dst != rank_ && peer_dead_[dst]) {
+      // Immediate-error ticket: blocking helpers and barriers that touch a
+      // dead peer stay bounded instead of wedging.
+      auto s = std::make_shared<SendReq>();
+      s->st = Status{rank_, tag, kErrPeerDead, 0};
+      s->done = true;
+      return new SockTicket(this, s);
+    }
     auto s = std::make_shared<SendReq>();
     s->st = Status{rank_, tag, 0, bytes};
+    s->dst = dst;
     if (dst == rank_) {
       // Self-send: loop straight back through the matching queues.
       Msg m;
@@ -322,6 +377,12 @@ class StreamTransport : public Transport {
     if (src != rank_ && (src < 0 || src >= size_ || !links_[src])) {
       std::fprintf(stderr, "tpu-acx[%d]: no wire to peer %d\n", rank_, src);
       _exit(14);
+    }
+    if (src != rank_ && peer_dead_[src]) {
+      auto r = std::make_shared<RecvReq>();
+      r->st = Status{src, tag, kErrPeerDead, 0};
+      r->done = true;
+      return new SockTicket(this, r);
     }
     auto r = std::make_shared<RecvReq>();
     r->buf = buf;
@@ -473,9 +534,15 @@ class StreamTransport : public Transport {
             links_[p]->ReadSome(reinterpret_cast<char*>(&in.hdr) + in.hdr_got,
                                 sizeof(WireHeader) - in.hdr_got);
         if (n == 0) return;
+        NoteRx(p);
         in.hdr_got += n;
         if (in.hdr_got < sizeof(WireHeader)) return;
         in.payload_got = 0;
+        if (in.hdr.magic == kMagicHb) {
+          hb_recv_.fetch_add(1, std::memory_order_relaxed);
+          in.hdr_got = 0;
+          continue;
+        }
         if (in.hdr.magic == kMagicRts) {
           in.direct.reset();
           in.payload.resize(sizeof(RvDesc));
@@ -510,6 +577,7 @@ class StreamTransport : public Transport {
               static_cast<char*>(r->buf) + in.payload_got,
               deliver - in.payload_got);
           if (n == 0) return;
+          NoteRx(p);
           in.payload_got += n;
         }
         // Oversized tail (recv buffer smaller than message): drain + drop.
@@ -519,6 +587,7 @@ class StreamTransport : public Transport {
           if (want > sizeof scratch) want = sizeof scratch;
           size_t n = links_[p]->ReadSome(scratch, want);
           if (n == 0) return;
+          NoteRx(p);
           in.payload_got += n;
         }
         r->st = Status{
@@ -533,6 +602,7 @@ class StreamTransport : public Transport {
         size_t n = links_[p]->ReadSome(in.payload.data() + in.payload_got,
                                        in.payload.size() - in.payload_got);
         if (n == 0) return;
+        NoteRx(p);
         in.payload_got += n;
       }
       if (in.hdr.magic == kMagicRts) {
@@ -564,11 +634,104 @@ class StreamTransport : public Transport {
   }
 
   void ProgressLocked() {
+    if (hb_interval_ns_ != 0) HeartbeatLocked();
     for (int p = 0; p < size_; p++) {
       if (p == rank_ || !links_[p]) continue;  // no wire (malformed env)
+      if (peer_dead_[p]) continue;
       FlushOutLocked(p);
       DrainInLocked(p);
+      if (!links_[p]->alive())
+        MarkPeerDeadLocked(p, "connection closed", /*hb_detected=*/false);
     }
+  }
+
+  // Liveness clock: ANY inbound bytes from p count (a multi-second bulk
+  // transfer holds heartbeat frames behind it in the FIFO outq, so payload
+  // bytes must refresh the clock or large messages would false-positive).
+  void NoteRx(int p) {
+    if (hb_interval_ns_ != 0) last_rx_ns_[p] = NowNs();
+  }
+
+  void HeartbeatLocked() {
+    const uint64_t now = NowNs();
+    if (now - last_hb_send_ns_ >= hb_interval_ns_) {
+      last_hb_send_ns_ = now;
+      for (int p = 0; p < size_; p++) {
+        if (p == rank_ || !links_[p] || peer_dead_[p]) continue;
+        auto s = std::make_shared<SendReq>();
+        s->hdr = WireHeader{kMagicHb, 0, 0, 0};
+        s->wire_payload = s->desc;
+        s->wire_bytes = 0;
+        s->dst = p;
+        peers_[p].outq.push_back(std::move(s));
+        hb_sent_.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
+    if (now < grace_deadline_ns_) return;
+    for (int p = 0; p < size_; p++) {
+      if (p == rank_ || !links_[p] || peer_dead_[p]) continue;
+      // A peer that never spoke starts its clock at the end of the grace
+      // window, not at process start.
+      if (last_rx_ns_[p] == 0) last_rx_ns_[p] = now;
+      if (now - last_rx_ns_[p] > peer_timeout_ns_)
+        MarkPeerDeadLocked(p, "heartbeat timeout", /*hb_detected=*/true);
+    }
+  }
+
+  // Latch peer p dead and fail everything in flight against it with
+  // kErrPeerDead, so every waiter (tickets, barriers, blocking helpers)
+  // unblocks in bounded time instead of wedging — the reference's failure
+  // mode (SURVEY.md §5.3).
+  void MarkPeerDeadLocked(int p, const char* why, bool hb_detected) {
+    if (peer_dead_[p]) return;
+    peer_dead_[p] = true;
+    peers_dead_n_.fetch_add(1, std::memory_order_relaxed);
+    ACX_TRACE_EVENT("peer_dead", static_cast<size_t>(p));
+    uint64_t failed = 0;
+    Peer& peer = peers_[p];
+    if (peer.in.direct) {
+      RecvReq* r = peer.in.direct.get();
+      r->st = Status{p, r->report_tag != INT_MIN ? r->report_tag : r->tag,
+                     kErrPeerDead, 0};
+      r->done = true;
+      peer.in.direct.reset();
+      failed++;
+    }
+    for (auto& r : peer.posted) {
+      r->st = Status{p, r->report_tag != INT_MIN ? r->report_tag : r->tag,
+                     kErrPeerDead, 0};
+      r->done = true;
+      failed++;
+    }
+    peer.posted.clear();
+    for (auto& s : peer.outq) {
+      if (s->done) continue;
+      s->st.error = kErrPeerDead;
+      s->st.bytes = 0;
+      s->done = true;
+      if (s->hdr.magic != kMagicHb && s->hdr.magic != kMagicAck) failed++;
+    }
+    peer.outq.clear();
+    for (auto it = rv_pending_.begin(); it != rv_pending_.end();) {
+      if (it->second->dst == p) {
+        it->second->st.error = kErrPeerDead;
+        it->second->st.bytes = 0;
+        it->second->done = true;
+        failed++;
+        it = rv_pending_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    if (failed != 0) failed_ops_.fetch_add(failed, std::memory_order_relaxed);
+    // Quiet latch on a clean EOF with nothing in flight: normal teardown
+    // can observe a peer's close after the final barrier, and that is not
+    // worth a scary message. Loud when real work was killed.
+    if (failed != 0 || hb_detected)
+      std::fprintf(stderr,
+                   "tpu-acx[%d]: peer %d declared dead (%s); failed %llu "
+                   "in-flight op(s)\n",
+                   rank_, p, why, static_cast<unsigned long long>(failed));
   }
 
   // Blocking control-plane helpers (used by Barrier/AllreduceInt only).
@@ -593,6 +756,18 @@ class StreamTransport : public Transport {
   bool rv_force_fallback_ = false;
   uint32_t rv_next_seq_ = 1;
   std::unordered_map<uint32_t, std::shared_ptr<SendReq>> rv_pending_;
+
+  // -- resilience state (all guarded by mu_ except the atomic counters) --
+  uint64_t hb_interval_ns_ = 0;  // 0 = heartbeats off (EOF detection stays on)
+  uint64_t peer_timeout_ns_ = 0;
+  uint64_t grace_deadline_ns_ = 0;
+  uint64_t last_hb_send_ns_ = 0;
+  std::vector<uint64_t> last_rx_ns_;
+  std::vector<bool> peer_dead_;
+  std::atomic<uint64_t> hb_sent_{0};
+  std::atomic<uint64_t> hb_recv_{0};
+  std::atomic<uint64_t> peers_dead_n_{0};
+  std::atomic<uint64_t> failed_ops_{0};
 };
 
 bool SockTicket::Test(Status* st) { return t_->TestReq(send_, recv_, st); }

@@ -38,12 +38,17 @@
 #include <string>
 #include <vector>
 
+#include "acx/fault.h"
 #include "src/net/link.h"
 
 static void usage() {
   fprintf(stderr,
           "usage: acxrun -np N [-timeout SEC] [-transport shm|socket] "
-          "prog [args...]\n");
+          "[-fault SPEC] prog [args...]\n"
+          "  -fault SPEC  arm deterministic fault injection in every rank\n"
+          "               (sets ACX_FAULT; spec: action[:key=val]..., e.g.\n"
+          "               drop:rank=0:kind=send:nth=1 — see include/acx/"
+          "fault.h)\n");
   exit(2);
 }
 
@@ -51,6 +56,7 @@ int main(int argc, char** argv) {
   int np = -1;
   int timeout_s = 120;
   const char* transport = nullptr;  // nullptr = leave env as-is (default shm)
+  const char* fault = nullptr;
   int argi = 1;
   while (argi < argc && argv[argi][0] == '-') {
     if (!strcmp(argv[argi], "-np") && argi + 1 < argc) {
@@ -62,11 +68,23 @@ int main(int argc, char** argv) {
     } else if (!strcmp(argv[argi], "-transport") && argi + 1 < argc) {
       transport = argv[argi + 1];
       argi += 2;
+    } else if (!strcmp(argv[argi], "-fault") && argi + 1 < argc) {
+      fault = argv[argi + 1];
+      argi += 2;
     } else {
       usage();
     }
   }
   if (np < 1 || argi >= argc) usage();
+  if (fault != nullptr) {
+    // Validate up front with the same parser the ranks use: a typo'd spec
+    // must fail the launch, not silently run the job fault-free.
+    acx::fault::Config fc;
+    if (!acx::fault::ParseSpec(fault, &fc)) {
+      fprintf(stderr, "acxrun: bad -fault spec '%s'\n", fault);
+      return 2;
+    }
+  }
   if (transport != nullptr && strcmp(transport, "shm") != 0 &&
       strcmp(transport, "socket") != 0) {
     fprintf(stderr, "acxrun: unknown -transport '%s' (want shm or socket)\n",
@@ -140,6 +158,7 @@ int main(int argc, char** argv) {
         setenv("ACX_SHM_RING_BYTES", std::to_string(ring_bytes).c_str(), 1);
       }
       if (transport != nullptr) setenv("ACX_TRANSPORT", transport, 1);
+      if (fault != nullptr) setenv("ACX_FAULT", fault, 1);
       execvp(argv[argi], &argv[argi]);
       fprintf(stderr, "acxrun: exec %s failed: %s\n", argv[argi],
               strerror(errno));
