@@ -122,7 +122,26 @@ check: ctest itest tools
 	@$(BUILD)/acxrun -np 2 -fault drop:rank=0:kind=send:nth=1 $(BUILD)/itests/ring || exit 1
 	@echo "== acxrun -np 2 ring (fault: 5ms delay on rank 1's first recv)"
 	@$(BUILD)/acxrun -np 2 -fault delay:rank=1:kind=recv:nth=1:us=5000 $(BUILD)/itests/ring || exit 1
+	@$(MAKE) --no-print-directory metrics-check || exit 1
 	@echo "ALL NATIVE TESTS PASSED"
+
+# --- metrics plane end-to-end ---
+# 2-rank ping-pong with metrics + tracing on, then validate every artifact
+# (span balance, counter/histogram invariants) and produce the merged
+# Perfetto timeline + fleet metrics with tools/acx_trace_merge.py.
+.PHONY: metrics-check
+metrics-check: tools
+	@rm -rf $(BUILD)/metrics-check && mkdir -p $(BUILD)/metrics-check
+	@echo "== metrics-check: acxrun -np 2 bench_pingpong (ACX_METRICS + ACX_TRACE)"
+	@ACX_METRICS=$(BUILD)/metrics-check/run ACX_TRACE=$(BUILD)/metrics-check/run \
+	  ACX_TRACE_CAP=2000000 \
+	  $(BUILD)/acxrun -np 2 $(BUILD)/bench_pingpong 8 > /dev/null || exit 1
+	@python3 tools/acx_trace_merge.py --validate \
+	  --out $(BUILD)/metrics-check/merged.trace.json \
+	  --metrics-out $(BUILD)/metrics-check/fleet.metrics.json \
+	  $(BUILD)/metrics-check/run.rank*.trace.json \
+	  $(BUILD)/metrics-check/run.rank*.metrics.json || exit 1
+	@echo "METRICS CHECK PASSED"
 
 # Header dependency tracking (-MMD): a header edit rebuilds its users.
 -include $(LIB_OBJS:.o=.d)

@@ -81,6 +81,12 @@ void CopyStatus(const Status& s, MpiStatusT* st) {
 // (called by both MPI_Init_thread and MPIX_Init, in either order).
 void EnsureTransport();
 
+// Folds the runtime's cumulative stats (proxy sweeps/retries/timeouts,
+// fault injections, heartbeat counters, flag-table watermark) into the
+// metrics registry. Called before every snapshot/dump so those sources
+// need no hot-path double counting. No-op when metrics are disabled.
+void RefreshRuntimeMetrics();
+
 // Element size for a compat MPI_Datatype id (include/compat/mpi.h).
 size_t DatatypeSize(int datatype);
 

@@ -1,0 +1,92 @@
+// tpu-acx: runtime-wide metrics plane — named counters and fixed-bucket
+// latency histograms over the op lifecycle (docs/DESIGN.md §8).
+//
+// The trace ring (acx/trace.h) answers "what happened, in order"; this
+// layer answers "how many and how long" without post-processing a trace:
+// a lock-light registry of atomic counters plus power-of-two-bucket
+// latency histograms fed from the same call sites as ACX_TRACE_EVENT
+// (trigger -> issue -> complete -> wait), snapshotted as JSON through
+// acx_metrics_snapshot / acx_metrics_dump_json (src/api/capi.cc) and
+// written to "<path>.rank<r>.metrics.json" at MPIX_Finalize.
+//
+// Gating: ACX_METRICS=<path> enables collection and the finalize dump;
+// ACX_METRICS=1 enables collection with snapshot-only export. Unset (the
+// default) every instrumented site pays one predictable branch — the
+// same discipline as ACX_TRACE — so the bench_pingpong hot path is
+// untouched. All mutation is relaxed atomics; there is no lock anywhere
+// on the record path.
+
+#pragma once
+
+#include <cstdint>
+
+namespace acx {
+namespace metrics {
+
+// Fixed counter set. Names in kCounterName (metrics.cc) — keep in sync.
+enum Counter : int {
+  kTriggers = 0,       // ops made PENDING (host queue / graph / device mirror)
+  kWaits,              // completions observed by a waiter
+  kOpsIsend,           // sends posted to the wire
+  kOpsIrecv,           // recvs posted to the wire
+  kOpsPready,          // send partitions pushed to the wire
+  kOpsParrived,        // recv partitions observed arrived
+  kBytesSent,
+  kBytesRecv,
+  kRetries,            // re-posts of ops whose issue was lost
+  kTimeouts,           // ops failed by deadline / retry exhaustion
+  kFaultsInjected,     // ACX_FAULT hits (drop + delay + fail)
+  kHbSent,             // heartbeats sent
+  kHbRecv,             // heartbeats received
+  kHbMisses,           // in-flight ops failed by dead-peer teardown
+  kPeersDead,
+  kSlotHighWater,      // max live-slot watermark observed (gauge-max)
+  kProxySweeps,
+  kOpsIssued,
+  kOpsCompleted,
+  kSlotsReclaimed,
+  kProxyBusyNs,        // proxy thread: time inside Sweep
+  kProxyIdleNs,        // proxy thread: time parked / sleeping
+  kNumCounters
+};
+
+// Fixed histogram set (latency segments, nanoseconds). Buckets are powers
+// of two: bucket 0 holds 0 ns, bucket i>0 holds [2^(i-1), 2^i) ns.
+enum Hist : int {
+  kTriggerToIssue = 0,  // flag PENDING -> transfer posted (proxy pickup)
+  kIssueToComplete,     // posted -> completion observed (wire + peer)
+  kCompleteToWait,      // completed -> waiter consumed it (waiter pickup)
+  kProxySweepNs,        // duration of one proxy-thread sweep
+  kNumHists
+};
+
+constexpr int kNumBuckets = 64;
+
+// True iff ACX_METRICS is set non-empty (checked once).
+bool Enabled();
+
+// Raw mutation (relaxed atomics; callers gate on Enabled()).
+void Add(Counter c, uint64_t v);
+void Set(Counter c, uint64_t v);       // overwrite (folding external stats)
+void MaxGauge(Counter c, uint64_t v);  // monotonic max
+void Observe(Hist h, uint64_t ns);
+
+// Op-lifecycle stamps, slot-indexed — the histogram feeders placed at the
+// existing ACX_TRACE_EVENT sites. Each Mark* consumes the previous stage's
+// stamp so a retried/partial lifecycle never records a bogus segment.
+void MarkTrigger(int64_t slot);
+void MarkIssue(int64_t slot, bool is_send, uint64_t bytes);
+void MarkComplete(int64_t slot);
+void MarkWait(int64_t slot);
+
+// JSON export. SnapshotJson serializes the full registry into buf (cap
+// bytes including the NUL) and returns the byte length needed excluding
+// the NUL (call with cap=0 to size). DumpJson writes the same JSON to a
+// file, returning 0 on success. FlushAtFinalize writes
+// "<ACX_METRICS>.rank<rank>.metrics.json" iff ACX_METRICS is a path.
+int SnapshotJson(char* buf, int cap);
+int DumpJson(const char* path);
+void FlushAtFinalize(int rank);
+
+}  // namespace metrics
+}  // namespace acx

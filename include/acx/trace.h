@@ -6,9 +6,19 @@
 // reclaim, ...) into an in-memory ring and writes
 // "<path>.rank<r>.trace.json" at MPIX_Finalize in Chrome trace-event
 // format — load it in chrome://tracing or Perfetto; each slot renders as
-// its own track. Disabled (the default) it costs one predictable branch
-// per call site. ACX_TRACE_CAP caps the ring (default 65536 events;
-// overflow drops new events and reports the drop count in the file).
+// its own track. Alongside the instants, Flush synthesizes paired duration
+// spans (ph "b"/"e": proxy_pickup, wire, wait_pickup, pready_push) from
+// the recorded transitions, so Perfetto shows op lifetimes as bars — the
+// synthesis runs at flush time and costs the hot path nothing. Disabled
+// (the default) it costs one predictable branch per call site.
+// ACX_TRACE_CAP caps the ring (default 65536 events; overflow drops NEW
+// events, keeping the oldest, and reports the drop count in the file).
+//
+// Crash safety: when tracing is enabled, an atexit hook plus best-effort
+// fatal-signal handlers (installed only over SIG_DFL dispositions) flush
+// the ring, so a rank that dies before MPIX_Finalize still leaves its
+// trace on disk. Flush snapshots rather than drains the ring, so a later
+// flush rewrites a superset — never truncates an earlier file.
 
 #pragma once
 
@@ -17,14 +27,21 @@
 namespace acx {
 namespace trace {
 
-// True iff ACX_TRACE is set (checked once).
+// True iff ACX_TRACE is set (checked once; first true call installs the
+// atexit/signal flush hooks).
 bool Enabled();
 
 // Record event `name` (STATIC string only — the pointer is stored) for a
 // slot (or -1 for process-scope events).
 void Emit(const char* name, int64_t slot);
 
-// Write the ring to ACX_TRACE.rank<rank>.trace.json and clear it.
+// Tell the trace layer this process's rank so the crash-path flush names
+// its file correctly (falls back to $ACX_RANK, then 0).
+void SetRank(int rank);
+
+// Write the ring (instants + synthesized spans) to
+// ACX_TRACE.rank<rank>.trace.json. Snapshot semantics: the ring is kept,
+// so repeated flushes rewrite supersets.
 void Flush(int rank);
 
 }  // namespace trace

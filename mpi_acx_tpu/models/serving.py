@@ -25,7 +25,10 @@ schedules.
 
 from __future__ import annotations
 
+import math
+import time
 from collections import deque
+from dataclasses import dataclass, field
 from functools import partial
 from typing import Dict, List, Optional, Sequence
 
@@ -33,6 +36,61 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+
+def _pct(samples: List[float], p: float) -> float:
+    """Nearest-rank percentile, StepTimer's convention (profiling.py):
+    the ceil(p*n)-th smallest sample, no interpolation."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return s[max(0, math.ceil(p * len(s)) - 1)]
+
+
+@dataclass
+class RequestTelemetry:
+    """Per-request serving telemetry (times from the batch's arrival at
+    _serve entry, so queue wait is included — the number a caller of a
+    serving system actually experiences)."""
+
+    rid: int
+    ttft_s: float        # time to first token (prefill emits it)
+    latency_s: float     # arrival -> retire
+    new_tokens: int
+    tokens_per_s: float  # new_tokens / latency_s
+    retries: int         # failed attempts that re-queued this request
+
+
+@dataclass
+class ServingMetrics:
+    """Batch-level serving telemetry returned on ServedBatch.metrics."""
+
+    requests: int = 0
+    wall_s: float = 0.0
+    new_tokens: int = 0
+    tokens_per_s: float = 0.0     # aggregate: new_tokens / wall_s
+    steps: int = 0                # decode step_fn dispatches
+    prefills: int = 0             # successful refills
+    requeues: int = 0             # failure-path restarts
+    ttft_p50_s: float = 0.0
+    ttft_p99_s: float = 0.0
+    itl_p50_s: float = 0.0        # inter-token latency (per decoded token)
+    itl_p99_s: float = 0.0
+    queue_depth_max: int = 0
+    queue_depth_mean: float = 0.0
+    slot_occupancy_mean: float = 0.0  # fraction of slots owned per step
+    per_request: List[RequestTelemetry] = field(default_factory=list)
+
+
+class ServedBatch(list):
+    """serve_greedy/serve_sample result: a plain list of per-request
+    ``prompt + generated`` arrays (full backward compatibility — index,
+    iterate, len as before) carrying the batch telemetry as
+    ``.metrics``."""
+
+    def __init__(self, outputs, metrics: ServingMetrics):
+        super().__init__(outputs)
+        self.metrics = metrics
 
 
 def _bucket(n: int, lo: int = 8) -> int:
@@ -208,22 +266,38 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
     # propagates (0 = fail fast).
     attempts = [0] * len(prompts)
 
+    # Telemetry (RequestTelemetry/ServingMetrics above). All requests
+    # "arrive" at entry, so per-request clocks start at t0 — queue wait
+    # counts toward TTFT and latency.
+    t0 = time.perf_counter()
+    ttft = [None] * len(prompts)      # type: List[Optional[float]]
+    finish = [None] * len(prompts)    # type: List[Optional[float]]
+    itl_samples: List[float] = []
+    qd_samples: List[int] = []
+    occ_samples: List[float] = []
+    n_steps = 0
+    n_prefills = 0
+    n_requeues = 0
+
     def _requeue(rid, prompt, exc):
         """Put a failed request back on the queue for a bit-equal
         restart (emitted tokens discarded; refill replays the same
         greedy/per-rid-key path), or re-raise past the retry budget."""
+        nonlocal n_requeues
         attempts[rid] += 1
         if attempts[rid] > max_request_retries:
             raise RuntimeError(
                 f"request {rid} failed {attempts[rid]} time(s), past "
                 f"max_request_retries={max_request_retries}") from exc
         emitted[rid] = []
+        ttft[rid] = None   # the replayed attempt re-earns its first token
+        n_requeues += 1
         queue.append((rid, prompt))
 
     def refill(b):
         """Returns True iff slot b now owns a request; a failed prefill
         re-queues the request instead of killing the server."""
-        nonlocal slots, keys
+        nonlocal slots, keys, n_prefills
         rid, prompt = queue.popleft()
         S = len(prompt)
         # Bucket for the prefill compile cache, capped at max_len so
@@ -250,6 +324,8 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
         owner[b] = rid
         emitted[rid].append(first)
         last_tok[b] = first
+        n_prefills += 1
+        ttft[rid] = time.perf_counter() - t0  # prefill emitted token one
         return True
 
     def retire(b):
@@ -258,6 +334,7 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
         done[rid] = np.concatenate(
             [np.asarray(prompts[rid], np.int32),
              np.asarray(emitted[rid], np.int32)])
+        finish[rid] = time.perf_counter() - t0
         owner[b] = -1
         # Park the freed slot at pos 0: an idle slot keeps stepping in
         # the batch, and a stale pos walks toward max_len where the
@@ -272,12 +349,15 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
 
     # Seed the slots, retiring 1-token requests on the spot so a slot
     # never enters the decode loop already finished.
+    qd_samples.append(len(queue))
     while queue and any(o < 0 for o in owner):
         b = owner.index(-1)
         if refill(b) and slot_finished(b):
             retire(b)
 
     while any(o >= 0 for o in owner) or queue:
+        qd_samples.append(len(queue))
+        occ_samples.append(sum(o >= 0 for o in owner) / n_slots)
         if not any(o >= 0 for o in owner):
             # All slots idle with requests still queued: only reachable
             # after a failure re-queued them — reseed and keep serving.
@@ -286,6 +366,7 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
                 if refill(b) and slot_finished(b):
                     retire(b)
             continue
+        step_t0 = time.perf_counter()
         try:
             slots, toks, keys = step_fn(slots, jnp.asarray(last_tok), keys)
         except Exception as exc:  # noqa: BLE001 — any device failure
@@ -307,6 +388,11 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
             last_tok = np.zeros((n_slots,), np.int32)
             continue
         block = np.asarray(toks, np.int32)           # [chunk, B]
+        # np.asarray forced the device sync, so this dt covers the real
+        # device step; each of the chunk tokens shares it evenly — the
+        # per-token cadence a streaming client would see.
+        step_dt = time.perf_counter() - step_t0
+        n_steps += 1
         for b in range(n_slots):
             last_tok[b] = block[-1, b]
             if owner[b] < 0:
@@ -319,6 +405,7 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
                 if slot_finished(b):
                     break
                 emitted[owner[b]].append(int(block[c, b]))
+                itl_samples.append(step_dt / chunk)
         for b in range(n_slots):
             while owner[b] >= 0 and slot_finished(b):
                 retire(b)
@@ -326,7 +413,39 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
                     refill(b)
 
     assert all(d is not None for d in done)
-    return done
+    wall = time.perf_counter() - t0
+    per_request = []
+    total_new = 0
+    for rid in range(len(prompts)):
+        nt = len(emitted[rid])
+        total_new += nt
+        lat = finish[rid] if finish[rid] is not None else wall
+        per_request.append(RequestTelemetry(
+            rid=rid,
+            ttft_s=ttft[rid] if ttft[rid] is not None else lat,
+            latency_s=lat,
+            new_tokens=nt,
+            tokens_per_s=nt / lat if lat > 0 else 0.0,
+            retries=attempts[rid]))
+    metrics = ServingMetrics(
+        requests=len(prompts),
+        wall_s=wall,
+        new_tokens=total_new,
+        tokens_per_s=total_new / wall if wall > 0 else 0.0,
+        steps=n_steps,
+        prefills=n_prefills,
+        requeues=n_requeues,
+        ttft_p50_s=_pct([r.ttft_s for r in per_request], 0.50),
+        ttft_p99_s=_pct([r.ttft_s for r in per_request], 0.99),
+        itl_p50_s=_pct(itl_samples, 0.50),
+        itl_p99_s=_pct(itl_samples, 0.99),
+        queue_depth_max=max(qd_samples) if qd_samples else 0,
+        queue_depth_mean=(sum(qd_samples) / len(qd_samples)
+                          if qd_samples else 0.0),
+        slot_occupancy_mean=(sum(occ_samples) / len(occ_samples)
+                             if occ_samples else 1.0),
+        per_request=per_request)
+    return ServedBatch(done, metrics)
 
 
 def serve_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
@@ -334,7 +453,7 @@ def serve_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
                  eos: Optional[int] = None, chunk: int = 1,
                  server_fns=None,
                  kv_int8: bool = False,
-                 max_request_retries: int = 2) -> List[np.ndarray]:
+                 max_request_retries: int = 2) -> ServedBatch:
     """Serve ``prompts`` (1-D int arrays, any lengths) through
     ``n_slots`` continuously-batched cache slots; each request decodes
     greedily for ``n_new`` tokens (an int, or one per request — the
@@ -355,6 +474,11 @@ def serve_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
     ``max_request_retries`` bounds per-request restarts after a failed
     prefill/step (see _serve) — a transient device fault costs the
     failed requests a replay, not the server.
+
+    The returned list is a ``ServedBatch``: a plain list of outputs
+    carrying batch telemetry as ``.metrics`` (per-request TTFT and
+    tokens/sec, inter-token latency percentiles, queue depth, slot
+    occupancy, requeue counts — see ServingMetrics).
     """
     return _serve(params, cfg, prompts, n_new, n_slots, max_len, family,
                   eos, chunk, server_fns, kv_int8, None, None,
@@ -368,7 +492,7 @@ def serve_sample(params, cfg, prompts: Sequence[np.ndarray], n_new,
                  eos: Optional[int] = None, chunk: int = 1,
                  server_fns=None,
                  kv_int8: bool = False,
-                 max_request_retries: int = 2) -> List[np.ndarray]:
+                 max_request_retries: int = 2) -> ServedBatch:
     """Stochastic continuous batching (temperature / top-k / top-p).
 
     Request ``rid`` draws from its own key stream
@@ -377,7 +501,8 @@ def serve_sample(params, cfg, prompts: Sequence[np.ndarray], n_new,
     the solo ``family.generate_sample(prompt, n,
     key=jax.random.fold_in(key, rid), ...)`` run bit for bit — the
     scheduler (slot assignment, refill order, chunking) cannot perturb
-    any request's sample path. All other parameters as serve_greedy.
+    any request's sample path. All other parameters (and the
+    ``ServedBatch``/telemetry return) as serve_greedy.
     """
     return _serve(params, cfg, prompts, n_new, n_slots, max_len, family,
                   eos, chunk, server_fns, kv_int8,

@@ -104,8 +104,10 @@ class StepTimer:
         return {
             "steps": n,
             "mean_s": sum(self.samples) / n,
+            "min_s": min(self.samples),
             "p50_s": self._pct(0.50),
             "p90_s": self._pct(0.90),
+            "p99_s": self._pct(0.99),
             "max_s": max(self.samples),
         }
 

@@ -87,6 +87,11 @@ def lib() -> ctypes.CDLL:
         _lib.MPIX_Op_status.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int),
             ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+        _lib.acx_metrics_enabled.restype = ctypes.c_int
+        _lib.acx_metrics_snapshot.restype = ctypes.c_int
+        _lib.acx_metrics_snapshot.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        _lib.acx_metrics_dump_json.restype = ctypes.c_int
+        _lib.acx_metrics_dump_json.argtypes = [ctypes.c_char_p]
     return _lib
 
 
@@ -364,6 +369,30 @@ class Runtime:
             "hb_recv": out[6],
             "peers_dead": out[7],
         }
+
+    # -- metrics plane ------------------------------------------------------
+
+    def metrics_enabled(self) -> bool:
+        """True iff ACX_METRICS was set when the native library loaded."""
+        return bool(self._lib.acx_metrics_enabled())
+
+    def metrics(self) -> dict:
+        """Snapshot of the native metrics registry (src/core/metrics.cc):
+        ``{"enabled": bool, "counters": {...}, "histograms": {name:
+        {"unit","count","sum","buckets"}}}``. Counters derived from runtime
+        stats (proxy sweeps, heartbeats, fault injections, slot watermark)
+        are refreshed at snapshot time. With ACX_METRICS unset the registry
+        is off and counters read zero."""
+        import json as _json
+        n = self._lib.acx_metrics_snapshot(None, 0)
+        buf = ctypes.create_string_buffer(n + 1)
+        self._lib.acx_metrics_snapshot(buf, n + 1)
+        return _json.loads(buf.value.decode())
+
+    def metrics_dump(self, path: str) -> None:
+        """Write the registry snapshot to ``path`` as JSON."""
+        if self._lib.acx_metrics_dump_json(path.encode()) != 0:
+            raise RuntimeError(f"acx_metrics_dump_json({path!r}) failed")
 
     def finalize(self) -> None:
         if self._open:

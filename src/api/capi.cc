@@ -10,8 +10,61 @@
 
 #include "acx/api_internal.h"
 #include "acx/fault.h"
+#include "acx/metrics.h"
+
+namespace acx {
+
+// Fold cumulative runtime stats into the metrics registry. Set (not Add):
+// every source here is itself a monotonic cumulative counter, so the
+// registry mirrors it instead of re-accumulating.
+void RefreshRuntimeMetrics() {
+  if (!metrics::Enabled()) return;
+  ApiState& g = GS();
+  if (g.proxy != nullptr) {
+    const Proxy::Stats s = g.proxy->stats();
+    metrics::Set(metrics::kProxySweeps, s.sweeps);
+    metrics::Set(metrics::kOpsIssued, s.ops_issued);
+    metrics::Set(metrics::kOpsCompleted, s.ops_completed);
+    metrics::Set(metrics::kSlotsReclaimed, s.slots_reclaimed);
+    metrics::Set(metrics::kRetries, s.retries);
+    metrics::Set(metrics::kTimeouts, s.timeouts);
+  }
+  const fault::Stats f = fault::stats();
+  metrics::Set(metrics::kFaultsInjected, f.drops + f.delays + f.fails);
+  if (g.transport != nullptr) {
+    const NetStats n = g.transport->net_stats();
+    metrics::Set(metrics::kHbSent, n.hb_sent);
+    metrics::Set(metrics::kHbRecv, n.hb_recv);
+    metrics::Set(metrics::kPeersDead, n.peers_dead);
+    metrics::Set(metrics::kHbMisses, n.failed_ops);
+  }
+  if (g.table != nullptr)
+    metrics::MaxGauge(metrics::kSlotHighWater, g.table->watermark());
+}
+
+}  // namespace acx
 
 extern "C" {
+
+// ---- metrics plane -------------------------------------------------------
+
+// 1 iff ACX_METRICS is set (any non-"0" value).
+int acx_metrics_enabled(void) { return acx::metrics::Enabled() ? 1 : 0; }
+
+// Writes the registry snapshot as JSON into buf (NUL-terminated, truncated
+// at cap). Returns the full length needed excluding the NUL — call with
+// (NULL, 0) to size the buffer. Refreshes runtime-derived counters first.
+int acx_metrics_snapshot(char* buf, int cap) {
+  acx::RefreshRuntimeMetrics();
+  return acx::metrics::SnapshotJson(buf, cap);
+}
+
+// Dumps the registry snapshot to `path`. Returns 0 on success.
+int acx_metrics_dump_json(const char* path) {
+  if (path == nullptr) return 1;
+  acx::RefreshRuntimeMetrics();
+  return acx::metrics::DumpJson(path);
+}
 
 // Fills out[4] = {sweeps, ops_issued, ops_completed, slots_reclaimed}.
 void acx_proxy_stats(uint64_t* out) {

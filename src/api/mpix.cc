@@ -25,6 +25,7 @@
 
 #include "acx/api_internal.h"
 #include "acx/debug.h"
+#include "acx/metrics.h"
 #include "acx/trace.h"
 #include "acx/net.h"
 #include "acx/runtime.h"
@@ -144,6 +145,7 @@ int EnqueueSendRecv(bool is_send, void* buf, int count, MPI_Datatype datatype,
   auto trigger = [table, proxy, idx] {
     table->Store(idx, kPending);
     ACX_TRACE_EVENT("trigger_fired", idx);
+    if (metrics::Enabled()) metrics::MarkTrigger(idx);
     // Post the transfer inline if no one else is sweeping (saves the
     // proxy-thread handoff); Kick still wakes a parked proxy to poll the
     // ISSUED op in case no host thread ever waits on it.
@@ -184,6 +186,7 @@ std::function<void()> MakeWaiter(int idx, MPI_Status* status,
   return [table, proxy, idx, status, graph_owned] {
     SpinUntil(table, proxy, idx, kCompleted);
     ACX_TRACE_EVENT("wait_observed", idx);
+    if (metrics::Enabled()) metrics::MarkWait(idx);
     CopyStatus(table->op(idx).status, status);
     if (!graph_owned) {
       table->Store(idx, kCleanup);
@@ -207,6 +210,7 @@ int EnqueueWait(MPIX_Request* reqp, MPI_Status* status, int qtype,
         g.table->Load(idx) == kCompleted) {
       // Fast path (reference try_complete_wait_op, sendrecv.cu:82-104):
       // already complete — consume inline, no queue hop.
+      if (metrics::Enabled()) metrics::MarkWait(idx);
       CopyStatus(g.table->op(idx).status, status);
       g.table->Store(idx, kCleanup);
       g.proxy->Kick();
@@ -248,6 +252,7 @@ int HostWaitBasic(MpixRequest* req, MPI_Status* status) {
   }
   SpinUntil(g.table, g.proxy, idx, kCompleted);
   ACX_TRACE_EVENT("wait_observed", idx);
+  if (metrics::Enabled()) metrics::MarkWait(idx);
   CopyStatus(g.table->op(idx).status, status);
   g.table->Store(idx, kCleanup);  // proxy frees request + ticket + slot
   g.proxy->Kick();
@@ -350,6 +355,7 @@ int MPIX_Init(void) {
   g.table = new FlagTable(nflags);
   g.proxy = new Proxy(g.table, g.transport);
   g.proxy->Start();
+  trace::SetRank(g.transport->rank());
   g.mpix_inited = true;
   ACX_DLOG("MPIX_Init: rank %d/%d, %zu flag slots", g.transport->rank(),
            g.transport->size(), nflags);
@@ -383,6 +389,12 @@ int MPIX_Finalize(void) {
   // After Stop: the proxy thread's tail events (final completions and
   // slot reclaims) are in the ring before the file is written.
   trace::Flush(g.transport->rank());
+  // Metrics dump while proxy/table/transport are still alive: the
+  // refresh folds their cumulative stats into the registry first.
+  if (metrics::Enabled()) {
+    RefreshRuntimeMetrics();
+    metrics::FlushAtFinalize(g.transport->rank());
+  }
   delete g.proxy;
   g.proxy = nullptr;
   delete g.table;

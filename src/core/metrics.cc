@@ -1,0 +1,232 @@
+#include "acx/metrics.h"
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "acx/fault.h"  // NowNs
+
+namespace acx {
+namespace metrics {
+namespace {
+
+// Keep in sync with enum Counter / enum Hist (acx/metrics.h).
+const char* const kCounterName[kNumCounters] = {
+    "triggers",        "waits",          "ops_isend",      "ops_irecv",
+    "ops_pready",      "ops_parrived",   "bytes_sent",     "bytes_recv",
+    "retries",         "timeouts",       "faults_injected", "hb_sent",
+    "hb_recv",         "hb_misses",      "peers_dead",     "slot_hwm",
+    "proxy_sweeps",    "ops_issued",     "ops_completed",  "slots_reclaimed",
+    "proxy_busy_ns",   "proxy_idle_ns",
+};
+
+const char* const kHistName[kNumHists] = {
+    "trigger_to_issue_ns",
+    "issue_to_complete_ns",
+    "complete_to_wait_ns",
+    "proxy_sweep_ns",
+};
+
+struct HistData {
+  std::atomic<uint64_t> count{0};
+  std::atomic<uint64_t> sum{0};
+  std::atomic<uint64_t> buckets[kNumBuckets] = {};
+};
+
+// Per-slot lifecycle stamps. Stamp writes are relaxed: the flag-table
+// protocol's release/release stores already order the enqueuer's trigger
+// stamp before the proxy's issue read (same contract as Op fields).
+struct Stamp {
+  std::atomic<uint64_t> trigger{0};
+  std::atomic<uint64_t> issue{0};
+  std::atomic<uint64_t> complete{0};
+};
+
+struct State {
+  std::atomic<uint64_t> counters[kNumCounters] = {};
+  HistData hists[kNumHists];
+  Stamp* stamps = nullptr;
+  size_t nstamps = 0;
+  const char* dump_path = nullptr;  // nullptr = snapshot-only (ACX_METRICS=1)
+};
+
+State& S() {
+  static State* s = [] {
+    State* st = new State;
+    // Stamp capacity mirrors the flag table size knob (MPIX_Init).
+    size_t n = 4096;
+    const char* e = std::getenv("ACX_NFLAGS");
+    if (e == nullptr) e = std::getenv("MPIACX_NFLAGS");
+    if (e != nullptr) {
+      const long v = std::atol(e);
+      if (v > 0) n = static_cast<size_t>(v);
+    }
+    st->nstamps = n;
+    st->stamps = new Stamp[n];
+    const char* p = std::getenv("ACX_METRICS");
+    if (p != nullptr && p[0] != '\0' && std::strcmp(p, "1") != 0 &&
+        std::strcmp(p, "0") != 0)
+      st->dump_path = p;
+    return st;
+  }();
+  return *s;
+}
+
+// Bucket i>0 holds [2^(i-1), 2^i) ns; bucket 0 holds exactly 0.
+int BucketOf(uint64_t ns) {
+  int b = 0;
+  while (ns != 0 && b < kNumBuckets - 1) {
+    ns >>= 1;
+    b++;
+  }
+  return b;
+}
+
+Stamp* StampFor(int64_t slot) {
+  State& s = S();
+  if (slot < 0 || static_cast<size_t>(slot) >= s.nstamps) return nullptr;
+  return &s.stamps[slot];
+}
+
+std::string SnapshotString() {
+  State& s = S();
+  std::string out;
+  out.reserve(4096);
+  out += "{\"enabled\":";
+  out += Enabled() ? "true" : "false";
+  out += ",\"counters\":{";
+  char buf[64];
+  for (int c = 0; c < kNumCounters; c++) {
+    std::snprintf(buf, sizeof buf, "%s\"%s\":%llu", c ? "," : "",
+                  kCounterName[c],
+                  (unsigned long long)s.counters[c].load(
+                      std::memory_order_relaxed));
+    out += buf;
+  }
+  out += "},\"histograms\":{";
+  for (int h = 0; h < kNumHists; h++) {
+    const HistData& hd = s.hists[h];
+    std::snprintf(buf, sizeof buf, "%s\"%s\":{\"unit\":\"ns\",", h ? "," : "",
+                  kHistName[h]);
+    out += buf;
+    std::snprintf(buf, sizeof buf, "\"count\":%llu,\"sum\":%llu,\"buckets\":[",
+                  (unsigned long long)hd.count.load(std::memory_order_relaxed),
+                  (unsigned long long)hd.sum.load(std::memory_order_relaxed));
+    out += buf;
+    for (int b = 0; b < kNumBuckets; b++) {
+      std::snprintf(buf, sizeof buf, "%s%llu", b ? "," : "",
+                    (unsigned long long)hd.buckets[b].load(
+                        std::memory_order_relaxed));
+      out += buf;
+    }
+    out += "]}";
+  }
+  out += "}}";
+  return out;
+}
+
+}  // namespace
+
+bool Enabled() {
+  static const bool on = [] {
+    const char* p = std::getenv("ACX_METRICS");
+    return p != nullptr && p[0] != '\0' && std::strcmp(p, "0") != 0;
+  }();
+  return on;
+}
+
+void Add(Counter c, uint64_t v) {
+  S().counters[c].fetch_add(v, std::memory_order_relaxed);
+}
+
+void Set(Counter c, uint64_t v) {
+  S().counters[c].store(v, std::memory_order_relaxed);
+}
+
+void MaxGauge(Counter c, uint64_t v) {
+  std::atomic<uint64_t>& g = S().counters[c];
+  uint64_t cur = g.load(std::memory_order_relaxed);
+  while (v > cur &&
+         !g.compare_exchange_weak(cur, v, std::memory_order_relaxed)) {
+  }
+}
+
+void Observe(Hist h, uint64_t ns) {
+  HistData& hd = S().hists[h];
+  hd.count.fetch_add(1, std::memory_order_relaxed);
+  hd.sum.fetch_add(ns, std::memory_order_relaxed);
+  hd.buckets[BucketOf(ns)].fetch_add(1, std::memory_order_relaxed);
+}
+
+void MarkTrigger(int64_t slot) {
+  Add(kTriggers, 1);
+  if (Stamp* st = StampFor(slot))
+    st->trigger.store(NowNs(), std::memory_order_relaxed);
+}
+
+void MarkIssue(int64_t slot, bool is_send, uint64_t bytes) {
+  Add(is_send ? kOpsIsend : kOpsIrecv, 1);
+  Add(is_send ? kBytesSent : kBytesRecv, bytes);
+  const uint64_t now = NowNs();
+  if (Stamp* st = StampFor(slot)) {
+    // exchange(0): a retry re-issues the same slot — the trigger segment
+    // must be recorded once, against the first post.
+    const uint64_t t = st->trigger.exchange(0, std::memory_order_relaxed);
+    if (t != 0 && now > t) Observe(kTriggerToIssue, now - t);
+    st->issue.store(now, std::memory_order_relaxed);
+  }
+}
+
+void MarkComplete(int64_t slot) {
+  const uint64_t now = NowNs();
+  if (Stamp* st = StampFor(slot)) {
+    const uint64_t t = st->issue.exchange(0, std::memory_order_relaxed);
+    if (t != 0 && now > t) Observe(kIssueToComplete, now - t);
+    st->complete.store(now, std::memory_order_relaxed);
+  }
+}
+
+void MarkWait(int64_t slot) {
+  Add(kWaits, 1);
+  const uint64_t now = NowNs();
+  if (Stamp* st = StampFor(slot)) {
+    const uint64_t t = st->complete.exchange(0, std::memory_order_relaxed);
+    if (t != 0 && now > t) Observe(kCompleteToWait, now - t);
+  }
+}
+
+int SnapshotJson(char* buf, int cap) {
+  const std::string s = SnapshotString();
+  if (buf != nullptr && cap > 0) {
+    const size_t n =
+        s.size() < static_cast<size_t>(cap) - 1 ? s.size() : cap - 1;
+    std::memcpy(buf, s.data(), n);
+    buf[n] = '\0';
+  }
+  return static_cast<int>(s.size());
+}
+
+int DumpJson(const char* path) {
+  FILE* f = std::fopen(path, "w");
+  if (f == nullptr) return 1;
+  const std::string s = SnapshotString();
+  std::fwrite(s.data(), 1, s.size(), f);
+  std::fputc('\n', f);
+  std::fclose(f);
+  return 0;
+}
+
+void FlushAtFinalize(int rank) {
+  State& s = S();
+  if (!Enabled() || s.dump_path == nullptr) return;
+  const std::string fn = std::string(s.dump_path) + ".rank" +
+                         std::to_string(rank) + ".metrics.json";
+  if (DumpJson(fn.c_str()) != 0)
+    std::fprintf(stderr, "tpu-acx: ACX_METRICS: cannot write %s\n",
+                 fn.c_str());
+}
+
+}  // namespace metrics
+}  // namespace acx

@@ -6,6 +6,7 @@
 
 #include "acx/debug.h"
 #include "acx/fault.h"
+#include "acx/metrics.h"
 #include "acx/trace.h"
 
 namespace acx {
@@ -115,6 +116,7 @@ bool Proxy::IssueOp(size_t i, Op& op, Stats& local, bool from_pending) {
         op.status = Status{op.peer, op.tag, err, 0};
         table_->Store(i, kCompleted);
         ACX_TRACE_EVENT("fault_fail", i);
+        if (metrics::Enabled()) metrics::MarkComplete(i);
         local.ops_completed++;
         return true;
       case fault::Action::kDrop:
@@ -144,12 +146,14 @@ bool Proxy::IssueOp(size_t i, Op& op, Stats& local, bool from_pending) {
     op.ticket = transport_->Isend(op.sbuf, op.bytes, op.peer, op.tag, op.ctx);
     if (from_pending) table_->Store(i, kIssued);
     ACX_TRACE_EVENT("isend_issued", i);
+    if (metrics::Enabled()) metrics::MarkIssue(i, true, op.bytes);
   } else {
     ACX_DLOG("slot %zu: irecv %zuB <- peer %d tag %d", i, op.bytes, op.peer,
              op.tag);
     op.ticket = transport_->Irecv(op.rbuf, op.bytes, op.peer, op.tag, op.ctx);
     if (from_pending) table_->Store(i, kIssued);
     ACX_TRACE_EVENT("irecv_issued", i);
+    if (metrics::Enabled()) metrics::MarkIssue(i, false, op.bytes);
   }
   local.ops_issued++;
   return true;
@@ -165,6 +169,7 @@ bool Proxy::CheckStalled(size_t i, Op& op, Stats& local) {
     op.status = Status{op.peer, op.tag, kErrTimeout, 0};
     table_->Store(i, kCompleted);
     ACX_TRACE_EVENT("op_timeout", i);
+    if (metrics::Enabled()) metrics::MarkComplete(i);
     local.timeouts++;
     local.ops_completed++;
     return true;
@@ -177,6 +182,7 @@ bool Proxy::CheckStalled(size_t i, Op& op, Stats& local) {
     op.status = Status{op.peer, op.tag, kErrTimeout, 0};
     table_->Store(i, kCompleted);
     ACX_TRACE_EVENT("op_timeout", i);
+    if (metrics::Enabled()) metrics::MarkComplete(i);
     local.timeouts++;
     local.ops_completed++;
     return true;
@@ -192,6 +198,8 @@ bool Proxy::Sweep() {
   // Only [0, watermark) can hold live slots (lowest-free-slot allocation);
   // with K concurrent ops this is a K-entry walk, not O(nflags).
   const size_t n = table_->watermark();
+  if (metrics::Enabled() && n > 0)
+    metrics::MaxGauge(metrics::kSlotHighWater, n);
   for (size_t i = 0; i < n; i++) {
     const int32_t f = table_->Load(i);
     Op& op = table_->op(i);
@@ -208,6 +216,8 @@ bool Proxy::Sweep() {
             op.chan->Pready(op.partition);
             table_->Store(i, kCompleted);
             ACX_TRACE_EVENT("pready_wire", i);
+            if (metrics::Enabled())
+              metrics::Add(metrics::kOpsPready, 1);
             local.ops_completed++;
             progressed = true;
             break;
@@ -229,6 +239,7 @@ bool Proxy::Sweep() {
             if (op.ticket != nullptr && op.ticket->Test(&op.status)) {
               table_->Store(i, kCompleted);
               ACX_TRACE_EVENT("op_completed", i);
+              if (metrics::Enabled()) metrics::MarkComplete(i);
               local.ops_completed++;
               progressed = true;
             } else if (CheckStalled(i, op, local)) {
@@ -240,6 +251,8 @@ bool Proxy::Sweep() {
             if (op.chan->Parrived(op.partition)) {
               table_->Store(i, kCompleted);
               ACX_TRACE_EVENT("parrived", i);
+              if (metrics::Enabled())
+                metrics::Add(metrics::kOpsParrived, 1);
               local.ops_completed++;
               progressed = true;
             }
@@ -278,12 +291,21 @@ void Proxy::Run() {
   // exponential growth capped at 200us; park on the condvar when the table
   // is fully idle. Kick() wakes us immediately in all cases.
   int idle_sweeps = 0;
+  // Busy/idle split for the metrics plane ("proxy idle fraction"): clocks
+  // are only read when ACX_METRICS is armed.
+  const bool mx = metrics::Enabled();
   while (!exit_.load(std::memory_order_acquire)) {
     const uint64_t kicks_before = kicks_.load(std::memory_order_acquire);
     bool progressed;
+    const uint64_t t_sweep = mx ? NowNs() : 0;
     {
       std::lock_guard<std::mutex> lk(sweep_mu_);
       progressed = Sweep();
+    }
+    if (mx) {
+      const uint64_t dt = NowNs() - t_sweep;
+      metrics::Add(metrics::kProxyBusyNs, dt);
+      metrics::Observe(metrics::kProxySweepNs, dt);
     }
     sweeps_.fetch_add(1, std::memory_order_relaxed);
     if (progressed) {
@@ -296,19 +318,23 @@ void Proxy::Run() {
       // (heartbeats, dead-peer checks), then park until work arrives. The
       // 50ms wait bound doubles as the heartbeat cadence floor.
       transport_->Tick();
+      const uint64_t t_idle = mx ? NowNs() : 0;
       std::unique_lock<std::mutex> lk(idle_mu_);
       idle_cv_.wait_for(lk, std::chrono::milliseconds(50), [&] {
         return exit_.load(std::memory_order_acquire) ||
                kicks_.load(std::memory_order_acquire) != kicks_before ||
                table_->active.load(std::memory_order_relaxed) != 0;
       });
+      if (mx) metrics::Add(metrics::kProxyIdleNs, NowNs() - t_idle);
       idle_sweeps = 0;
     } else if (idle_sweeps < 64) {
       std::this_thread::yield();
     } else {
       transport_->Tick();
       const int exp = idle_sweeps - 64 < 8 ? idle_sweeps - 64 : 8;
+      const uint64_t t_idle = mx ? NowNs() : 0;
       std::this_thread::sleep_for(std::chrono::microseconds(1 << exp));
+      if (mx) metrics::Add(metrics::kProxyIdleNs, NowNs() - t_idle);
     }
   }
 }

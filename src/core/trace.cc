@@ -1,11 +1,17 @@
 #include "acx/trace.h"
 
+#include <signal.h>
+
+#include <algorithm>
+#include <csignal>
+#include <atomic>
 #include <chrono>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <string>
+#include <unordered_map>
 #include <vector>
 
 namespace acx {
@@ -48,10 +54,199 @@ const char* path() {
   return p;
 }
 
+std::atomic<int> g_rank{-1};
+std::atomic<bool> g_flushing{false};
+
+int RankForFlush() {
+  int r = g_rank.load(std::memory_order_relaxed);
+  if (r >= 0) return r;
+  const char* e = std::getenv("ACX_RANK");
+  return e != nullptr ? std::atoi(e) : 0;
+}
+
+// Snapshot the ring without draining it (a later flush rewrites a
+// superset; an abnormal-exit flush after a normal finalize flush never
+// truncates the finalize file down to a tail). best_effort (signal/atexit
+// context) refuses to block on the ring mutex and skips empty rings.
+bool Snapshot(std::vector<Event>* events, uint64_t* dropped,
+              bool best_effort) {
+  Ring& r = ring();
+  std::unique_lock<std::mutex> lk(r.mu, std::defer_lock);
+  if (best_effort) {
+    if (!lk.try_lock()) return false;
+    if (r.events.empty()) return false;
+  } else {
+    lk.lock();
+  }
+  *events = r.events;
+  *dropped = r.dropped;
+  return true;
+}
+
+void WriteFile(const std::vector<Event>& events, uint64_t dropped, int rank);
+
+void FlushBestEffort() {
+  if (!Enabled()) return;
+  std::vector<Event> events;
+  uint64_t dropped = 0;
+  if (!Snapshot(&events, &dropped, /*best_effort=*/true)) return;
+  WriteFile(events, dropped, RankForFlush());
+}
+
+void OnFatalSignal(int sig) {
+  // One flusher only; fopen/fprintf are not async-signal-safe, but a
+  // best-effort trace of a dying rank beats a guaranteed empty one.
+  if (!g_flushing.exchange(true)) FlushBestEffort();
+  std::signal(sig, SIG_DFL);
+  std::raise(sig);
+}
+
+void InstallCrashHooks() {
+  std::atexit(FlushBestEffort);
+  const int sigs[] = {SIGTERM, SIGINT, SIGABRT, SIGSEGV, SIGBUS};
+  for (int sig : sigs) {
+    // Only claim default dispositions — never stomp a runtime's (e.g.
+    // Python's SIGINT) installed handler.
+    struct sigaction old {};
+    if (sigaction(sig, nullptr, &old) != 0) continue;
+    if (old.sa_handler != SIG_DFL || (old.sa_flags & SA_SIGINFO)) continue;
+    struct sigaction sa {};
+    sa.sa_handler = OnFatalSignal;
+    sigemptyset(&sa.sa_mask);
+    sigaction(sig, &sa, nullptr);
+  }
+}
+
+// One output record (instant, span begin, or span end), sortable by
+// timestamp so the written stream stays time-ordered with spans inline.
+struct Record {
+  uint64_t ts_ns;
+  std::string json;  // everything but the "ts" field
+};
+
+void AppendTs(std::string* out, uint64_t ts_ns) {
+  char buf[48];
+  // Chrome/Perfetto "ts" is in µs and accepts decimals — keep the ns
+  // precision as fractional µs.
+  std::snprintf(buf, sizeof buf, "\"ts\":%llu.%03llu",
+                (unsigned long long)(ts_ns / 1000),
+                (unsigned long long)(ts_ns % 1000));
+  *out += buf;
+}
+
+// Synthesize duration spans from the instant stream: for each slot the
+// lifecycle transitions pair up into segments. An end-name arriving with
+// its begin stamp set emits one async "b"/"e" pair (name+cat+id matched,
+// the Perfetto async-span contract) and the chain advances.
+struct SpanRule {
+  const char* begin1;
+  const char* begin2;  // alternate begin (send/recv flavor), or nullptr
+  const char* end;
+  const char* span;
+};
+
+const SpanRule kSpanRules[] = {
+    {"trigger_fired", nullptr, "isend_issued", "proxy_pickup"},
+    {"trigger_fired", nullptr, "irecv_issued", "proxy_pickup"},
+    {"isend_issued", "irecv_issued", "op_completed", "wire"},
+    {"op_completed", nullptr, "wait_observed", "wait_pickup"},
+    {"pready_marked", nullptr, "pready_wire", "pready_push"},
+};
+
+size_t SynthesizeSpans(const std::vector<Event>& events, int rank,
+                       std::vector<Record>* out) {
+  // last[slot][name] = ts of the most recent instant with that name.
+  std::unordered_map<int64_t, std::unordered_map<std::string, uint64_t>> last;
+  uint64_t next_id = 0;
+  size_t spans = 0;
+  for (const Event& e : events) {
+    auto& slot_last = last[e.slot];
+    for (const SpanRule& rule : kSpanRules) {
+      if (std::strcmp(e.name, rule.end) != 0) continue;
+      uint64_t b_ts = 0;
+      auto it = slot_last.find(rule.begin1);
+      if (it != slot_last.end()) {
+        b_ts = it->second;
+        slot_last.erase(it);
+      } else if (rule.begin2 != nullptr &&
+                 (it = slot_last.find(rule.begin2)) != slot_last.end()) {
+        b_ts = it->second;
+        slot_last.erase(it);
+      } else {
+        continue;
+      }
+      if (e.ts_ns < b_ts) continue;
+      char buf[192];
+      const uint64_t id = next_id++;
+      std::snprintf(buf, sizeof buf,
+                    "{\"name\":\"%s\",\"cat\":\"acx\",\"ph\":\"b\","
+                    "\"id\":%llu,\"pid\":%d,\"tid\":%lld,",
+                    rule.span, (unsigned long long)id, rank,
+                    (long long)e.slot);
+      out->push_back(Record{b_ts, buf});
+      std::snprintf(buf, sizeof buf,
+                    "{\"name\":\"%s\",\"cat\":\"acx\",\"ph\":\"e\","
+                    "\"id\":%llu,\"pid\":%d,\"tid\":%lld,",
+                    rule.span, (unsigned long long)id, rank,
+                    (long long)e.slot);
+      out->push_back(Record{e.ts_ns, buf});
+      spans++;
+    }
+    slot_last[e.name] = e.ts_ns;
+  }
+  return spans;
+}
+
+void WriteFile(const std::vector<Event>& events, uint64_t dropped, int rank) {
+  std::string fn = std::string(path()) + ".rank" + std::to_string(rank) +
+                   ".trace.json";
+  FILE* f = std::fopen(fn.c_str(), "w");
+  if (f == nullptr) {
+    std::fprintf(stderr, "tpu-acx: ACX_TRACE: cannot write %s\n", fn.c_str());
+    return;
+  }
+  // Chrome trace-event JSON: instant events (one tid per slot, so each op
+  // slot gets its own track) plus synthesized lifecycle spans.
+  std::vector<Record> records;
+  records.reserve(events.size() * 2);
+  for (const Event& e : events) {
+    char buf[160];
+    std::snprintf(buf, sizeof buf,
+                  "{\"name\":\"%s\",\"ph\":\"i\",\"s\":\"t\","
+                  "\"pid\":%d,\"tid\":%lld,",
+                  e.name, rank, (long long)e.slot);
+    records.push_back(Record{e.ts_ns, buf});
+  }
+  const size_t spans = SynthesizeSpans(events, rank, &records);
+  // Stable sort keeps the stream time-ordered with span boundaries
+  // interleaved at their instants' timestamps (begin records sort back to
+  // their begin instant).
+  std::stable_sort(records.begin(), records.end(),
+                   [](const Record& a, const Record& b) {
+                     return a.ts_ns < b.ts_ns;
+                   });
+  std::fprintf(f, "{\"traceEvents\":[\n");
+  for (size_t i = 0; i < records.size(); i++) {
+    std::string line = records[i].json;
+    AppendTs(&line, records[i].ts_ns);
+    line += "}";
+    std::fprintf(f, "%s%s\n", line.c_str(),
+                 i + 1 < records.size() ? "," : "");
+  }
+  std::fprintf(f, "],\"displayTimeUnit\":\"ms\",\"otherData\":{"
+                  "\"dropped\":%llu,\"events\":%zu,\"spans\":%zu}}\n",
+               (unsigned long long)dropped, events.size(), spans);
+  std::fclose(f);
+}
+
 }  // namespace
 
 bool Enabled() {
-  static const bool on = path() != nullptr && path()[0] != '\0';
+  static const bool on = [] {
+    const bool v = path() != nullptr && path()[0] != '\0';
+    if (v) InstallCrashHooks();
+    return v;
+  }();
   return on;
 }
 
@@ -71,42 +266,17 @@ void Emit(const char* name, int64_t slot) {
   r.events.push_back(Event{ts, name, slot});
 }
 
+void SetRank(int rank) {
+  g_rank.store(rank, std::memory_order_relaxed);
+  (void)Enabled();  // arm the crash hooks as soon as the rank is known
+}
+
 void Flush(int rank) {
   if (!Enabled()) return;
-  Ring& r = ring();
   std::vector<Event> events;
-  uint64_t dropped;
-  {
-    std::lock_guard<std::mutex> lk(r.mu);
-    events.swap(r.events);
-    dropped = r.dropped;
-    r.dropped = 0;
-  }
-  std::string fn = std::string(path()) + ".rank" + std::to_string(rank) +
-                   ".trace.json";
-  FILE* f = std::fopen(fn.c_str(), "w");
-  if (f == nullptr) {
-    std::fprintf(stderr, "tpu-acx: ACX_TRACE: cannot write %s\n", fn.c_str());
-    return;
-  }
-  // Chrome trace-event JSON: instant events, one tid per slot so each
-  // op slot gets its own track in the viewer.
-  std::fprintf(f, "{\"traceEvents\":[\n");
-  for (size_t i = 0; i < events.size(); i++) {
-    const Event& e = events[i];
-    // Chrome/Perfetto "ts" is in µs and accepts decimals — keep the ns
-    // precision as fractional µs.
-    std::fprintf(f,
-                 "{\"name\":\"%s\",\"ph\":\"i\",\"s\":\"t\",\"ts\":%llu.%03llu,"
-                 "\"pid\":%d,\"tid\":%lld}%s\n",
-                 e.name, (unsigned long long)(e.ts_ns / 1000),
-                 (unsigned long long)(e.ts_ns % 1000), rank,
-                 (long long)e.slot, i + 1 < events.size() ? "," : "");
-  }
-  std::fprintf(f, "],\"displayTimeUnit\":\"ms\",\"otherData\":{"
-                  "\"dropped\":%llu,\"events\":%zu}}\n",
-               (unsigned long long)dropped, events.size());
-  std::fclose(f);
+  uint64_t dropped = 0;
+  Snapshot(&events, &dropped, /*best_effort=*/false);
+  WriteFile(events, dropped, rank);
 }
 
 }  // namespace trace

@@ -14,6 +14,7 @@
 
 #include "acx/api_internal.h"
 #include "acx/net.h"
+#include "acx/trace.h"
 #include "compat/mpi.h"
 
 namespace acx {
@@ -25,7 +26,11 @@ ApiState& GS() {
 
 void EnsureTransport() {
   ApiState& g = GS();
-  if (g.transport == nullptr) g.transport = CreateTransportFromEnv();
+  if (g.transport == nullptr) {
+    g.transport = CreateTransportFromEnv();
+    // Crash-path trace flushes need the rank as early as possible.
+    trace::SetRank(g.transport->rank());
+  }
 }
 
 size_t DatatypeSize(int datatype) {
@@ -116,7 +121,12 @@ int MPI_Type_size(MPI_Datatype datatype, int* size) {
 
 int MPI_Barrier(MPI_Comm comm) {
   acx::EnsureTransport();
+  // barrier_enter/exit instants are the cross-rank clock anchors
+  // tools/acx_trace_merge.py aligns per-rank steady clocks on: every rank
+  // leaves the same barrier at (nearly) the same wall instant.
+  ACX_TRACE_EVENT("barrier_enter", -1);
   GS().transport->Barrier(comm);
+  ACX_TRACE_EVENT("barrier_exit", -1);
   return MPI_SUCCESS;
 }
 

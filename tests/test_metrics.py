@@ -1,0 +1,254 @@
+"""Unified metrics plane (include/acx/metrics.h, src/core/metrics.cc,
+tools/acx_trace_merge.py): native counter/histogram registry, lifecycle
+spans in the trace, crash-safe flushes, and the cross-rank merge tool.
+
+Everything here drives real 2-rank runs through acxrun — the registry's
+numbers are checked against what the workload actually did, not against
+the implementation's own bookkeeping.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MERGE = os.path.join(REPO, "tools", "acx_trace_merge.py")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _built():
+    r = subprocess.run(["make", "-C", REPO, "itest", "tools"],
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def _acxrun(env_extra, *argv, np_ranks=2, timeout=300):
+    env = dict(os.environ)
+    env.update(env_extra)
+    return subprocess.run(
+        [os.path.join(REPO, "build", "acxrun"), "-np", str(np_ranks),
+         "-timeout", "120", *argv],
+        env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def _run_ring(tmp_path, env_extra):
+    r = _acxrun(env_extra, os.path.join(REPO, "build", "itests", "ring"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    return r
+
+
+# -- registry artifacts -----------------------------------------------------
+
+
+def test_metrics_json_written_per_rank(tmp_path):
+    """ACX_METRICS=<path> dumps one <path>.rank<r>.metrics.json per rank
+    at finalize, with the counters the ring run must have produced: the
+    itest sends/recvs one int per rank per phase (2 phases)."""
+    _run_ring(tmp_path, {"ACX_METRICS": str(tmp_path / "m")})
+    for rank in (0, 1):
+        d = json.loads((tmp_path / f"m.rank{rank}.metrics.json").read_text())
+        assert d["enabled"] is True
+        c = d["counters"]
+        assert len(c) >= 8
+        assert c["ops_isend"] == 2 and c["ops_irecv"] == 2
+        assert c["bytes_sent"] == 8 and c["bytes_recv"] == 8  # 2 x int32
+        assert c["triggers"] == 4 and c["waits"] == 4
+        assert c["ops_issued"] == 4 and c["ops_completed"] == 4
+        assert c["slot_hwm"] >= 1
+        h = d["histograms"]
+        assert len(h) >= 3
+        for name in ("trigger_to_issue_ns", "issue_to_complete_ns",
+                     "complete_to_wait_ns"):
+            assert h[name]["count"] == 4, name
+            assert h[name]["sum"] > 0
+            assert sum(h[name]["buckets"]) == h[name]["count"]
+
+
+def test_metrics_disabled_by_default(tmp_path):
+    """Without ACX_METRICS no artifact appears (and the hot path took
+    the one-branch disabled route the whole run)."""
+    env = {k: v for k, v in os.environ.items() if k != "ACX_METRICS"}
+    r = subprocess.run(
+        [os.path.join(REPO, "build", "acxrun"), "-np", "2", "-timeout",
+         "120", os.path.join(REPO, "build", "itests", "ring")],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert not list(tmp_path.glob("*.metrics.json"))
+
+
+def test_python_runtime_metrics_snapshot():
+    """Runtime.metrics() reads the registry through the C API. Run in a
+    subprocess so ACX_METRICS=1 (snapshot-only mode: no file) is set
+    before the native library loads."""
+    prog = textwrap.dedent("""
+        import json, sys
+        import numpy as np
+        from mpi_acx_tpu import runtime
+        rt = runtime.Runtime()
+        assert rt.metrics_enabled()
+        src = np.arange(16, dtype=np.float32)
+        dst = np.zeros(16, dtype=np.float32)
+        s = rt.isend_enqueue(src, dest=0, tag=7)
+        r = rt.irecv_enqueue(dst, source=0, tag=7)
+        rt.wait(r); rt.wait(s)
+        m = rt.metrics()
+        assert m["enabled"] is True
+        assert m["counters"]["ops_isend"] == 1
+        assert m["counters"]["bytes_sent"] == 64
+        assert m["histograms"]["issue_to_complete_ns"]["count"] >= 1
+        rt.finalize()
+        print("METRICS_OK", json.dumps(len(m["counters"])))
+    """)
+    env = dict(os.environ)
+    env["ACX_METRICS"] = "1"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", prog], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "METRICS_OK" in r.stdout
+
+
+# -- trace spans ------------------------------------------------------------
+
+
+def test_trace_spans_balanced_and_sorted(tmp_path):
+    """The upgraded trace carries paired duration spans (ph b/e) next to
+    the instants, stays time-sorted, and balances every begin with an
+    end of the same name+id."""
+    _run_ring(tmp_path, {"ACX_TRACE": str(tmp_path / "t")})
+    for rank in (0, 1):
+        d = json.loads((tmp_path / f"t.rank{rank}.trace.json").read_text())
+        evs = d["traceEvents"]
+        ts = [e["ts"] for e in evs]
+        assert ts == sorted(ts)
+        assert {e["name"] for e in evs if e["ph"] == "i"} >= {
+            "trigger_fired", "op_completed"}
+        begins = [(e["name"], e["id"]) for e in evs if e["ph"] == "b"]
+        ends = [(e["name"], e["id"]) for e in evs if e["ph"] == "e"]
+        assert begins and sorted(begins) == sorted(ends)
+        assert {n for n, _ in begins} >= {"proxy_pickup", "wire",
+                                          "wait_pickup"}
+        assert d["otherData"]["spans"] == len(begins)
+
+
+def test_trace_ring_overflow_drops_new_keeps_old(tmp_path):
+    """Satellite: with a tiny ACX_TRACE_CAP the ring drops NEW events,
+    keeps the oldest, and reports the count in otherData.dropped."""
+    _run_ring(tmp_path, {"ACX_TRACE": str(tmp_path / "t"),
+                         "ACX_TRACE_CAP": "16"})
+    for rank in (0, 1):
+        d = json.loads((tmp_path / f"t.rank{rank}.trace.json").read_text())
+        other = d["otherData"]
+        assert other["events"] == 16          # capped, not truncated lower
+        assert other["dropped"] > 0
+        names = [e["name"] for e in d["traceEvents"] if e["ph"] == "i"]
+        # The FIRST events of the run survive — the enqueue of op one
+        # happens before event 17 on every rank of the 2-int ring.
+        assert "isend_enqueue" in names or "irecv_enqueue" in names
+
+
+# -- crash-safe flush -------------------------------------------------------
+
+
+_CRASH_PROG = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, %r)
+    from mpi_acx_tpu import runtime
+    rt = runtime.Runtime()
+    import numpy as np
+    src = np.arange(4, dtype=np.int32)
+    dst = np.zeros(4, dtype=np.int32)
+    s = rt.isend_enqueue(src, dest=0)
+    r = rt.irecv_enqueue(dst, source=0)
+    rt.wait(r); rt.wait(s)
+    mode = sys.argv[1]
+    if mode == "exit":
+        sys.exit(0)          # NO finalize: only the atexit hook can flush
+    os.kill(os.getpid(), int(mode))
+""") % REPO
+
+
+@pytest.mark.parametrize("mode,rc", [("exit", 0),
+                                     (str(int(signal.SIGTERM)),
+                                      -signal.SIGTERM)],
+                         ids=["atexit", "sigterm"])
+def test_crash_flush_writes_trace(tmp_path, mode, rc):
+    """A rank that never reaches MPIX_Finalize still leaves its trace:
+    the atexit hook covers plain exits, the signal hook covers a
+    SIGTERM'd process (handlers installed only over SIG_DFL)."""
+    env = dict(os.environ)
+    env["ACX_TRACE"] = str(tmp_path / "t")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", _CRASH_PROG, mode], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == rc, (r.returncode, r.stdout, r.stderr)
+    f = tmp_path / "t.rank0.trace.json"
+    assert f.exists(), "crash flush did not write the trace"
+    d = json.loads(f.read_text())
+    assert {e["name"] for e in d["traceEvents"]} >= {"trigger_fired",
+                                                     "op_completed"}
+
+
+# -- merge tool -------------------------------------------------------------
+
+
+def test_merge_tool_end_to_end(tmp_path):
+    """2-rank run -> one Perfetto-loadable file with one named process
+    per rank and every span intact, plus the fleet metrics aggregate,
+    all under --validate."""
+    _run_ring(tmp_path, {"ACX_TRACE": str(tmp_path / "t"),
+                         "ACX_METRICS": str(tmp_path / "m")})
+    merged = tmp_path / "merged.trace.json"
+    fleet = tmp_path / "fleet.metrics.json"
+    r = subprocess.run(
+        [sys.executable, MERGE, "--validate", "--out", str(merged),
+         "--metrics-out", str(fleet)]
+        + [str(tmp_path / f"t.rank{k}.trace.json") for k in (0, 1)]
+        + [str(tmp_path / f"m.rank{k}.metrics.json") for k in (0, 1)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    summary = json.loads(r.stdout)
+    assert summary["valid"] and summary["traces"] == 2
+
+    d = json.loads(merged.read_text())
+    assert {e["pid"] for e in d["traceEvents"]} == {0, 1}
+    proc_names = {e["args"]["name"] for e in d["traceEvents"]
+                  if e.get("ph") == "M"}
+    assert proc_names == {"rank 0", "rank 1"}
+    spans = [e for e in d["traceEvents"] if e.get("ph") == "b"]
+    assert spans and {e["pid"] for e in spans} == {0, 1}
+
+    f = json.loads(fleet.read_text())
+    assert f["ranks"] == [0, 1]
+    assert f["counters"]["ops_isend"] == 4          # 2 per rank, summed
+    assert f["counters"]["slot_hwm"] >= 1           # maxed, not summed
+    assert f["histograms"]["issue_to_complete_ns"]["count"] == 8
+
+
+def test_merge_tool_validate_catches_corruption(tmp_path):
+    """--validate is a real check: an unbalanced span fails it."""
+    bad = tmp_path / "bad.rank0.trace.json"
+    bad.write_text(json.dumps({
+        "traceEvents": [
+            {"name": "wire", "cat": "acx", "ph": "b", "id": 0, "pid": 0,
+             "tid": 1, "ts": 1.0},
+        ],
+        "otherData": {"dropped": 0, "events": 0, "spans": 1}}))
+    r = subprocess.run([sys.executable, MERGE, "--validate", str(bad)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1
+    assert "unbalanced span" in r.stderr
+
+
+def test_makefile_metrics_check_target():
+    """`make metrics-check` (wired into `make check`) goes green."""
+    r = subprocess.run(["make", "-C", REPO, "metrics-check"],
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "METRICS CHECK PASSED" in r.stdout

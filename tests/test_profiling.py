@@ -29,7 +29,8 @@ def test_step_timer_stats_and_dump(tmp_path):
             region.sync(f(x))
     s = t.summary()
     assert s["steps"] == 5
-    assert 0 < s["p50_s"] <= s["p90_s"] <= s["max_s"]
+    assert 0 < s["min_s"] <= s["p50_s"] <= s["p90_s"] <= s["p99_s"] \
+        <= s["max_s"]
     assert abs(s["mean_s"] - sum(t.samples) / 5) < 1e-12
     out = t.dump(str(tmp_path / "steps.json"), extra={"tag": "test"})
     loaded = json.load(open(tmp_path / "steps.json"))
@@ -57,6 +58,8 @@ def test_percentiles_nearest_rank():
     t = profiling.StepTimer()
     t.samples = [float(i) for i in range(1, 11)]   # 1..10
     s = t.summary()
+    assert s["min_s"] == 1.0
     assert s["p50_s"] == 5.0    # ceil(0.5*10)=5th smallest
     assert s["p90_s"] == 9.0    # ceil(0.9*10)=9th smallest, not the max
+    assert s["p99_s"] == 10.0   # ceil(0.99*10)=10th smallest
     assert s["max_s"] == 10.0

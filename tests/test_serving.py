@@ -357,3 +357,61 @@ def test_serve_sample_int8_kv_equals_solo():
                                    temperature=0.8, top_k=13,
                                    max_len=24, kv_int8=True)
         np.testing.assert_array_equal(np.asarray(g), np.asarray(want)[0])
+
+
+def test_serving_telemetry():
+    """serve_greedy returns a ServedBatch: the outputs behave as the
+    plain list they always were, and .metrics carries the batch
+    telemetry — per-request TTFT/latency/tokens-per-sec, queue depth,
+    slot occupancy, requeue counts."""
+    cfg, params, mod = _gpt2()
+    n_new = 4
+    prompts = _prompts(jax.random.key(22), 5, cfg.vocab, lens=[4, 7, 5])
+    got = serving.serve_greedy(params, cfg, prompts, n_new, n_slots=2,
+                               max_len=24, family=mod)
+    assert isinstance(got, list) and len(got) == 5   # list face intact
+    m = got.metrics
+    assert isinstance(m, serving.ServingMetrics)
+    assert m.requests == 5
+    assert m.new_tokens == sum(len(g) - len(p)
+                               for p, g in zip(prompts, got)) == 5 * n_new
+    assert m.wall_s > 0 and m.tokens_per_s > 0
+    assert m.steps > 0 and m.prefills == 5 and m.requeues == 0
+    # 5 requests into 2 slots: 3 must have queued behind the seed.
+    assert m.queue_depth_max >= 3
+    assert 0 < m.slot_occupancy_mean <= 1.0
+    assert 0 < m.ttft_p50_s <= m.ttft_p99_s
+    assert 0 < m.itl_p50_s <= m.itl_p99_s
+    assert len(m.per_request) == 5
+    for r in m.per_request:
+        assert r.new_tokens == n_new and r.retries == 0
+        assert 0 < r.ttft_s <= r.latency_s <= m.wall_s
+        assert r.tokens_per_s > 0
+
+
+def test_serving_telemetry_counts_requeues():
+    """A request whose step failed and was re-queued shows up in the
+    telemetry (requeues, per-request retries) — and the batch still
+    completes bit-equal."""
+    cfg, params, mod = _gpt2()
+    fns = serving.make_server_fns(params, cfg, mod)
+    prefill_fn, step_fn, scatter_fn = fns[0], fns[1], fns[2]
+    boom = {"n": 0}
+
+    def flaky_step(slots, tok, keys):
+        boom["n"] += 1
+        if boom["n"] == 2:
+            raise RuntimeError("injected step failure")
+        return step_fn(slots, tok, keys)
+
+    prompts = _prompts(jax.random.key(23), 3, cfg.vocab, lens=[4, 6])
+    got = serving.serve_greedy(
+        params, cfg, prompts, 4, n_slots=2, max_len=24, family=mod,
+        server_fns=(prefill_fn, flaky_step, scatter_fn) + fns[3:])
+    m = got.metrics
+    assert m.requeues >= 1
+    assert sum(r.retries for r in m.per_request) >= 1
+    for p, g in zip(prompts, got):
+        want = mod.generate(params, cfg, jnp.asarray(p)[None], 4,
+                            max_len=24)
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(want)[0])

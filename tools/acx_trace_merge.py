@@ -1,0 +1,238 @@
+#!/usr/bin/env python3
+"""Merge per-rank ACX traces into one Perfetto timeline, aggregate
+per-rank metrics into a fleet view, and validate both artifact kinds.
+
+Each rank writes its own ``<path>.rank<r>.trace.json`` (src/core/trace.cc)
+on its own steady clock with its own zero — loading two of them side by
+side in Perfetto puts rank 1's first event at t=0 even if it really fired
+mid-way through rank 0's run. This tool:
+
+  * merges the traces into one Chrome trace-event file with one process
+    (pid = rank, named "rank <r>") per input file;
+  * aligns the per-rank clocks on a common barrier: every rank leaves the
+    same MPI_Barrier at (nearly) the same wall instant, so the k-th
+    ``barrier_exit`` instant (slot -1, emitted by the MPI shim) is a shared
+    anchor. Each rank is shifted so its LAST common barrier_exit lands at
+    the max across ranks (the barrier releases when the last rank arrives);
+    the applied shift is reported as that rank's clock skew. Traces without
+    common anchors merge unaligned (skew reported as null);
+  * aggregates sibling ``*.metrics.json`` registries (src/core/metrics.cc)
+    into one fleet file: counters sum (``slot_hwm`` maxes — a watermark
+    across ranks is a max, not a sum), histogram counts/sums/buckets
+    vector-add;
+  * validates (``--validate``): traces parse, timestamps are sorted, every
+    span begin has a matching end (name+cat+id+pid, the Perfetto async-span
+    contract) and span/instant counts match ``otherData``; metrics files
+    parse, expose >= 8 counters and >= 3 histograms, and every histogram's
+    count equals the sum of its buckets.
+
+Usage:
+    python3 tools/acx_trace_merge.py [--out merged.json]
+        [--metrics-out fleet.json] [--validate]
+        run.rank0.trace.json run.rank1.trace.json
+        run.rank0.metrics.json run.rank1.metrics.json
+
+Inputs are classified by filename (``.trace.json`` / ``.metrics.json``);
+the rank is parsed from the ``.rank<r>.`` filename component (falling back
+to input order). Prints one JSON summary line; exits non-zero if any
+``--validate`` check fails.
+"""
+
+import argparse
+import json
+import re
+import sys
+
+
+def parse_rank(path, fallback):
+    m = re.search(r"\.rank(\d+)\.", path)
+    return int(m.group(1)) if m else fallback
+
+
+def load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+# ---- validation -----------------------------------------------------------
+
+def validate_trace(path, d, errors):
+    evs = d.get("traceEvents")
+    if not isinstance(evs, list):
+        errors.append(f"{path}: no traceEvents list")
+        return
+    ts = [float(e["ts"]) for e in evs if "ts" in e]
+    if ts != sorted(ts):
+        errors.append(f"{path}: timestamps not sorted")
+    open_spans = {}
+    n_inst = n_span = 0
+    for e in evs:
+        ph = e.get("ph")
+        if ph == "i":
+            n_inst += 1
+        elif ph in ("b", "e"):
+            key = (e.get("name"), e.get("cat"), e.get("id"), e.get("pid"))
+            if ph == "b":
+                open_spans[key] = open_spans.get(key, 0) + 1
+                n_span += 1
+            else:
+                if open_spans.get(key, 0) <= 0:
+                    errors.append(f"{path}: span end without begin: {key}")
+                else:
+                    open_spans[key] -= 1
+    for key, n in open_spans.items():
+        if n != 0:
+            errors.append(f"{path}: unbalanced span: {key}")
+    other = d.get("otherData", {})
+    if "dropped" not in other:
+        errors.append(f"{path}: otherData.dropped missing")
+    if other.get("events", n_inst) != n_inst:
+        errors.append(f"{path}: otherData.events={other.get('events')} "
+                      f"but {n_inst} instants")
+    if other.get("spans", n_span) != n_span:
+        errors.append(f"{path}: otherData.spans={other.get('spans')} "
+                      f"but {n_span} span begins")
+
+
+def validate_metrics(path, d, errors):
+    counters = d.get("counters")
+    hists = d.get("histograms")
+    if not isinstance(counters, dict) or len(counters) < 8:
+        errors.append(f"{path}: wants >= 8 counters, got "
+                      f"{len(counters) if isinstance(counters, dict) else 0}")
+    if not isinstance(hists, dict) or len(hists) < 3:
+        errors.append(f"{path}: wants >= 3 histograms, got "
+                      f"{len(hists) if isinstance(hists, dict) else 0}")
+        return
+    for name, h in hists.items():
+        if h.get("count", -1) != sum(h.get("buckets", [])):
+            errors.append(f"{path}: histogram {name}: count {h.get('count')}"
+                          f" != sum(buckets) {sum(h.get('buckets', []))}")
+
+
+# ---- trace merge ----------------------------------------------------------
+
+def barrier_anchors(d):
+    """Timestamps (µs) of this rank's barrier_exit instants, in order."""
+    return [float(e["ts"]) for e in d.get("traceEvents", [])
+            if e.get("ph") == "i" and e.get("name") == "barrier_exit"]
+
+
+def merge_traces(traces):
+    """traces: list of (rank, dict). Returns (merged_dict, skew_us)."""
+    anchors = {r: barrier_anchors(d) for r, d in traces}
+    n_common = min((len(a) for a in anchors.values()), default=0)
+    skew = {}
+    if n_common > 0 and len(traces) > 1:
+        # Anchor on the LAST common barrier (k = n_common-1): late in the
+        # run both clocks have drifted as far as they will, and a barrier
+        # releases only when the last rank arrives — its exit is the
+        # tightest shared instant available.
+        k = n_common - 1
+        target = max(a[k] for a in anchors.values())
+        for r, _ in traces:
+            skew[r] = target - anchors[r][k]
+    else:
+        skew = {r: None for r, _ in traces}
+
+    events = []
+    for r, d in traces:
+        shift = skew[r] or 0.0
+        events.append({"name": "process_name", "ph": "M", "pid": r,
+                       "args": {"name": f"rank {r}"}})
+        for e in d.get("traceEvents", []):
+            e = dict(e)
+            e["pid"] = r
+            if "ts" in e:
+                e["ts"] = float(e["ts"]) + shift
+            events.append(e)
+    # Metadata events carry no ts; sort them first, then by time.
+    events.sort(key=lambda e: (0, 0) if "ts" not in e else (1, e["ts"]))
+    return ({"traceEvents": events, "displayTimeUnit": "ms",
+             "otherData": {"ranks": sorted(r for r, _ in traces),
+                           "skew_us": {str(r): skew[r] for r in skew}}},
+            skew)
+
+
+# ---- metrics aggregation --------------------------------------------------
+
+# Watermarks: a per-rank max aggregates across ranks as a max.
+MAX_COUNTERS = {"slot_hwm"}
+
+
+def merge_metrics(metrics):
+    """metrics: list of (rank, dict). Sums counters (maxing watermarks)
+    and vector-adds histograms into one fleet registry."""
+    counters = {}
+    hists = {}
+    for _, d in metrics:
+        for k, v in d.get("counters", {}).items():
+            if k in MAX_COUNTERS:
+                counters[k] = max(counters.get(k, 0), v)
+            else:
+                counters[k] = counters.get(k, 0) + v
+        for name, h in d.get("histograms", {}).items():
+            agg = hists.setdefault(name, {"unit": h.get("unit", "ns"),
+                                          "count": 0, "sum": 0,
+                                          "buckets": [0] * len(h["buckets"])})
+            agg["count"] += h["count"]
+            agg["sum"] += h["sum"]
+            for i, b in enumerate(h["buckets"]):
+                agg["buckets"][i] += b
+    return {"ranks": sorted(r for r, _ in metrics),
+            "counters": counters, "histograms": hists}
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="merge/aggregate/validate per-rank ACX observability "
+                    "artifacts")
+    ap.add_argument("inputs", nargs="+",
+                    help="*.trace.json and/or *.metrics.json files")
+    ap.add_argument("--out", help="write the merged Perfetto trace here")
+    ap.add_argument("--metrics-out", help="write the fleet metrics here")
+    ap.add_argument("--validate", action="store_true",
+                    help="check artifact invariants; exit 1 on failure")
+    args = ap.parse_args()
+
+    traces, metrics, errors = [], [], []
+    for i, path in enumerate(args.inputs):
+        try:
+            d = load(path)
+        except (OSError, json.JSONDecodeError) as exc:
+            errors.append(f"{path}: {exc}")
+            continue
+        if path.endswith(".metrics.json") or "histograms" in d:
+            metrics.append((parse_rank(path, i), d))
+            if args.validate:
+                validate_metrics(path, d, errors)
+        else:
+            traces.append((parse_rank(path, i), d))
+            if args.validate:
+                validate_trace(path, d, errors)
+
+    summary = {"traces": len(traces), "metrics": len(metrics)}
+    if traces and args.out:
+        merged, skew = merge_traces(traces)
+        with open(args.out, "w") as f:
+            json.dump(merged, f)
+        summary["out"] = args.out
+        summary["events"] = len(merged["traceEvents"])
+        summary["skew_us"] = {str(r): skew[r] for r in skew}
+    if metrics and args.metrics_out:
+        fleet = merge_metrics(metrics)
+        with open(args.metrics_out, "w") as f:
+            json.dump(fleet, f, indent=1)
+        summary["metrics_out"] = args.metrics_out
+    if args.validate:
+        summary["errors"] = errors
+        summary["valid"] = not errors
+    print(json.dumps(summary))
+    if errors:
+        for e in errors:
+            print(f"acx_trace_merge: {e}", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -18,12 +18,20 @@ itself costs ~0.1-0.2 us per event (mutexed ns clock), so the traced
 totals read above the untraced bench_pingpong p50 — compare SHAPES,
 not absolutes, across runs.
 
+When the metrics plane is available the budget is read straight from the
+native histogram registry (ACX_METRICS, src/core/metrics.cc): per-segment
+p50/p90 derived from the power-of-two latency buckets, with no tracing
+mutex on the hot path ("source": "metrics"). The trace-stitched
+send/recv breakdown below rides along either way; if the metrics file is
+missing the stitching is the only source ("source": "trace").
+
 Usage: python tools/latency_budget.py [--msg-bytes N]  (builds if needed)
 Prints one JSON line with per-segment p50/p90 in microseconds.
 """
 
 import argparse
 import json
+import math
 import os
 import statistics
 import subprocess
@@ -31,6 +39,20 @@ import sys
 import tempfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def hist_pct_us(hist, p):
+    """Nearest-rank percentile from a power-of-two bucket histogram
+    (bucket 0 = exactly 0 ns, bucket i = [2^(i-1), 2^i) ns), reported at
+    the bucket midpoint in µs."""
+    target = max(1, math.ceil(p * hist["count"]))
+    cum = 0
+    for i, n in enumerate(hist["buckets"]):
+        cum += n
+        if cum >= target:
+            ns = 0 if i == 0 else (2 ** (i - 1) + 2 ** i) / 2
+            return round(ns / 1000.0, 3)
+    return 0.0
 
 
 def main():
@@ -44,6 +66,7 @@ def main():
         env = dict(os.environ)
         env["ACX_TRACE"] = os.path.join(td, "lb")
         env["ACX_TRACE_CAP"] = "2000000"
+        env["ACX_METRICS"] = os.path.join(td, "lb")
         r = subprocess.run(
             [os.path.join(REPO, "build", "acxrun"), "-np", "2",
              "-timeout", "300",
@@ -56,6 +79,10 @@ def main():
                            if l.startswith("BENCH")), "")
         d = json.loads(
             open(os.path.join(td, "lb.rank0.trace.json")).read())
+        hists = None
+        mpath = os.path.join(td, "lb.rank0.metrics.json")
+        if os.path.exists(mpath):
+            hists = json.loads(open(mpath).read()).get("histograms")
 
     # Stitch per-op lifecycles: events for one op share a slot (tid) and
     # the slot is reused only after slot_reclaimed, so one pass with a
@@ -96,6 +123,22 @@ def main():
                 "p90_us": round(v[int(0.9 * len(v))], 3)}
 
     out = {"bench_line": bench_line}
+    # Histogram-derived budget (no trace mutex in these numbers): the
+    # registry's segments pool send+recv ops, so this is the fleet-wide
+    # shape; the stitched send/recv breakdown below separates the kinds.
+    if hists:
+        out["source"] = "metrics"
+        for seg in ("trigger_to_issue_ns", "issue_to_complete_ns",
+                    "complete_to_wait_ns"):
+            h = hists.get(seg)
+            if h and h["count"] > 0:
+                out[f"hist:{seg[:-3]}"] = {
+                    "n": h["count"],
+                    "p50_us": hist_pct_us(h, 0.50),
+                    "p90_us": hist_pct_us(h, 0.90),
+                }
+    else:
+        out["source"] = "trace"
     for kind, seg in KINDS.items():
         kops = ops[kind][20:] or ops[kind]   # drop cold-start
         out[f"n_{kind}"] = len(kops)
