@@ -122,8 +122,41 @@ check: ctest itest tools
 	@$(BUILD)/acxrun -np 2 -fault drop:rank=0:kind=send:nth=1 $(BUILD)/itests/ring || exit 1
 	@echo "== acxrun -np 2 ring (fault: 5ms delay on rank 1's first recv)"
 	@$(BUILD)/acxrun -np 2 -fault delay:rank=1:kind=recv:nth=1:us=5000 $(BUILD)/itests/ring || exit 1
+	@$(MAKE) --no-print-directory chaos-check || exit 1
 	@$(MAKE) --no-print-directory metrics-check || exit 1
 	@echo "ALL NATIVE TESTS PASSED"
+
+# --- survivable links end-to-end (DESIGN.md §9) ---
+# chaos-ring under every wire-level fault on the socket plane (the only
+# plane with reconnectable links), drain-on-death with a mid-flight rank
+# kill, and a metrics-instrumented chaos leg validated by the merge tool.
+.PHONY: chaos-check
+chaos-check: itest tools
+	@echo "== chaos-check: drop_frame (sequence gap -> NAK re-pull)"
+	@$(BUILD)/acxrun -np 2 -transport socket \
+	  -fault drop_frame:rank=0:nth=3:count=2 $(BUILD)/itests/chaos-ring || exit 1
+	@echo "== chaos-check: corrupt_frame (CRC reject -> NAK -> replay)"
+	@$(BUILD)/acxrun -np 2 -transport socket \
+	  -fault corrupt_frame:rank=1:nth=4:count=3 $(BUILD)/itests/chaos-ring || exit 1
+	@echo "== chaos-check: stall_link_ms (frozen send side, no loss)"
+	@$(BUILD)/acxrun -np 2 -transport socket \
+	  -fault stall_link_ms:rank=0:nth=5:ms=40 $(BUILD)/itests/chaos-ring || exit 1
+	@echo "== chaos-check: close_link_once (epoch-bumped reconnect + replay)"
+	@$(BUILD)/acxrun -np 2 -transport socket \
+	  -fault close_link_once:rank=0:nth=6 $(BUILD)/itests/chaos-ring || exit 1
+	@echo "== chaos-check: drain-on-death (survivors drain and exit 0)"
+	@$(BUILD)/acxrun -np 3 $(BUILD)/itests/drain-on-death || exit 1
+	@rm -rf $(BUILD)/chaos-metrics && mkdir -p $(BUILD)/chaos-metrics
+	@echo "== chaos-check: corrupt_frame with ACX_METRICS + ACX_TRACE"
+	@ACX_METRICS=$(BUILD)/chaos-metrics/run ACX_TRACE=$(BUILD)/chaos-metrics/run \
+	  $(BUILD)/acxrun -np 2 -transport socket \
+	  -fault corrupt_frame:rank=0:nth=2 $(BUILD)/itests/chaos-ring || exit 1
+	@python3 tools/acx_trace_merge.py --validate \
+	  --out $(BUILD)/chaos-metrics/merged.trace.json \
+	  --metrics-out $(BUILD)/chaos-metrics/fleet.metrics.json \
+	  $(BUILD)/chaos-metrics/run.rank*.trace.json \
+	  $(BUILD)/chaos-metrics/run.rank*.metrics.json || exit 1
+	@echo "CHAOS CHECK PASSED"
 
 # --- metrics plane end-to-end ---
 # 2-rank ping-pong with metrics + tracing on, then validate every artifact

@@ -74,6 +74,23 @@ void test_parse_spec() {
   CHECK(fault::ParseSpec("none", &c));
   CHECK(c.action == fault::Action::kNone);
 
+  // Wire-level actions (docs/DESIGN.md §9 chaos machinery).
+  CHECK(fault::ParseSpec("drop_frame:rank=0:nth=3:count=2", &c));
+  CHECK(c.action == fault::Action::kDropFrame);
+  CHECK(c.rank == 0 && c.nth == 3 && c.count == 2);
+
+  CHECK(fault::ParseSpec("corrupt_frame:peer=1:nth=4", &c));
+  CHECK(c.action == fault::Action::kCorruptFrame);
+  CHECK(c.peer == 1 && c.nth == 4 && c.count == 1);
+
+  CHECK(fault::ParseSpec("stall_link_ms:ms=40:nth=5", &c));
+  CHECK(c.action == fault::Action::kStallLink);
+  CHECK(c.stall_ms == 40 && c.nth == 5);
+
+  CHECK(fault::ParseSpec("close_link_once:rank=1:nth=6", &c));
+  CHECK(c.action == fault::Action::kCloseLink);
+  CHECK(c.rank == 1 && c.nth == 6);
+
   // Malformed specs must be rejected, not half-parsed.
   CHECK(!fault::ParseSpec("", &c));
   CHECK(!fault::ParseSpec(nullptr, &c));
@@ -83,7 +100,33 @@ void test_parse_spec() {
   CHECK(!fault::ParseSpec("drop:kind=sideways", &c));
   CHECK(!fault::ParseSpec("drop:nth=0", &c));
   CHECK(!fault::ParseSpec("drop:count=0", &c));
+  CHECK(!fault::ParseSpec("stall_link_ms:ms=0", &c));
   std::printf("parse_spec: OK\n");
+}
+
+void test_on_frame_window() {
+  // Frame and issue consults are disjoint: an armed wire action never
+  // fires at OnIssue, and OnFrame filters by rank/peer before consuming
+  // its window.
+  fault::Config c;
+  CHECK(fault::ParseSpec("drop_frame:rank=0:peer=1:nth=2:count=1", &c));
+  fault::Configure(c);
+  uint64_t us = 0;
+  int err = 0;
+  CHECK(fault::OnIssue(0, true, 1, &us, &err) == fault::Action::kNone);
+  CHECK(fault::OnFrame(1, 1, &us) == fault::Action::kNone);  // wrong rank
+  CHECK(fault::OnFrame(0, 0, &us) == fault::Action::kNone);  // wrong peer
+  CHECK(fault::OnFrame(0, 1, &us) == fault::Action::kNone);  // match 1
+  CHECK(fault::OnFrame(0, 1, &us) == fault::Action::kDropFrame);  // match 2
+  CHECK(fault::OnFrame(0, 1, &us) == fault::Action::kNone);  // window spent
+
+  CHECK(fault::ParseSpec("stall_link_ms:ms=7:nth=1", &c));
+  fault::Configure(c);
+  us = 0;
+  CHECK(fault::OnFrame(0, 1, &us) == fault::Action::kStallLink);
+  CHECK(us == 7000);  // ms -> us for the transport's stall gate
+  RestorePolicy();
+  std::printf("on_frame_window: OK\n");
 }
 
 void test_on_issue_window() {
@@ -293,6 +336,7 @@ void test_deadline_api() {
 int main() {
   test_parse_spec();
   test_on_issue_window();
+  test_on_frame_window();
   test_drop_retry_success();
   test_injected_fail();
   test_injected_delay();

@@ -14,15 +14,28 @@
 //   * fail  — the op completes immediately with an error status (default
 //             kErrInjected) — the permanent-failure path.
 //
+// Wire-level actions (consulted by the stream transport's OnFrame, not the
+// proxy's OnIssue — they hit sequenced frames about to enter the wire, so
+// they exercise the CRC/NAK/replay/reconnect machinery of DESIGN.md §9):
+//   * drop_frame      — swallow the frame after recording it for replay;
+//                       the receiver's sequence gap triggers a NAK re-pull.
+//   * corrupt_frame   — flip bits in the payload-CRC field on the wire;
+//                       the receiver rejects the frame and NAKs.
+//   * stall_link_ms   — freeze the link's send side for `ms` milliseconds.
+//   * close_link_once — hard-close the link fd; the transport must run the
+//                       epoch-bumped reconnect ladder and replay.
+//
 // Spec grammar: action[:key=value]...
 //   rank=R   inject only on rank R               (default: every rank)
-//   kind=K   send | recv | any                   (default: any)
-//   peer=P   only ops to/from peer P             (default: any)
-//   nth=N    first matching issue attempt hit, 1-based   (default 1)
-//   count=C  how many consecutive matches are hit        (default 1)
-//   us=U     delay microseconds (delay action)           (default 1000)
+//   kind=K   send | recv | any (issue actions)   (default: any)
+//   peer=P   only ops/frames to/from peer P      (default: any)
+//   nth=N    first matching attempt/frame hit, 1-based    (default 1)
+//   count=C  how many consecutive matches are hit         (default 1)
+//   us=U     delay microseconds (delay action)            (default 1000)
+//   ms=M     stall milliseconds (stall_link_ms action)    (default 10)
 //   err=E    status error code (fail action)     (default kErrInjected)
-// Example: ACX_FAULT=drop:rank=0:kind=send:nth=1
+// Examples: ACX_FAULT=drop:rank=0:kind=send:nth=1
+//           ACX_FAULT=corrupt_frame:rank=1:nth=4:count=3
 #pragma once
 
 #include <atomic>
@@ -42,7 +55,19 @@ inline uint64_t NowNs() {
 
 namespace fault {
 
-enum class Action : int32_t { kNone = 0, kDrop = 1, kDelay = 2, kFail = 3 };
+enum class Action : int32_t {
+  kNone = 0,
+  // Issue-level (proxy OnIssue):
+  kDrop = 1,
+  kDelay = 2,
+  kFail = 3,
+  // Wire-level (transport OnFrame); everything >= kDropFrame is a frame
+  // action and is invisible to OnIssue, and vice versa.
+  kDropFrame = 4,
+  kCorruptFrame = 5,
+  kStallLink = 6,
+  kCloseLink = 7,
+};
 
 struct Config {
   Action action = Action::kNone;
@@ -52,6 +77,7 @@ struct Config {
   int nth = 1;     // 1-based index of the first matching attempt hit
   int count = 1;   // how many consecutive matches are hit
   uint64_t delay_us = 1000;
+  uint64_t stall_ms = 10;  // stall_link_ms duration
   int err = 0;     // 0 = kErrInjected
 };
 
@@ -74,10 +100,20 @@ void Configure(const Config& cfg);
 Action OnIssue(int rank, bool is_send, int peer, uint64_t* delay_us,
                int* err);
 
+// Consult the plane for one sequenced frame about to be written to peer's
+// link. Only frame actions (kDropFrame..kCloseLink) ever fire here; issue
+// actions return kNone without consuming a match. kStallLink fills
+// *stall_us with the stall duration in microseconds.
+Action OnFrame(int rank, int peer, uint64_t* stall_us);
+
 struct Stats {
   uint64_t drops = 0;
   uint64_t delays = 0;
   uint64_t fails = 0;
+  uint64_t frame_drops = 0;
+  uint64_t frame_corrupts = 0;
+  uint64_t link_stalls = 0;
+  uint64_t link_closes = 0;
 };
 Stats stats();
 
@@ -86,11 +122,15 @@ Stats stats();
 // Process-wide retry/deadline policy for enqueued ops. Env-seeded at first
 // use (ACX_OP_TIMEOUT_MS: per-op deadline, 0 = none; ACX_RETRY_BACKOFF_US:
 // initial re-post backoff; ACX_MAX_RETRIES: re-post budget for an op whose
-// issue was lost), mutable at runtime through MPIX_Set_deadline.
+// issue was lost; ACX_RECONNECT_MAX / ACX_RECONNECT_BACKOFF_MS: the stream
+// transport's link-reconnect ladder), mutable at runtime through
+// MPIX_Set_deadline.
 struct RetryPolicy {
   std::atomic<uint64_t> timeout_ns{0};
   std::atomic<uint64_t> backoff_us{200};
   std::atomic<uint32_t> max_retries{8};
+  std::atomic<uint32_t> reconnect_max{5};
+  std::atomic<uint64_t> reconnect_backoff_ms{50};
 };
 RetryPolicy& Policy();
 

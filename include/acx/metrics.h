@@ -47,6 +47,11 @@ enum Counter : int {
   kSlotsReclaimed,
   kProxyBusyNs,        // proxy thread: time inside Sweep
   kProxyIdleNs,        // proxy thread: time parked / sleeping
+  kReconnects,         // links re-established after an outage (§9)
+  kFramesReplayed,     // frames resent from the replay buffer
+  kCrcRejects,         // payload CRC mismatches detected on receive
+  kNaksSent,           // re-pull requests sent (gap / CRC / tail loss)
+  kDrainedSlots,       // in-flight ops cancelled by MPIX_Drain
   kNumCounters
 };
 

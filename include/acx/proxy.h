@@ -42,6 +42,12 @@ class Proxy {
   // this so the waiter completes its own op without a thread handoff.
   bool TryProgress();
 
+  // Drain support (MPIX_Drain, DESIGN.md §9): complete every op still in
+  // flight (PENDING / ISSUED / RECOVERING) with a typed error — kErrPeerDead
+  // when its peer is unhealthy, kErrTimeout otherwise. Returns the number of
+  // ops cancelled. Runs as its own exclusive sweep.
+  int CancelInflight();
+
   // Stats (observability the reference lacks). Counters are plain atomics so
   // the hot sweep loop never takes a lock.
   struct Stats {

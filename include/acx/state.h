@@ -46,6 +46,10 @@ enum Flag : int32_t {
   kIssued = 3,
   kCompleted = 4,
   kCleanup = 5,
+  // ISSUED op parked while the transport reconnects its peer's link
+  // (DESIGN.md §9). Returns to ISSUED when the link heals, or COMPLETED
+  // with a typed error when recovery is exhausted.
+  kRecovering = 6,
 };
 
 const char* FlagName(int32_t f);
@@ -111,6 +115,8 @@ struct Op {
   uint64_t not_before_ns = 0;  // injected-delay gate on a PENDING op
   uint32_t attempts = 0;       // issue attempts (incl. dropped ones)
   uint32_t backoff_us = 0;     // current backoff step (doubles per retry)
+  uint64_t parked_at_ns = 0;   // when the op entered RECOVERING (deadline
+                               // credit: parked time doesn't count)
 
   void Reset() { *this = Op{}; }
 };

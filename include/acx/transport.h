@@ -53,7 +53,17 @@ struct NetStats {
   uint64_t hb_recv = 0;
   uint64_t peers_dead = 0;
   uint64_t failed_ops = 0;  // in-flight ops failed by dead-peer teardown
+  // Survivable-link recovery (DESIGN.md §9); zero on transports without it.
+  uint64_t reconnects = 0;       // successful epoch-bumped reconnects
+  uint64_t replayed_frames = 0;  // frames re-sent from the replay buffer
+  uint64_t crc_rejects = 0;      // frames dropped on payload CRC mismatch
+  uint64_t naks_sent = 0;        // re-pull requests sent to peers
+  uint64_t links_recovering = 0; // links currently in the reconnect ladder
 };
+
+// Per-peer link health, surfaced so the proxy can park in-flight ops while
+// the transport runs its reconnect ladder instead of failing them.
+enum class PeerHealth { kHealthy = 0, kRecovering = 1, kDead = 2 };
 
 class Transport {
  public:
@@ -88,6 +98,11 @@ class Transport {
   // idle branches; transports without background work ignore it.
   virtual void Tick() {}
   virtual NetStats net_stats() const { return NetStats{}; }
+
+  // Link health for peer `rank`. Transports without a failure model are
+  // always healthy. Must be cheap when nothing is recovering — the proxy
+  // consults it for every op that has not completed yet.
+  virtual PeerHealth peer_health(int /*rank*/) { return PeerHealth::kHealthy; }
 };
 
 }  // namespace acx

@@ -97,13 +97,22 @@ int MPIX_Set_deadline(double timeout_ms);
 int MPIX_Get_deadline(double *timeout_ms);
 
 /* Nonblocking introspection of a request: *state is the acx flag value
- * (0 AVAILABLE .. 5 CLEANUP), *error the op's status code once COMPLETED
- * (0 before), *attempts the issue-attempt count (retries show up here).
- * For partitioned requests: min state, first error, max attempts across
+ * (0 AVAILABLE .. 6 RECOVERING; 6 = parked while the peer's link
+ * reconnects), *error the op's status code once COMPLETED (0 before),
+ * *attempts the issue-attempt count (retries show up here). For
+ * partitioned requests: min state, first error, max attempts across
  * partitions. Any out-pointer may be NULL. Returns nonzero on a bad
  * handle. */
 int MPIX_Op_status(MPIX_Request request, int *state, int *error,
                    int *attempts);
+
+/* Graceful drain (docs/DESIGN.md "Survivable links"): wait up to timeout_ms
+ * for every in-flight op — including ops parked on a reconnecting link —
+ * then cancel the stragglers with MPIX_ERR_PEER_DEAD (peer unhealthy) or
+ * MPIX_ERR_TIMEOUT. Returns the number of ops cancelled (0 = clean drain),
+ * or -1 before MPIX_Init. Survivors of a peer loss call this to unblock
+ * every waiter in bounded time and keep running. */
+int MPIX_Drain(double timeout_ms);
 
 #ifdef __cplusplus
 }

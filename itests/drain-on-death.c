@@ -1,0 +1,124 @@
+/* tpu-acx integration test: survivors drain and exit cleanly after a rank
+ * dies mid-flight.
+ *
+ * The victim (highest rank) exits without a word while the survivors have
+ * a recv posted against it — and no failure detector is armed to save
+ * them: heartbeats are off (on the shm plane the victim is simply never
+ * declared dead) and the reconnect ladder is pinned long (on the socket
+ * plane the EOF parks the op in RECOVERING for ~10s of dial attempts).
+ * MPIX_Drain is therefore the ONLY mechanism that can unblock the waiter:
+ * it cancels the in-flight op with a typed error (TIMEOUT while the peer
+ * still looks healthy, PEER_DEAD while its link is recovering), the
+ * drained-slot counter ticks, healthy traffic among survivors is
+ * untouched, and every survivor exits 0 — the reference wedges forever in
+ * this scenario. Survivors _exit after MPIX_Finalize instead of running
+ * MPI_Finalize's barrier: the victim is deliberately never declared dead,
+ * so a barrier against it would block on the ladder, not on the drain
+ * under test. Run under `acxrun -np N` (N >= 3 keeps a live neighbor pair
+ * to prove the survivors still talk). */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <unistd.h>
+
+#include <mpi.h>
+#include <mpi-acx.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+void acx_recovery_stats(uint64_t *out);
+int acx_drain(double timeout_ms);
+#ifdef __cplusplus
+}
+#endif
+
+int main(int argc, char **argv) {
+    /* Pin the reconnect ladder well past the test window so a socket-plane
+     * EOF keeps the op parked in RECOVERING until the drain cancels it
+     * (500+1000+2000+2000+... ms of backoff before the peer could be
+     * declared dead). Must be set before the transport exists. */
+    setenv("ACX_RECONNECT_MAX", "8", 1);
+    setenv("ACX_RECONNECT_BACKOFF_MS", "500", 1);
+
+    int provided, rank, size, errs = 0;
+    MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided);
+    if (provided < MPI_THREAD_MULTIPLE) MPI_Abort(MPI_COMM_WORLD, 1);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    if (size < 2) {
+        printf("drain-on-death: needs >= 2 ranks\n");
+        MPI_Abort(MPI_COMM_WORLD, 1);
+    }
+
+    if (MPIX_Init()) MPI_Abort(MPI_COMM_WORLD, 2);
+
+    const int victim = size - 1;
+    if (rank == victim) {
+        usleep(100 * 1000); /* let survivors post against us first */
+        _exit(0);           /* die mid-flight: no finalize, no goodbye */
+    }
+
+    cudaStream_t stream = 0;
+    MPI_Status st;
+
+    /* A recv from the victim that can never complete. */
+    int dead_v = -1;
+    MPIX_Request dead_req;
+    MPIX_Irecv_enqueue(&dead_v, 1, MPI_INT, victim, 7, MPI_COMM_WORLD,
+                       &dead_req, MPIX_QUEUE_XLA_STREAM, &stream);
+
+    /* A live neighbor exchange among survivors: draining the dead op must
+     * not break healthy traffic. */
+    int nsurv = size - 1, sv = rank * 13 + 1, rv = -1;
+    MPIX_Request live_req[2];
+    if (nsurv >= 2) {
+        const int right = (rank + 1) % nsurv;
+        const int left = (rank + nsurv - 1) % nsurv;
+        MPIX_Isend_enqueue(&sv, 1, MPI_INT, right, 9, MPI_COMM_WORLD,
+                           &live_req[0], MPIX_QUEUE_XLA_STREAM, &stream);
+        MPIX_Irecv_enqueue(&rv, 1, MPI_INT, left, 9, MPI_COMM_WORLD,
+                           &live_req[1], MPIX_QUEUE_XLA_STREAM, &stream);
+        MPIX_Wait(&live_req[0], MPI_STATUS_IGNORE);
+        MPIX_Wait(&live_req[1], &st);
+        if (st.MPI_ERROR != MPI_SUCCESS || rv != left * 13 + 1) {
+            printf("[%d] live exchange broken (err %d, got %d)\n", rank,
+                   st.MPI_ERROR, rv);
+            errs++;
+        }
+    }
+
+    /* Give the victim time to actually die, then drain. The dead recv
+     * must be cancelled (>= 1); a clean 0 would mean it "completed". */
+    usleep(200 * 1000);
+    const int drained = MPIX_Drain(400);
+    if (drained < 1) {
+        printf("[%d] MPIX_Drain cancelled %d ops, want >= 1\n", rank,
+               drained);
+        errs++;
+    }
+
+    /* The cancelled request's waiter unblocks immediately with the typed
+     * error the drain stamped. */
+    MPIX_Wait(&dead_req, &st);
+    if (st.MPI_ERROR != MPIX_ERR_PEER_DEAD &&
+        st.MPI_ERROR != MPIX_ERR_TIMEOUT) {
+        printf("[%d] drained recv status %d, want PEER_DEAD/TIMEOUT\n",
+               rank, st.MPI_ERROR);
+        errs++;
+    }
+
+    uint64_t rs[6];
+    acx_recovery_stats(rs);
+    if (rs[4] < 1) {
+        printf("[%d] drained_slots %llu, want >= 1\n", rank,
+               (unsigned long long)rs[4]);
+        errs++;
+    }
+
+    MPIX_Finalize(); /* local teardown only — no barrier with the dead */
+    if (rank == 0 && errs == 0) printf("drain-on-death: OK\n");
+    fflush(stdout);
+    fflush(stderr);
+    _exit(errs != 0); /* skip MPI_Finalize's barrier: see header comment */
+}

@@ -72,6 +72,8 @@ class ServingMetrics:
     steps: int = 0                # decode step_fn dispatches
     prefills: int = 0             # successful refills
     requeues: int = 0             # failure-path restarts
+    peer_requeues: int = 0        # requeues from peer loss (uncharged)
+    slots_shed: int = 0           # slots retired to match lost capacity
     ttft_p50_s: float = 0.0
     ttft_p99_s: float = 0.0
     itl_p50_s: float = 0.0        # inter-token latency (per decoded token)
@@ -91,6 +93,25 @@ class ServedBatch(list):
     def __init__(self, outputs, metrics: ServingMetrics):
         super().__init__(outputs)
         self.metrics = metrics
+
+
+def _peer_dead(exc: BaseException) -> bool:
+    """True iff ``exc`` is peer-loss shaped: the runtime's typed
+    AcxPeerDeadError, anything carrying ``error == ERR_PEER_DEAD``
+    (a multi-host collective that failed on a dead rank), or an error
+    message naming the condition. Peer loss is an infrastructure event,
+    not the request's fault — the scheduler requeues its victims without
+    charging their retry budget (docs/DESIGN.md "Survivable links")."""
+    try:
+        from mpi_acx_tpu.runtime import ERR_PEER_DEAD, AcxPeerDeadError
+    except Exception:  # pragma: no cover — runtime layer unavailable
+        AcxPeerDeadError, ERR_PEER_DEAD = (), 20
+    if isinstance(exc, AcxPeerDeadError):
+        return True
+    if getattr(exc, "error", None) == ERR_PEER_DEAD:
+        return True
+    msg = str(exc).lower()
+    return "peer dead" in msg or "peer_dead" in msg
 
 
 def _bucket(n: int, lo: int = 8) -> int:
@@ -253,7 +274,9 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
     slots["pos"] = jnp.zeros((n_slots,), jnp.int32)
 
     queue = deque(enumerate(np.asarray(p, np.int32) for p in prompts))
-    owner = [-1] * n_slots              # request id per slot (-1 idle)
+    # Request id per slot; -1 = idle, -2 = shed (capacity retired after a
+    # peer loss — never refilled, skipped by every owner[b] >= 0 loop).
+    owner = [-1] * n_slots
     emitted: List[List[int]] = [[] for _ in prompts]
     done: List[Optional[np.ndarray]] = [None] * len(prompts)
     last_tok = np.zeros((n_slots,), np.int32)
@@ -278,21 +301,43 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
     n_steps = 0
     n_prefills = 0
     n_requeues = 0
+    n_peer_requeues = 0
+    n_shed = 0
 
-    def _requeue(rid, prompt, exc):
+    def _requeue(rid, prompt, exc, charge=True):
         """Put a failed request back on the queue for a bit-equal
         restart (emitted tokens discarded; refill replays the same
-        greedy/per-rid-key path), or re-raise past the retry budget."""
-        nonlocal n_requeues
-        attempts[rid] += 1
-        if attempts[rid] > max_request_retries:
-            raise RuntimeError(
-                f"request {rid} failed {attempts[rid]} time(s), past "
-                f"max_request_retries={max_request_retries}") from exc
+        greedy/per-rid-key path), or re-raise past the retry budget.
+        ``charge=False`` (peer loss) requeues without spending the
+        request's retry budget: losing a rank is not the request's
+        fault, and a long recovery must not burn victims out of the
+        server."""
+        nonlocal n_requeues, n_peer_requeues
+        if charge:
+            attempts[rid] += 1
+            if attempts[rid] > max_request_retries:
+                raise RuntimeError(
+                    f"request {rid} failed {attempts[rid]} time(s), past "
+                    f"max_request_retries={max_request_retries}") from exc
+        else:
+            n_peer_requeues += 1
         emitted[rid] = []
         ttft[rid] = None   # the replayed attempt re-earns its first token
         n_requeues += 1
         queue.append((rid, prompt))
+
+    def _shed_slot():
+        """Retire one idle slot for good (owner -2): a lost rank shrank
+        the job's capacity, so the batch shrinks with it instead of
+        hammering the survivors at the old width. Always keeps at least
+        one slot alive — a server with zero slots is just an outage."""
+        nonlocal n_shed
+        alive = [b for b in range(n_slots) if owner[b] != -2]
+        idle = [b for b in alive if owner[b] == -1]
+        if len(alive) <= 1 or not idle:
+            return
+        owner[max(idle)] = -2
+        n_shed += 1
 
     def refill(b):
         """Returns True iff slot b now owns a request; a failed prefill
@@ -319,7 +364,7 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
                 keys = keys.at[b].set(rkey)
             slots = scatter_fn(slots, one, b, S)
         except Exception as exc:  # noqa: BLE001 — any device failure
-            _requeue(rid, prompt, exc)
+            _requeue(rid, prompt, exc, charge=not _peer_dead(exc))
             return False
         owner[b] = rid
         emitted[rid].append(first)
@@ -350,7 +395,7 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
     # Seed the slots, retiring 1-token requests on the spot so a slot
     # never enters the decode loop already finished.
     qd_samples.append(len(queue))
-    while queue and any(o < 0 for o in owner):
+    while queue and any(o == -1 for o in owner):
         b = owner.index(-1)
         if refill(b) and slot_finished(b):
             retire(b)
@@ -361,7 +406,7 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
         if not any(o >= 0 for o in owner):
             # All slots idle with requests still queued: only reachable
             # after a failure re-queued them — reseed and keep serving.
-            while queue and any(o < 0 for o in owner):
+            while queue and any(o == -1 for o in owner):
                 b = owner.index(-1)
                 if refill(b) and slot_finished(b):
                     retire(b)
@@ -374,12 +419,19 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
             # its buffers cannot be trusted. Re-queue every active
             # request (bit-equal restart, bounded per request by
             # max_request_retries), rebuild the cache, and continue —
-            # the queued-but-unstarted requests are unaffected.
+            # the queued-but-unstarted requests are unaffected. A
+            # peer-loss failure additionally sheds a slot (the job's
+            # capacity shrank with the lost rank) and does NOT charge
+            # the victims' retry budget.
+            lost_peer = _peer_dead(exc)
             for b in range(n_slots):
                 if owner[b] >= 0:
                     rid = owner[b]
                     owner[b] = -1
-                    _requeue(rid, np.asarray(prompts[rid], np.int32), exc)
+                    _requeue(rid, np.asarray(prompts[rid], np.int32), exc,
+                             charge=not lost_peer)
+            if lost_peer:
+                _shed_slot()
             slots = family.init_kv_cache(cfg, n_slots, max_len,
                                          kv_int8=kv_int8)
             slots["pos"] = jnp.zeros((n_slots,), jnp.int32)
@@ -435,6 +487,8 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
         steps=n_steps,
         prefills=n_prefills,
         requeues=n_requeues,
+        peer_requeues=n_peer_requeues,
+        slots_shed=n_shed,
         ttft_p50_s=_pct([r.ttft_s for r in per_request], 0.50),
         ttft_p99_s=_pct([r.ttft_s for r in per_request], 0.99),
         itl_p50_s=_pct(itl_samples, 0.50),

@@ -96,6 +96,35 @@ def initialize(coordinator_address: Optional[str] = None,
     _initialized = True
 
 
+def recovery_budget_s(max_attempts: Optional[int] = None,
+                      backoff_ms: Optional[float] = None,
+                      cap_ms: float = 2000.0,
+                      margin_s: float = 1.0) -> float:
+    """Worst-case seconds a lost link may spend in RECOVERING before the
+    native transport gives up and declares the peer dead (docs/DESIGN.md
+    "Survivable links"). Computed from the same knobs the transport reads
+    — ACX_RECONNECT_MAX dial attempts with exponential backoff starting
+    at ACX_RECONNECT_BACKOFF_MS, each wait capped at ``cap_ms`` — plus a
+    fixed ``margin_s`` for the handshake itself.
+
+    This is the number multi-host callers size their patience with: a
+    coordinator waiting on a wedged worker (or a serving loop deciding
+    when a requeued batch is definitely not coming back) should wait at
+    least this long before treating recovery as failed — any shorter and
+    it races the transport's own verdict; much longer only delays the
+    inevitable."""
+    if max_attempts is None:
+        max_attempts = int(os.environ.get("ACX_RECONNECT_MAX", "5"))
+    if backoff_ms is None:
+        backoff_ms = float(os.environ.get("ACX_RECONNECT_BACKOFF_MS", "50"))
+    total_ms = 0.0
+    for attempt in range(1, max(0, max_attempts)):
+        # Mirror of the native DialBackoffMs ladder: the wait AFTER
+        # attempt k is backoff * 2^(k-1), capped.
+        total_ms += min(backoff_ms * (2.0 ** (attempt - 1)), cap_ms)
+    return total_ms / 1000.0 + margin_s
+
+
 def process_count() -> int:
     return jax.process_count()
 

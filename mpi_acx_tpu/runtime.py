@@ -79,6 +79,9 @@ def lib() -> ctypes.CDLL:
         _lib.acx_request_partition_slots.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
         _lib.acx_resilience_stats.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+        _lib.acx_recovery_stats.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+        _lib.acx_drain.restype = ctypes.c_int
+        _lib.acx_drain.argtypes = [ctypes.c_double]
         _lib.MPIX_Set_deadline.restype = ctypes.c_int
         _lib.MPIX_Set_deadline.argtypes = [ctypes.c_double]
         _lib.MPIX_Get_deadline.restype = ctypes.c_int
@@ -323,6 +326,7 @@ class Runtime:
             "slots_reclaimed": out[3],
         }
         stats.update(self.resilience_stats())
+        stats.update(self.recovery_stats())
         return stats
 
     # -- resilience plane ---------------------------------------------------
@@ -370,6 +374,36 @@ class Runtime:
             "peers_dead": out[7],
         }
 
+    # -- survivable links (docs/DESIGN.md "Survivable links") ---------------
+
+    def drain(self, timeout_ms: float = 1000.0) -> int:
+        """Graceful drain (MPIX_Drain): wait up to ``timeout_ms`` for every
+        in-flight op — including ops parked while a peer's link reconnects —
+        then cancel the stragglers with a typed error (``AcxPeerDeadError``
+        for unhealthy peers, ``AcxTimeoutError`` otherwise) so every waiter
+        unblocks in bounded time. Returns the number of ops cancelled
+        (0 = clean drain). Survivors of a peer loss call this to shed the
+        dead rank's traffic and keep serving."""
+        n = self._lib.acx_drain(float(timeout_ms))
+        if n < 0:
+            raise RuntimeError("acx_drain: runtime not initialized")
+        return n
+
+    def recovery_stats(self) -> dict:
+        """Process-wide survivable-link counters: link reconnects, frames
+        replayed from the resend buffer, CRC-rejected frames, NAKs sent,
+        ops cancelled by drain, and links currently mid-reconnect."""
+        out = (ctypes.c_uint64 * 6)()
+        self._lib.acx_recovery_stats(out)
+        return {
+            "reconnects": out[0],
+            "replayed_frames": out[1],
+            "crc_rejects": out[2],
+            "naks_sent": out[3],
+            "drained_slots": out[4],
+            "links_recovering": out[5],
+        }
+
     # -- metrics plane ------------------------------------------------------
 
     def metrics_enabled(self) -> bool:
@@ -384,10 +418,16 @@ class Runtime:
         are refreshed at snapshot time. With ACX_METRICS unset the registry
         is off and counters read zero."""
         import json as _json
+        # The snapshot length can grow between the size probe and the
+        # fill (live counters gain digits under the proxy thread), so
+        # retry with slack until the fill fits its buffer.
         n = self._lib.acx_metrics_snapshot(None, 0)
-        buf = ctypes.create_string_buffer(n + 1)
-        self._lib.acx_metrics_snapshot(buf, n + 1)
-        return _json.loads(buf.value.decode())
+        while True:
+            cap = n + 256
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.acx_metrics_snapshot(buf, cap)
+            if n < cap:
+                return _json.loads(buf.value.decode())
 
     def metrics_dump(self, path: str) -> None:
         """Write the registry snapshot to ``path`` as JSON."""

@@ -5,6 +5,8 @@
 // a running kernel stores into and the proxy polls
 // (reference partitioned.cu:200-212 -> init.cpp:82-115).
 
+#include <sched.h>
+
 #include <atomic>
 #include <cstdint>
 
@@ -13,6 +15,10 @@
 #include "acx/metrics.h"
 
 namespace acx {
+
+// Cumulative count of ops cancelled by acx_drain (process lifetime; the
+// proxy's own counters don't distinguish drained from completed).
+static std::atomic<uint64_t> g_drained{0};
 
 // Fold cumulative runtime stats into the metrics registry. Set (not Add):
 // every source here is itself a monotonic cumulative counter, so the
@@ -37,7 +43,13 @@ void RefreshRuntimeMetrics() {
     metrics::Set(metrics::kHbRecv, n.hb_recv);
     metrics::Set(metrics::kPeersDead, n.peers_dead);
     metrics::Set(metrics::kHbMisses, n.failed_ops);
+    metrics::Set(metrics::kReconnects, n.reconnects);
+    metrics::Set(metrics::kFramesReplayed, n.replayed_frames);
+    metrics::Set(metrics::kCrcRejects, n.crc_rejects);
+    metrics::Set(metrics::kNaksSent, n.naks_sent);
   }
+  metrics::Set(metrics::kDrainedSlots,
+               g_drained.load(std::memory_order_relaxed));
   if (g.table != nullptr)
     metrics::MaxGauge(metrics::kSlotHighWater, g.table->watermark());
 }
@@ -106,6 +118,57 @@ void acx_resilience_stats(uint64_t* out) {
     out[5] = out[6] = out[7] = 0;
   }
 }
+
+// Fills out[6] = {reconnects, replayed_frames, crc_rejects, naks_sent,
+// drained_slots, links_recovering} — the survivable-link counters
+// (DESIGN.md §9). Safe before init (zeros).
+void acx_recovery_stats(uint64_t* out) {
+  acx::ApiState& g = acx::GS();
+  if (g.transport != nullptr) {
+    acx::NetStats n = g.transport->net_stats();
+    out[0] = n.reconnects;
+    out[1] = n.replayed_frames;
+    out[2] = n.crc_rejects;
+    out[3] = n.naks_sent;
+    out[5] = n.links_recovering;
+  } else {
+    out[0] = out[1] = out[2] = out[3] = out[5] = 0;
+  }
+  out[4] = acx::g_drained.load(std::memory_order_relaxed);
+}
+
+// Graceful drain (DESIGN.md §9): give everything in flight — including ops
+// parked on a reconnecting link — `timeout_ms` to finish under caller-driven
+// progress, then cancel the stragglers with typed errors (kErrPeerDead when
+// the op's peer is unhealthy, kErrTimeout otherwise). Returns the number of
+// ops cancelled (0 = everything finished), or -1 before MPIX_Init. Waiters
+// on cancelled requests unblock immediately with the op's error status.
+int acx_drain(double timeout_ms) {
+  acx::ApiState& g = acx::GS();
+  if (g.table == nullptr || g.proxy == nullptr) return -1;
+  const uint64_t deadline =
+      acx::NowNs() +
+      static_cast<uint64_t>(timeout_ms < 0 ? 0 : timeout_ms * 1e6);
+  const auto any_inflight = [&g] {
+    const size_t n = g.table->watermark();
+    for (size_t i = 0; i < n; i++) {
+      const int32_t f = g.table->Load(i);
+      if (f == acx::kPending || f == acx::kIssued || f == acx::kRecovering)
+        return true;
+    }
+    return false;
+  };
+  while (any_inflight() && acx::NowNs() < deadline) {
+    if (!g.proxy->TryProgress()) sched_yield();
+  }
+  const int n = g.proxy->CancelInflight();
+  if (n > 0)
+    acx::g_drained.fetch_add(static_cast<uint64_t>(n),
+                             std::memory_order_relaxed);
+  return n;
+}
+
+int MPIX_Drain(double timeout_ms) { return acx_drain(timeout_ms); }
 
 int MPIX_Set_deadline(double timeout_ms) {
   if (timeout_ms < 0) return 1;

@@ -94,7 +94,8 @@ void ArmGraphCleanup(Graph* g, int idx) {
     std::lock_guard<std::mutex> lk(g2.lifecycle_mu);
     if (g2.table == nullptr || g2.table != expect_table) return;
     int32_t f = g2.table->Load(idx);
-    while ((f == kPending || f == kIssued) && g2.proxy != nullptr) {
+    while ((f == kPending || f == kIssued || f == kRecovering) &&
+           g2.proxy != nullptr) {
       sched_yield();
       f = g2.table->Load(idx);
     }

@@ -25,6 +25,14 @@ RetryPolicy& Policy() {
     }
     if (const char* e = getenv("ACX_MAX_RETRIES"))
       pp->max_retries.store(static_cast<uint32_t>(atoi(e)));
+    if (const char* e = getenv("ACX_RECONNECT_MAX")) {
+      const int v = atoi(e);
+      if (v >= 0) pp->reconnect_max.store(static_cast<uint32_t>(v));
+    }
+    if (const char* e = getenv("ACX_RECONNECT_BACKOFF_MS")) {
+      const unsigned long long ms = strtoull(e, nullptr, 10);
+      if (ms > 0) pp->reconnect_backoff_ms.store(ms);
+    }
     return pp;
   }();
   return *p;
@@ -40,6 +48,10 @@ struct State {
   std::atomic<uint64_t> drops{0};
   std::atomic<uint64_t> delays{0};
   std::atomic<uint64_t> fails{0};
+  std::atomic<uint64_t> frame_drops{0};
+  std::atomic<uint64_t> frame_corrupts{0};
+  std::atomic<uint64_t> link_stalls{0};
+  std::atomic<uint64_t> link_closes{0};
 };
 
 State& S() {
@@ -85,6 +97,10 @@ bool ParseSpec(const char* spec, Config* out) {
   if (strcmp(tok, "drop") == 0) c.action = Action::kDrop;
   else if (strcmp(tok, "delay") == 0) c.action = Action::kDelay;
   else if (strcmp(tok, "fail") == 0) c.action = Action::kFail;
+  else if (strcmp(tok, "drop_frame") == 0) c.action = Action::kDropFrame;
+  else if (strcmp(tok, "corrupt_frame") == 0) c.action = Action::kCorruptFrame;
+  else if (strcmp(tok, "stall_link_ms") == 0) c.action = Action::kStallLink;
+  else if (strcmp(tok, "close_link_once") == 0) c.action = Action::kCloseLink;
   else if (strcmp(tok, "none") == 0) c.action = Action::kNone;
   else return false;
   while (*p != '\0') {
@@ -98,6 +114,7 @@ bool ParseSpec(const char* spec, Config* out) {
     else if (strcmp(tok, "nth") == 0) c.nth = atoi(val);
     else if (strcmp(tok, "count") == 0) c.count = atoi(val);
     else if (strcmp(tok, "us") == 0) c.delay_us = strtoull(val, nullptr, 10);
+    else if (strcmp(tok, "ms") == 0) c.stall_ms = strtoull(val, nullptr, 10);
     else if (strcmp(tok, "err") == 0) c.err = atoi(val);
     else if (strcmp(tok, "kind") == 0) {
       if (strcmp(val, "send") == 0) c.kind = 1;
@@ -109,6 +126,8 @@ bool ParseSpec(const char* spec, Config* out) {
     }
   }
   if (c.nth < 1 || c.count < 1) return false;
+  // A zero-length stall is a typo, not a fault: reject like nth=0.
+  if (c.action == Action::kStallLink && c.stall_ms < 1) return false;
   *out = c;
   return true;
 }
@@ -124,7 +143,11 @@ Action OnIssue(int rank, bool is_send, int peer, uint64_t* delay_us,
                int* err) {
   State& s = S();
   const Config& c = s.cfg;
-  if (c.action == Action::kNone) return Action::kNone;
+  // Frame actions never fire (or consume a match) at the issue level; the
+  // shared matched counter stays consistent because exactly one action is
+  // armed at a time and the other consult site early-returns symmetrically.
+  if (c.action == Action::kNone || c.action >= Action::kDropFrame)
+    return Action::kNone;
   if (c.rank >= 0 && rank != c.rank) return Action::kNone;
   if (c.kind == 1 && !is_send) return Action::kNone;
   if (c.kind == 2 && is_send) return Action::kNone;
@@ -151,12 +174,46 @@ Action OnIssue(int rank, bool is_send, int peer, uint64_t* delay_us,
   return c.action;
 }
 
+Action OnFrame(int rank, int peer, uint64_t* stall_us) {
+  State& s = S();
+  const Config& c = s.cfg;
+  if (c.action < Action::kDropFrame) return Action::kNone;
+  if (c.rank >= 0 && rank != c.rank) return Action::kNone;
+  if (c.peer >= 0 && peer != c.peer) return Action::kNone;
+  const uint64_t m = s.matched.fetch_add(1, std::memory_order_relaxed) + 1;
+  if (m < static_cast<uint64_t>(c.nth) ||
+      m >= static_cast<uint64_t>(c.nth) + static_cast<uint64_t>(c.count))
+    return Action::kNone;
+  switch (c.action) {
+    case Action::kDropFrame:
+      s.frame_drops.fetch_add(1, std::memory_order_relaxed);
+      break;
+    case Action::kCorruptFrame:
+      s.frame_corrupts.fetch_add(1, std::memory_order_relaxed);
+      break;
+    case Action::kStallLink:
+      s.link_stalls.fetch_add(1, std::memory_order_relaxed);
+      if (stall_us != nullptr) *stall_us = c.stall_ms * 1000;
+      break;
+    case Action::kCloseLink:
+      s.link_closes.fetch_add(1, std::memory_order_relaxed);
+      break;
+    default:
+      break;
+  }
+  return c.action;
+}
+
 Stats stats() {
   State& s = S();
   Stats out;
   out.drops = s.drops.load(std::memory_order_relaxed);
   out.delays = s.delays.load(std::memory_order_relaxed);
   out.fails = s.fails.load(std::memory_order_relaxed);
+  out.frame_drops = s.frame_drops.load(std::memory_order_relaxed);
+  out.frame_corrupts = s.frame_corrupts.load(std::memory_order_relaxed);
+  out.link_stalls = s.link_stalls.load(std::memory_order_relaxed);
+  out.link_closes = s.link_closes.load(std::memory_order_relaxed);
   return out;
 }
 

@@ -14,6 +14,7 @@ const char* FlagName(int32_t f) {
     case kIssued: return "ISSUED";
     case kCompleted: return "COMPLETED";
     case kCleanup: return "CLEANUP";
+    case kRecovering: return "RECOVERING";
     default: return "<invalid>";
   }
 }

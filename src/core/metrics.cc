@@ -19,7 +19,8 @@ const char* const kCounterName[kNumCounters] = {
     "retries",         "timeouts",       "faults_injected", "hb_sent",
     "hb_recv",         "hb_misses",      "peers_dead",     "slot_hwm",
     "proxy_sweeps",    "ops_issued",     "ops_completed",  "slots_reclaimed",
-    "proxy_busy_ns",   "proxy_idle_ns",
+    "proxy_busy_ns",   "proxy_idle_ns",  "reconnects",     "frames_replayed",
+    "crc_rejects",     "naks_sent",      "drained_slots",
 };
 
 const char* const kHistName[kNumHists] = {

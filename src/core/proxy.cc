@@ -43,6 +43,31 @@ bool Proxy::TryProgress() {
   return progressed;
 }
 
+int Proxy::CancelInflight() {
+  // Exclusive sweep: no concurrent Sweep may race the flag stores below.
+  std::lock_guard<std::mutex> lk(sweep_mu_);
+  int count = 0;
+  const size_t n = table_->watermark();
+  for (size_t i = 0; i < n; i++) {
+    const int32_t f = table_->Load(i);
+    if (f != kPending && f != kIssued && f != kRecovering) continue;
+    Op& op = table_->op(i);
+    int err = kErrTimeout;
+    if (op.peer >= 0 &&
+        transport_->peer_health(op.peer) != PeerHealth::kHealthy)
+      err = kErrPeerDead;
+    op.status = Status{op.peer, op.tag, err, 0};
+    table_->Store(i, kCompleted);
+    ACX_TRACE_EVENT("op_drained", i);
+    if (metrics::Enabled()) metrics::MarkComplete(i);
+    count++;
+  }
+  if (count != 0)
+    ops_completed_.fetch_add(static_cast<uint64_t>(count),
+                             std::memory_order_relaxed);
+  return count;
+}
+
 Proxy::Stats Proxy::stats() const {
   Stats s;
   s.sweeps = sweeps_.load(std::memory_order_relaxed);
@@ -242,6 +267,16 @@ bool Proxy::Sweep() {
               if (metrics::Enabled()) metrics::MarkComplete(i);
               local.ops_completed++;
               progressed = true;
+            } else if (op.peer >= 0 &&
+                       transport_->peer_health(op.peer) ==
+                           PeerHealth::kRecovering) {
+              // Peer's link is reconnecting (DESIGN.md §9): park the op so
+              // the deadline/retry police don't fail it for the outage.
+              // Parked time is credited back when the op resumes.
+              op.parked_at_ns = NowNs();
+              table_->Store(i, kRecovering);
+              ACX_TRACE_EVENT("op_parked", i);
+              progressed = true;
             } else if (CheckStalled(i, op, local)) {
               progressed = true;
             }
@@ -260,6 +295,29 @@ bool Proxy::Sweep() {
           }
           default:
             break;  // kPready never sits in ISSUED
+        }
+        break;
+      }
+      case kRecovering: {
+        // Parked on a reconnecting link. Test first: the replay machinery
+        // can complete the op mid-recovery, and a failed recovery completes
+        // the ticket with kErrPeerDead — both surface here.
+        if (op.ticket != nullptr && op.ticket->Test(&op.status)) {
+          table_->Store(i, kCompleted);
+          ACX_TRACE_EVENT("op_completed", i);
+          if (metrics::Enabled()) metrics::MarkComplete(i);
+          local.ops_completed++;
+          progressed = true;
+        } else if (op.peer < 0 || transport_->peer_health(op.peer) !=
+                                      PeerHealth::kRecovering) {
+          // Link healed (or the verdict is in and the ticket will report
+          // it next pass). Credit the parked time against the deadline.
+          if (op.deadline_ns != 0 && op.parked_at_ns != 0)
+            op.deadline_ns += NowNs() - op.parked_at_ns;
+          op.parked_at_ns = 0;
+          table_->Store(i, kIssued);
+          ACX_TRACE_EVENT("op_resumed", i);
+          progressed = true;
         }
         break;
       }
@@ -320,11 +378,19 @@ void Proxy::Run() {
       transport_->Tick();
       const uint64_t t_idle = mx ? NowNs() : 0;
       std::unique_lock<std::mutex> lk(idle_mu_);
-      idle_cv_.wait_for(lk, std::chrono::milliseconds(50), [&] {
-        return exit_.load(std::memory_order_acquire) ||
-               kicks_.load(std::memory_order_acquire) != kicks_before ||
-               table_->active.load(std::memory_order_relaxed) != 0;
-      });
+      // wait_until on system_clock, not wait_for: libstdc++'s wait_for
+      // takes the pthread_cond_clockwait path, which the GCC-10 libtsan
+      // does not intercept — TSAN then never sees the mutex released
+      // inside the wait and flags every later Kick() as a double lock.
+      // Wall-clock jumps only perturb the 50ms nap; the predicate and
+      // the outer loop re-check regardless.
+      idle_cv_.wait_until(
+          lk, std::chrono::system_clock::now() + std::chrono::milliseconds(50),
+          [&] {
+            return exit_.load(std::memory_order_acquire) ||
+                   kicks_.load(std::memory_order_acquire) != kicks_before ||
+                   table_->active.load(std::memory_order_relaxed) != 0;
+          });
       if (mx) metrics::Add(metrics::kProxyIdleNs, NowNs() - t_idle);
       idle_sweeps = 0;
     } else if (idle_sweeps < 64) {

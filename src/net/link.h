@@ -40,6 +40,10 @@ class Link {
   // EOF concept, so ShmLink stays alive forever — dead-peer detection there
   // rides on heartbeats (ACX_HEARTBEAT_MS) instead.
   virtual bool alive() const { return true; }
+  // Tear down the wire under the transport (fault injection, desync
+  // recovery). The next Read/WriteSome observes the failure and latches
+  // alive()=false; links without a teardown concept ignore it.
+  virtual void ForceClose() {}
 };
 
 class SockLink : public Link {
@@ -92,6 +96,13 @@ class SockLink : public Link {
   }
 
   bool alive() const override { return alive_; }
+
+  void ForceClose() override {
+    // shutdown (not close): the fd number stays reserved until the dtor, so
+    // a concurrent accept can't recycle it while the transport still holds
+    // this Link. Both directions die; reads see EOF, writes see EPIPE.
+    if (fd_ >= 0) shutdown(fd_, SHUT_RDWR);
+  }
 
  private:
   int fd_;

@@ -21,11 +21,15 @@
 
 #include <errno.h>
 #include <fcntl.h>
+#include <poll.h>
 #include <sched.h>
+#include <stddef.h>
 #include <string.h>
 #include <sys/mman.h>
 #include <sys/prctl.h>
+#include <sys/socket.h>
 #include <sys/uio.h>
+#include <sys/un.h>
 #include <unistd.h>
 
 #include <climits>
@@ -38,28 +42,38 @@
 #include <deque>
 #include <memory>
 #include <mutex>
+#include <string>
 #include <vector>
 
 #include "acx/fault.h"
 #include "acx/trace.h"
 #include "src/net/link.h"
+#include "src/net/wire.h"
 
 namespace acx {
 namespace {
 
-constexpr uint32_t kMagic = 0xAC0C0101u;
+// Frame format lives in src/net/wire.h (40-byte header: magic, tag, ctx,
+// payload CRC32C, bytes, per-link seq, link epoch, header CRC32C). The
+// aliases keep this file's protocol code readable.
+using wire::WireHeader;
+constexpr uint32_t kMagic = wire::kMagic;
 // Rendezvous frames (large-message single-copy path, same host only):
 // an RTS frame advertises {addr, seq, pid} of the sender's buffer; the
 // receiver pulls the payload with one process_vm_readv straight into the
 // destination (the copy-through-the-ring path costs two copies) and acks.
 // A nack (ok=0, e.g. pvread denied by a hardened kernel) makes the sender
 // re-send the payload as a normal copy frame on a private (seq, ctx) key.
-constexpr uint32_t kMagicRts = 0xAC0C0102u;
-constexpr uint32_t kMagicAck = 0xAC0C0103u;
+constexpr uint32_t kMagicRts = wire::kMagicRts;
+constexpr uint32_t kMagicAck = wire::kMagicAck;
 // Heartbeat: a zero-payload keepalive frame. Any inbound bytes refresh the
 // peer's liveness clock, so heartbeats only need to flow when the wire is
 // otherwise quiet. Essential on the shm plane, which has no EOF concept.
-constexpr uint32_t kMagicHb = 0xAC0C0104u;
+// Its seq field carries the sender's tx high-water mark so a receiver can
+// NAK tail loss (a dropped final frame with no traffic behind it).
+constexpr uint32_t kMagicHb = wire::kMagicHb;
+constexpr uint32_t kMagicSeqAck = wire::kMagicSeqAck;
+constexpr uint32_t kMagicNak = wire::kMagicNak;
 
 // Internal context ids. User contexts are >= 0; the control plane and the
 // partitioned layer get their own namespaces so they can never match user
@@ -74,12 +88,6 @@ inline int PartCtx(int ctx) { return -1000 - ctx; }
 inline int PartTag(int tag, int p) { return tag * 4096 + p; }
 
 #pragma pack(push, 1)
-struct WireHeader {
-  uint32_t magic;
-  int32_t tag;
-  int32_t ctx;
-  uint64_t bytes;
-};
 struct RvDesc {  // RTS wire payload
   uint64_t addr;
   uint32_t seq;
@@ -90,6 +98,33 @@ struct RvAck {  // ACK wire payload
   int32_t ok;
 };
 #pragma pack(pop)
+
+inline WireHeader MakeHdr(uint32_t magic, int tag, int ctx, uint64_t bytes) {
+  WireHeader h{};
+  h.magic = magic;
+  h.tag = tag;
+  h.ctx = ctx;
+  h.bytes = bytes;
+  return h;
+}
+
+// Actual on-wire payload length of a frame. NOT hdr.bytes for RTS/ACK: an
+// RTS advertises the full message length in bytes while carrying only the
+// 16-byte descriptor, and an ACK advertises 0 while carrying 8.
+inline size_t WirePayloadLen(const WireHeader& h) {
+  switch (h.magic) {
+    case wire::kMagicRts: return sizeof(RvDesc);
+    case wire::kMagicAck: return sizeof(RvAck);
+    case wire::kMagic: return static_cast<size_t>(h.bytes);
+    default: return 0;
+  }
+}
+
+inline bool KnownMagic(uint32_t m) {
+  return m == wire::kMagic || m == wire::kMagicRts || m == wire::kMagicAck ||
+         m == wire::kMagicHb || m == wire::kMagicSeqAck ||
+         m == wire::kMagicNak || m == wire::kMagicHello;
+}
 
 // Zero-copy send: the wire is fed straight from the user buffer (legal —
 // the caller may not touch it until the ticket completes), so large
@@ -103,6 +138,15 @@ struct SendReq {
   size_t off = 0;  // progress over [header | wire payload]
   bool rv = false;  // rendezvous: wire completion != user completion
   bool done = false;
+  // Replay frame: wire_payload is a complete [header|payload] blob borrowed
+  // from the peer's replay buffer; no separate header is written and no new
+  // record is made (hdr.seq identifies the record to un-queue on write).
+  bool raw = false;
+  bool fault_checked = false;  // OnFrame consulted once per frame
+  // corrupt_frame poisons the on-wire crc field; the pristine values are
+  // kept so the replay record (and any post-reconnect resend) is clean.
+  bool corrupted = false;
+  uint32_t good_crc = 0, good_hcrc = 0;
   int dst = -1;   // destination rank (dead-peer teardown scans rv_pending_)
   char desc[16];  // storage for RTS/ACK wire payloads
   Status st;
@@ -137,6 +181,9 @@ struct InState {
   std::vector<char> payload;
   size_t payload_got = 0;
   std::shared_ptr<RecvReq> direct;
+  uint32_t run_crc = 0;    // incremental CRC32C over the streamed payload
+  bool discard = false;    // stale/duplicate/out-of-order frame: drain+drop
+  bool nak_after = false;  // sequence gap: re-pull once the frame is drained
 };
 
 class StreamTransport;
@@ -160,7 +207,8 @@ class StreamTransport : public Transport {
   // links[i] is the wire to rank i (null at i == rank). shm_base/shm_len, if
   // set, is a mapping to munmap at teardown.
   StreamTransport(int rank, int size, std::vector<std::unique_ptr<Link>> links,
-                  void* shm_base = nullptr, size_t shm_len = 0)
+                  void* shm_base = nullptr, size_t shm_len = 0,
+                  bool sock_plane = false)
       : rank_(rank), size_(size), links_(std::move(links)), peers_(size),
         shm_base_(shm_base), shm_len_(shm_len) {
     const char* e = getenv("ACX_RV_THRESHOLD");
@@ -193,6 +241,44 @@ class StreamTransport : public Transport {
         grace_deadline_ns_ = NowNs() + static_cast<uint64_t>(grace_ms * 1e6);
       }
     }
+    // Survivable links (DESIGN.md §9). Payload CRC stamping is on by
+    // default (ACX_CRC=0 disables); the recovery machinery (sequencing
+    // checks, replay, NAK, epoch-bumped reconnect) arms only on the socket
+    // plane inside an acxrun-managed job (ACX_JOB_ID names the rendezvous
+    // namespace for the reconnect listeners). The shm plane has no EOF or
+    // reconnect concept, and standalone unit tests keep PR-1 semantics.
+    if (const char* c = getenv("ACX_CRC")) crc_on_ = atoi(c) != 0;
+    if (const char* rb = getenv("ACX_REPLAY_BUF_BYTES")) {
+      const unsigned long long v = strtoull(rb, nullptr, 10);
+      if (v > 0) replay_budget_ = static_cast<size_t>(v);
+    }
+    const char* job = getenv("ACX_JOB_ID");
+    recovery_armed_ = sock_plane && size_ > 1 && job != nullptr;
+    if (recovery_armed_) {
+      job_id_ = job;
+      // Abstract-namespace AF_UNIX listener: reconnecting peers dial
+      // "\0acx-<job>-<rank>". Abstract names need no filesystem cleanup and
+      // vanish with the process — a dead rank's name can't be dialed.
+      listen_fd_ = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
+      if (listen_fd_ >= 0) {
+        struct sockaddr_un sa;
+        memset(&sa, 0, sizeof sa);
+        sa.sun_family = AF_UNIX;
+        const int n = snprintf(sa.sun_path + 1, sizeof(sa.sun_path) - 1,
+                               "acx-%s-%d", job_id_.c_str(), rank_);
+        const socklen_t slen = static_cast<socklen_t>(
+            offsetof(struct sockaddr_un, sun_path) + 1 + n);
+        if (bind(listen_fd_, reinterpret_cast<struct sockaddr*>(&sa), slen) !=
+                0 ||
+            listen(listen_fd_, size_) != 0) {
+          close(listen_fd_);
+          listen_fd_ = -1;
+        }
+      }
+      // Without a listener nobody can reconnect TO us; fall back to the
+      // PR-1 fail-stop behavior rather than promise recovery we can't do.
+      if (listen_fd_ < 0) recovery_armed_ = false;
+    }
 #ifdef PR_SET_PTRACER
     // Let sibling ranks process_vm_readv our send buffers even under
     // Yama ptrace_scope=1 (no-op where Yama is absent; nack path covers
@@ -213,6 +299,7 @@ class StreamTransport : public Transport {
   }
 
   ~StreamTransport() override {
+    if (listen_fd_ >= 0) close(listen_fd_);
     links_.clear();
     if (shm_base_ != nullptr) munmap(shm_base_, shm_len_);
   }
@@ -292,7 +379,26 @@ class StreamTransport : public Transport {
     ns.hb_recv = hb_recv_.load(std::memory_order_relaxed);
     ns.peers_dead = peers_dead_n_.load(std::memory_order_relaxed);
     ns.failed_ops = failed_ops_.load(std::memory_order_relaxed);
+    ns.reconnects = reconnects_.load(std::memory_order_relaxed);
+    ns.replayed_frames = frames_replayed_.load(std::memory_order_relaxed);
+    ns.crc_rejects = crc_rejects_.load(std::memory_order_relaxed);
+    ns.naks_sent = naks_sent_.load(std::memory_order_relaxed);
+    ns.links_recovering = recovering_count_.load(std::memory_order_relaxed);
     return ns;
+  }
+
+  PeerHealth peer_health(int r) override {
+    if (r < 0 || r >= size_ || r == rank_) return PeerHealth::kHealthy;
+    // Lock-free fast path: nothing recovering, nobody dead — the common
+    // state for the whole life of a healthy job, and the proxy consults
+    // this for every not-yet-complete op.
+    if (recovering_count_.load(std::memory_order_relaxed) == 0 &&
+        peers_dead_n_.load(std::memory_order_relaxed) == 0)
+      return PeerHealth::kHealthy;
+    std::lock_guard<std::mutex> lk(mu_);
+    if (peer_dead_[r]) return PeerHealth::kDead;
+    return peers_[r].health != 0 ? PeerHealth::kRecovering
+                                 : PeerHealth::kHealthy;
   }
 
   // Called from SockTicket::Test.
@@ -312,11 +418,37 @@ class StreamTransport : public Transport {
   friend class SockPsendChan;
   friend class SockPrecvChan;
 
+  // One fully-written-but-unacked frame, byte-exact as it went on the wire
+  // ([header|payload]). `queued` marks a record currently re-enqueued on the
+  // outq as a raw frame (its blob is borrowed — the record must not be
+  // popped or evicted until the write completes).
+  struct ReplayRec {
+    uint64_t seq = 0;
+    std::vector<char> frame;
+    bool queued = false;
+  };
+
   struct Peer {
     std::deque<std::shared_ptr<SendReq>> outq;
     InState in;
     std::deque<Msg> arrived;                     // unmatched arrivals, FIFO
     std::deque<std::shared_ptr<RecvReq>> posted; // unmatched recvs, FIFO
+
+    // -- survivable-link state (DESIGN.md §9) --
+    uint32_t epoch = 1;        // link incarnation; bumped per reconnect
+    uint64_t tx_seq = 0;       // last sequence number assigned
+    uint64_t rx_seq = 0;       // last in-order frame delivered
+    uint64_t acked_rx = 0;     // rx_seq we last advertised in a SeqAck
+    uint32_t rx_since_ack = 0; // delivered frames since the last SeqAck
+    uint64_t last_nak_ns = 0;  // NAK rate limit
+    std::deque<ReplayRec> replay;  // fully-written, unacked frames
+    size_t replay_bytes = 0;
+    bool replay_broken = false;    // an unacked record was evicted
+    int health = 0;                // 0 healthy, 1 recovering
+    int rec_attempts = 0;          // dialer: connects attempted this outage
+    uint64_t rec_next_ns = 0;      // dialer: next connect attempt
+    uint64_t rec_deadline_ns = 0;  // acceptor: give up waiting for a dial
+    uint64_t stall_until_ns = 0;   // stall_link_ms fault gate
   };
 
   Ticket* IsendLocked(const void* buf, size_t bytes, int dst, int tag,
@@ -353,7 +485,7 @@ class StreamTransport : public Transport {
       // Rendezvous: put a 16-byte RTS on the wire instead of the payload;
       // completion comes from the receiver's ACK (HandleAckLocked).
       const uint32_t seq = rv_next_seq_++;
-      s->hdr = WireHeader{kMagicRts, tag, ctx, bytes};
+      s->hdr = MakeHdr(kMagicRts, tag, ctx, bytes);
       RvDesc d{reinterpret_cast<uint64_t>(buf), seq, getpid()};
       static_assert(sizeof d <= sizeof s->desc, "desc too small");
       memcpy(s->desc, &d, sizeof d);
@@ -362,13 +494,35 @@ class StreamTransport : public Transport {
       s->rv = true;
       rv_pending_[seq] = s;
     } else {
-      s->hdr = WireHeader{kMagic, tag, ctx, bytes};
+      s->hdr = MakeHdr(kMagic, tag, ctx, bytes);
       s->wire_payload = s->payload;
       s->wire_bytes = bytes;
     }
+    s->hdr.crc = PayloadCrc(s->wire_payload, s->wire_bytes);
+    StampSeqLocked(dst, &s->hdr);
     peers_[dst].outq.push_back(s);
     FlushOutLocked(dst);
     return new SockTicket(this, s);
+  }
+
+  // -- wire stamping ---------------------------------------------------------
+  // Sequence numbers are assigned at ENQUEUE time (all enqueues push_back and
+  // the outq drains front-to-back) so wire order equals sequence order.
+
+  uint32_t PayloadCrc(const char* p, size_t n) const {
+    return (crc_on_ && n != 0) ? wire::Crc32c(0, p, n) : 0;
+  }
+
+  // Epoch + header CRC for an unsequenced frame whose seq field the caller
+  // already filled (heartbeat high-water, SeqAck/NAK cumulative rx).
+  void SealHdrLocked(int dst, WireHeader* h) {
+    h->epoch = peers_[dst].epoch;
+    h->hcrc = wire::HeaderCrc(*h);
+  }
+
+  void StampSeqLocked(int dst, WireHeader* h) {
+    h->seq = ++peers_[dst].tx_seq;
+    SealHdrLocked(dst, h);
   }
 
   Ticket* IrecvLocked(void* buf, size_t bytes, int src, int tag, int ctx) {
@@ -451,11 +605,14 @@ class StreamTransport : public Transport {
 
   void SendAckLocked(int dst, uint32_t seq, bool ok) {
     auto s = std::make_shared<SendReq>();
-    s->hdr = WireHeader{kMagicAck, 0, 0, 0};
+    s->hdr = MakeHdr(kMagicAck, 0, 0, 0);
     RvAck a{seq, ok ? 1 : 0};
     memcpy(s->desc, &a, sizeof a);
     s->wire_payload = s->desc;
     s->wire_bytes = sizeof a;
+    s->dst = dst;
+    s->hdr.crc = PayloadCrc(s->wire_payload, s->wire_bytes);
+    StampSeqLocked(dst, &s->hdr);
     peers_[dst].outq.push_back(std::move(s));
     FlushOutLocked(dst);
   }
@@ -472,11 +629,14 @@ class StreamTransport : public Transport {
     // Receiver couldn't pvread: re-send as a normal copy frame on the
     // fallback key it just posted.
     s->rv = false;
-    s->hdr = WireHeader{kMagic, static_cast<int>(a.seq & 0x7fffffff),
-                        kRvDataCtx, s->bytes};
+    s->hdr = MakeHdr(kMagic, static_cast<int>(a.seq & 0x7fffffff), kRvDataCtx,
+                     s->bytes);
     s->wire_payload = s->payload;
     s->wire_bytes = s->bytes;
     s->off = 0;
+    s->fault_checked = false;
+    s->hdr.crc = PayloadCrc(s->wire_payload, s->wire_bytes);
+    StampSeqLocked(src, &s->hdr);
     peers_[src].outq.push_back(std::move(s));
     FlushOutLocked(src);
   }
@@ -498,23 +658,186 @@ class StreamTransport : public Transport {
     peers_[src].arrived.push_back(std::move(m));
   }
 
+  // Copy a fully-written frame into the bounded replay buffer. Called at
+  // full-write time (the payload is still borrowed, so the copy is legal);
+  // a corrupt_frame-poisoned header is recorded with its pristine CRCs so a
+  // replay heals rather than re-injects.
+  void RecordFrameLocked(int p, SendReq* s) {
+    Peer& peer = peers_[p];
+    ReplayRec rec;
+    rec.seq = s->hdr.seq;
+    rec.frame.resize(sizeof(WireHeader) + s->wire_bytes);
+    WireHeader h = s->hdr;
+    if (s->corrupted) {
+      h.crc = s->good_crc;
+      h.hcrc = s->good_hcrc;
+    }
+    memcpy(rec.frame.data(), &h, sizeof h);
+    if (s->wire_bytes != 0)
+      memcpy(rec.frame.data() + sizeof h, s->wire_payload, s->wire_bytes);
+    peer.replay_bytes += rec.frame.size();
+    peer.replay.push_back(std::move(rec));
+    // Bounded buffer: evict oldest while over budget. A record whose blob is
+    // borrowed by an in-flight raw frame pins everything behind it. Any
+    // eviction of an unacked record breaks replayability — latched so a
+    // future recovery fails loudly instead of replaying a gapped stream.
+    while (peer.replay_bytes > replay_budget_ && !peer.replay.empty() &&
+           !peer.replay.front().queued) {
+      peer.replay_bytes -= peer.replay.front().frame.size();
+      peer.replay.pop_front();
+      peer.replay_broken = true;
+    }
+  }
+
+  // A raw (replay) frame finished writing: release its record's blob.
+  void ClearQueuedLocked(int p, uint64_t seq) {
+    for (auto& rec : peers_[p].replay) {
+      if (rec.seq == seq) {
+        rec.queued = false;
+        return;
+      }
+    }
+  }
+
+  // Peer acknowledged delivery of everything up to `acked`: trim records.
+  void HandleSeqAckLocked(int p, uint64_t acked) {
+    Peer& peer = peers_[p];
+    while (!peer.replay.empty() && !peer.replay.front().queued &&
+           peer.replay.front().seq <= acked) {
+      peer.replay_bytes -= peer.replay.front().frame.size();
+      peer.replay.pop_front();
+    }
+  }
+
+  // Header-only cumulative ack of our delivered-in-order high water.
+  void SendSeqAckLocked(int p) {
+    Peer& peer = peers_[p];
+    auto s = std::make_shared<SendReq>();
+    s->hdr = MakeHdr(kMagicSeqAck, 0, 0, 0);
+    s->hdr.seq = peer.rx_seq;
+    SealHdrLocked(p, &s->hdr);
+    s->wire_payload = s->desc;
+    s->wire_bytes = 0;
+    s->dst = p;
+    peer.acked_rx = peer.rx_seq;
+    peer.rx_since_ack = 0;
+    peer.outq.push_back(std::move(s));
+    FlushOutLocked(p);
+  }
+
+  // Rate-limited re-pull: "I have everything through rx_seq; resend from
+  // rx_seq+1". Fired on a sequence gap, a CRC reject, or a heartbeat whose
+  // tx high-water is ahead of us (tail loss).
+  void MaybeNakLocked(int p) {
+    Peer& peer = peers_[p];
+    const uint64_t now = NowNs();
+    if (now - peer.last_nak_ns < 1000000) return;  // 1ms
+    peer.last_nak_ns = now;
+    auto s = std::make_shared<SendReq>();
+    s->hdr = MakeHdr(kMagicNak, 0, 0, 0);
+    s->hdr.seq = peer.rx_seq;
+    SealHdrLocked(p, &s->hdr);
+    s->wire_payload = s->desc;
+    s->wire_bytes = 0;
+    s->dst = p;
+    peer.outq.push_back(std::move(s));
+    naks_sent_.fetch_add(1, std::memory_order_relaxed);
+    FlushOutLocked(p);
+  }
+
+  // Peer asked for a resend from r+1. Requeue every unacked, not-already-
+  // queued record as a raw frame ahead of the unwritten tail of the outq
+  // (replayed seqs are lower than anything not yet written, so wire order
+  // stays sequence order). Duplicates are skip-consumed by the receiver.
+  void HandleNakLocked(int p, uint64_t r) {
+    Peer& peer = peers_[p];
+    HandleSeqAckLocked(p, r);  // everything <= r is implicitly acked
+    if (peer.replay.empty()) return;  // raced with a covering ack
+    if (peer.replay.front().seq != r + 1) {
+      MarkPeerDeadLocked(p, "replay buffer exhausted", /*hb_detected=*/true);
+      return;
+    }
+    auto& q = peer.outq;
+    auto ins = q.begin();
+    if (!q.empty() && q.front()->off > 0) ++ins;  // never tear a mid-write
+    uint64_t count = 0;
+    for (auto& rec : peer.replay) {
+      if (rec.queued) continue;
+      rec.queued = true;
+      auto s = std::make_shared<SendReq>();
+      s->raw = true;
+      s->dst = p;
+      s->hdr.seq = rec.seq;
+      s->wire_payload = rec.frame.data();
+      s->wire_bytes = rec.frame.size();
+      ins = q.insert(ins, std::move(s));
+      ++ins;
+      count++;
+    }
+    if (count != 0)
+      frames_replayed_.fetch_add(count, std::memory_order_relaxed);
+    FlushOutLocked(p);
+  }
+
   void FlushOutLocked(int p) {
-    auto& q = peers_[p].outq;
+    Peer& peer = peers_[p];
+    if (peer.health != 0) return;  // reconnecting: no wire to write to
+    if (peer.stall_until_ns != 0) {
+      if (NowNs() < peer.stall_until_ns) return;  // stall_link_ms fault
+      peer.stall_until_ns = 0;
+    }
+    auto& q = peer.outq;
     while (!q.empty()) {
       auto& s = q.front();
-      while (s->off < sizeof(WireHeader)) {
+      if (s->off == 0 && !s->raw && !s->fault_checked && recovery_armed_ &&
+          fault::Enabled() && wire::Sequenced(s->hdr.magic)) {
+        s->fault_checked = true;  // one consult per frame, whatever happens
+        uint64_t stall_us = 0;
+        switch (fault::OnFrame(rank_, p, &stall_us)) {
+          case fault::Action::kDropFrame:
+            // Swallowed — but recorded, so the receiver's NAK heals it.
+            RecordFrameLocked(p, s.get());
+            if (!s->rv) {
+              s->done = true;
+              s->payload = nullptr;
+            }
+            q.pop_front();
+            continue;
+          case fault::Action::kCorruptFrame:
+            s->good_crc = s->hdr.crc;
+            s->good_hcrc = s->hdr.hcrc;
+            s->hdr.crc ^= 0xDEADBEEFu;
+            s->hdr.hcrc = wire::HeaderCrc(s->hdr);  // header itself stays valid
+            s->corrupted = true;
+            break;
+          case fault::Action::kStallLink:
+            peer.stall_until_ns = NowNs() + stall_us * 1000;
+            return;
+          case fault::Action::kCloseLink:
+            links_[p]->ForceClose();
+            return;  // next Progress pass sees !alive and starts recovery
+          default:
+            break;
+        }
+      }
+      const size_t hdr_len = s->raw ? 0 : sizeof(WireHeader);
+      while (s->off < hdr_len) {
         size_t n = links_[p]->WriteSome(
-            reinterpret_cast<const char*>(&s->hdr) + s->off,
-            sizeof(WireHeader) - s->off);
+            reinterpret_cast<const char*>(&s->hdr) + s->off, hdr_len - s->off);
         if (n == 0) return;  // wire full
         s->off += n;
       }
-      const size_t total = sizeof(WireHeader) + s->wire_bytes;
+      const size_t total = hdr_len + s->wire_bytes;
       while (s->off < total) {
-        size_t n = links_[p]->WriteSome(
-            s->wire_payload + (s->off - sizeof(WireHeader)), total - s->off);
+        size_t n = links_[p]->WriteSome(s->wire_payload + (s->off - hdr_len),
+                                        total - s->off);
         if (n == 0) return;
         s->off += n;
+      }
+      if (s->raw) {
+        ClearQueuedLocked(p, s->hdr.seq);
+      } else if (recovery_armed_ && wire::Sequenced(s->hdr.magic)) {
+        RecordFrameLocked(p, s.get());
       }
       if (!s->rv) {
         // Rendezvous sends stay pending (and keep borrowing the user
@@ -526,9 +849,33 @@ class StreamTransport : public Transport {
     }
   }
 
+  // The byte stream from p desynced (header CRC or magic check failed): a
+  // torn frame means nothing downstream can be trusted. With recovery armed
+  // the link is torn down and rebuilt — the epoch/seq/replay machinery
+  // restores exactly-once delivery. Disarmed, this stays PR-1 fail-stop.
+  void StreamDesyncLocked(int p) {
+    std::fprintf(stderr, "tpu-acx[%d]: wire desync from %d (bad header)\n",
+                 rank_, p);
+    if (!recovery_armed_) _exit(14);
+    links_[p]->ForceClose();
+    StartRecoveryLocked(p, "wire desync");
+  }
+
+  // A sequenced frame was delivered in order: advance rx and ack every 16
+  // frames (the idle flush in ProgressLocked covers quiet tails).
+  void BumpRxLocked(int p, uint64_t seq) {
+    Peer& peer = peers_[p];
+    peer.rx_seq = seq;
+    if (++peer.rx_since_ack >= 16) SendSeqAckLocked(p);
+  }
+
   void DrainInLocked(int p) {
-    InState& in = peers_[p].in;
+    Peer& peer = peers_[p];
+    InState& in = peer.in;
     for (;;) {
+      // A NAK/desync handled below can flip the peer into recovery (or
+      // dead) mid-drain; stop touching the link the moment that happens.
+      if (peer_dead_[p] || peer.health != 0) return;
       if (in.hdr_got < sizeof(WireHeader)) {
         size_t n =
             links_[p]->ReadSome(reinterpret_cast<char*>(&in.hdr) + in.hdr_got,
@@ -537,50 +884,108 @@ class StreamTransport : public Transport {
         NoteRx(p);
         in.hdr_got += n;
         if (in.hdr_got < sizeof(WireHeader)) return;
+        // Header integrity gate: magic and header-CRC must both hold
+        // before ANY field is trusted.
+        if (!KnownMagic(in.hdr.magic) ||
+            in.hdr.hcrc != wire::HeaderCrc(in.hdr)) {
+          StreamDesyncLocked(p);
+          return;
+        }
         in.payload_got = 0;
+        in.run_crc = 0;
+        in.discard = false;
+        in.nak_after = false;
+        // -- unsequenced control frames (header-only) --
         if (in.hdr.magic == kMagicHb) {
           hb_recv_.fetch_add(1, std::memory_order_relaxed);
+          // Tail loss: the sender's tx high-water is ahead of what we've
+          // delivered and nothing behind the gap is coming (heartbeats are
+          // FIFO behind data, so everything written earlier was read).
+          if (recovery_armed_ && in.hdr.epoch == peer.epoch &&
+              in.hdr.seq > peer.rx_seq)
+            MaybeNakLocked(p);
           in.hdr_got = 0;
           continue;
         }
-        if (in.hdr.magic == kMagicRts) {
-          in.direct.reset();
-          in.payload.resize(sizeof(RvDesc));
-        } else if (in.hdr.magic == kMagicAck) {
-          in.direct.reset();
-          in.payload.resize(sizeof(RvAck));
-        } else if (in.hdr.magic == kMagic) {
-          // Direct delivery: if a matching recv is already posted, stream
-          // the payload straight into its buffer (one memcpy off the wire).
-          // Only unexpected messages pay the assembly-buffer copy.
-          auto& posted = peers_[p].posted;
-          for (auto it = posted.begin(); it != posted.end(); ++it) {
-            if ((*it)->tag == in.hdr.tag && (*it)->ctx == in.hdr.ctx) {
-              in.direct = *it;
-              posted.erase(it);
-              break;
-            }
-          }
-          if (in.direct == nullptr) in.payload.resize(in.hdr.bytes);
-        } else {
-          std::fprintf(stderr, "tpu-acx[%d]: bad wire magic from %d\n", rank_,
-                       p);
-          _exit(14);
+        if (in.hdr.magic == kMagicSeqAck) {
+          HandleSeqAckLocked(p, in.hdr.seq);
+          in.hdr_got = 0;
+          continue;
         }
+        if (in.hdr.magic == kMagicNak) {
+          HandleNakLocked(p, in.hdr.seq);
+          in.hdr_got = 0;
+          continue;
+        }
+        if (in.hdr.magic == wire::kMagicHello) {
+          // Handshake frames only ever travel on a fresh reconnect socket.
+          StreamDesyncLocked(p);
+          return;
+        }
+        // -- sequenced data frames --
+        if (recovery_armed_) {
+          if (in.hdr.epoch != peer.epoch || in.hdr.seq <= peer.rx_seq) {
+            // Stale epoch or duplicate (replay overshoot): consume quietly.
+            in.discard = true;
+          } else if (in.hdr.seq > peer.rx_seq + 1) {
+            // Gap: something was lost ahead of this frame. Consume it (the
+            // replay will re-deliver it in order) and ask for a resend.
+            in.discard = true;
+            in.nak_after = true;
+          }
+        }
+        if (!in.discard) {
+          if (in.hdr.magic == kMagicRts) {
+            in.direct.reset();
+            in.payload.resize(sizeof(RvDesc));
+          } else if (in.hdr.magic == kMagicAck) {
+            in.direct.reset();
+            in.payload.resize(sizeof(RvAck));
+          } else {
+            // Direct delivery: if a matching recv is already posted, stream
+            // the payload straight into its buffer (one memcpy off the
+            // wire). Only unexpected messages pay the assembly-buffer copy.
+            auto& posted = peer.posted;
+            for (auto it = posted.begin(); it != posted.end(); ++it) {
+              if ((*it)->tag == in.hdr.tag && (*it)->ctx == in.hdr.ctx) {
+                in.direct = *it;
+                posted.erase(it);
+                break;
+              }
+            }
+            if (in.direct == nullptr) in.payload.resize(in.hdr.bytes);
+          }
+        }
+      }
+      const size_t wire_len = WirePayloadLen(in.hdr);
+      if (in.discard) {
+        while (in.payload_got < wire_len) {
+          char scratch[4096];
+          size_t want = wire_len - in.payload_got;
+          if (want > sizeof scratch) want = sizeof scratch;
+          size_t n = links_[p]->ReadSome(scratch, want);
+          if (n == 0) return;
+          NoteRx(p);
+          in.payload_got += n;
+        }
+        if (in.nak_after) MaybeNakLocked(p);
+        in.hdr_got = 0;
+        continue;
       }
       if (in.direct != nullptr) {
         RecvReq* r = in.direct.get();
         const size_t deliver =
             r->bytes < in.hdr.bytes ? r->bytes : in.hdr.bytes;
         while (in.payload_got < deliver) {
-          size_t n = links_[p]->ReadSome(
-              static_cast<char*>(r->buf) + in.payload_got,
-              deliver - in.payload_got);
+          char* dst = static_cast<char*>(r->buf) + in.payload_got;
+          size_t n = links_[p]->ReadSome(dst, deliver - in.payload_got);
           if (n == 0) return;
           NoteRx(p);
+          if (in.hdr.crc != 0) in.run_crc = wire::Crc32c(in.run_crc, dst, n);
           in.payload_got += n;
         }
         // Oversized tail (recv buffer smaller than message): drain + drop.
+        // Still CRC'd — the sender's checksum covers the whole payload.
         while (in.payload_got < in.hdr.bytes) {
           char scratch[4096];
           size_t want = in.hdr.bytes - in.payload_got;
@@ -588,8 +993,27 @@ class StreamTransport : public Transport {
           size_t n = links_[p]->ReadSome(scratch, want);
           if (n == 0) return;
           NoteRx(p);
+          if (in.hdr.crc != 0)
+            in.run_crc = wire::Crc32c(in.run_crc, scratch, n);
           in.payload_got += n;
         }
+        if (in.hdr.crc != 0 && in.run_crc != in.hdr.crc) {
+          crc_rejects_.fetch_add(1, std::memory_order_relaxed);
+          if (!recovery_armed_) {
+            std::fprintf(stderr, "tpu-acx[%d]: payload CRC mismatch from %d\n",
+                         rank_, p);
+            _exit(14);
+          }
+          // Do NOT complete the recv or advance rx_seq: re-arm the recv at
+          // the head of the posted queue (it must match first again) and
+          // pull a clean copy from the sender's replay buffer.
+          peer.posted.push_front(in.direct);
+          in.direct.reset();
+          in.hdr_got = 0;
+          MaybeNakLocked(p);
+          continue;
+        }
+        if (recovery_armed_) BumpRxLocked(p, in.hdr.seq);
         r->st = Status{
             p, r->report_tag != INT_MIN ? r->report_tag : in.hdr.tag,
             in.hdr.bytes > r->bytes ? kErrTruncate : 0, deliver};
@@ -605,6 +1029,21 @@ class StreamTransport : public Transport {
         NoteRx(p);
         in.payload_got += n;
       }
+      if (in.hdr.crc != 0 &&
+          wire::Crc32c(0, in.payload.data(), in.payload.size()) !=
+              in.hdr.crc) {
+        crc_rejects_.fetch_add(1, std::memory_order_relaxed);
+        if (!recovery_armed_) {
+          std::fprintf(stderr, "tpu-acx[%d]: payload CRC mismatch from %d\n",
+                       rank_, p);
+          _exit(14);
+        }
+        in.payload.clear();
+        in.hdr_got = 0;
+        MaybeNakLocked(p);
+        continue;
+      }
+      if (recovery_armed_) BumpRxLocked(p, in.hdr.seq);
       if (in.hdr.magic == kMagicRts) {
         Msg m;
         m.tag = in.hdr.tag;
@@ -635,13 +1074,30 @@ class StreamTransport : public Transport {
 
   void ProgressLocked() {
     if (hb_interval_ns_ != 0) HeartbeatLocked();
+    if (recovery_armed_) {
+      PollRecoveryLocked();
+      // Idle SeqAck flush: without traffic the sender's replay buffer would
+      // never trim. Coarse 5ms timer — one NowNs per pass is the only cost.
+      const uint64_t now = NowNs();
+      if (now - last_ack_flush_ns_ >= 5000000) {
+        last_ack_flush_ns_ = now;
+        for (int p = 0; p < size_; p++) {
+          if (p == rank_ || !links_[p] || peer_dead_[p]) continue;
+          Peer& peer = peers_[p];
+          if (peer.health == 0 && peer.rx_seq > peer.acked_rx)
+            SendSeqAckLocked(p);
+        }
+      }
+    }
     for (int p = 0; p < size_; p++) {
       if (p == rank_ || !links_[p]) continue;  // no wire (malformed env)
       if (peer_dead_[p]) continue;
+      if (peers_[p].health != 0) continue;  // reconnecting: leave the link be
       FlushOutLocked(p);
       DrainInLocked(p);
+      if (peers_[p].health != 0 || peer_dead_[p]) continue;  // changed above
       if (!links_[p]->alive())
-        MarkPeerDeadLocked(p, "connection closed", /*hb_detected=*/false);
+        StartRecoveryLocked(p, "connection closed");
     }
   }
 
@@ -658,8 +1114,13 @@ class StreamTransport : public Transport {
       last_hb_send_ns_ = now;
       for (int p = 0; p < size_; p++) {
         if (p == rank_ || !links_[p] || peer_dead_[p]) continue;
+        if (peers_[p].health != 0) continue;  // reconnecting: nothing to send on
         auto s = std::make_shared<SendReq>();
-        s->hdr = WireHeader{kMagicHb, 0, 0, 0};
+        s->hdr = MakeHdr(kMagicHb, 0, 0, 0);
+        // seq carries the tx high-water WITHOUT consuming a number, so the
+        // receiver can detect tail loss (see the kMagicHb comment up top).
+        s->hdr.seq = peers_[p].tx_seq;
+        SealHdrLocked(p, &s->hdr);
         s->wire_payload = s->desc;
         s->wire_bytes = 0;
         s->dst = p;
@@ -670,6 +1131,13 @@ class StreamTransport : public Transport {
     if (now < grace_deadline_ns_) return;
     for (int p = 0; p < size_; p++) {
       if (p == rank_ || !links_[p] || peer_dead_[p]) continue;
+      // A reconnecting peer is by definition not speaking; the reconnect
+      // ladder's own deadline is the liveness verdict for it (satellite:
+      // heartbeat monitor must not declare reconnecting links dead).
+      if (peers_[p].health != 0) {
+        last_rx_ns_[p] = now;
+        continue;
+      }
       // A peer that never spoke starts its clock at the end of the grace
       // window, not at process start.
       if (last_rx_ns_[p] == 0) last_rx_ns_[p] = now;
@@ -689,6 +1157,12 @@ class StreamTransport : public Transport {
     ACX_TRACE_EVENT("peer_dead", static_cast<size_t>(p));
     uint64_t failed = 0;
     Peer& peer = peers_[p];
+    if (peer.health == 1) {
+      peer.health = 0;
+      recovering_count_.fetch_sub(1, std::memory_order_relaxed);
+    }
+    peer.replay.clear();
+    peer.replay_bytes = 0;
     if (peer.in.direct) {
       RecvReq* r = peer.in.direct.get();
       r->st = Status{p, r->report_tag != INT_MIN ? r->report_tag : r->tag,
@@ -709,7 +1183,10 @@ class StreamTransport : public Transport {
       s->st.error = kErrPeerDead;
       s->st.bytes = 0;
       s->done = true;
-      if (s->hdr.magic != kMagicHb && s->hdr.magic != kMagicAck) failed++;
+      // Only user-visible ops count as failed work: raw replay frames and
+      // SeqAck/NAK/heartbeat control frames are protocol-internal.
+      if (!s->raw && (s->hdr.magic == kMagic || s->hdr.magic == kMagicRts))
+        failed++;
     }
     peer.outq.clear();
     for (auto it = rv_pending_.begin(); it != rv_pending_.end();) {
@@ -732,6 +1209,297 @@ class StreamTransport : public Transport {
                    "tpu-acx[%d]: peer %d declared dead (%s); failed %llu "
                    "in-flight op(s)\n",
                    rank_, p, why, static_cast<unsigned long long>(failed));
+  }
+
+  // -- survivable-link recovery engine (DESIGN.md §9) ------------------------
+  //
+  // Roles are fixed by rank order: the LOWER rank dials the HIGHER rank's
+  // abstract-namespace listener, so the two sides of an outage never race
+  // each other's connect. The dialer walks a bounded exponential ladder
+  // (ACX_RECONNECT_MAX attempts, ACX_RECONNECT_BACKOFF_MS base, 2s cap);
+  // the acceptor waits out the whole ladder plus margin before giving up.
+  // The 40-byte hello is a WireHeader (magic=kMagicHello): tag = sender's
+  // rank, seq = sender's delivered-in-order high water for this peer,
+  // epoch = proposed / agreed link epoch. The acceptor's reply is
+  // authoritative: agreed = max(proposal, own epoch + 1).
+
+  // True when nothing user-visible is pending against p — dying peers at
+  // clean teardown then take the quiet dead-latch fast path instead of a
+  // pointless reconnect storm. Replay contents deliberately do NOT count:
+  // fully-delivered-but-unacked frames are not in-flight work.
+  bool NothingInFlightLocked(int p) {
+    Peer& peer = peers_[p];
+    if (peer.in.direct) return false;
+    if (!peer.posted.empty()) return false;
+    for (auto& s : peer.outq)
+      if (!s->raw && !s->done && wire::Sequenced(s->hdr.magic)) return false;
+    for (auto& kv : rv_pending_)
+      if (kv.second->dst == p) return false;
+    return true;
+  }
+
+  uint64_t DialBackoffMs(int attempt) const {
+    uint64_t ms =
+        Policy().reconnect_backoff_ms.load(std::memory_order_relaxed);
+    if (ms == 0) ms = 1;
+    for (int i = 1; i < attempt && ms < 2000; i++) ms *= 2;
+    return ms < 2000 ? ms : 2000;
+  }
+
+  uint64_t AcceptDeadlineNs() const {
+    const uint32_t maxa =
+        Policy().reconnect_max.load(std::memory_order_relaxed);
+    uint64_t total_ms = 1000;  // handshake + scheduling margin
+    for (uint32_t a = 1; a <= maxa; a++) total_ms += DialBackoffMs(a);
+    return total_ms * 1000000ull;
+  }
+
+  // The link to p failed (EOF, desync, forced close). Either park the peer
+  // in RECOVERING and start the reconnect ladder, or — when recovery can't
+  // help (disarmed, replay gapped) or isn't needed (nothing in flight) —
+  // fall through to the PR-1 dead-latch.
+  void StartRecoveryLocked(int p, const char* why) {
+    Peer& peer = peers_[p];
+    if (peer_dead_[p] || peer.health != 0) return;
+    if (NothingInFlightLocked(p)) {
+      MarkPeerDeadLocked(p, why, /*hb_detected=*/false);
+      return;
+    }
+    if (!recovery_armed_ || peer.replay_broken) {
+      MarkPeerDeadLocked(p, why, /*hb_detected=*/true);
+      return;
+    }
+    peer.health = 1;
+    recovering_count_.fetch_add(1, std::memory_order_relaxed);
+    peer.rec_attempts = 0;
+    const uint64_t now = NowNs();
+    if (rank_ < p)
+      peer.rec_next_ns = now;  // dial immediately; ladder spaces retries
+    else
+      peer.rec_deadline_ns = now + AcceptDeadlineNs();
+    ACX_TRACE_EVENT("link_recovering", static_cast<size_t>(p));
+    std::fprintf(stderr,
+                 "tpu-acx[%d]: link to %d lost (%s); attempting reconnect\n",
+                 rank_, p, why);
+  }
+
+  // Pump every in-progress recovery: accept incoming dials, fire due
+  // outgoing dials, expire acceptor deadlines. Gated on recovering_count_
+  // so a healthy job pays zero syscalls here. (Safe: a failing dialer's
+  // ForceClose/exit propagates EOF to us long before its ladder expires,
+  // so by the time it dials, our count is nonzero and we are accepting.)
+  void PollRecoveryLocked() {
+    if (recovering_count_.load(std::memory_order_relaxed) == 0) return;
+    HandleDialLocked();
+    const uint64_t now = NowNs();
+    for (int p = 0; p < size_; p++) {
+      if (p == rank_ || peer_dead_[p] || peers_[p].health == 0) continue;
+      if (rank_ < p) {
+        if (now >= peers_[p].rec_next_ns) DialPeerLocked(p);
+      } else if (now >= peers_[p].rec_deadline_ns) {
+        MarkPeerDeadLocked(p, "reconnect wait expired", /*hb_detected=*/true);
+      }
+    }
+  }
+
+  void DialPeerLocked(int p) {
+    Peer& peer = peers_[p];
+    const uint32_t maxa =
+        Policy().reconnect_max.load(std::memory_order_relaxed);
+    if (peer.rec_attempts >= static_cast<int>(maxa)) {
+      MarkPeerDeadLocked(p, "reconnect attempts exhausted",
+                         /*hb_detected=*/true);
+      return;
+    }
+    peer.rec_attempts++;
+    peer.rec_next_ns =
+        NowNs() + DialBackoffMs(peer.rec_attempts) * 1000000ull;
+    int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0) return;
+    struct sockaddr_un sa;
+    memset(&sa, 0, sizeof sa);
+    sa.sun_family = AF_UNIX;
+    const int n = snprintf(sa.sun_path + 1, sizeof(sa.sun_path) - 1,
+                           "acx-%s-%d", job_id_.c_str(), p);
+    const socklen_t slen = static_cast<socklen_t>(
+        offsetof(struct sockaddr_un, sun_path) + 1 + n);
+    if (connect(fd, reinterpret_cast<struct sockaddr*>(&sa), slen) != 0) {
+      close(fd);  // peer not listening (yet, or ever) — ladder retries
+      return;
+    }
+    WireHeader hello = MakeHdr(wire::kMagicHello, rank_, 0, 0);
+    hello.seq = peer.rx_seq;
+    hello.epoch = peer.epoch + 1;  // proposal; the reply is authoritative
+    hello.hcrc = wire::HeaderCrc(hello);
+    WireHeader reply{};
+    if (!IoFullTimed(fd, &hello, sizeof hello, 1000, /*wr=*/true) ||
+        !IoFullTimed(fd, &reply, sizeof reply, 1000, /*wr=*/false) ||
+        reply.magic != wire::kMagicHello ||
+        reply.hcrc != wire::HeaderCrc(reply) || reply.tag != p ||
+        reply.epoch < hello.epoch) {
+      close(fd);
+      return;
+    }
+    AdoptLinkLocked(p, fd, reply.seq, reply.epoch);
+  }
+
+  void HandleDialLocked() {
+    if (listen_fd_ < 0) return;
+    for (;;) {
+      const int fd = accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) return;  // EAGAIN: no (more) pending dials
+      WireHeader hello{};
+      // Only LOWER ranks dial us; anything else on the listener is noise.
+      if (!IoFullTimed(fd, &hello, sizeof hello, 1000, /*wr=*/false) ||
+          hello.magic != wire::kMagicHello ||
+          hello.hcrc != wire::HeaderCrc(hello) || hello.tag < 0 ||
+          hello.tag >= size_ || hello.tag >= rank_ || peer_dead_[hello.tag]) {
+        close(fd);
+        continue;
+      }
+      const int p = hello.tag;
+      const uint32_t own = peers_[p].epoch + 1;
+      const uint32_t agreed = hello.epoch > own ? hello.epoch : own;
+      WireHeader reply = MakeHdr(wire::kMagicHello, rank_, 0, 0);
+      reply.seq = peers_[p].rx_seq;
+      reply.epoch = agreed;
+      reply.hcrc = wire::HeaderCrc(reply);
+      if (!IoFullTimed(fd, &reply, sizeof reply, 1000, /*wr=*/true)) {
+        close(fd);
+        continue;
+      }
+      // Adopt even if our side of the link still looked healthy — the
+      // incoming hello IS the failure signal (the dialer saw something we
+      // haven't read yet).
+      AdoptLinkLocked(p, fd, hello.seq, agreed);
+    }
+  }
+
+  // Install the reconnected socket as the live link to p and restore
+  // exactly-once delivery: rewind the outq, replay every frame the peer
+  // hasn't delivered (epoch re-stamped in place), reset inbound assembly.
+  void AdoptLinkLocked(int p, int fd, uint64_t peer_rx, uint32_t agreed) {
+    Peer& peer = peers_[p];
+    const int fl = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+    links_[p] = std::make_unique<SockLink>(fd, rank_, p);  // old fd closes
+    peer.epoch = agreed;
+    // Purge the outq: raw replay frames are regenerated from the replay
+    // buffer below; unsequenced control frames (HB/SeqAck/NAK) are stale
+    // and cheap to regenerate; sequenced survivors rewind to byte 0 with
+    // pristine CRCs and the new epoch.
+    for (auto it = peer.outq.begin(); it != peer.outq.end();) {
+      auto& s = *it;
+      if (s->raw) {
+        ClearQueuedLocked(p, s->hdr.seq);
+        it = peer.outq.erase(it);
+      } else if (!wire::Sequenced(s->hdr.magic)) {
+        it = peer.outq.erase(it);
+      } else {
+        s->off = 0;
+        if (s->corrupted) {
+          s->hdr.crc = s->good_crc;
+          s->corrupted = false;
+        }
+        SealHdrLocked(p, &s->hdr);
+        ++it;
+      }
+    }
+    HandleSeqAckLocked(p, peer_rx);  // peer holds everything through peer_rx
+    if (!peer.replay.empty() && peer.replay.front().seq != peer_rx + 1) {
+      // The peer needs a frame we no longer hold: recovery can't be
+      // lossless, and a silent gap is worse than a dead link.
+      MarkPeerDeadLocked(p, "replay buffer exhausted", /*hb_detected=*/true);
+      return;
+    }
+    uint64_t count = 0;
+    auto ins = peer.outq.begin();
+    for (auto& rec : peer.replay) {
+      rec.queued = true;
+      char* blob = rec.frame.data();
+      memcpy(blob + offsetof(WireHeader, epoch), &agreed, sizeof agreed);
+      const uint32_t hcrc = wire::Crc32c(0, blob, offsetof(WireHeader, hcrc));
+      memcpy(blob + offsetof(WireHeader, hcrc), &hcrc, sizeof hcrc);
+      auto s = std::make_shared<SendReq>();
+      s->raw = true;
+      s->dst = p;
+      s->hdr.seq = rec.seq;
+      s->wire_payload = blob;
+      s->wire_bytes = rec.frame.size();
+      ins = peer.outq.insert(ins, std::move(s));
+      ++ins;
+      count++;
+    }
+    if (count != 0)
+      frames_replayed_.fetch_add(count, std::memory_order_relaxed);
+    // Inbound assembly state is a torn frame from the dead link: rewind.
+    // A half-filled direct recv re-arms at the head of the posted queue;
+    // the replayed copy will match it again and overwrite from byte 0.
+    InState& in = peer.in;
+    if (in.direct) {
+      peer.posted.push_front(in.direct);
+      in.direct.reset();
+    }
+    in.hdr_got = 0;
+    in.payload.clear();
+    in.payload_got = 0;
+    in.run_crc = 0;
+    in.discard = false;
+    in.nak_after = false;
+    if (peer.health == 1) {
+      peer.health = 0;
+      recovering_count_.fetch_sub(1, std::memory_order_relaxed);
+    }
+    peer.rec_attempts = 0;
+    peer.rec_next_ns = 0;
+    peer.rec_deadline_ns = 0;
+    peer.stall_until_ns = 0;
+    peer.last_nak_ns = 0;
+    last_rx_ns_[p] = NowNs();
+    reconnects_.fetch_add(1, std::memory_order_relaxed);
+    ACX_TRACE_EVENT("link_reconnected", static_cast<size_t>(p));
+    std::fprintf(stderr,
+                 "tpu-acx[%d]: link to %d re-established (epoch %u, "
+                 "replaying %llu frame(s))\n",
+                 rank_, p, agreed, static_cast<unsigned long long>(count));
+    FlushOutLocked(p);
+  }
+
+  // Exact-length IO with a poll-based deadline, for the 40-byte handshake
+  // on a fresh (blocking) reconnect socket. Safe under mu_: the peer's
+  // handshake side runs under its OWN lock, so there is no circular wait —
+  // worst case is the bounded timeout.
+  static bool IoFullTimed(int fd, void* buf, size_t n, int timeout_ms,
+                          bool wr) {
+    char* pbuf = static_cast<char*>(buf);
+    size_t got = 0;
+    const uint64_t deadline =
+        NowNs() + static_cast<uint64_t>(timeout_ms) * 1000000ull;
+    while (got < n) {
+      const uint64_t now = NowNs();
+      if (now >= deadline) return false;
+      struct pollfd pf;
+      pf.fd = fd;
+      pf.events = wr ? POLLOUT : POLLIN;
+      pf.revents = 0;
+      const int pr =
+          poll(&pf, 1, static_cast<int>((deadline - now) / 1000000ull) + 1);
+      if (pr < 0) {
+        if (errno == EINTR) continue;
+        return false;
+      }
+      if (pr == 0) return false;
+      const ssize_t r = wr ? send(fd, pbuf + got, n - got, MSG_NOSIGNAL)
+                           : read(fd, pbuf + got, n - got);
+      if (r > 0) {
+        got += static_cast<size_t>(r);
+        continue;
+      }
+      if (r < 0 && (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK))
+        continue;
+      return false;  // EOF or hard error
+    }
+    return true;
   }
 
   // Blocking control-plane helpers (used by Barrier/AllreduceInt only).
@@ -768,6 +1536,19 @@ class StreamTransport : public Transport {
   std::atomic<uint64_t> hb_recv_{0};
   std::atomic<uint64_t> peers_dead_n_{0};
   std::atomic<uint64_t> failed_ops_{0};
+
+  // -- survivable-link state (DESIGN.md §9) --
+  bool recovery_armed_ = false;  // socket plane + ACX_JOB_ID + live listener
+  bool crc_on_ = true;           // ACX_CRC (payload CRC32C stamping)
+  size_t replay_budget_ = 4u << 20;  // ACX_REPLAY_BUF_BYTES, per link
+  std::string job_id_;
+  int listen_fd_ = -1;
+  uint64_t last_ack_flush_ns_ = 0;  // idle SeqAck flush timer
+  std::atomic<uint64_t> reconnects_{0};
+  std::atomic<uint64_t> frames_replayed_{0};
+  std::atomic<uint64_t> crc_rejects_{0};
+  std::atomic<uint64_t> naks_sent_{0};
+  std::atomic<uint64_t> recovering_count_{0};
 };
 
 bool SockTicket::Test(Status* st) { return t_->TestReq(send_, recv_, st); }
@@ -891,7 +1672,8 @@ Transport* CreateSocketTransport(int rank, int size,
     fcntl(fds[i], F_SETFL, fl | O_NONBLOCK);
     links[i] = std::make_unique<SockLink>(fds[i], rank, i);
   }
-  return new StreamTransport(rank, size, std::move(links));
+  return new StreamTransport(rank, size, std::move(links), nullptr, 0,
+                             /*sock_plane=*/true);
 }
 
 Transport* CreateShmTransport(int rank, int size, void* base,

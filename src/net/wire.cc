@@ -1,0 +1,62 @@
+#include "src/net/wire.h"
+
+namespace acx {
+namespace wire {
+
+namespace {
+
+// Software fallback: classic byte-at-a-time table for the reflected
+// Castagnoli polynomial. Built once at static-init time.
+struct Table {
+  uint32_t t[256];
+  Table() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : (c >> 1);
+      t[i] = c;
+    }
+  }
+};
+const Table kTable;
+
+uint32_t SwUpdate(uint32_t state, const unsigned char* p, size_t n) {
+  while (n--) state = kTable.t[(state ^ *p++) & 0xFF] ^ (state >> 8);
+  return state;
+}
+
+#if defined(__x86_64__) || defined(__i386__)
+__attribute__((target("sse4.2")))
+uint32_t HwUpdate(uint32_t state, const unsigned char* p, size_t n) {
+#if defined(__x86_64__)
+  uint64_t s64 = state;
+  while (n >= 8) {
+    uint64_t chunk;
+    __builtin_memcpy(&chunk, p, 8);
+    s64 = __builtin_ia32_crc32di(s64, chunk);
+    p += 8;
+    n -= 8;
+  }
+  state = (uint32_t)s64;
+#endif
+  while (n--) state = __builtin_ia32_crc32qi(state, *p++);
+  return state;
+}
+#endif
+
+}  // namespace
+
+uint32_t Crc32c(uint32_t crc, const void* data, size_t n) {
+  const unsigned char* p = static_cast<const unsigned char*>(data);
+  uint32_t state = crc ^ 0xFFFFFFFFu;
+#if defined(__x86_64__) || defined(__i386__)
+  static const bool hw = __builtin_cpu_supports("sse4.2");
+  state = hw ? HwUpdate(state, p, n) : SwUpdate(state, p, n);
+#else
+  state = SwUpdate(state, p, n);
+#endif
+  return state ^ 0xFFFFFFFFu;
+}
+
+}  // namespace wire
+}  // namespace acx

@@ -1,0 +1,59 @@
+// Wire framing for the stream transport: a fixed 40-byte header carrying a
+// per-link epoch, a monotonic per-link sequence number, a CRC32C of the
+// payload, and a CRC32C of the header itself. The header CRC catches stream
+// desync (a torn read lands mid-frame); the payload CRC catches payload
+// corruption; epoch+seq drive the replay/reconnect protocol in
+// socket_transport.cc (DESIGN.md §9).
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+
+namespace acx {
+namespace wire {
+
+// Frame classes. The low byte distinguishes them; the upper bytes are a
+// transport signature so a desynced stream is overwhelmingly likely to fail
+// the magic check even before the header CRC is consulted.
+constexpr uint32_t kMagic       = 0xAC0C0101;  // eager copy: header + payload
+constexpr uint32_t kMagicRts    = 0xAC0C0102;  // rendezvous RTS: header + RvDesc
+constexpr uint32_t kMagicAck    = 0xAC0C0103;  // rendezvous ACK: header + RvAck
+constexpr uint32_t kMagicHb     = 0xAC0C0104;  // heartbeat: header only
+constexpr uint32_t kMagicSeqAck = 0xAC0C0105;  // cumulative receive ack: header only
+constexpr uint32_t kMagicNak    = 0xAC0C0106;  // negative ack / re-pull: header only
+constexpr uint32_t kMagicHello  = 0xAC0C0107;  // reconnect handshake: header only
+
+#pragma pack(push, 1)
+struct WireHeader {
+  uint32_t magic;  // frame class, above
+  int32_t  tag;    // message tag (kMagicHello: dialer's rank)
+  int32_t  ctx;    // context id (kCtrlCtx, kRvDataCtx, PartCtx(...))
+  uint32_t crc;    // CRC32C of the payload; 0 = unchecked (ACX_CRC=0 / empty)
+  uint64_t bytes;  // payload length following the header
+  uint64_t seq;    // per-link monotonic sequence (kMagicHb: tx high-water;
+                   //   kMagicSeqAck/kMagicNak: cumulative rx; kMagicHello:
+                   //   sender's rx high-water for resume)
+  uint32_t epoch;  // link incarnation (kMagicHello: proposed/agreed epoch)
+  uint32_t hcrc;   // CRC32C of bytes [0, offsetof(hcrc)) of this header
+};
+#pragma pack(pop)
+static_assert(sizeof(WireHeader) == 40, "wire header is part of the protocol");
+
+// Incremental CRC32C (Castagnoli, reflected poly 0x82F63B78). Start with
+// crc=0; feeding a buffer in pieces gives the same result as one shot.
+// Hardware SSE4.2 path when available, software table otherwise.
+uint32_t Crc32c(uint32_t crc, const void* data, size_t n);
+
+inline uint32_t HeaderCrc(const WireHeader& h) {
+  return Crc32c(0, &h, offsetof(WireHeader, hcrc));
+}
+
+// Frames that consume a sequence number and are recorded for replay.
+// Control frames (hb/seqack/nak/hello) ride outside the sequence space so
+// they can flow while the data stream is stalled or being replayed.
+inline bool Sequenced(uint32_t magic) {
+  return magic == kMagic || magic == kMagicRts || magic == kMagicAck;
+}
+
+}  // namespace wire
+}  // namespace acx

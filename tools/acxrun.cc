@@ -48,7 +48,12 @@ static void usage() {
           "  -fault SPEC  arm deterministic fault injection in every rank\n"
           "               (sets ACX_FAULT; spec: action[:key=val]..., e.g.\n"
           "               drop:rank=0:kind=send:nth=1 — see include/acx/"
-          "fault.h)\n");
+          "fault.h)\n"
+          "               op-level actions:   drop | delay | fail\n"
+          "               wire-level actions: drop_frame | corrupt_frame |\n"
+          "               stall_link_ms (ms=M) | close_link_once — exercise\n"
+          "               the CRC/NAK/replay/reconnect machinery on the\n"
+          "               socket plane (-transport socket)\n");
   exit(2);
 }
 
@@ -132,6 +137,7 @@ int main(int argc, char** argv) {
 
   std::vector<pid_t> pids(np);
   for (int r = 0; r < np; r++) {
+    const std::string job_id = std::to_string(getpid());  // captured pre-fork
     pid_t pid = fork();
     if (pid < 0) {
       perror("acxrun: fork");
@@ -153,6 +159,10 @@ int main(int argc, char** argv) {
       setenv("ACX_RANK", std::to_string(r).c_str(), 1);
       setenv("ACX_SIZE", std::to_string(np).c_str(), 1);
       setenv("ACX_FDS", fds.c_str(), 1);
+      // Job id namespaces the per-rank reconnect listeners (abstract AF_UNIX
+      // "\0acx-<job>-<rank>", DESIGN.md §9). The launcher pid is unique per
+      // concurrent job on a host; overwrite=0 lets a test pin its own id.
+      setenv("ACX_JOB_ID", job_id.c_str(), 0);
       if (shm_fd >= 0) {
         setenv("ACX_SHM_FD", std::to_string(shm_fd).c_str(), 1);
         setenv("ACX_SHM_RING_BYTES", std::to_string(ring_bytes).c_str(), 1);
