@@ -124,6 +124,7 @@ check: ctest itest tools
 	@$(BUILD)/acxrun -np 2 -fault delay:rank=1:kind=recv:nth=1:us=5000 $(BUILD)/itests/ring || exit 1
 	@$(MAKE) --no-print-directory chaos-check || exit 1
 	@$(MAKE) --no-print-directory metrics-check || exit 1
+	@$(MAKE) --no-print-directory doctor-check || exit 1
 	@echo "ALL NATIVE TESTS PASSED"
 
 # --- survivable links end-to-end (DESIGN.md §9) ---
@@ -163,7 +164,7 @@ chaos-check: itest tools
 # (span balance, counter/histogram invariants) and produce the merged
 # Perfetto timeline + fleet metrics with tools/acx_trace_merge.py.
 .PHONY: metrics-check
-metrics-check: tools
+metrics-check: ctest tools
 	@rm -rf $(BUILD)/metrics-check && mkdir -p $(BUILD)/metrics-check
 	@echo "== metrics-check: acxrun -np 2 bench_pingpong (ACX_METRICS + ACX_TRACE)"
 	@ACX_METRICS=$(BUILD)/metrics-check/run ACX_TRACE=$(BUILD)/metrics-check/run \
@@ -174,7 +175,26 @@ metrics-check: tools
 	  --metrics-out $(BUILD)/metrics-check/fleet.metrics.json \
 	  $(BUILD)/metrics-check/run.rank*.trace.json \
 	  $(BUILD)/metrics-check/run.rank*.metrics.json || exit 1
+	@echo "== metrics-check: flight-recorder hot-path overhead bound"
+	@$(BUILD)/ctests/test_flight || exit 1
 	@echo "METRICS CHECK PASSED"
+
+# --- stall watchdog + hang doctor end-to-end (DESIGN.md §10) ---
+# hang-doctor wedges ranks 0/1 on purpose (withheld Pready + unanswered
+# recv); every stuck rank's watchdog must write a flight dump while the job
+# is hung, and tools/acx_doctor.py must pair the per-rank dumps and name
+# both the anomaly and the culprit rank.
+.PHONY: doctor-check
+doctor-check: ctest itest tools
+	@rm -rf $(BUILD)/doctor-check && mkdir -p $(BUILD)/doctor-check
+	@echo "== doctor-check: acxrun -np 2 hang-doctor (watchdog dumps fire)"
+	@ACX_FLIGHT=$(BUILD)/doctor-check/hang \
+	  $(BUILD)/acxrun -np 2 $(BUILD)/itests/hang-doctor || exit 1
+	@echo "== doctor-check: acx_doctor.py names the culprit"
+	@python3 tools/acx_doctor.py \
+	  --expect-anomaly never_published_partition --expect-culprit 0 \
+	  $(BUILD)/doctor-check/hang.rank*.flight.json || exit 1
+	@echo "DOCTOR CHECK PASSED"
 
 # Header dependency tracking (-MMD): a header edit rebuilds its users.
 -include $(LIB_OBJS:.o=.d)

@@ -4,8 +4,16 @@
 // Two gates, matching the reference's compile-time + our own runtime knob:
 //   * compile-time: build with ACX_DEBUG=1 (make) -> -DACX_DEBUG
 //   * run-time:     env ACX_DEBUG=1 enables output in debug builds
+//
+// Every line carries "[acx debug r<rank> t=<mono_ms>]" so interleaved
+// multi-rank stderr stays attributable: rank is learned from MPIX_Init
+// (SetDebugRank) or $ACX_RANK, "r?" until either happens; t is steady-clock
+// milliseconds since this process first logged.
 #pragma once
 
+#include <atomic>
+#include <chrono>
+#include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 
@@ -23,12 +31,51 @@ inline bool DebugEnabled() {
 #endif
 }
 
+// -2 = not yet resolved, -1 = genuinely unknown (single process, no env).
+inline std::atomic<int>& DebugRankCell() {
+  static std::atomic<int> r{-2};
+  return r;
+}
+
+// Called from MPIX_Init once the transport knows its rank.
+inline void SetDebugRank(int rank) {
+  DebugRankCell().store(rank, std::memory_order_relaxed);
+}
+
+inline int DebugRank() {
+  int r = DebugRankCell().load(std::memory_order_relaxed);
+  if (r == -2) {
+    const char* e = std::getenv("ACX_RANK");
+    r = e != nullptr ? std::atoi(e) : -1;
+    DebugRankCell().store(r, std::memory_order_relaxed);
+  }
+  return r;
+}
+
+inline uint64_t DebugMonoMs() {
+  static const std::chrono::steady_clock::time_point t0 =
+      std::chrono::steady_clock::now();
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::milliseconds>(
+          std::chrono::steady_clock::now() - t0)
+          .count());
+}
+
+inline void DebugLogPrefix(const char* file, int line) {
+  const int r = DebugRank();
+  const unsigned long long t = DebugMonoMs();
+  if (r >= 0)
+    std::fprintf(stderr, "[acx debug r%d t=%llu] %s:%d: ", r, t, file, line);
+  else
+    std::fprintf(stderr, "[acx debug r? t=%llu] %s:%d: ", t, file, line);
+}
+
 }  // namespace acx
 
 #define ACX_DLOG(...)                              \
   do {                                             \
     if (::acx::DebugEnabled()) {                   \
-      std::fprintf(stderr, "[acx debug] %s:%d: ", __FILE__, __LINE__); \
+      ::acx::DebugLogPrefix(__FILE__, __LINE__);   \
       std::fprintf(stderr, __VA_ARGS__);           \
       std::fprintf(stderr, "\n");                  \
     }                                              \

@@ -1,0 +1,155 @@
+// tpu-acx: flight recorder — the black box for silent hangs.
+//
+// The trace plane (acx/trace.h) is opt-in and mutex-ringed: great for
+// postmortem latency analysis, useless as an always-on hang witness. This
+// layer is the complement: a fixed-size per-rank ring of 32-byte binary
+// op-lifecycle events that is ON BY DEFAULT and lock-light enough to leave
+// armed in production — one relaxed fetch_add on the ring head plus six
+// plain stores per event. Writers never take a lock and never wait; an
+// in-progress record that a dump races with is simply a torn (garbage)
+// event in a diagnostic artifact, which the reader tolerates.
+//
+// Event kinds cover the whole op path: slot state transitions in the proxy
+// sweep, enqueue/trigger/wait in the MPIX API, pready/parrived marks,
+// wire-level tx/rx/ack/nak and link recovery in the stream transport, and
+// process-scope anchors (barrier, init/finalize, watchdog trips). Each
+// event stamps slot/peer/tag/seq plus a 16-bit aux (partition index or
+// error code), so tools/acx_doctor.py can pair sends with recvs and
+// partitions by index ACROSS ranks and name the rank everyone is waiting
+// on.
+//
+// Dumps — "<prefix>.rank<r>.flight.json", prefix from $ACX_FLIGHT (default
+// "acx") — fire on stall-watchdog trip (ACX_HANG_DUMP_MS), on fatal signal
+// (only when $ACX_FLIGHT is set; shares trace.cc's crash-flush registry),
+// and on explicit MPIX_Dump_state / acx_flight_dump / Runtime.hang_report()
+// calls. A dump contains the recorder config, watchdog counters, a racy
+// point-in-time snapshot of the live slot table, per-peer link clocks
+// (epoch / tx / rx / acked seq, replay backlog, health), and the last-N
+// events oldest-first.
+//
+// ACX_FLIGHT_EVENTS sizes the ring (rounded up to a power of two; default
+// 8192; 0 disables recording entirely). ACX_STALL_WARN_MS /
+// ACX_HANG_DUMP_MS set the watchdog thresholds consumed by the proxy
+// (defaults 10000 / 30000; 0 disables that stage).
+#pragma once
+
+#include <cstdint>
+
+namespace acx {
+namespace flight {
+
+// Event kinds. Values are stable within one build only — dumps carry the
+// kind NAME, never the raw value, so readers key on strings.
+enum Kind : uint16_t {
+  kNone = 0,
+  // -- op lifecycle (src/api/mpix.cc, src/core/proxy.cc) --
+  kIsendEnqueue,    // slot reserved for an enqueued send (peer/tag/bytes)
+  kIrecvEnqueue,    // slot reserved for an enqueued recv
+  kTriggerFired,    // execution queue reached the trigger point (-> PENDING)
+  kIsendIssued,     // proxy posted the send on the data plane
+  kIrecvIssued,     // proxy posted the recv
+  kOpCompleted,     // proxy observed completion (aux = status.error)
+  kWaitObserved,    // a host waiter consumed COMPLETED
+  kOpTimeout,       // deadline expired / retries exhausted (aux = error)
+  kOpRetry,         // lost issue re-posted (aux = attempt number)
+  kOpParked,        // ISSUED -> RECOVERING (peer link down)
+  kOpResumed,       // RECOVERING -> ISSUED (link healed)
+  kOpDrained,       // cancelled by MPIX_Drain/CancelInflight (aux = error)
+  kSlotReclaimed,   // CLEANUP -> AVAILABLE
+  kOpFault,         // injected fault hit the op (aux = fault action)
+  // -- partitioned (per-partition slots; aux = partition index) --
+  kPsendSlot,       // partition slot reserved at Psend_init
+  kPrecvSlot,       // partition slot reserved at Precv_init
+  kPreadyMark,      // MPIX_Pready (host or device mirror) marked partition
+  kPreadyWire,      // proxy pushed the partition to the wire
+  kParrived,        // proxy observed the partition's arrival
+  // -- wire (src/net/socket_transport.cc; seq = link sequence number) --
+  kTxData,          // sequenced data frame written to the link
+  kTxRts,           // rendezvous RTS written
+  kTxAck,           // rendezvous ACK written
+  kTxSeqAck,        // cumulative seq-ack written (seq = acked rx seq)
+  kTxNak,           // re-pull request written (seq = first missing seq)
+  kRxData,          // in-order data frame delivered (seq = rx seq)
+  kRxSeqAck,        // peer's cumulative ack arrived (seq = acked tx seq)
+  kRxNak,           // peer requested replay (seq = first seq to resend)
+  kLinkRecovering,  // peer entered the reconnect ladder
+  kLinkUp,          // epoch-bumped reconnect completed (aux = new epoch)
+  kPeerDead,        // peer declared dead (EOF / heartbeat loss)
+  // -- process scope (slot = -1) --
+  kBarrierEnter,
+  kBarrierExit,
+  kStallWarn,       // watchdog stage 1: slot pending past ACX_STALL_WARN_MS
+  kHangDump,        // watchdog stage 2: dump fired at ACX_HANG_DUMP_MS
+  kInit,            // MPIX_Init done (peer = rank, tag = world size)
+  kFinalize,        // MPIX_Finalize entered
+  kKindCount,       // sentinel
+};
+
+// Name for a kind (static string; "unknown" out of range).
+const char* KindName(uint16_t k);
+
+// One ring record. Exactly 32 bytes so the ring stays cache-friendly and
+// a torn concurrent write can't straddle more than two lines.
+struct Event {
+  uint64_t t_ns;  // steady-clock ns (acx::NowNs)
+  uint64_t seq;   // wire sequence / attempt count / kind-specific ordinal
+  int32_t slot;   // flag-table slot, -1 for process scope
+  int32_t peer;   // peer rank, -1 if n/a
+  int32_t tag;    // op tag, -1 if n/a
+  uint16_t kind;  // Kind
+  int16_t aux;    // partition index / error code / epoch, kind-specific
+};
+static_assert(sizeof(Event) == 32, "flight Event must stay 32 bytes");
+
+// True iff the ring exists (ACX_FLIGHT_EVENTS != 0; checked once, first
+// true call sizes the ring and registers the crash-dump hook).
+bool Enabled();
+
+// Record one event. Lock-free: relaxed head bump + plain stores. Safe from
+// any thread; a dump racing a write reads one torn record at worst.
+void Record(uint16_t kind, int32_t slot, int32_t peer, int32_t tag,
+            uint64_t seq, int16_t aux);
+
+// Tell the recorder this process's rank so dumps name their file correctly
+// (falls back to $ACX_RANK, then 0).
+void SetRank(int rank);
+
+// Write "<prefix>.rank<r>.flight.json". prefix == nullptr means $ACX_FLIGHT,
+// falling back to "acx". reason lands in the dump header ("watchdog",
+// "explicit", "fatal-signal", ...). Returns 0 on success. Works before
+// MPIX_Init (slot/peer sections are empty) and from the crash path (all
+// runtime state is read racily, no locks taken).
+int Dump(const char* prefix, const char* reason);
+
+// Watchdog thresholds, env-seeded at first use (milliseconds in the env,
+// nanoseconds out; 0 = that stage disabled).
+uint64_t StallWarnNs();  // ACX_STALL_WARN_MS, default 10000
+uint64_t HangDumpNs();   // ACX_HANG_DUMP_MS, default 30000
+
+// Watchdog bookkeeping (proxy calls these when a stage fires; counters
+// land in dumps and acx_flight_stats).
+void NoteStallWarn();
+void NoteHangDump();
+
+struct Stats {
+  uint64_t recorded = 0;      // total events ever written (>= capacity when
+                              // the ring has wrapped)
+  uint64_t capacity = 0;      // ring size in events (0 = disabled)
+  uint64_t stall_warns = 0;   // watchdog stage-1 trips
+  uint64_t hang_dumps = 0;    // watchdog stage-2 trips
+  uint64_t dumps_written = 0; // flight.json files written (any reason)
+};
+Stats stats();
+
+}  // namespace flight
+}  // namespace acx
+
+// Hot-path recording macro. `kind` is a bare Kind enumerator name.
+#define ACX_FLIGHT(kind, slot, peer, tag, seq, aux)                     \
+  do {                                                                  \
+    if (::acx::flight::Enabled())                                       \
+      ::acx::flight::Record(                                            \
+          (uint16_t)(::acx::flight::kind), (int32_t)(slot),             \
+          (int32_t)(peer), (int32_t)(tag), (uint64_t)(seq),             \
+          (int16_t)(aux));                                              \
+  } while (0)

@@ -71,6 +71,11 @@ class Proxy {
   bool IssueOp(size_t i, Op& op, Stats& local, bool from_pending);
   // Deadline/retry policing for an ISSUED-but-incomplete op.
   bool CheckStalled(size_t i, Op& op, Stats& local);
+  // Stall watchdog (acx/flightrec.h): stamp in-flight slots, escalate
+  // warn -> dump per ACX_STALL_WARN_MS / ACX_HANG_DUMP_MS. Returns true
+  // when a hang dump should fire (caller dumps AFTER releasing sweep_mu_).
+  // Callers must hold sweep_mu_ (reads/writes Op watch fields).
+  bool WatchdogScan(uint64_t now);
 
   FlagTable* table_;
   Transport* transport_;

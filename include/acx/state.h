@@ -118,6 +118,11 @@ struct Op {
   uint64_t parked_at_ns = 0;   // when the op entered RECOVERING (deadline
                                // credit: parked time doesn't count)
 
+  // -- stall watchdog (proxy-private; acx/flightrec.h) --
+  uint64_t watch_since_ns = 0;  // first time the watchdog saw this op
+                                // in flight; 0 = not yet observed
+  uint8_t watch_stage = 0;      // 0 quiet, 1 warned, 2 dumped
+
   void Reset() { *this = Op{}; }
 };
 

@@ -44,6 +44,16 @@ void SetRank(int rank);
 // so repeated flushes rewrite supersets.
 void Flush(int rank);
 
+// Shared crash-flush registry. Registers `fn` to run once when the process
+// dies on a fatal signal (SIGTERM/INT/ABRT/SEGV/BUS, claimed only over
+// SIG_DFL dispositions) and — when `on_exit` — also at normal exit via
+// atexit. First call installs the hooks. `fn` must be best-effort safe:
+// no locks it could already hold, no allocation it can avoid. At most 4
+// flushers (trace + flight today); extras are dropped. All registered
+// flushers run under one process-wide "already flushing" latch, so a crash
+// inside a flusher cannot recurse.
+void RegisterCrashFlusher(void (*fn)(), bool on_exit);
+
 }  // namespace trace
 }  // namespace acx
 

@@ -65,6 +65,18 @@ struct NetStats {
 // the transport runs its reconnect ladder instead of failing them.
 enum class PeerHealth { kHealthy = 0, kRecovering = 1, kDead = 2 };
 
+// Point-in-time snapshot of one link's wire clocks, for stall reports and
+// flight-recorder dumps (acx/flightrec.h): which epoch the link is on, how
+// far each direction has advanced, how much the peer has acknowledged, and
+// how much replay backlog is held for it.
+struct LinkClock {
+  uint32_t epoch = 0;
+  uint64_t tx_seq = 0;        // last sequenced frame queued to the peer
+  uint64_t rx_seq = 0;        // last in-order frame delivered from the peer
+  uint64_t acked_rx = 0;      // rx seq last advertised back to the peer
+  uint64_t replay_bytes = 0;  // unacked bytes held in the replay buffer
+};
+
 class Transport {
  public:
   virtual ~Transport() = default;
@@ -103,6 +115,12 @@ class Transport {
   // always healthy. Must be cheap when nothing is recovering — the proxy
   // consults it for every op that has not completed yet.
   virtual PeerHealth peer_health(int /*rank*/) { return PeerHealth::kHealthy; }
+
+  // Best-effort snapshot of the wire clocks for peer `rank`'s link. False
+  // when the transport has no sequenced wire (self/shm) or cannot take the
+  // snapshot without blocking — callers on the dump/signal path must
+  // tolerate a refusal, never retry-spin on it.
+  virtual bool link_clock(int /*rank*/, LinkClock* /*out*/) { return false; }
 };
 
 }  // namespace acx

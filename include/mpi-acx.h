@@ -114,6 +114,14 @@ int MPIX_Op_status(MPIX_Request request, int *state, int *error,
  * every waiter in bounded time and keep running. */
 int MPIX_Drain(double timeout_ms);
 
+/* Dump this rank's runtime state — flight-recorder events, live slot
+ * table, per-peer link clocks — to <prefix>.rank<r>.flight.json, where
+ * prefix is $ACX_FLIGHT or "acx". The dump is crash-safe (no locks taken)
+ * and also fires automatically on stall-watchdog trip (ACX_HANG_DUMP_MS)
+ * and fatal signals. Feed the per-rank files to tools/acx_doctor.py for a
+ * cross-rank hang diagnosis. Returns 0 on success. */
+int MPIX_Dump_state(void);
+
 #ifdef __cplusplus
 }
 #endif

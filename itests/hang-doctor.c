@@ -1,0 +1,175 @@
+/* tpu-acx integration test: stall watchdog + flight dumps + hang doctor.
+ *
+ * Builds a real cross-rank hang and asserts the observability plane turns
+ * it into evidence: rank 0 opens a 2-partition Psend channel to rank 1 but
+ * publishes only partition 0, so rank 1's partition-1 arrival poll can
+ * never complete; rank 0 additionally posts a recv (tag 9) that rank 1
+ * only answers at the very end, so BOTH ranks hold a hopeless in-flight op.
+ * With ACX_STALL_WARN_MS/ACX_HANG_DUMP_MS tightened, each rank's stall
+ * watchdog must trip and write <ACX_FLIGHT>.rank<r>.flight.json while the
+ * job is wedged. Once both dump files exist the test un-wedges itself
+ * (Pready of partition 1, then the tag-9 reply), verifies the payload, and
+ * exits clean — the hang was real but bounded.
+ *
+ * `make doctor-check` re-runs this binary with ACX_FLIGHT pointed into
+ * build/ and feeds the two dumps to tools/acx_doctor.py, which must name
+ * the anomaly (never_published_partition) and the culprit (rank 0). In the
+ * generic `make check` legs the test manages its own /tmp prefix and
+ * removes the dumps on success. Ranks >= 2 (np=4 leg) idle through
+ * finalize. Run under `acxrun -np N`.
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <mpi.h>
+#include <mpi-acx.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+void acx_flight_stats(uint64_t *out);
+#ifdef __cplusplus
+}
+#endif
+
+#define PARTS 2
+#define PART_INTS 4
+#define DONE_TAG 9
+
+/* Block until `path` exists non-empty, up to max_ms. */
+static int wait_for_file(const char *path, int max_ms) {
+    for (int waited = 0; waited < max_ms; waited += 20) {
+        struct stat st;
+        if (stat(path, &st) == 0 && st.st_size > 0) return 1;
+        usleep(20 * 1000);
+    }
+    return 0;
+}
+
+int main(int argc, char **argv) {
+    /* Tight watchdog so the deliberate hang converts to dumps quickly;
+     * must be set before the runtime latches the thresholds. */
+    setenv("ACX_STALL_WARN_MS", "150", 1);
+    setenv("ACX_HANG_DUMP_MS", "400", 1);
+    /* Dump prefix: keep the caller's (make doctor-check inspects the
+     * files); otherwise use a job-scoped /tmp prefix we clean up. */
+    int own_prefix = getenv("ACX_FLIGHT") == NULL;
+    if (own_prefix) {
+        const char *job = getenv("ACX_JOB_ID");
+        char prefix[256];
+        snprintf(prefix, sizeof prefix, "/tmp/hang-doctor-%s",
+                 job != NULL ? job : "solo");
+        setenv("ACX_FLIGHT", prefix, 1);
+    }
+
+    int provided, rank, size, errs = 0;
+    MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided);
+    if (provided < MPI_THREAD_MULTIPLE) MPI_Abort(MPI_COMM_WORLD, 1);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    if (size < 2) {
+        printf("hang-doctor: needs >= 2 ranks\n");
+        MPI_Abort(MPI_COMM_WORLD, 1);
+    }
+    if (MPIX_Init()) MPI_Abort(MPI_COMM_WORLD, 2);
+
+    char dump0[512], dump1[512];
+    snprintf(dump0, sizeof dump0, "%s.rank0.flight.json",
+             getenv("ACX_FLIGHT"));
+    snprintf(dump1, sizeof dump1, "%s.rank1.flight.json",
+             getenv("ACX_FLIGHT"));
+
+    if (rank == 0) {
+        int send_buf[PARTS * PART_INTS];
+        for (int i = 0; i < PARTS * PART_INTS; i++) send_buf[i] = 100 + i;
+        MPIX_Request sreq, rreq;
+        MPI_Status st;
+        cudaStream_t stream = 0;
+        MPIX_Psend_init(send_buf, PARTS, PART_INTS, MPI_INT, 1, 0,
+                        MPI_COMM_WORLD, MPI_INFO_NULL, &sreq);
+        MPIX_Start(&sreq);
+        MPIX_Pready(0, sreq);            /* partition 1 deliberately withheld */
+        int done = -1;
+        MPIX_Irecv_enqueue(&done, 1, MPI_INT, 1, DONE_TAG, MPI_COMM_WORLD,
+                           &rreq, MPIX_QUEUE_XLA_STREAM, &stream);
+
+        /* Wedged: our tag-9 recv has no sender yet, rank 1 polls a
+         * partition we never published. Both watchdogs must now trip. */
+        if (!wait_for_file(dump0, 15000) || !wait_for_file(dump1, 15000)) {
+            printf("[0] watchdog dumps never appeared (%s, %s)\n",
+                   dump0, dump1);
+            errs++;
+        }
+        uint64_t fs[5];
+        acx_flight_stats(fs);
+        if (fs[3] < 1) {   /* hang_dumps */
+            printf("[0] watchdog tripped no hang dump (hang_dumps=%llu)\n",
+                   (unsigned long long)fs[3]);
+            errs++;
+        }
+        if (fs[2] < 1) {   /* stall_warns fire earlier, at 150ms */
+            printf("[0] no stall warning recorded (stall_warns=%llu)\n",
+                   (unsigned long long)fs[2]);
+            errs++;
+        }
+
+        /* Un-wedge: publish the withheld partition, then collect the
+         * tag-9 reply rank 1 sends after its side completes. */
+        MPIX_Pready(1, sreq);
+        MPIX_Wait(&sreq, &st);
+        MPIX_Wait(&rreq, &st);
+        if (done != 4242) {
+            printf("[0] bad done token %d\n", done);
+            errs++;
+        }
+        MPIX_Request_free(&sreq);
+    } else if (rank == 1) {
+        int recv_buf[PARTS * PART_INTS];
+        memset(recv_buf, -1, sizeof recv_buf);
+        MPIX_Request rreq;
+        MPI_Status st;
+        MPIX_Precv_init(recv_buf, PARTS, PART_INTS, MPI_INT, 0, 0,
+                        MPI_COMM_WORLD, MPI_INFO_NULL, &rreq);
+        MPIX_Start(&rreq);
+
+        /* Partition 0 arrives (it was published); partition 1 is the
+         * hang this test exists to diagnose. */
+        int flag = 0;
+        while (!flag) {
+            if (MPIX_Parrived(rreq, 0, &flag)) MPI_Abort(MPI_COMM_WORLD, 3);
+            if (!flag) usleep(1000);
+        }
+        if (!wait_for_file(dump0, 15000) || !wait_for_file(dump1, 15000)) {
+            printf("[1] watchdog dumps never appeared (%s, %s)\n",
+                   dump0, dump1);
+            errs++;
+        }
+
+        /* Rank 0 publishes partition 1 once it has seen both dumps. */
+        MPIX_Wait(&rreq, &st);
+        for (int i = 0; i < PARTS * PART_INTS; i++) {
+            if (recv_buf[i] != 100 + i) {
+                if (errs < 3)
+                    printf("[1] part data [%d]: got %d, want %d\n", i,
+                           recv_buf[i], 100 + i);
+                errs++;
+            }
+        }
+        int done = 4242;
+        MPI_Send(&done, 1, MPI_INT, 0, DONE_TAG, MPI_COMM_WORLD);
+        MPIX_Request_free(&rreq);
+    }
+    /* Ranks >= 2 just ride along to finalize (np=4 leg). */
+
+    MPI_Allreduce(MPI_IN_PLACE, &errs, 1, MPI_INT, MPI_MAX, MPI_COMM_WORLD);
+    MPIX_Finalize();
+    MPI_Finalize();
+    if (own_prefix && rank <= 1 && errs == 0)
+        unlink(rank == 0 ? dump0 : dump1);
+    if (rank == 0 && errs == 0) printf("hang-doctor: OK\n");
+    return errs != 0;
+}

@@ -26,6 +26,7 @@ schedules.
 from __future__ import annotations
 
 import math
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -74,6 +75,7 @@ class ServingMetrics:
     requeues: int = 0             # failure-path restarts
     peer_requeues: int = 0        # requeues from peer loss (uncharged)
     slots_shed: int = 0           # slots retired to match lost capacity
+    hang_dumps: int = 0           # flight dumps written on step failure
     ttft_p50_s: float = 0.0
     ttft_p99_s: float = 0.0
     itl_p50_s: float = 0.0        # inter-token latency (per decoded token)
@@ -112,6 +114,26 @@ def _peer_dead(exc: BaseException) -> bool:
         return True
     msg = str(exc).lower()
     return "peer dead" in msg or "peer_dead" in msg
+
+
+def _flight_dump_best_effort() -> bool:
+    """Write this rank's flight-recorder dump if the operator opted in
+    ($ACX_FLIGHT names a prefix — same gate as the fatal-signal dump, so
+    deliberate failure-path tests don't litter the cwd) and the native
+    runtime is already loaded (never build or load the library just for a
+    dump — the serving loop must keep making progress). A failed step
+    usually means a comm op wedged underneath XLA; the dump plus
+    tools/acx_doctor.py turns 'the batch hung' into 'rank R never sent
+    tag T'. Returns True iff a dump file was written."""
+    if not os.environ.get("ACX_FLIGHT"):
+        return False
+    try:
+        import mpi_acx_tpu.runtime as _rt
+        if _rt._lib is None:
+            return False
+        return _rt._lib.acx_flight_dump(None) == 0
+    except Exception:  # pragma: no cover — diagnostics must never raise
+        return False
 
 
 def _bucket(n: int, lo: int = 8) -> int:
@@ -303,6 +325,7 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
     n_requeues = 0
     n_peer_requeues = 0
     n_shed = 0
+    n_hang_dumps = 0
 
     def _requeue(rid, prompt, exc, charge=True):
         """Put a failed request back on the queue for a bit-equal
@@ -424,6 +447,10 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
             # capacity shrank with the lost rank) and does NOT charge
             # the victims' retry budget.
             lost_peer = _peer_dead(exc)
+            # Snapshot the comm plane before touching anything: the flight
+            # dump captures the wedged op/link state as the failure left it.
+            if _flight_dump_best_effort():
+                n_hang_dumps += 1
             for b in range(n_slots):
                 if owner[b] >= 0:
                     rid = owner[b]
@@ -489,6 +516,7 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
         requeues=n_requeues,
         peer_requeues=n_peer_requeues,
         slots_shed=n_shed,
+        hang_dumps=n_hang_dumps,
         ttft_p50_s=_pct([r.ttft_s for r in per_request], 0.50),
         ttft_p99_s=_pct([r.ttft_s for r in per_request], 0.99),
         itl_p50_s=_pct(itl_samples, 0.50),

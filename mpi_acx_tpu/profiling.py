@@ -94,8 +94,15 @@ class StepTimer:
 
     def _pct(self, p: float) -> float:
         s = sorted(self.samples)
+        if not s:
+            return 0.0
         # Nearest-rank percentile: the ceil(p*n)-th smallest sample.
         return s[max(0, math.ceil(p * len(s)) - 1)]
+
+    def reset(self) -> None:
+        """Drop all recorded samples (e.g. after a warmup phase, so the
+        compile-step outlier doesn't poison the percentiles)."""
+        self.samples = []
 
     def summary(self) -> Dict[str, float]:
         if not self.samples:

@@ -95,6 +95,10 @@ def lib() -> ctypes.CDLL:
         _lib.acx_metrics_snapshot.argtypes = [ctypes.c_char_p, ctypes.c_int]
         _lib.acx_metrics_dump_json.restype = ctypes.c_int
         _lib.acx_metrics_dump_json.argtypes = [ctypes.c_char_p]
+        _lib.acx_flight_dump.restype = ctypes.c_int
+        _lib.acx_flight_dump.argtypes = [ctypes.c_char_p]
+        _lib.acx_flight_stats.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+        _lib.MPIX_Dump_state.restype = ctypes.c_int
     return _lib
 
 
@@ -433,6 +437,36 @@ class Runtime:
         """Write the registry snapshot to ``path`` as JSON."""
         if self._lib.acx_metrics_dump_json(path.encode()) != 0:
             raise RuntimeError(f"acx_metrics_dump_json({path!r}) failed")
+
+    # -- flight recorder ----------------------------------------------------
+
+    def hang_report(self, path: Optional[str] = None) -> str:
+        """Write this rank's flight dump — recent op-lifecycle events, live
+        slot table, per-peer link clocks — for tools/acx_doctor.py.
+
+        ``path`` is the file *prefix*; the dump lands at
+        ``<prefix>.rank<r>.flight.json`` (default prefix: $ACX_FLIGHT,
+        then "acx"). Returns the written filename."""
+        prefix = path if path is not None else os.environ.get(
+            "ACX_FLIGHT", "acx")
+        arg = path.encode() if path is not None else None
+        if self._lib.acx_flight_dump(arg) != 0:
+            raise RuntimeError(f"acx_flight_dump({path!r}) failed")
+        return f"{prefix}.rank{self.rank}.flight.json"
+
+    def flight_stats(self) -> dict:
+        """Flight-recorder counters: events recorded (lifetime), ring
+        capacity (0 = disabled via ACX_FLIGHT_EVENTS=0), stall warnings,
+        watchdog hang dumps, and dump files written."""
+        out = (ctypes.c_uint64 * 5)()
+        self._lib.acx_flight_stats(out)
+        return {
+            "recorded": out[0],
+            "capacity": out[1],
+            "stall_warns": out[2],
+            "hang_dumps": out[3],
+            "dumps_written": out[4],
+        }
 
     def finalize(self) -> None:
         if self._open:

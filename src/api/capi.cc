@@ -12,6 +12,7 @@
 
 #include "acx/api_internal.h"
 #include "acx/fault.h"
+#include "acx/flightrec.h"
 #include "acx/metrics.h"
 
 namespace acx {
@@ -169,6 +170,33 @@ int acx_drain(double timeout_ms) {
 }
 
 int MPIX_Drain(double timeout_ms) { return acx_drain(timeout_ms); }
+
+// ---- flight recorder -----------------------------------------------------
+
+// Writes this rank's flight dump to <prefix>.rank<r>.flight.json. A NULL
+// (or empty) prefix falls back to $ACX_FLIGHT, then "acx". Returns 0 on
+// success, -1 if the file could not be written. Safe at any time, including
+// before MPIX_Init (the dump just has empty slot/peer sections).
+int acx_flight_dump(const char* prefix) {
+  return acx::flight::Dump(prefix, "explicit");
+}
+
+// Fills out[5] = {recorded, capacity, stall_warns, hang_dumps,
+// dumps_written}. `recorded` is the lifetime event count (may exceed
+// `capacity`, the ring size; capacity == 0 means the recorder is disabled
+// via ACX_FLIGHT_EVENTS=0).
+void acx_flight_stats(uint64_t* out) {
+  const acx::flight::Stats s = acx::flight::stats();
+  out[0] = s.recorded;
+  out[1] = s.capacity;
+  out[2] = s.stall_warns;
+  out[3] = s.hang_dumps;
+  out[4] = s.dumps_written;
+}
+
+// MPIX-surface alias: dump runtime state (currently the flight recording)
+// for post-mortem analysis by tools/acx_doctor.py.
+int MPIX_Dump_state(void) { return acx_flight_dump(nullptr); }
 
 int MPIX_Set_deadline(double timeout_ms) {
   if (timeout_ms < 0) return 1;

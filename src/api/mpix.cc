@@ -25,6 +25,7 @@
 
 #include "acx/api_internal.h"
 #include "acx/debug.h"
+#include "acx/flightrec.h"
 #include "acx/metrics.h"
 #include "acx/trace.h"
 #include "acx/net.h"
@@ -146,6 +147,7 @@ int EnqueueSendRecv(bool is_send, void* buf, int count, MPI_Datatype datatype,
   auto trigger = [table, proxy, idx] {
     table->Store(idx, kPending);
     ACX_TRACE_EVENT("trigger_fired", idx);
+    ACX_FLIGHT(kTriggerFired, idx, -1, -1, 0, 0);
     if (metrics::Enabled()) metrics::MarkTrigger(idx);
     // Post the transfer inline if no one else is sweeping (saves the
     // proxy-thread handoff); Kick still wakes a parked proxy to poll the
@@ -173,6 +175,10 @@ int EnqueueSendRecv(bool is_send, void* buf, int count, MPI_Datatype datatype,
     return kErr;
   }
   ACX_TRACE_EVENT(is_send ? "isend_enqueue" : "irecv_enqueue", idx);
+  if (is_send)
+    ACX_FLIGHT(kIsendEnqueue, idx, peer, tag, op.bytes, 0);
+  else
+    ACX_FLIGHT(kIrecvEnqueue, idx, peer, tag, op.bytes, 0);
   *request = req;
   return MPI_SUCCESS;
 }
@@ -187,6 +193,7 @@ std::function<void()> MakeWaiter(int idx, MPI_Status* status,
   return [table, proxy, idx, status, graph_owned] {
     SpinUntil(table, proxy, idx, kCompleted);
     ACX_TRACE_EVENT("wait_observed", idx);
+    ACX_FLIGHT(kWaitObserved, idx, -1, -1, 0, 0);
     if (metrics::Enabled()) metrics::MarkWait(idx);
     CopyStatus(table->op(idx).status, status);
     if (!graph_owned) {
@@ -211,6 +218,7 @@ int EnqueueWait(MPIX_Request* reqp, MPI_Status* status, int qtype,
         g.table->Load(idx) == kCompleted) {
       // Fast path (reference try_complete_wait_op, sendrecv.cu:82-104):
       // already complete — consume inline, no queue hop.
+      ACX_FLIGHT(kWaitObserved, idx, -1, -1, 0, 0);
       if (metrics::Enabled()) metrics::MarkWait(idx);
       CopyStatus(g.table->op(idx).status, status);
       g.table->Store(idx, kCleanup);
@@ -253,6 +261,7 @@ int HostWaitBasic(MpixRequest* req, MPI_Status* status) {
   }
   SpinUntil(g.table, g.proxy, idx, kCompleted);
   ACX_TRACE_EVENT("wait_observed", idx);
+  ACX_FLIGHT(kWaitObserved, idx, -1, -1, 0, 0);
   if (metrics::Enabled()) metrics::MarkWait(idx);
   CopyStatus(g.table->op(idx).status, status);
   g.table->Store(idx, kCleanup);  // proxy frees request + ticket + slot
@@ -318,11 +327,22 @@ int PartitionedInit(bool is_send, void* buf, int partitions, MPI_Count count,
     op.kind = is_send ? OpKind::kPready : OpKind::kParrived;
     op.chan = chan;
     op.partition = p;
+    // Identity stamps so observability (flight dumps, stall reports) and
+    // drain error-typing can attribute the partition slot to its peer.
+    op.peer = peer;
+    op.tag = tag;
+    op.bytes = part_bytes;
     req->part_idx[p] = idx;
   }
   if (trace::Enabled()) {
     for (int p = 0; p < partitions; p++)
       trace::Emit(is_send ? "psend_slot" : "precv_slot", req->part_idx[p]);
+  }
+  for (int p = 0; p < partitions; p++) {
+    if (is_send)
+      ACX_FLIGHT(kPsendSlot, req->part_idx[p], peer, tag, part_bytes, p);
+    else
+      ACX_FLIGHT(kPrecvSlot, req->part_idx[p], peer, tag, part_bytes, p);
   }
   *request = req;
   return MPI_SUCCESS;
@@ -357,6 +377,9 @@ int MPIX_Init(void) {
   g.proxy = new Proxy(g.table, g.transport);
   g.proxy->Start();
   trace::SetRank(g.transport->rank());
+  flight::SetRank(g.transport->rank());
+  SetDebugRank(g.transport->rank());
+  ACX_FLIGHT(kInit, -1, g.transport->rank(), g.transport->size(), 0, 0);
   g.mpix_inited = true;
   ACX_DLOG("MPIX_Init: rank %d/%d, %zu flag slots", g.transport->rank(),
            g.transport->size(), nflags);
@@ -368,6 +391,7 @@ int MPIX_Finalize(void) {
   // Serialize against graph cleanup hooks (see ArmGraphCleanup).
   std::lock_guard<std::mutex> lk(g.lifecycle_mu);
   if (!g.mpix_inited) return kErr;
+  ACX_FLIGHT(kFinalize, -1, g.transport->rank(), g.transport->size(), 0, 0);
   // Leaked-slot diagnostics (reference init.cpp:262-266).
   size_t leaked = 0;
   for (size_t i = 0; i < g.table->size(); i++) {
@@ -490,9 +514,15 @@ int MPIX_Start(MPIX_Request* request) {
   if (req->kind == ReqKind::kPrecv) {
     // Receive partitions go straight to ISSUED so the proxy polls arrival
     // (reference partitioned.cu:133-136); send partitions stay RESERVED
-    // until Pready.
-    for (int p = 0; p < req->partitions; p++)
+    // until Pready. Re-arm the watchdog clock while we still own the slot
+    // (RESERVED): persistent requests reuse slots across rounds without
+    // Free/Reset, and the proxy must never write non-inflight slots.
+    for (int p = 0; p < req->partitions; p++) {
+      Op& op = g.table->op(req->part_idx[p]);
+      op.watch_since_ns = 0;
+      op.watch_stage = 0;
       g.table->Store(req->part_idx[p], kIssued);
+    }
     g.proxy->Kick();
   }
   req->started = true;
@@ -560,8 +590,20 @@ int MPIX_Pready(int partition, void* request) {
     return kErr;
   }
   if (partition < 0 || partition >= partitions) return kErr;
+  {
+    // Re-arm the watchdog clock before publishing (slot is RESERVED and
+    // app-owned here; see MPIX_Start for why the proxy can't do this).
+    Op& op = g.table->op(part_idx[partition]);
+    op.watch_since_ns = 0;
+    op.watch_stage = 0;
+  }
   g.table->Store(part_idx[partition], kPending);
   ACX_TRACE_EVENT("pready_marked", part_idx[partition]);
+  {
+    const Op& op = g.table->op(part_idx[partition]);
+    ACX_FLIGHT(kPreadyMark, part_idx[partition], op.peer, op.tag, 0,
+               partition);
+  }
   g.proxy->Kick();
   return MPI_SUCCESS;
 }

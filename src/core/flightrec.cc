@@ -1,0 +1,306 @@
+#include "acx/flightrec.h"
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "acx/api_internal.h"
+#include "acx/fault.h"
+#include "acx/state.h"
+#include "acx/trace.h"
+#include "acx/transport.h"
+
+// The ring is deliberately racy (torn records are tolerated diagnostics,
+// see acx/flightrec.h); teach TSAN builds not to flag the by-design races
+// in the writer and the dump reader.
+#if defined(__SANITIZE_THREAD__)
+#define ACX_NO_TSAN __attribute__((no_sanitize("thread")))
+#elif defined(__has_feature)
+#if __has_feature(thread_sanitizer)
+#define ACX_NO_TSAN __attribute__((no_sanitize("thread")))
+#else
+#define ACX_NO_TSAN
+#endif
+#else
+#define ACX_NO_TSAN
+#endif
+
+namespace acx {
+namespace flight {
+namespace {
+
+// Ring storage. Sized once from ACX_FLIGHT_EVENTS (rounded up to a power
+// of two so the index wrap is a mask, not a modulo). Writers bump `head`
+// with one relaxed fetch_add and then fill the claimed record with plain
+// stores — no locks, no fences. A dump that races a writer reads at most
+// one torn record per writer thread; the reader treats events as
+// diagnostic, not authoritative.
+struct Ring {
+  Event* buf = nullptr;
+  uint64_t mask = 0;
+  uint64_t cap = 0;
+  std::atomic<uint64_t> head{0};
+};
+
+Ring& ring() {
+  static Ring* r = [] {
+    Ring* r = new Ring;
+    uint64_t cap = 8192;
+    const char* e = std::getenv("ACX_FLIGHT_EVENTS");
+    if (e != nullptr) cap = strtoull(e, nullptr, 10);
+    if (cap > 0) {
+      uint64_t p2 = 1;
+      while (p2 < cap && p2 < (1ull << 24)) p2 <<= 1;
+      r->buf = static_cast<Event*>(std::calloc(p2, sizeof(Event)));
+      if (r->buf != nullptr) {
+        r->cap = p2;
+        r->mask = p2 - 1;
+      }
+    }
+    return r;
+  }();
+  return *r;
+}
+
+std::atomic<int> g_rank{-1};
+std::atomic<uint64_t> g_stall_warns{0};
+std::atomic<uint64_t> g_hang_dumps{0};
+std::atomic<uint64_t> g_dumps_written{0};
+
+int RankForDump() {
+  int r = g_rank.load(std::memory_order_relaxed);
+  if (r >= 0) return r;
+  const char* e = std::getenv("ACX_RANK");
+  return e != nullptr ? std::atoi(e) : 0;
+}
+
+uint64_t EnvMsToNs(const char* name, uint64_t def_ms) {
+  const char* e = std::getenv(name);
+  uint64_t ms = def_ms;
+  if (e != nullptr) ms = strtoull(e, nullptr, 10);
+  return ms * 1000000ull;
+}
+
+const char* kKindNames[] = {
+    "none",
+    "isend_enqueue", "irecv_enqueue", "trigger_fired", "isend_issued",
+    "irecv_issued", "op_completed", "wait_observed", "op_timeout",
+    "op_retry", "op_parked", "op_resumed", "op_drained", "slot_reclaimed",
+    "op_fault",
+    "psend_slot", "precv_slot", "pready_mark", "pready_wire", "parrived",
+    "tx_data", "tx_rts", "tx_ack", "tx_seqack", "tx_nak",
+    "rx_data", "rx_seqack", "rx_nak",
+    "link_recovering", "link_up", "peer_dead",
+    "barrier_enter", "barrier_exit", "stall_warn", "hang_dump",
+    "init", "finalize",
+};
+static_assert(sizeof(kKindNames) / sizeof(kKindNames[0]) == kKindCount,
+              "kind-name table out of sync with flight::Kind");
+
+const char* OpKindName(OpKind k) {
+  switch (k) {
+    case OpKind::kIsend: return "isend";
+    case OpKind::kIrecv: return "irecv";
+    case OpKind::kPready: return "pready";
+    case OpKind::kParrived: return "parrived";
+    default: return "none";
+  }
+}
+
+const char* HealthName(PeerHealth h) {
+  switch (h) {
+    case PeerHealth::kRecovering: return "recovering";
+    case PeerHealth::kDead: return "dead";
+    default: return "healthy";
+  }
+}
+
+// Fatal-signal flusher (registered with trace.cc's crash registry). Gated
+// on $ACX_FLIGHT being set: a crash dump to an implicit cwd path would
+// litter test runs that deliberately kill ranks; when the operator asked
+// for flight files by naming a prefix, the dying rank writes one.
+void DumpOnCrash() {
+  if (std::getenv("ACX_FLIGHT") != nullptr) Dump(nullptr, "fatal-signal");
+}
+
+}  // namespace
+
+const char* KindName(uint16_t k) {
+  return k < kKindCount ? kKindNames[k] : "unknown";
+}
+
+bool Enabled() {
+  static const bool on = [] {
+    const bool v = ring().cap > 0;
+    if (v) trace::RegisterCrashFlusher(DumpOnCrash, /*on_exit=*/false);
+    return v;
+  }();
+  return on;
+}
+
+ACX_NO_TSAN
+void Record(uint16_t kind, int32_t slot, int32_t peer, int32_t tag,
+            uint64_t seq, int16_t aux) {
+  Ring& r = ring();
+  if (r.cap == 0) return;
+  const uint64_t i = r.head.fetch_add(1, std::memory_order_relaxed) & r.mask;
+  Event& e = r.buf[i];
+  e.t_ns = NowNs();
+  e.seq = seq;
+  e.slot = slot;
+  e.peer = peer;
+  e.tag = tag;
+  e.kind = kind;
+  e.aux = aux;
+}
+
+void SetRank(int rank) {
+  g_rank.store(rank, std::memory_order_relaxed);
+  (void)Enabled();  // size the ring + arm the crash hook up front
+}
+
+uint64_t StallWarnNs() {
+  static const uint64_t ns = EnvMsToNs("ACX_STALL_WARN_MS", 10000);
+  return ns;
+}
+
+uint64_t HangDumpNs() {
+  static const uint64_t ns = EnvMsToNs("ACX_HANG_DUMP_MS", 30000);
+  return ns;
+}
+
+void NoteStallWarn() {
+  g_stall_warns.fetch_add(1, std::memory_order_relaxed);
+}
+
+void NoteHangDump() { g_hang_dumps.fetch_add(1, std::memory_order_relaxed); }
+
+Stats stats() {
+  Stats s;
+  Ring& r = ring();
+  s.recorded = r.head.load(std::memory_order_relaxed);
+  s.capacity = r.cap;
+  s.stall_warns = g_stall_warns.load(std::memory_order_relaxed);
+  s.hang_dumps = g_hang_dumps.load(std::memory_order_relaxed);
+  s.dumps_written = g_dumps_written.load(std::memory_order_relaxed);
+  return s;
+}
+
+ACX_NO_TSAN
+int Dump(const char* prefix, const char* reason) {
+  if (prefix == nullptr) prefix = std::getenv("ACX_FLIGHT");
+  if (prefix == nullptr || prefix[0] == '\0') prefix = "acx";
+  const int rank = RankForDump();
+  std::string fn = std::string(prefix) + ".rank" + std::to_string(rank) +
+                   ".flight.json";
+  FILE* f = std::fopen(fn.c_str(), "w");
+  if (f == nullptr) {
+    std::fprintf(stderr, "tpu-acx: flight: cannot write %s\n", fn.c_str());
+    return -1;
+  }
+  const uint64_t now = NowNs();
+  ApiState& g = GS();
+  const int size = g.transport != nullptr ? g.transport->size() : 0;
+
+  std::fprintf(f,
+               "{\"rank\":%d,\"size\":%d,\"reason\":\"%s\",\"now_ns\":%llu,\n",
+               rank, size, reason != nullptr ? reason : "explicit",
+               (unsigned long long)now);
+  std::fprintf(f,
+               "\"config\":{\"events_cap\":%llu,\"stall_warn_ms\":%llu,"
+               "\"hang_dump_ms\":%llu},\n",
+               (unsigned long long)ring().cap,
+               (unsigned long long)(StallWarnNs() / 1000000ull),
+               (unsigned long long)(HangDumpNs() / 1000000ull));
+  {
+    const Stats s = stats();
+    std::fprintf(f,
+                 "\"stats\":{\"recorded\":%llu,\"stall_warns\":%llu,"
+                 "\"hang_dumps\":%llu,\"dumps_written\":%llu},\n",
+                 (unsigned long long)s.recorded,
+                 (unsigned long long)s.stall_warns,
+                 (unsigned long long)s.hang_dumps,
+                 (unsigned long long)(s.dumps_written + 1));
+  }
+
+  // Live slot table: point-in-time, read racily (the proxy may transition
+  // slots mid-snapshot; a dump must never take its locks — this path runs
+  // from signal context). Every non-AVAILABLE slot below the watermark.
+  std::fprintf(f, "\"slots\":[");
+  bool first = true;
+  if (g.table != nullptr) {
+    const size_t wm = g.table->watermark();
+    for (size_t i = 0; i < wm; i++) {
+      const int32_t st = g.table->Load((int)i, std::memory_order_relaxed);
+      if (st == kAvailable) continue;
+      const Op& op = g.table->op((int)i);
+      const uint64_t since = op.watch_since_ns;
+      const double age_ms =
+          (since != 0 && now > since) ? (now - since) / 1e6 : 0.0;
+      std::fprintf(f,
+                   "%s\n {\"slot\":%zu,\"state\":\"%s\",\"kind\":\"%s\","
+                   "\"peer\":%d,\"tag\":%d,\"bytes\":%zu,\"partition\":%d,"
+                   "\"attempts\":%u,\"error\":%d,\"age_ms\":%.1f}",
+                   first ? "" : ",", i, FlagName(st), OpKindName(op.kind),
+                   op.peer, op.tag, op.bytes, op.partition, op.attempts,
+                   op.status.error, age_ms);
+      first = false;
+    }
+  }
+  std::fprintf(f, "],\n");
+
+  // Per-peer link clocks: health plus the wire's epoch/seq/ack counters
+  // (best-effort — the transport refuses to block for them).
+  std::fprintf(f, "\"peers\":[");
+  first = true;
+  if (g.transport != nullptr) {
+    const int self = g.transport->rank();
+    for (int r = 0; r < size; r++) {
+      if (r == self) continue;
+      const PeerHealth h = g.transport->peer_health(r);
+      LinkClock lc;
+      const bool have = g.transport->link_clock(r, &lc);
+      std::fprintf(f,
+                   "%s\n {\"rank\":%d,\"health\":\"%s\",\"have_clock\":%s,"
+                   "\"epoch\":%u,\"tx_seq\":%llu,\"rx_seq\":%llu,"
+                   "\"acked_rx\":%llu,\"replay_bytes\":%llu}",
+                   first ? "" : ",", r, HealthName(h),
+                   have ? "true" : "false", lc.epoch,
+                   (unsigned long long)lc.tx_seq,
+                   (unsigned long long)lc.rx_seq,
+                   (unsigned long long)lc.acked_rx,
+                   (unsigned long long)lc.replay_bytes);
+      first = false;
+    }
+  }
+  std::fprintf(f, "],\n");
+
+  // The ring, oldest-first. Snapshot the head once; records written after
+  // that by racing threads show up as at most one torn event each.
+  std::fprintf(f, "\"events\":[");
+  first = true;
+  {
+    Ring& r = ring();
+    const uint64_t head = r.head.load(std::memory_order_relaxed);
+    const uint64_t n = head < r.cap ? head : r.cap;
+    for (uint64_t k = 0; k < n; k++) {
+      const Event e = r.buf[(head - n + k) & r.mask];
+      std::fprintf(f,
+                   "%s\n {\"t_ns\":%llu,\"kind\":\"%s\",\"slot\":%d,"
+                   "\"peer\":%d,\"tag\":%d,\"seq\":%llu,\"aux\":%d}",
+                   first ? "" : ",", (unsigned long long)e.t_ns,
+                   KindName(e.kind), e.slot, e.peer, e.tag,
+                   (unsigned long long)e.seq, (int)e.aux);
+      first = false;
+    }
+  }
+  std::fprintf(f, "]}\n");
+  std::fclose(f);
+  g_dumps_written.fetch_add(1, std::memory_order_relaxed);
+  return 0;
+}
+
+}  // namespace flight
+}  // namespace acx

@@ -6,6 +6,7 @@
 
 #include "acx/debug.h"
 #include "acx/fault.h"
+#include "acx/flightrec.h"
 #include "acx/metrics.h"
 #include "acx/trace.h"
 
@@ -57,6 +58,10 @@ int Proxy::CancelInflight() {
         transport_->peer_health(op.peer) != PeerHealth::kHealthy)
       err = kErrPeerDead;
     op.status = Status{op.peer, op.tag, err, 0};
+    // Flight events that read op fields must be recorded BEFORE the release
+    // store of COMPLETED: once the waiter observes it, it may Free() the
+    // slot and Op::Reset() races with any later read of the op.
+    ACX_FLIGHT(kOpDrained, i, op.peer, op.tag, op.attempts, err);
     table_->Store(i, kCompleted);
     ACX_TRACE_EVENT("op_drained", i);
     if (metrics::Enabled()) metrics::MarkComplete(i);
@@ -118,11 +123,14 @@ bool Proxy::IssueOp(size_t i, Op& op, Stats& local, bool from_pending) {
       consult = false;
     } else {
       // Fresh trigger (first launch or graph re-fire): reset bookkeeping so
-      // a re-fired graph op gets a fresh deadline and retry budget.
+      // a re-fired graph op gets a fresh deadline and retry budget (and a
+      // fresh watchdog clock — a re-fire is not a stall).
       op.attempts = 0;
       op.deadline_ns = 0;
       op.retry_at_ns = 0;
       op.backoff_us = 0;
+      op.watch_since_ns = 0;
+      op.watch_stage = 0;
     }
   }
   if (consult && fault::Enabled()) {
@@ -136,9 +144,14 @@ bool Proxy::IssueOp(size_t i, Op& op, Stats& local, bool from_pending) {
         else
           op.retry_at_ns = NowNs() + delay_us * 1000;
         ACX_TRACE_EVENT("fault_delay", i);
+        ACX_FLIGHT(kOpFault, i, op.peer, op.tag, op.attempts,
+                   (int16_t)fault::Action::kDelay);
         return true;
       case fault::Action::kFail:
         op.status = Status{op.peer, op.tag, err, 0};
+        ACX_FLIGHT(kOpFault, i, op.peer, op.tag, op.attempts,
+                   (int16_t)fault::Action::kFail);
+        ACX_FLIGHT(kOpCompleted, i, op.peer, op.tag, op.attempts, err);
         table_->Store(i, kCompleted);
         ACX_TRACE_EVENT("fault_fail", i);
         if (metrics::Enabled()) metrics::MarkComplete(i);
@@ -155,6 +168,8 @@ bool Proxy::IssueOp(size_t i, Op& op, Stats& local, bool from_pending) {
         op.ticket = nullptr;
         if (from_pending) table_->Store(i, kIssued);
         ACX_TRACE_EVENT("fault_drop", i);
+        ACX_FLIGHT(kOpFault, i, op.peer, op.tag, op.attempts,
+                   (int16_t)fault::Action::kDrop);
         return true;
       default:
         break;
@@ -171,6 +186,7 @@ bool Proxy::IssueOp(size_t i, Op& op, Stats& local, bool from_pending) {
     op.ticket = transport_->Isend(op.sbuf, op.bytes, op.peer, op.tag, op.ctx);
     if (from_pending) table_->Store(i, kIssued);
     ACX_TRACE_EVENT("isend_issued", i);
+    ACX_FLIGHT(kIsendIssued, i, op.peer, op.tag, op.attempts, op.partition);
     if (metrics::Enabled()) metrics::MarkIssue(i, true, op.bytes);
   } else {
     ACX_DLOG("slot %zu: irecv %zuB <- peer %d tag %d", i, op.bytes, op.peer,
@@ -178,6 +194,7 @@ bool Proxy::IssueOp(size_t i, Op& op, Stats& local, bool from_pending) {
     op.ticket = transport_->Irecv(op.rbuf, op.bytes, op.peer, op.tag, op.ctx);
     if (from_pending) table_->Store(i, kIssued);
     ACX_TRACE_EVENT("irecv_issued", i);
+    ACX_FLIGHT(kIrecvIssued, i, op.peer, op.tag, op.attempts, op.partition);
     if (metrics::Enabled()) metrics::MarkIssue(i, false, op.bytes);
   }
   local.ops_issued++;
@@ -192,6 +209,7 @@ bool Proxy::CheckStalled(size_t i, Op& op, Stats& local) {
   const uint64_t now = NowNs();
   if (op.deadline_ns != 0 && now >= op.deadline_ns) {
     op.status = Status{op.peer, op.tag, kErrTimeout, 0};
+    ACX_FLIGHT(kOpTimeout, i, op.peer, op.tag, op.attempts, kErrTimeout);
     table_->Store(i, kCompleted);
     ACX_TRACE_EVENT("op_timeout", i);
     if (metrics::Enabled()) metrics::MarkComplete(i);
@@ -205,6 +223,7 @@ bool Proxy::CheckStalled(size_t i, Op& op, Stats& local) {
   if (!unposted || now < op.retry_at_ns) return false;
   if (op.attempts > Policy().max_retries.load(std::memory_order_relaxed)) {
     op.status = Status{op.peer, op.tag, kErrTimeout, 0};
+    ACX_FLIGHT(kOpTimeout, i, op.peer, op.tag, op.attempts, kErrTimeout);
     table_->Store(i, kCompleted);
     ACX_TRACE_EVENT("op_timeout", i);
     if (metrics::Enabled()) metrics::MarkComplete(i);
@@ -214,6 +233,7 @@ bool Proxy::CheckStalled(size_t i, Op& op, Stats& local) {
   }
   local.retries++;
   ACX_TRACE_EVENT("op_retry", i);
+  ACX_FLIGHT(kOpRetry, i, op.peer, op.tag, op.attempts, 0);
   return IssueOp(i, op, local, false);
 }
 
@@ -239,6 +259,7 @@ bool Proxy::Sweep() {
             // Send-side partition became ready (host call or device-mirrored
             // flag write): push it to the wire and complete the slot.
             op.chan->Pready(op.partition);
+            ACX_FLIGHT(kPreadyWire, i, op.peer, op.tag, 0, op.partition);
             table_->Store(i, kCompleted);
             ACX_TRACE_EVENT("pready_wire", i);
             if (metrics::Enabled())
@@ -262,6 +283,8 @@ bool Proxy::Sweep() {
             // any thread that acquires COMPLETED sees a coherent status (the
             // reference needed a mutex here; see its init.cpp:119-141).
             if (op.ticket != nullptr && op.ticket->Test(&op.status)) {
+              ACX_FLIGHT(kOpCompleted, i, op.peer, op.tag, op.attempts,
+                         op.status.error);
               table_->Store(i, kCompleted);
               ACX_TRACE_EVENT("op_completed", i);
               if (metrics::Enabled()) metrics::MarkComplete(i);
@@ -276,6 +299,7 @@ bool Proxy::Sweep() {
               op.parked_at_ns = NowNs();
               table_->Store(i, kRecovering);
               ACX_TRACE_EVENT("op_parked", i);
+              ACX_FLIGHT(kOpParked, i, op.peer, op.tag, op.attempts, 0);
               progressed = true;
             } else if (CheckStalled(i, op, local)) {
               progressed = true;
@@ -284,6 +308,7 @@ bool Proxy::Sweep() {
           }
           case OpKind::kParrived: {
             if (op.chan->Parrived(op.partition)) {
+              ACX_FLIGHT(kParrived, i, op.peer, op.tag, 0, op.partition);
               table_->Store(i, kCompleted);
               ACX_TRACE_EVENT("parrived", i);
               if (metrics::Enabled())
@@ -303,6 +328,8 @@ bool Proxy::Sweep() {
         // can complete the op mid-recovery, and a failed recovery completes
         // the ticket with kErrPeerDead — both surface here.
         if (op.ticket != nullptr && op.ticket->Test(&op.status)) {
+          ACX_FLIGHT(kOpCompleted, i, op.peer, op.tag, op.attempts,
+                     op.status.error);
           table_->Store(i, kCompleted);
           ACX_TRACE_EVENT("op_completed", i);
           if (metrics::Enabled()) metrics::MarkComplete(i);
@@ -317,6 +344,7 @@ bool Proxy::Sweep() {
           op.parked_at_ns = 0;
           table_->Store(i, kIssued);
           ACX_TRACE_EVENT("op_resumed", i);
+          ACX_FLIGHT(kOpResumed, i, op.peer, op.tag, op.attempts, 0);
           progressed = true;
         }
         break;
@@ -328,6 +356,7 @@ bool Proxy::Sweep() {
         op.owner = nullptr;
         table_->Free(static_cast<int>(i));
         ACX_TRACE_EVENT("slot_reclaimed", i);
+        ACX_FLIGHT(kSlotReclaimed, i, -1, -1, 0, 0);
         local.slots_reclaimed++;
         progressed = true;
         break;
@@ -344,11 +373,88 @@ bool Proxy::Sweep() {
   return progressed;
 }
 
+bool Proxy::WatchdogScan(uint64_t now) {
+  const uint64_t warn_ns = flight::StallWarnNs();
+  const uint64_t dump_ns = flight::HangDumpNs();
+  bool do_dump = false;
+  const size_t n = table_->watermark();
+  for (size_t i = 0; i < n; i++) {
+    const int32_t f = table_->Load(i);
+    Op& op = table_->op(i);
+    if (f != kPending && f != kIssued && f != kRecovering) {
+      // Not in flight: hands off. Writing the watch fields here would race
+      // with Op::Reset() on Free from the consuming thread. Slots freed
+      // through Free() come back zeroed; persistent partitioned slots are
+      // re-armed by MPIX_Start / MPIX_Pready while the app thread owns them.
+      continue;
+    }
+    if (op.watch_since_ns == 0) {
+      op.watch_since_ns = now;
+      op.watch_stage = 0;
+      continue;
+    }
+    const uint64_t age = now - op.watch_since_ns;
+    if (op.watch_stage == 0 && warn_ns != 0 && age >= warn_ns) {
+      op.watch_stage = 1;
+      flight::NoteStallWarn();
+      ACX_FLIGHT(kStallWarn, i, op.peer, op.tag, op.attempts,
+                 op.partition);
+      // Structured one-line stall report: enough to attribute the wait
+      // without a dump — slot identity, peer link clocks, replay state.
+      const PeerHealth h = op.peer >= 0 ? transport_->peer_health(op.peer)
+                                        : PeerHealth::kHealthy;
+      LinkClock lc;
+      const bool have_lc =
+          op.peer >= 0 && transport_->link_clock(op.peer, &lc);
+      std::fprintf(
+          stderr,
+          "tpu-acx: stall: rank=%d slot=%zu state=%s kind=%d peer=%d "
+          "tag=%d part=%d age_ms=%llu attempts=%u peer_health=%d "
+          "epoch=%u tx_seq=%llu rx_seq=%llu acked_rx=%llu "
+          "replay_bytes=%llu (warn at ACX_STALL_WARN_MS=%llu)\n",
+          transport_->rank(), i, FlagName(f), (int)op.kind, op.peer,
+          op.tag, op.partition, (unsigned long long)(age / 1000000ull),
+          op.attempts, (int)h, have_lc ? lc.epoch : 0,
+          (unsigned long long)(have_lc ? lc.tx_seq : 0),
+          (unsigned long long)(have_lc ? lc.rx_seq : 0),
+          (unsigned long long)(have_lc ? lc.acked_rx : 0),
+          (unsigned long long)(have_lc ? lc.replay_bytes : 0),
+          (unsigned long long)(warn_ns / 1000000ull));
+    }
+    if (op.watch_stage <= 1 && dump_ns != 0 && age >= dump_ns) {
+      op.watch_stage = 2;
+      flight::NoteHangDump();
+      ACX_FLIGHT(kHangDump, i, op.peer, op.tag, op.attempts, op.partition);
+      do_dump = true;
+    }
+  }
+  return do_dump;
+}
+
 void Proxy::Run() {
   // Backoff ladder: spin a few sweeps, then yield, then sleep with
   // exponential growth capped at 200us; park on the condvar when the table
   // is fully idle. Kick() wakes us immediately in all cases.
   int idle_sweeps = 0;
+  // Stall watchdog cadence: thresholds are env-latched once; when armed,
+  // the clock is read only every 64 loop iterations (the sweep itself must
+  // not pay a clock read per pass) and the scan runs at quarter-threshold
+  // granularity, clamped to [10ms, 1s].
+  const uint64_t wd_warn = flight::StallWarnNs();
+  const uint64_t wd_dump = flight::HangDumpNs();
+  const bool wd_armed =
+      (wd_warn != 0 || wd_dump != 0) && flight::Enabled();
+  uint64_t wd_interval = 0;
+  if (wd_armed) {
+    uint64_t base = wd_warn != 0 && (wd_dump == 0 || wd_warn < wd_dump)
+                        ? wd_warn
+                        : wd_dump;
+    wd_interval = base / 4;
+    if (wd_interval < 10000000ull) wd_interval = 10000000ull;
+    if (wd_interval > 1000000000ull) wd_interval = 1000000000ull;
+  }
+  uint64_t wd_next = wd_armed ? NowNs() + wd_interval : 0;
+  unsigned wd_tick = 0;
   // Busy/idle split for the metrics plane ("proxy idle fraction"): clocks
   // are only read when ACX_METRICS is armed.
   const bool mx = metrics::Enabled();
@@ -366,6 +472,31 @@ void Proxy::Run() {
       metrics::Observe(metrics::kProxySweepNs, dt);
     }
     sweeps_.fetch_add(1, std::memory_order_relaxed);
+    // Watchdog: cheap modular tick so the hot sweep loop reads the clock
+    // at most once per 64 iterations; the slow idle branches below nap
+    // long enough that 64 ticks still bounds detection latency well under
+    // any sane threshold.
+    if (wd_armed && (++wd_tick & 63u) == 0) {
+      const uint64_t now = NowNs();
+      if (now >= wd_next) {
+        wd_next = now + wd_interval;
+        bool do_dump;
+        {
+          std::lock_guard<std::mutex> lk(sweep_mu_);
+          do_dump = WatchdogScan(now);
+        }
+        if (do_dump) {
+          // Dump outside sweep_mu_: Dump reads the table racily by design
+          // and must never extend the lock hold time of the hot path.
+          std::fprintf(stderr,
+                       "tpu-acx: hang: rank=%d in-flight op(s) exceeded "
+                       "ACX_HANG_DUMP_MS=%llu — writing flight dump\n",
+                       transport_->rank(),
+                       (unsigned long long)(wd_dump / 1000000ull));
+          flight::Dump(nullptr, "watchdog");
+        }
+      }
+    }
     if (progressed) {
       idle_sweeps = 0;
       continue;

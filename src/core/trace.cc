@@ -93,16 +93,34 @@ void FlushBestEffort() {
   WriteFile(events, dropped, RankForFlush());
 }
 
+// Crash-flush registry shared with the flight recorder (acx/flightrec.h):
+// one set of signal/atexit hooks, N best-effort flushers. Small fixed
+// array — registration happens a handful of times at startup, the signal
+// path just walks it.
+constexpr int kMaxFlushers = 4;
+void (*g_flushers[kMaxFlushers])() = {};
+bool g_flusher_on_exit[kMaxFlushers] = {};
+std::atomic<int> g_nflushers{0};
+
+void RunFlushersAtExit() {
+  const int n = g_nflushers.load(std::memory_order_acquire);
+  for (int i = 0; i < n; i++)
+    if (g_flusher_on_exit[i]) g_flushers[i]();
+}
+
 void OnFatalSignal(int sig) {
-  // One flusher only; fopen/fprintf are not async-signal-safe, but a
-  // best-effort trace of a dying rank beats a guaranteed empty one.
-  if (!g_flushing.exchange(true)) FlushBestEffort();
+  // One flushing pass only; fopen/fprintf are not async-signal-safe, but a
+  // best-effort black box from a dying rank beats a guaranteed empty one.
+  if (!g_flushing.exchange(true)) {
+    const int n = g_nflushers.load(std::memory_order_acquire);
+    for (int i = 0; i < n; i++) g_flushers[i]();
+  }
   std::signal(sig, SIG_DFL);
   std::raise(sig);
 }
 
 void InstallCrashHooks() {
-  std::atexit(FlushBestEffort);
+  std::atexit(RunFlushersAtExit);
   const int sigs[] = {SIGTERM, SIGINT, SIGABRT, SIGSEGV, SIGBUS};
   for (int sig : sigs) {
     // Only claim default dispositions — never stomp a runtime's (e.g.
@@ -244,10 +262,24 @@ void WriteFile(const std::vector<Event>& events, uint64_t dropped, int rank) {
 bool Enabled() {
   static const bool on = [] {
     const bool v = path() != nullptr && path()[0] != '\0';
-    if (v) InstallCrashHooks();
+    if (v) RegisterCrashFlusher(FlushBestEffort, /*on_exit=*/true);
     return v;
   }();
   return on;
+}
+
+void RegisterCrashFlusher(void (*fn)(), bool on_exit) {
+  static std::once_flag once;
+  std::call_once(once, InstallCrashHooks);
+  static std::mutex mu;
+  std::lock_guard<std::mutex> lk(mu);
+  const int n = g_nflushers.load(std::memory_order_relaxed);
+  if (n >= kMaxFlushers) return;
+  for (int i = 0; i < n; i++)
+    if (g_flushers[i] == fn) return;  // idempotent
+  g_flushers[n] = fn;
+  g_flusher_on_exit[n] = on_exit;
+  g_nflushers.store(n + 1, std::memory_order_release);
 }
 
 void Emit(const char* name, int64_t slot) {

@@ -46,6 +46,7 @@
 #include <vector>
 
 #include "acx/fault.h"
+#include "acx/flightrec.h"
 #include "acx/trace.h"
 #include "src/net/link.h"
 #include "src/net/wire.h"
@@ -399,6 +400,26 @@ class StreamTransport : public Transport {
     if (peer_dead_[r]) return PeerHealth::kDead;
     return peers_[r].health != 0 ? PeerHealth::kRecovering
                                  : PeerHealth::kHealthy;
+  }
+
+  bool link_clock(int r, LinkClock* out) override {
+    if (r < 0 || r >= size_ || r == rank_) return false;
+    // Best-effort contract (acx/transport.h): callers include the stall
+    // watchdog and the flight-recorder dump path, which may run from a
+    // fatal-signal handler — never block on mu_, just try a few times.
+    std::unique_lock<std::mutex> lk(mu_, std::try_to_lock);
+    for (int i = 0; i < 4 && !lk.owns_lock(); i++) {
+      sched_yield();
+      (void)lk.try_lock();
+    }
+    if (!lk.owns_lock()) return false;
+    const Peer& p = peers_[r];
+    out->epoch = p.epoch;
+    out->tx_seq = p.tx_seq;
+    out->rx_seq = p.rx_seq;
+    out->acked_rx = p.acked_rx;
+    out->replay_bytes = p.replay_bytes;
+    return true;
   }
 
   // Called from SockTicket::Test.
@@ -839,6 +860,30 @@ class StreamTransport : public Transport {
       } else if (recovery_armed_ && wire::Sequenced(s->hdr.magic)) {
         RecordFrameLocked(p, s.get());
       }
+      // Flight-record the frame at its full-write point — the moment it is
+      // irrevocably on the wire (raw replays are already counted in
+      // frames_replayed_; heartbeats/hellos are protocol noise).
+      if (!s->raw) {
+        switch (s->hdr.magic) {
+          case kMagic:
+            ACX_FLIGHT(kTxData, -1, p, s->hdr.tag, s->hdr.seq, 0);
+            break;
+          case kMagicRts:
+            ACX_FLIGHT(kTxRts, -1, p, s->hdr.tag, s->hdr.seq, 0);
+            break;
+          case kMagicAck:
+            ACX_FLIGHT(kTxAck, -1, p, s->hdr.tag, s->hdr.seq, 0);
+            break;
+          case kMagicSeqAck:
+            ACX_FLIGHT(kTxSeqAck, -1, p, -1, s->hdr.seq, 0);
+            break;
+          case kMagicNak:
+            ACX_FLIGHT(kTxNak, -1, p, -1, s->hdr.seq, 0);
+            break;
+          default:
+            break;
+        }
+      }
       if (!s->rv) {
         // Rendezvous sends stay pending (and keep borrowing the user
         // buffer) until the receiver's ACK arrives.
@@ -866,6 +911,7 @@ class StreamTransport : public Transport {
   void BumpRxLocked(int p, uint64_t seq) {
     Peer& peer = peers_[p];
     peer.rx_seq = seq;
+    ACX_FLIGHT(kRxData, -1, p, -1, seq, 0);
     if (++peer.rx_since_ack >= 16) SendSeqAckLocked(p);
   }
 
@@ -908,11 +954,13 @@ class StreamTransport : public Transport {
           continue;
         }
         if (in.hdr.magic == kMagicSeqAck) {
+          ACX_FLIGHT(kRxSeqAck, -1, p, -1, in.hdr.seq, 0);
           HandleSeqAckLocked(p, in.hdr.seq);
           in.hdr_got = 0;
           continue;
         }
         if (in.hdr.magic == kMagicNak) {
+          ACX_FLIGHT(kRxNak, -1, p, -1, in.hdr.seq, 0);
           HandleNakLocked(p, in.hdr.seq);
           in.hdr_got = 0;
           continue;
@@ -1155,6 +1203,7 @@ class StreamTransport : public Transport {
     peer_dead_[p] = true;
     peers_dead_n_.fetch_add(1, std::memory_order_relaxed);
     ACX_TRACE_EVENT("peer_dead", static_cast<size_t>(p));
+    ACX_FLIGHT(kPeerDead, -1, p, -1, peers_[p].rx_seq, peers_[p].epoch);
     uint64_t failed = 0;
     Peer& peer = peers_[p];
     if (peer.health == 1) {
@@ -1278,6 +1327,7 @@ class StreamTransport : public Transport {
     else
       peer.rec_deadline_ns = now + AcceptDeadlineNs();
     ACX_TRACE_EVENT("link_recovering", static_cast<size_t>(p));
+    ACX_FLIGHT(kLinkRecovering, -1, p, -1, peer.rx_seq, peer.epoch);
     std::fprintf(stderr,
                  "tpu-acx[%d]: link to %d lost (%s); attempting reconnect\n",
                  rank_, p, why);
@@ -1458,6 +1508,7 @@ class StreamTransport : public Transport {
     last_rx_ns_[p] = NowNs();
     reconnects_.fetch_add(1, std::memory_order_relaxed);
     ACX_TRACE_EVENT("link_reconnected", static_cast<size_t>(p));
+    ACX_FLIGHT(kLinkUp, -1, p, -1, peer.rx_seq, agreed);
     std::fprintf(stderr,
                  "tpu-acx[%d]: link to %d re-established (epoch %u, "
                  "replaying %llu frame(s))\n",

@@ -13,6 +13,7 @@
 #include <sched.h>
 
 #include "acx/api_internal.h"
+#include "acx/flightrec.h"
 #include "acx/net.h"
 #include "acx/trace.h"
 #include "compat/mpi.h"
@@ -28,8 +29,9 @@ void EnsureTransport() {
   ApiState& g = GS();
   if (g.transport == nullptr) {
     g.transport = CreateTransportFromEnv();
-    // Crash-path trace flushes need the rank as early as possible.
+    // Crash-path trace/flight flushes need the rank as early as possible.
     trace::SetRank(g.transport->rank());
+    flight::SetRank(g.transport->rank());
   }
 }
 
@@ -125,8 +127,10 @@ int MPI_Barrier(MPI_Comm comm) {
   // tools/acx_trace_merge.py aligns per-rank steady clocks on: every rank
   // leaves the same barrier at (nearly) the same wall instant.
   ACX_TRACE_EVENT("barrier_enter", -1);
+  ACX_FLIGHT(kBarrierEnter, -1, -1, comm, 0, 0);
   GS().transport->Barrier(comm);
   ACX_TRACE_EVENT("barrier_exit", -1);
+  ACX_FLIGHT(kBarrierExit, -1, -1, comm, 0, 0);
   return MPI_SUCCESS;
 }
 

@@ -1,0 +1,207 @@
+"""Cross-rank hang doctor (tools/acx_doctor.py): pair-matching of stuck
+operations across per-rank flight dumps and the culprit diagnosis.
+
+These tests feed the doctor *synthetic* two-rank dumps — the shape
+src/core/flightrec.cc writes, boiled down to the fields the matcher keys
+on — so each anomaly is exercised in isolation without spinning up real
+ranks. The end-to-end path (real watchdog trip under acxrun, real dump
+files) is covered by `make doctor-check` / itests/hang-doctor.c.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _doctor():
+    spec = importlib.util.spec_from_file_location(
+        "acx_doctor", os.path.join(REPO, "tools", "acx_doctor.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+doctor = _doctor()
+
+
+def _dump(rank, size=2, slots=(), peers=(), events=(), reason="watchdog"):
+    """A minimal flight dump of the documented shape."""
+    return {
+        "rank": rank,
+        "size": size,
+        "reason": reason,
+        "now_ns": 5_000_000_000,
+        "config": {"events_cap": 8192, "stall_warn_ms": 150,
+                   "hang_dump_ms": 400},
+        "stats": {"recorded": len(events), "stall_warns": 1,
+                  "hang_dumps": 1, "dumps_written": 1},
+        "slots": list(slots),
+        "peers": list(peers),
+        "events": list(events),
+    }
+
+
+def _slot(slot, state, kind, peer, tag, partition=-1, age_ms=500.0):
+    return {"slot": slot, "state": state, "kind": kind, "peer": peer,
+            "tag": tag, "bytes": 16, "partition": partition,
+            "attempts": 1, "error": 0, "age_ms": age_ms}
+
+
+def _event(kind, slot=-1, peer=-1, tag=-1, seq=0, aux=0):
+    return {"t_ns": 1_000_000, "kind": kind, "slot": slot, "peer": peer,
+            "tag": tag, "seq": seq, "aux": aux}
+
+
+def test_unmatched_send_blames_missing_receiver():
+    # Rank 0 sends tag 5 to rank 1; rank 1 never posted any recv for it.
+    dumps = {
+        0: _dump(0, slots=[_slot(3, "ISSUED", "isend", peer=1, tag=5)],
+                 events=[_event("isend_enqueue", 3, 1, 5)]),
+        1: _dump(1, events=[_event("init", -1, 1)]),
+    }
+    diag = doctor.diagnose(dumps)
+    assert diag["anomaly"] == "unmatched_send"
+    assert diag["culprit"] == 1
+    assert any("rank 0 waits on rank 1" in w for w in diag["waits"])
+
+
+def test_posted_recv_is_not_unmatched():
+    # Same stuck send, but rank 1 DID post the matching recv (it's just
+    # late-matching) — that is a slow run, not an anomaly.
+    dumps = {
+        0: _dump(0, slots=[_slot(3, "ISSUED", "isend", peer=1, tag=5)]),
+        1: _dump(1, slots=[_slot(0, "ISSUED", "irecv", peer=0, tag=5)]),
+    }
+    assert doctor.diagnose(dumps)["anomaly"] == "none"
+
+
+def test_never_published_partition_blames_sender():
+    # Rank 1 polls partition 1 from rank 0; rank 0 holds the matching
+    # send partition RESERVED with no pready_mark in its history.
+    dumps = {
+        0: _dump(0, slots=[
+            _slot(0, "RESERVED", "pready", peer=1, tag=0, partition=0),
+            _slot(1, "RESERVED", "pready", peer=1, tag=0, partition=1),
+        ], events=[
+            _event("psend_slot", 0, 1, 0, aux=0),
+            _event("psend_slot", 1, 1, 0, aux=1),
+            _event("pready_mark", 0, 1, 0, aux=0),
+        ]),
+        1: _dump(1, slots=[
+            _slot(1, "ISSUED", "parrived", peer=0, tag=0, partition=1),
+        ]),
+    }
+    diag = doctor.diagnose(dumps)
+    assert diag["anomaly"] == "never_published_partition"
+    assert diag["culprit"] == 0
+    assert "partition 1" in diag["detail"]
+
+
+def test_published_partition_is_not_an_anomaly():
+    # The sender DID publish partition 1 — data is merely in flight.
+    dumps = {
+        0: _dump(0, events=[_event("pready_mark", 1, 1, 0, aux=1)]),
+        1: _dump(1, slots=[
+            _slot(1, "ISSUED", "parrived", peer=0, tag=0, partition=1),
+        ]),
+    }
+    assert doctor.diagnose(dumps)["anomaly"] == "none"
+
+
+def test_unmatched_recv_blames_silent_sender():
+    dumps = {
+        0: _dump(0, slots=[_slot(2, "ISSUED", "irecv", peer=1, tag=9)]),
+        1: _dump(1, events=[_event("init", -1, 1)]),
+    }
+    diag = doctor.diagnose(dumps)
+    assert diag["anomaly"] == "unmatched_recv"
+    assert diag["culprit"] == 1
+
+
+def test_tag_mismatch_beats_unmatched():
+    # Both sides stuck on each other with different tags: diagnose the
+    # tag mismatch, not two separate unmatched ops.
+    dumps = {
+        0: _dump(0, slots=[_slot(3, "ISSUED", "isend", peer=1, tag=5)]),
+        1: _dump(1, slots=[_slot(0, "ISSUED", "irecv", peer=0, tag=6)]),
+    }
+    diag = doctor.diagnose(dumps)
+    assert diag["anomaly"] == "tag_mismatch"
+    assert "tag=5" in diag["detail"] and "tag=6" in diag["detail"]
+
+
+def test_dead_link_outranks_everything():
+    dumps = {
+        0: _dump(0,
+                 slots=[_slot(3, "ISSUED", "isend", peer=1, tag=5)],
+                 peers=[{"rank": 1, "health": "dead", "have_clock": True,
+                         "epoch": 2, "tx_seq": 10, "rx_seq": 4,
+                         "acked_rx": 4, "replay_bytes": 0}]),
+        1: _dump(1, events=[_event("init", -1, 1)]),
+    }
+    diag = doctor.diagnose(dumps)
+    assert diag["anomaly"] == "dead_link"
+    assert diag["culprit"] == 1
+
+
+def test_barrier_skew_blames_straggler():
+    # Ranks 0 and 1 sit inside barrier 2; rank 2 only ever entered one.
+    in_barrier = [_event("barrier_enter"), _event("barrier_exit"),
+                  _event("barrier_enter")]
+    dumps = {
+        0: _dump(0, size=3, events=in_barrier),
+        1: _dump(1, size=3, events=in_barrier),
+        2: _dump(2, size=3,
+                 events=[_event("barrier_enter"), _event("barrier_exit")]),
+    }
+    diag = doctor.diagnose(dumps)
+    assert diag["anomaly"] == "barrier_skew"
+    assert diag["culprit"] == 2
+
+
+def test_clean_run_reports_no_anomaly():
+    dumps = {
+        0: _dump(0, reason="explicit", events=[
+            _event("init", -1, 0), _event("isend_enqueue", 0, 1, 0),
+            _event("op_completed", 0, 1, 0), _event("finalize", -1, 0),
+        ]),
+        1: _dump(1, reason="explicit", events=[
+            _event("init", -1, 1), _event("irecv_enqueue", 0, 0, 0),
+            _event("op_completed", 0, 0, 0), _event("finalize", -1, 1),
+        ]),
+    }
+    diag = doctor.diagnose(dumps)
+    assert diag["anomaly"] == "none"
+    assert diag["culprit"] is None
+    assert diag["waits"] == []
+
+
+def test_cli_expectation_oracle(tmp_path, capsys):
+    # The CLI is the `make doctor-check` oracle: exit 0 iff the diagnosis
+    # matches the --expect-* flags.
+    files = []
+    d0 = _dump(0, slots=[_slot(3, "ISSUED", "isend", peer=1, tag=5)],
+               events=[_event("isend_enqueue", 3, 1, 5)])
+    d1 = _dump(1, events=[_event("init", -1, 1)])
+    for d in (d0, d1):
+        p = tmp_path / f"hang.rank{d['rank']}.flight.json"
+        p.write_text(json.dumps(d))
+        files.append(str(p))
+    assert doctor.main(["--expect-anomaly", "unmatched_send",
+                        "--expect-culprit", "1"] + files) == 0
+    out = capsys.readouterr().out
+    assert "culprit: rank 1" in out
+    assert doctor.main(["--expect-anomaly", "dead_link"] + files) == 1
+    assert doctor.main(["--expect-culprit", "0"] + files) == 1
+
+
+def test_cli_json_mode(tmp_path, capsys):
+    p = tmp_path / "hang.rank0.flight.json"
+    p.write_text(json.dumps(_dump(0, reason="explicit")))
+    assert doctor.main(["--json", str(p)]) == 0
+    diag = json.loads(capsys.readouterr().out)
+    assert diag["anomaly"] == "none"

@@ -1,0 +1,313 @@
+#!/usr/bin/env python3
+"""Cross-rank hang doctor: merge per-rank flight-recorder dumps and say
+who waits on whom — and which rank is the culprit.
+
+Each rank writes ``<prefix>.rank<r>.flight.json`` (src/core/flightrec.cc)
+when its stall watchdog trips (ACX_HANG_DUMP_MS), on a fatal signal, or on
+an explicit ``MPIX_Dump_state`` / ``Runtime.hang_report()`` call. One dump
+shows a rank stuck; only the merged view shows *why*: rank 1's parrived
+poll on partition 3 is hopeless because rank 0 reserved that partition and
+never published it, rank 2's irecv of tag 7 waits on a send rank 3 never
+made, rank 0 sits in a barrier rank 2 never entered.
+
+This tool pairs the stuck operations across ranks — sends with recvs by
+(src, dst, tag), partitioned channels by partition index, barriers by
+entry count — and prints a diagnosis naming one of:
+
+    dead_link                  a peer was declared dead (heartbeat loss)
+    never_published_partition  recv side polls a partition the send side
+                               reserved but never MPIX_Pready'd
+    tag_mismatch               both sides stuck on each other with
+                               different tags
+    unmatched_send             a send in flight toward a rank that never
+                               posted a matching recv
+    unmatched_recv             a recv posted for a message the source
+                               never sent
+    barrier_skew               some ranks entered a barrier another rank
+                               never reached
+    none                       no anomaly detected
+
+The culprit is the rank whose *missing* action would unblock the job: the
+sender that never published the partition, the rank that never posted the
+recv / never sent, the rank missing from the barrier. When several
+anomalies coexist the most causal one wins (a dead link explains stuck
+ops; a never-published partition explains a stuck parrived poll), in the
+priority order listed above.
+
+Usage:
+    python3 tools/acx_doctor.py [--json] [--expect-culprit N]
+        [--expect-anomaly NAME] hang.rank0.flight.json hang.rank1...
+
+``--expect-*`` flags make the tool a test oracle: exit 0 iff the
+diagnosis matches (itests/hang-doctor.c + `make doctor-check`).
+"""
+
+import argparse
+import json
+import sys
+
+# Slot states that mean "still waiting on the wire / the peer".
+STUCK_STATES = ("PENDING", "ISSUED", "RECOVERING")
+
+
+def load_dumps(paths):
+    """Parse flight dumps into {rank: dump} (later files win on dup)."""
+    dumps = {}
+    for p in paths:
+        with open(p) as f:
+            d = json.load(f)
+        d["_path"] = p
+        dumps[int(d["rank"])] = d
+    return dumps
+
+
+def _stuck_slots(dump):
+    return [s for s in dump.get("slots", [])
+            if s.get("state") in STUCK_STATES]
+
+
+def _events(dump, kind=None):
+    evs = dump.get("events", [])
+    if kind is None:
+        return evs
+    return [e for e in evs if e.get("kind") == kind]
+
+
+def _has_recv_for(dump, src, tag):
+    """Did `dump`'s rank ever post a recv matching (src, tag)? Stuck slots
+    and completed history (irecv_enqueue / irecv_issued events) count —
+    a recv that exists but hasn't matched yet is not the anomaly."""
+    for s in dump.get("slots", []):
+        if s.get("kind") == "irecv" and s.get("peer") == src \
+                and s.get("tag") == tag:
+            return True
+    for e in dump.get("events", []):
+        if e.get("kind") in ("irecv_enqueue", "irecv_issued") \
+                and e.get("peer") == src and e.get("tag") == tag:
+            return True
+    return False
+
+
+def _has_send_for(dump, dst, tag):
+    """Did `dump`'s rank ever produce a send matching (dst, tag)?"""
+    for s in dump.get("slots", []):
+        if s.get("kind") in ("isend", "pready") and s.get("peer") == dst \
+                and s.get("tag") == tag:
+            return True
+    for e in dump.get("events", []):
+        if e.get("kind") in ("isend_enqueue", "isend_issued", "psend_slot",
+                             "pready_mark") \
+                and e.get("peer") == dst and e.get("tag") == tag:
+            return True
+    return False
+
+
+def _published_partition(dump, peer, tag, partition):
+    """True iff `dump`'s rank published (MPIX_Pready) this partition."""
+    for e in dump.get("events", []):
+        if e.get("kind") in ("pready_mark", "pready_wire") \
+                and e.get("aux") == partition and e.get("peer") == peer \
+                and (tag is None or e.get("tag") == tag):
+            return True
+    return False
+
+
+def _reserved_send_partition(dump, peer, tag, partition):
+    """True iff `dump`'s rank holds the matching send-side partition slot
+    still RESERVED (allocated by MPIX_Psend_init, never Pready'd)."""
+    for s in dump.get("slots", []):
+        if s.get("kind") == "pready" and s.get("state") == "RESERVED" \
+                and s.get("peer") == peer and s.get("partition") == partition \
+                and (tag is None or s.get("tag") == tag):
+            return True
+    return False
+
+
+def diagnose(dumps):
+    """Diagnose a set of per-rank flight dumps ({rank: dump}).
+
+    Returns {"anomaly": str, "culprit": int|None, "detail": str,
+    "waits": [str, ...]} — `waits` is the who-waits-on-whom evidence,
+    one line per stuck operation."""
+    waits = []
+    for rank in sorted(dumps):
+        d = dumps[rank]
+        for s in _stuck_slots(d):
+            part = s.get("partition", -1)
+            waits.append(
+                "rank %d waits on rank %s: %s slot %s tag=%s%s "
+                "state=%s age=%.0fms" % (
+                    rank, s.get("peer"), s.get("kind"), s.get("slot"),
+                    s.get("tag"),
+                    (" partition=%d" % part) if part >= 0 else "",
+                    s.get("state"), s.get("age_ms", 0.0)))
+
+    # 1. dead link: a declared-dead peer explains every stuck op on it.
+    for rank in sorted(dumps):
+        for p in dumps[rank].get("peers", []):
+            if p.get("health") == "dead":
+                return {
+                    "anomaly": "dead_link",
+                    "culprit": int(p["rank"]),
+                    "detail": "rank %d declared rank %d dead (heartbeat "
+                              "loss); ops toward it cannot complete"
+                              % (rank, p["rank"]),
+                    "waits": waits,
+                }
+
+    # 2. never-published partition: recv side polls partition p from S;
+    # S holds the matching send partition RESERVED and never Pready'd it.
+    for rank in sorted(dumps):
+        for s in _stuck_slots(dumps[rank]):
+            if s.get("kind") != "parrived":
+                continue
+            src, tag, part = s.get("peer"), s.get("tag"), s.get("partition")
+            peer_dump = dumps.get(src)
+            if peer_dump is None:
+                continue
+            if _published_partition(peer_dump, rank, tag, part):
+                continue  # published; the data is merely late
+            if _reserved_send_partition(peer_dump, rank, tag, part) or \
+                    not _has_send_for(peer_dump, rank, tag):
+                return {
+                    "anomaly": "never_published_partition",
+                    "culprit": int(src),
+                    "detail": "rank %d polls partition %s of tag=%s from "
+                              "rank %s, but rank %s reserved that "
+                              "partition and never called MPIX_Pready"
+                              % (rank, part, tag, src, src),
+                    "waits": waits,
+                }
+
+    # 3. tag mismatch: both sides stuck on each other, tags disagree.
+    for rank in sorted(dumps):
+        for s in _stuck_slots(dumps[rank]):
+            if s.get("kind") != "isend":
+                continue
+            dst = s.get("peer")
+            peer_dump = dumps.get(dst)
+            if peer_dump is None:
+                continue
+            for r in _stuck_slots(peer_dump):
+                if r.get("kind") == "irecv" and r.get("peer") == rank \
+                        and r.get("tag") != s.get("tag"):
+                    return {
+                        "anomaly": "tag_mismatch",
+                        "culprit": int(rank),
+                        "detail": "rank %d sends tag=%s to rank %s, which "
+                                  "only has a recv posted for tag=%s"
+                                  % (rank, s.get("tag"), dst, r.get("tag")),
+                        "waits": waits,
+                    }
+
+    # 4. unmatched send: the destination never posted a matching recv.
+    for rank in sorted(dumps):
+        for s in _stuck_slots(dumps[rank]):
+            if s.get("kind") != "isend":
+                continue
+            dst, tag = s.get("peer"), s.get("tag")
+            peer_dump = dumps.get(dst)
+            if peer_dump is not None and not _has_recv_for(peer_dump, rank,
+                                                           tag):
+                return {
+                    "anomaly": "unmatched_send",
+                    "culprit": int(dst),
+                    "detail": "rank %d's send tag=%s to rank %s has no "
+                              "matching recv — rank %s never posted one"
+                              % (rank, tag, dst, dst),
+                    "waits": waits,
+                }
+
+    # 5. unmatched recv: the source never produced a matching send.
+    for rank in sorted(dumps):
+        for s in _stuck_slots(dumps[rank]):
+            if s.get("kind") != "irecv":
+                continue
+            src, tag = s.get("peer"), s.get("tag")
+            peer_dump = dumps.get(src)
+            if peer_dump is not None and not _has_send_for(peer_dump, rank,
+                                                           tag):
+                return {
+                    "anomaly": "unmatched_recv",
+                    "culprit": int(src),
+                    "detail": "rank %d's recv tag=%s from rank %s has no "
+                              "matching send — rank %s never sent it"
+                              % (rank, tag, src, src),
+                    "waits": waits,
+                }
+
+    # 6. barrier skew: some ranks sit inside barrier k (enter without
+    # exit) while another rank never reached it. The rank with the fewest
+    # barrier entries is the one the others wait for.
+    entered = {r: len(_events(d, "barrier_enter")) for r, d in dumps.items()}
+    exited = {r: len(_events(d, "barrier_exit")) for r, d in dumps.items()}
+    in_barrier = [r for r in dumps if entered[r] > exited[r]]
+    if in_barrier and entered:
+        straggler = min(dumps, key=lambda r: entered[r])
+        if straggler not in in_barrier \
+                and entered[straggler] < max(entered.values()):
+            return {
+                "anomaly": "barrier_skew",
+                "culprit": int(straggler),
+                "detail": "rank(s) %s wait inside barrier %d; rank %d has "
+                          "only entered %d barrier(s)"
+                          % (sorted(in_barrier), max(entered.values()),
+                             straggler, entered[straggler]),
+                "waits": waits,
+            }
+
+    return {"anomaly": "none", "culprit": None,
+            "detail": "no anomaly detected", "waits": waits}
+
+
+def format_report(dumps, diag):
+    lines = []
+    lines.append("acx doctor: %d rank dump(s): %s" % (
+        len(dumps),
+        ", ".join("rank %d (%s, %d events)" % (
+            r, dumps[r].get("reason", "?"), len(dumps[r].get("events", [])))
+            for r in sorted(dumps))))
+    for w in diag["waits"]:
+        lines.append("  " + w)
+    lines.append("diagnosis: %s" % diag["detail"])
+    lines.append("anomaly: %s" % diag["anomaly"])
+    if diag["culprit"] is not None:
+        lines.append("culprit: rank %d" % diag["culprit"])
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Merge per-rank flight dumps and diagnose a hang.")
+    ap.add_argument("files", nargs="+",
+                    help="per-rank <prefix>.rank<r>.flight.json dumps")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the diagnosis as one JSON object")
+    ap.add_argument("--expect-anomaly", default=None, metavar="NAME",
+                    help="exit nonzero unless the diagnosis matches")
+    ap.add_argument("--expect-culprit", type=int, default=None, metavar="N",
+                    help="exit nonzero unless the culprit is rank N")
+    args = ap.parse_args(argv)
+
+    dumps = load_dumps(args.files)
+    diag = diagnose(dumps)
+    if args.json:
+        print(json.dumps({k: v for k, v in diag.items()}, indent=1))
+    else:
+        print(format_report(dumps, diag))
+
+    if args.expect_anomaly is not None and \
+            diag["anomaly"] != args.expect_anomaly:
+        print("doctor: FAIL expected anomaly %s, got %s"
+              % (args.expect_anomaly, diag["anomaly"]), file=sys.stderr)
+        return 1
+    if args.expect_culprit is not None and \
+            diag["culprit"] != args.expect_culprit:
+        print("doctor: FAIL expected culprit rank %d, got %s"
+              % (args.expect_culprit, diag["culprit"]), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
