@@ -125,6 +125,7 @@ check: ctest itest tools
 	@$(MAKE) --no-print-directory chaos-check || exit 1
 	@$(MAKE) --no-print-directory metrics-check || exit 1
 	@$(MAKE) --no-print-directory doctor-check || exit 1
+	@$(MAKE) --no-print-directory decode-check || exit 1
 	@echo "ALL NATIVE TESTS PASSED"
 
 # --- survivable links end-to-end (DESIGN.md §9) ---
@@ -195,6 +196,20 @@ doctor-check: ctest itest tools
 	  --expect-anomaly never_published_partition --expect-culprit 0 \
 	  $(BUILD)/doctor-check/hang.rank*.flight.json || exit 1
 	@echo "DOCTOR CHECK PASSED"
+
+# --- flash-decode kernel (ops/flash_decode.py, DESIGN.md §11) ---
+# Interpret-mode parity of the Pallas decode kernel vs the dense
+# reference (GQA/window/per-slot-pos/int8 grid + block-skip), then a
+# CPU dryrun of the decode bench child asserting the dense-vs-flash
+# A/B rows land. No chip required — the kernel runs interpreted.
+.PHONY: decode-check
+decode-check:
+	@echo "== decode-check: flash-decode interpret parity"
+	@JAX_PLATFORMS=cpu python3 -m pytest tests/test_flash_decode.py -q \
+	  -p no:cacheprovider || exit 1
+	@echo "== decode-check: bench.py --dryrun-decode (A/B rows emitted)"
+	@JAX_PLATFORMS=cpu python3 bench.py --dryrun-decode || exit 1
+	@echo "DECODE CHECK PASSED"
 
 # Header dependency tracking (-MMD): a header edit rebuilds its users.
 -include $(LIB_OBJS:.o=.d)

@@ -125,6 +125,32 @@ def _bench_cfg():
     return tfm.gpt2_small()
 
 
+# A `*_speedup` row is a RATIO of two measured rows; it is only evidence
+# when baseline and variant came from the same code. This maps each
+# speedup row to its (baseline, variant) component rows so the artifact
+# writer can refuse ratios whose parts were measured at different revs
+# (or predate rev stamping — both sides silently defaulting to
+# "unrecorded" used to count as a match).
+_SPEEDUP_COMPONENTS = {
+    "flash_speedup_s4096": ("dense_ms", "flash_ms"),
+    "decode_int8w_speedup": ("decode_tokens_per_s",
+                             "decode_int8w_tokens_per_s"),
+    "decode_flash_speedup": ("decode_tokens_per_s",
+                             "decode_flash_tokens_per_s"),
+    "decode_longctx_int8kv_speedup": ("decode_longctx_tokens_per_s",
+                                      "decode_longctx_int8kv_tokens_per_s"),
+    "decode_longctx_flash_speedup": (
+        "decode_longctx_dense_tokens_per_s",
+        "decode_longctx_flash_tokens_per_s"),
+    "decode_longctx_int8kv_flash_speedup": (
+        "decode_longctx_int8kv_dense_tokens_per_s",
+        "decode_longctx_int8kv_flash_tokens_per_s"),
+    "spec_speedup": ("spec_plain_ms", "spec_ms"),
+    "serve_speedup": ("serve_static_tokens_per_s",
+                      "serve_cont_tokens_per_s"),
+}
+
+
 def _load_bank() -> dict:
     """BENCH_BANK.json as a dict; {} when absent or corrupt. The one
     read path for the bank (banking, reuse, outage fallback)."""
@@ -362,6 +388,8 @@ def tpu_child_decode():
     set (amortized over the batch) plus each row's padded KV cache, so
     the per-step floor is bytes_moved / HBM_BW and roofline tok/s =
     B / floor (round-4 verdict item #7)."""
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
     from mpi_acx_tpu.models import transformer as tfm
@@ -370,10 +398,25 @@ def tpu_child_decode():
     params = tfm.cast_params(tfm.init_params(jax.random.key(0), cfg),
                              jnp.bfloat16)
     B, S_p, n_new, max_len = 8, 32, 64, 256
+    lc_max, lc_new = 2048, 32
+    if os.environ.get("ACX_BENCH_TINY") == "1":
+        # The flash A/B doubles the longctx compiles and the forced-flash
+        # rows run the kernel INTERPRETED on CPU — shrink the smoke so
+        # make decode-check stays seconds-scale.
+        n_new, lc_max, lc_new = 8, 512, 4
     prompt = jax.random.randint(jax.random.key(1), (B, S_p), 0, cfg.vocab)
     gen = jax.jit(lambda p, t: tfm.generate(p, cfg, t, n_new,
                                             max_len=max_len))
     decode_toks = B * n_new / _timeit(gen, params, prompt)
+
+    # Dense-vs-flash A/B at the short operating point. The auto policy
+    # picks dense at max_len=256 (below the block-skip crossover), so
+    # decode_tokens_per_s above IS the dense baseline; this row forces
+    # the ops/flash_decode.py kernel on the identical workload.
+    fcfg = dataclasses.replace(cfg, decode_flash=True)
+    fgen = jax.jit(lambda p, t: tfm.generate(p, fcfg, t, n_new,
+                                             max_len=max_len))
+    decode_toks_f = B * n_new / _timeit(fgen, params, prompt)
 
     # Roofline: v5e HBM ~819 GB/s (public spec). Static shapes mean the
     # kernels stream the PADDED (max_len) cache each step.
@@ -397,21 +440,35 @@ def tpu_child_decode():
 
     # Long-context operating point (max_len=2048): the KV stream is
     # now ~2.4x the int8 weight stream — the regime ops/kvquant.py
-    # targets. A/B the bf16 vs int8 cache at the same workload.
-    lc_max, lc_new = 2048, 32
+    # targets. A/B bf16 vs int8 cache AND dense vs flash on the same
+    # workload: the dcfg/fcfg pair forces the decode backend either way
+    # (cfg's None would auto-pick flash here, max_len >= 1024).
+    dcfg = dataclasses.replace(cfg, decode_flash=False)
     lprompt = jax.random.randint(jax.random.key(3), (B, 32), 0,
                                  cfg.vocab)
-    lgen = jax.jit(lambda p, t: tfm.generate(p, cfg, t, lc_new,
-                                             max_len=lc_max))
-    lgen8 = jax.jit(lambda p, t: tfm.generate(p, cfg, t, lc_new,
-                                              max_len=lc_max,
-                                              kv_int8=True))
-    lc_toks = B * lc_new / _timeit(lgen, qparams, lprompt)
-    lc_toks8 = B * lc_new / _timeit(lgen8, qparams, lprompt)
+
+    def ltoks(c, int8):
+        lgen = jax.jit(lambda p, t: tfm.generate(p, c, t, lc_new,
+                                                 max_len=lc_max,
+                                                 kv_int8=int8))
+        return B * lc_new / _timeit(lgen, qparams, lprompt)
+
+    lc_toks, lc_toks8 = ltoks(cfg, False), ltoks(cfg, True)
+    lc_dense, lc_dense8 = ltoks(dcfg, False), ltoks(dcfg, True)
+    lc_flash, lc_flash8 = ltoks(fcfg, False), ltoks(fcfg, True)
     lc_kv = 2 * cfg.n_layers * lc_max * cfg.d_model * 2 * B
     lc_kv8 = lc_kv // 2 + lc_kv // (2 * cfg.head_dim) * 4  # codes+scales
+    # Length-aware roofline: the flash kernel reads O(live length), not
+    # O(max_len) — over this run the mean live length is S_p + lc_new/2
+    # cache rows, so the bandwidth floor shrinks by live/max. The dense
+    # rooflines above keep charging the full padded cache.
+    live_frac = (32 + lc_new / 2) / lc_max
+    lc_kv_live = lc_kv * live_frac
+    lc_kv8_live = lc_kv8 * live_frac
     print(json.dumps({
         "decode_tokens_per_s": round(decode_toks, 1),
+        "decode_flash_tokens_per_s": round(decode_toks_f, 1),
+        "decode_flash_speedup": round(decode_toks_f / decode_toks, 2),
         "decode_roofline_tokens_per_s": round(roofline, 1),
         "decode_roofline_frac": round(decode_toks / roofline, 3),
         "decode_weight_mb": round(wbytes / 1e6, 1),
@@ -424,12 +481,25 @@ def tpu_child_decode():
         "decode_longctx_tokens_per_s": round(lc_toks, 1),
         "decode_longctx_int8kv_tokens_per_s": round(lc_toks8, 1),
         "decode_longctx_int8kv_speedup": round(lc_toks8 / lc_toks, 2),
+        "decode_longctx_dense_tokens_per_s": round(lc_dense, 1),
+        "decode_longctx_flash_tokens_per_s": round(lc_flash, 1),
+        "decode_longctx_flash_speedup": round(lc_flash / lc_dense, 2),
+        "decode_longctx_int8kv_dense_tokens_per_s": round(lc_dense8, 1),
+        "decode_longctx_int8kv_flash_tokens_per_s": round(lc_flash8, 1),
+        "decode_longctx_int8kv_flash_speedup": round(
+            lc_flash8 / lc_dense8, 2),
         "decode_longctx_kv_mb": round(lc_kv / 1e6, 1),
         "decode_longctx_int8kv_mb": round(lc_kv8 / 1e6, 1),
         "decode_longctx_roofline_tokens_per_s": round(
             B * HBM_BW / (qbytes + lc_kv), 1),
         "decode_longctx_int8kv_roofline_tokens_per_s": round(
             B * HBM_BW / (qbytes + lc_kv8), 1),
+        "decode_longctx_live_roofline_tokens_per_s": round(
+            B * HBM_BW / (qbytes + lc_kv_live), 1),
+        "decode_longctx_int8kv_live_roofline_tokens_per_s": round(
+            B * HBM_BW / (qbytes + lc_kv8_live), 1),
+        "decode_longctx_live_roofline_frac": round(
+            lc_flash / (B * HBM_BW / (qbytes + lc_kv_live)), 3),
         "device": str(jax.devices()[0].platform),
     }))
 
@@ -876,13 +946,42 @@ def main(full: bool = False):
         Rounds 2-4 each ended with a tpu_error-only artifact while
         chip-measured evidence existed in the repo — the artifact
         should carry it rather than pretend none exists. Called on ANY
-        recorded outage (probe-dead OR mid---full tunnel death)."""
-        rows = {k: {"value": v.get("value"), "ts": v.get("ts"),
-                    "rev": v.get("rev", "unrecorded")}
-                for k, v in _load_bank().items()
+        recorded outage (probe-dead OR mid---full tunnel death).
+
+        `*_speedup` rows are ratios and only attach when the speedup
+        AND both its component rows (_SPEEDUP_COMPONENTS) carry the
+        SAME recorded rev — a baseline and variant measured on
+        different code (or before rev stamping, when both sides
+        defaulted to "unrecorded") is refused and listed loudly under
+        banked_speedups_dropped instead."""
+        bank = {k: v for k, v in _load_bank().items()
                 if isinstance(v, dict) and v.get("device") == "tpu"}
+
+        def rev_of(key):
+            r = bank.get(key, {}).get("rev")
+            return r if r not in (None, "unrecorded", "unknown") else None
+
+        rows, dropped = {}, {}
+        for k, v in bank.items():
+            if "_speedup" in k:
+                parts = _SPEEDUP_COMPONENTS.get(k)
+                if parts is None:
+                    dropped[k] = "no component mapping for this ratio"
+                    continue
+                revs = {rev_of(k)} | {rev_of(p) for p in parts}
+                if None in revs:
+                    dropped[k] = "ratio or component rev unrecorded"
+                    continue
+                if len(revs) != 1:
+                    dropped[k] = ("baseline and variant measured at "
+                                  "different revs")
+                    continue
+            rows[k] = {"value": v.get("value"), "ts": v.get("ts"),
+                       "rev": v.get("rev", "unrecorded")}
         if rows:
             out["banked_tpu_rows"] = rows
+        if dropped:
+            out["banked_speedups_dropped"] = dropped
 
     if "tpu_error" in out:
         attach_banked_rows()
@@ -978,7 +1077,9 @@ def main(full: bool = False):
         write_full(partial=True)
         # TPU groups FIRST and back-to-back: healthy-tunnel minutes are
         # the scarce resource — no host-only work may sit between them.
-        for name, timeout in (("flash", 420), ("decode", 420),
+        # decode got 600 s when the flash A/B tripled its compile count
+        # (short flash + forced dense/flash x bf16/int8 longctx).
+        for name, timeout in (("flash", 420), ("decode", 600),
                               ("train", 600), ("trainseg", 900)):
             run_group(name, timeout=timeout)
             if name in errs:
@@ -1015,7 +1116,39 @@ def main(full: bool = False):
         sys.exit(1)
 
 
+def dryrun_decode():
+    """`make decode-check` hook: run the decode child in-process on the
+    tiny CPU geometry and assert the dense-vs-flash A/B rows actually
+    land — the flash rows exercise the ops/flash_decode.py kernel in
+    interpret mode, so this catches kernel breakage AND row-name drift
+    before a healthy-tunnel window burns minutes on it."""
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        tpu_child_decode()
+    rows = json.loads(buf.getvalue().strip().splitlines()[-1])
+    need = ["decode_flash_tokens_per_s", "decode_flash_speedup",
+            "decode_longctx_dense_tokens_per_s",
+            "decode_longctx_flash_tokens_per_s",
+            "decode_longctx_flash_speedup",
+            "decode_longctx_int8kv_dense_tokens_per_s",
+            "decode_longctx_int8kv_flash_tokens_per_s",
+            "decode_longctx_int8kv_flash_speedup",
+            "decode_longctx_live_roofline_tokens_per_s"]
+    missing = [k for k in need if k not in rows]
+    assert not missing, f"decode dryrun: rows missing {missing}"
+    assert all(rows[k] > 0 for k in need), rows
+    print(json.dumps({"dryrun_decode_ok": True,
+                      "rows": {k: rows[k] for k in need}}))
+
+
 if __name__ == "__main__":
+    if "--dryrun-decode" in sys.argv:
+        # The dryrun is a correctness smoke, never a measurement: force
+        # the tiny CPU geometry no matter how it was invoked.
+        os.environ["ACX_BENCH_TINY"] = "1"
     if os.environ.get("ACX_BENCH_TINY") == "1":
         # Smoke mode runs on CPU by definition; the env var alone is
         # not enough (the axon sitecustomize overrides jax_platforms
@@ -1031,6 +1164,8 @@ if __name__ == "__main__":
         tpu_child_fwd()
     elif "--tpu-child-flash" in sys.argv:
         tpu_child_flash()
+    elif "--dryrun-decode" in sys.argv:
+        dryrun_decode()
     elif "--tpu-child-decode" in sys.argv:
         tpu_child_decode()
     elif "--tpu-child-trainseg" in sys.argv:

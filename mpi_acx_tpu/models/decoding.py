@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def grouped_decode_attend(q, kc, vc, pos, max_len, n_rep):
+def grouped_decode_attend(q, kc, vc, pos, max_len, n_rep, flash=None):
     """W-token grouped-query attention against an UN-REPEATED KV cache:
     q [B, W, Hq, D] occupying positions pos..pos+W-1, kc/vc
     [B, max_len, Hkv, D] with Hq = Hkv*n_rep -> o [B, W, Hq*D]. Query
@@ -27,6 +27,24 @@ def grouped_decode_attend(q, kc, vc, pos, max_len, n_rep):
     decode path — the three families' steps, the tensor-parallel loops,
     and the speculative window passes — shares this single definition of
     the scale/mask/softmax math.
+
+    ``flash`` is the ``decode_flash`` config knob, dispatched through
+    :func:`mpi_acx_tpu.ops.flash_decode.select_decode_attend` (the
+    ``select_attention`` idiom): ``None`` -> auto (the length-aware
+    Pallas decode kernel on TPU when max_len is big and 128-divisible,
+    dense otherwise), ``True`` -> always the kernel (interpret mode off
+    TPU, so CPU tests run the same code path), ``False`` -> the dense
+    reference below."""
+    from mpi_acx_tpu.ops.flash_decode import select_decode_attend
+
+    return select_decode_attend(flash)(q, kc, vc, pos, max_len, n_rep)
+
+
+def dense_decode_attend(q, kc, vc, pos, max_len, n_rep):
+    """Dense-einsum reference for :func:`grouped_decode_attend` — reads
+    the whole [B, max_len, Hkv, D] cache every step (the flash kernel's
+    parity ground truth; also the dispatch target below the kernel's
+    crossover and on non-TPU backends).
 
     ``kc``/``vc`` may each be an ``(int8 codes, f32 scales [B, max_len,
     Hkv, 1])`` tuple (ops/kvquant.py layout). The per-position scales
@@ -48,12 +66,14 @@ def grouped_decode_attend(q, kc, vc, pos, max_len, n_rep):
     B, W = q.shape[:2]
     Hkv, Dh = kc.shape[2], kc.shape[3]
     qg = q.reshape(B, W, Hkv, n_rep, Dh)
+    # Pre-scale q by 1/sqrt(Dh) (W*Hq*Dh elements) instead of dividing
+    # the [B, g, r, W, max_len] f32 logits — same trick as _flash_kernel.
+    qg = (qg.astype(jnp.float32) * (1.0 / Dh ** 0.5)).astype(q.dtype)
     kin = kc if ks is None else kc.astype(q.dtype)  # int8 exact in bf16
     logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, kin).astype(jnp.float32)
     if ks is not None:
         # [B, max_len, Hkv, 1] -> [B, g, 1, 1, k] against bgrqk.
         logits = logits * ks[..., 0].transpose(0, 2, 1)[:, :, None, None]
-    logits = logits / jnp.sqrt(Dh)
     pos = jnp.asarray(pos)
     if pos.ndim == 0:
         rows = pos + jnp.arange(W)[:, None]            # [W, 1]

@@ -36,6 +36,7 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     dtype: Any = jnp.bfloat16
     use_flash: Optional[bool] = None  # None = shared auto policy
+    decode_flash: Optional[bool] = None  # decode kernel; None = auto
 
     @property
     def head_dim(self) -> int:
@@ -278,7 +279,8 @@ def decode_step(params: Params, cfg: LlamaConfig, cache,
         return _qkv(cfg, lp, x, positions)               # k,v [B,1,Hkv,D]
 
     def attend_fn(lp, x, q, kc, vc, pos):
-        o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep)
+        o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep,
+                                  flash=cfg.decode_flash)
         return _mlp(cfg, lp, x + o @ wread(lp, "wo", x.dtype))
 
     from mpi_acx_tpu.models.decoding import run_decode_layers

@@ -49,6 +49,7 @@ class MoeTransformerConfig:
     max_seq: int = 1024
     dtype: Any = jnp.bfloat16
     use_flash: Optional[bool] = None
+    decode_flash: Optional[bool] = None  # decode kernel; None = auto
 
     @property
     def head_dim(self) -> int:

@@ -67,7 +67,8 @@ def _window_pass_llama(params, cfg, cache, tokens):
         return lm._qkv(cfg, lp, x, positions)
 
     def attend_fn(lp, x, q, kc, vc, _pos):
-        o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep)
+        o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep,
+                                  flash=cfg.decode_flash)
         return lm._mlp(cfg, lp, x + o @ wread(lp, "wo", x.dtype))
 
     x, ks, vs = decode_layer_scan(params["layers"], x, cache["k"],
@@ -118,7 +119,8 @@ def _window_pass(params, cfg, cache, tokens, ffn=None):
         return tfm._qkv(cfg, lp, x)                    # [1, W, H, Dh]
 
     def attend_fn(lp, x, q, kc, vc, pos):
-        o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep=1)
+        o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep=1,
+                                  flash=cfg.decode_flash)
         return ffn(cfg, lp, x + o @ wread(lp, "wo", x.dtype))
 
     x, ks, vs = decode_layer_scan(params["layers"], x, cache["k"],

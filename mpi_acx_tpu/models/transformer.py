@@ -44,6 +44,10 @@ class TransformerConfig:
     # kernel grid overhead; flash 1.4x at 2048, 5.3x at 4096), dense
     # elsewhere. Flash requires S % 128 == 0 (block sizes self-fit to S).
     use_flash: Optional[bool] = None
+    # Decode-attention backend (ops/flash_decode.py): None = auto (the
+    # length-aware Pallas kernel on TPU for long 128-aligned caches),
+    # True = always the kernel (interpret mode off-TPU), False = dense.
+    decode_flash: Optional[bool] = None
 
     @property
     def head_dim(self) -> int:
@@ -284,7 +288,8 @@ def decode_step(params: Params, cfg: TransformerConfig, cache,
         return _qkv(cfg, lp, x)                        # [B, 1, H, Dh]
 
     def attend_fn(lp, x, q, kc, vc, pos):
-        o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep=1)
+        o = grouped_decode_attend(q, kc, vc, pos, max_len, n_rep=1,
+                                  flash=cfg.decode_flash)
         return ffn(cfg, lp, x + o @ wread(lp, "wo", x.dtype))
 
     from mpi_acx_tpu.models.decoding import run_decode_layers

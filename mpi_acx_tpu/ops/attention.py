@@ -50,6 +50,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax<0.5 exposes this as TPUCompilerParams; newer releases renamed it.
+_CompilerParams = (getattr(pltpu, "CompilerParams", None)
+                   or getattr(pltpu, "TPUCompilerParams"))
+
 _NEG_INF = -1e30
 
 
@@ -90,17 +94,23 @@ def select_attention(use_flash):
     return flash_attention if use_flash else attention_reference
 
 
-def _online_softmax_step(q, kb, vb, m, l, acc, row0, col0, masked, prec):
+def _online_softmax_step(q, kb, vb, m, l, acc, row0, col0, masked, prec,
+                         rows=None):
     """One flash block update, shared by the resident and streaming
-    kernels (BASELINE.md's bit-identical claim rests on this being THE
-    single definition): scaled-q x K^T logits, optional causal mask with
-    absolute row/col offsets, and the rescale-and-accumulate of the
-    online-softmax state. Returns (m, l, acc)."""
+    kernels AND the decode kernel in ops/flash_decode.py (BASELINE.md's
+    bit-identical claim rests on this being THE single definition):
+    scaled-q x K^T logits, optional causal mask with absolute row/col
+    offsets, and the rescale-and-accumulate of the online-softmax state.
+    Callers whose row positions are not affine in the row index (the
+    decode kernel's ``pos + i // n_rep``) pass absolute ``rows``
+    (broadcastable to ``s``) directly instead of ``row0``. Returns
+    (m, l, acc)."""
     s = jax.lax.dot_general(
         q, kb, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32, precision=prec)  # [BQ, BK] f32
     if masked:
-        rows = row0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        if rows is None:
+            rows = row0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         cols = col0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(rows >= cols, s, _NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -180,6 +190,10 @@ def _out_struct(shape, dtype, *operands):
 
 
 def _fit_blocks(S, block_q, block_k):
+    """Shrink the requested block sizes to divisors of S. Returns
+    ``(block_q, block_k)``, or ``None`` when S has no usable 128-multiple
+    divisor (e.g. S=648) — callers fall back to the dense reference
+    instead of crashing the model call."""
     def fit(block):
         b = min(block, S)
         while b > 128 and S % b:
@@ -187,8 +201,41 @@ def _fit_blocks(S, block_q, block_k):
         return b
 
     bq, bk = fit(block_q), fit(block_k)
-    assert S % bq == 0 and S % bk == 0, (S, bq, bk)
+    if S % bq or S % bk:
+        return None
     return bq, bk
+
+
+_fallback_warned: set = set()
+
+
+def _warn_dense_fallback(S, Sk):
+    """One-time (per shape) warning that the flash kernel can't tile this
+    sequence length and the dense reference is used instead."""
+    key = (S, Sk)
+    if key not in _fallback_warned:
+        _fallback_warned.add(key)
+        import warnings
+
+        warnings.warn(
+            f"flash_attention: no block size divides S={S}/Sk={Sk}; "
+            "falling back to the dense reference for this shape",
+            RuntimeWarning, stacklevel=3)
+
+
+def _reference_lse(q, k, v, causal: bool = True):
+    """Dense fallback for :func:`flash_attention_lse`: same contract
+    (o [B, S, H, D], lse [B, H, S] f32), materialized logits."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    logits = logits / jnp.sqrt(d)
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool))
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)   # [B, H, S] f32
+    p = jnp.exp(logits - lse[..., None]).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v), lse
 
 
 def _flash_fwd_impl(qt, kt, vt, causal, block_q, block_k):
@@ -288,7 +335,7 @@ def _flash_stream_fwd_impl(qt, kt, vt, causal, block_q, block_k):
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=jax.default_backend() != "tpu",
@@ -496,8 +543,12 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
     Sk = k.shape[1]
     if streaming is None:
         streaming = Sk >= 16384
-    block_q, _ = _fit_blocks(S, block_q, block_k)
-    _, block_k = _fit_blocks(Sk, block_q, block_k)
+    fit_q = _fit_blocks(S, block_q, block_k)
+    fit_k = _fit_blocks(Sk, block_q, block_k)
+    if fit_q is None or fit_k is None:
+        _warn_dense_fallback(S, Sk)
+        return attention_reference(q, k, v, causal=causal)
+    block_q, block_k = fit_q[0], fit_k[1]
 
     def to_bhsd(x):
         return jnp.transpose(x, (0, 2, 1, 3))            # [B, H, S, D]
@@ -524,8 +575,12 @@ def flash_attention_lse(q, k, v, causal: bool = True, block_q: int = 512,
     """
     B, S, H, D = q.shape
     Sk = k.shape[1]
-    block_q, _ = _fit_blocks(S, block_q, block_k)
-    _, block_k = _fit_blocks(Sk, block_q, block_k)
+    fit_q = _fit_blocks(S, block_q, block_k)
+    fit_k = _fit_blocks(Sk, block_q, block_k)
+    if fit_q is None or fit_k is None:
+        _warn_dense_fallback(S, Sk)
+        return _reference_lse(q, k, v, causal=causal)
+    block_q, block_k = fit_q[0], fit_k[1]
 
     def to_bhsd(x):
         return jnp.transpose(x, (0, 2, 1, 3))            # [B, H, S, D]

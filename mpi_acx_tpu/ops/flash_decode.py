@@ -1,0 +1,260 @@
+"""Length-aware GQA flash-decode: a Pallas TPU decode-attention kernel.
+
+:func:`mpi_acx_tpu.models.decoding.grouped_decode_attend` — the single
+decode-attention definition every family, the serving loop, the
+speculative window passes, and the TP generation loops share — is a
+dense einsum that reads the ENTIRE ``[B, max_len, Hkv, D]`` cache every
+token, even when a slot sits at position 40 of 4096 (measured ~17% of
+the KV-bandwidth roofline on the longctx bench). This kernel replaces
+that read with an online softmax over K/V blocks (the same
+``_online_softmax_step`` as ops/attention.py — THE shared block-update
+definition) that is
+
+* **length-aware** — each slot's ``pos`` lands in SMEM and bounds the
+  fori_loop at ``ceil((pos + W) / block_k)`` blocks, with per-row
+  causal masking only on the straddle block. The K/V cache stays in HBM
+  (``memory_space=ANY``) and each program DMAs exactly the live blocks
+  into VMEM scratch, so HBM traffic is O(live length), not O(max_len).
+* **GQA-native** — q ``[B, W, Hkv, n_rep, D]`` rides the grid as
+  ``[B, Hkv, W*n_rep, D]`` (row ``i`` is window slot ``i // n_rep``),
+  attending the UN-repeated KV group directly.
+* **int8-fused** — when the cache is an ``(int8 codes, f32 scales)``
+  tuple (ops/kvquant.py), the codes blocks are dequantized IN REGISTER
+  in VMEM via the per-position scales: ``kb = codes_f32 * scales``.
+  Algebraically identical to the dense path's scale-on-scores factoring
+  (``sum_d q_d*(K_kd*s_k) == (sum_d q_d*K_kd)*s_k``), but int8 is the
+  only HBM-resident form and the only form that crosses the DMA — the
+  bytes halving the factoring was built for finally reaches the wire.
+* **window-capable** — W > 1 for the speculative-decode window passes,
+  and ``pos`` scalar or ``[B]`` for continuous-batching serving.
+
+Dispatch mirrors ``select_attention``: :func:`select_decode_attend` is
+the ONE flash/dense decode switch (``decode_flash`` config field on all
+three families). Off-TPU the pallas_call runs in interpret mode, so the
+tier-1 CPU tests exercise this exact code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mpi_acx_tpu.ops.attention import (_NEG_INF, _online_softmax_step,
+                                       _out_struct)
+
+# jax renamed TPUCompilerParams -> CompilerParams; accept either.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
+
+def _fit_block_k(max_len, want):
+    """Largest divisor of max_len <= want, preferring 128-multiples
+    (Mosaic-native tiling); any divisor as a last resort (interpret
+    mode, where arbitrary cache lengths are legal)."""
+    b = min(want, max_len)
+    while b > 128 and max_len % b:
+        b -= 128
+    while max_len % b:
+        b -= 1
+    return b
+
+
+_fallback_warned: set = set()
+
+
+def _warn_dense_fallback(max_len):
+    if max_len not in _fallback_warned:
+        _fallback_warned.add(max_len)
+        import warnings
+
+        warnings.warn(
+            f"flash_decode: max_len={max_len} is not a multiple of 128; "
+            "Mosaic cannot tile the cache — using the dense decode "
+            "reference for this cache", RuntimeWarning, stacklevel=3)
+
+
+def _decode_kernel(pos_ref, q_ref, *refs, block_k, n_rep, n_k, quant,
+                   scale):
+    """One (batch slot, KV group) program: online softmax over the LIVE
+    K/V blocks of this slot's cache row.
+
+    ``pos_ref`` is this slot's position in SMEM — it sets the trip
+    counts, so a slot at position 40 of a 4096 cache issues one block's
+    DMA, not 16. Blocks [0, n_full) are visible to every window row and
+    run unmasked; blocks [n_full, n_live) straddle some row's horizon
+    and mask with the ABSOLUTE row positions ``pos + i // n_rep``
+    (row i of the [W*n_rep, D] q tile is window slot i // n_rep — not
+    affine in i, hence the ``rows=`` form of _online_softmax_step).
+    K/V HBM refs are manually DMA'd block-by-block into VMEM scratch;
+    with ``quant`` the scales ride two extra [block_k, 1] f32 copies
+    and dequantization happens in register, after the wire."""
+    if quant:
+        (k_ref, v_ref, ks_ref, vs_ref, o_ref,
+         k_scr, v_scr, ks_scr, vs_scr, sem) = refs
+    else:
+        k_ref, v_ref, o_ref, k_scr, v_scr, sem = refs
+    b = pl.program_id(0)
+    g = pl.program_id(1)
+    pos = pos_ref[0, 0]
+    Wn, D = q_ref.shape[2], q_ref.shape[3]
+    W = Wn // n_rep
+
+    # Pre-scale q once (the _flash_kernel idiom); on the quant path q
+    # stays f32 to dot against the dequantized f32 blocks exactly.
+    qv = q_ref[0, 0].astype(jnp.float32) * scale         # [Wn, D]
+    if quant:
+        q, prec = qv, jax.lax.Precision.HIGHEST
+    else:
+        q = qv.astype(q_ref.dtype)
+        prec = (jax.lax.Precision.HIGHEST if q_ref.dtype == jnp.float32
+                else jax.lax.Precision.DEFAULT)
+
+    # Absolute row positions for the straddle-block mask.
+    rows = pos + jax.lax.broadcasted_iota(jnp.int32, (Wn, 1), 0) // n_rep
+
+    def load(j):
+        cps = [pltpu.make_async_copy(
+                   k_ref.at[b, pl.ds(j * block_k, block_k), g],
+                   k_scr, sem.at[0]),
+               pltpu.make_async_copy(
+                   v_ref.at[b, pl.ds(j * block_k, block_k), g],
+                   v_scr, sem.at[1])]
+        if quant:
+            cps += [pltpu.make_async_copy(
+                        ks_ref.at[b, pl.ds(j * block_k, block_k), g],
+                        ks_scr, sem.at[2]),
+                    pltpu.make_async_copy(
+                        vs_ref.at[b, pl.ds(j * block_k, block_k), g],
+                        vs_scr, sem.at[3])]
+        for c in cps:
+            c.start()
+        for c in cps:
+            c.wait()
+        if quant:
+            return (k_scr[...].astype(jnp.float32) * ks_scr[...],
+                    v_scr[...].astype(jnp.float32) * vs_scr[...])
+        return k_scr[...], v_scr[...]
+
+    def step(j, carry, masked):
+        m, l, acc = carry
+        kb, vb = load(j)
+        return _online_softmax_step(q, kb, vb, m, l, acc, 0, j * block_k,
+                                    masked, prec, rows=rows)
+
+    m0 = jnp.full((Wn, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Wn, 1), jnp.float32)
+    acc0 = jnp.zeros((Wn, D), jnp.float32)
+
+    # Block-skip bounds: block j holds cache cols [j*bk, (j+1)*bk); the
+    # last visible col is pos + W - 1, so n_live = ceil((pos+W)/bk)
+    # blocks carry any live key. A block is FULLY visible to every row
+    # when its last col <= pos (row 0's horizon): n_full blocks.
+    n_live = jnp.minimum((pos + W + block_k - 1) // block_k, n_k)
+    n_full = jnp.minimum((pos + 1) // block_k, n_live)
+    carry = jax.lax.fori_loop(
+        0, n_full, lambda j, c: step(j, c, masked=False), (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(
+        n_full, n_live, lambda j, c: step(j, c, masked=True), carry)
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+def flash_decode_attend(q, kc, vc, pos, max_len, n_rep, block_k: int = 256):
+    """Length-aware Pallas decode attention; drop-in for
+    :func:`mpi_acx_tpu.models.decoding.dense_decode_attend` — same
+    signature, same output [B, W, Hq*D], same (codes, scales) tuple
+    convention for int8 caches. See the module docstring."""
+    ks = vs = None
+    if isinstance(kc, tuple):
+        kc, ks = kc
+    if isinstance(vc, tuple):
+        vc, vs = vc
+    quant = ks is not None
+    if jax.default_backend() == "tpu" and max_len % 128:
+        _warn_dense_fallback(max_len)
+        from mpi_acx_tpu.models.decoding import dense_decode_attend
+        kin = kc if ks is None else (kc, ks)
+        vin = vc if vs is None else (vc, vs)
+        return dense_decode_attend(q, kin, vin, pos, max_len, n_rep)
+
+    B, W, Hq, D = q.shape
+    Hkv = kc.shape[2]
+    assert Hq == Hkv * n_rep, (Hq, Hkv, n_rep)
+    Wn = W * n_rep
+    block_k = _fit_block_k(max_len, block_k)
+
+    pos = jnp.asarray(pos, jnp.int32)
+    if pos.ndim == 0:
+        pos = jnp.full((B,), pos, jnp.int32)
+    pos2 = pos.reshape(B, 1)
+
+    # [B, W, Hkv, n_rep, D] -> [B, Hkv, W*n_rep, D]: row i = w*n_rep + r
+    # so the kernel recovers the window slot as i // n_rep.
+    qg = q.reshape(B, W, Hkv, n_rep, D).transpose(0, 2, 1, 3, 4).reshape(
+        B, Hkv, Wn, D)
+
+    kernel = functools.partial(
+        _decode_kernel, block_k=block_k, n_rep=n_rep,
+        n_k=max_len // block_k, quant=quant, scale=1.0 / D ** 0.5)
+    in_specs = [
+        pl.BlockSpec((1, 1), lambda b, g: (b, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1, Wn, D), lambda b, g: (b, g, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pltpu.ANY),     # K cache stays in HBM
+        pl.BlockSpec(memory_space=pltpu.ANY),     # V cache stays in HBM
+    ]
+    operands = [pos2, qg, kc, vc]
+    scratch = [pltpu.VMEM((block_k, D), kc.dtype),
+               pltpu.VMEM((block_k, D), vc.dtype)]
+    if quant:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        operands += [ks, vs]
+        scratch += [pltpu.VMEM((block_k, 1), jnp.float32)] * 2
+    scratch.append(pltpu.SemaphoreType.DMA((4,)))
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, Hkv),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, Wn, D), lambda b, g: (b, g, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=_out_struct((B, Hkv, Wn, D), q.dtype, q, kc, vc),
+        scratch_shapes=scratch,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=jax.default_backend() != "tpu",
+    )(*operands)
+    return out.reshape(B, Hkv, W, n_rep, D).transpose(0, 2, 1, 3, 4).reshape(
+        B, W, Hq * D)
+
+
+def auto_decode_attend(q, kc, vc, pos, max_len, n_rep):
+    """THE decode flash/dense auto policy (mirrors ``auto_attention``):
+    the Pallas kernel on TPU when the cache is long enough for
+    block-skip to pay (max_len >= 1024) and Mosaic can tile it
+    (max_len % 128 == 0); the dense reference elsewhere — including
+    every CPU path, where a dense einsum beats an interpreted kernel."""
+    if (jax.default_backend() == "tpu" and max_len >= 1024
+            and max_len % 128 == 0):
+        return flash_decode_attend(q, kc, vc, pos, max_len, n_rep)
+    from mpi_acx_tpu.models.decoding import dense_decode_attend
+
+    return dense_decode_attend(q, kc, vc, pos, max_len, n_rep)
+
+
+def select_decode_attend(decode_flash):
+    """THE single flash/dense dispatch for the ``decode_flash`` config
+    field (the ``select_attention`` idiom — every decode path routes
+    here so the policy can't drift): ``None`` -> per-shape auto policy,
+    ``True`` -> Pallas decode kernel (interpret mode off-TPU), ``False``
+    -> dense reference. All returned callables take
+    ``(q, kc, vc, pos, max_len, n_rep)``."""
+    from mpi_acx_tpu.models.decoding import dense_decode_attend
+
+    if decode_flash is None:
+        return auto_decode_attend
+    return flash_decode_attend if decode_flash else dense_decode_attend

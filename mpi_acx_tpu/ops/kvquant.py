@@ -11,15 +11,22 @@ Scheme: symmetric per-(position, head) scales — each cached K/V vector
 [head_dim] gets one f32 scale (amax/127), stored in a parallel
 [..., 1] buffer. Quantization happens at WRITE time (one new vector
 per step; the prompt bulk at prefill). At READ time the codes are NOT
-dequantized: decoding.grouped_decode_attend keeps the int8 buffers as
-the attention einsums' operands and applies K's scales to the logits
-and V's to the probabilities (scale-on-scores factoring). The first
-design dequantized the full cache slice before attending, betting XLA
-would fuse the convert+mul into the einsum's operand read the way it
-does for int8 weights (wquant.py) — the r05 chip A/B measured that at
-0.73x the bf16 baseline (XLA materializes the dequantized [B, S, H, D]
-tensor in HBM: int8 read + bf16 write + bf16 read), which is why the
-factored form is the only read path.
+dequantized to HBM — there are two read paths, both keeping int8 as
+the only HBM-resident form. The dense path
+(decoding.dense_decode_attend) keeps the int8 buffers as the attention
+einsums' operands and applies K's scales to the logits and V's to the
+probabilities (scale-on-scores factoring). The flash path
+(ops/flash_decode.py, the default on TPU for long caches) DMAs each
+live int8 block into VMEM and dequantizes IN REGISTER against the
+per-position scales before the dot — algebraically the same factoring
+(sum_d q_d*(K_kd*s_k) == (sum_d q_d*K_kd)*s_k), with the added
+length-aware win that dead blocks never cross the wire at all. What
+is never done: dequantizing the full cache slice before attending.
+The first design did, betting XLA would fuse the convert+mul into the
+einsum's operand read the way it does for int8 weights (wquant.py) —
+the r05 chip A/B measured that at 0.73x the bf16 baseline (XLA
+materializes the dequantized [B, S, H, D] tensor in HBM: int8 read +
+bf16 write + bf16 read).
 
 Integration: decoding.decode_layer_scan carries the scale buffers and
 the per-family caches gain "ks"/"vs" entries (transformer.init_kv_cache

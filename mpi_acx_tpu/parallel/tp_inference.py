@@ -330,7 +330,8 @@ def make_tp_generate(cfg, mesh: Mesh, n_new: int,
 
         def decode_attend(lp, x, q, kcl, vcl, pos, max_len):
             # Shared MHA decode attention (GQA construction, n_rep=1).
-            o = grouped_decode_attend(q, kcl, vcl, pos, max_len, n_rep=1)
+            o = grouped_decode_attend(q, kcl, vcl, pos, max_len, n_rep=1,
+                                      flash=cfg.decode_flash)
             return mlp(lp, out_proj(lp, o, x))
 
         def finish(x):
@@ -598,7 +599,8 @@ def make_tp_generate_llama(cfg: lm.LlamaConfig, mesh: Mesh, n_new: int,
         def decode_attend(lp, x, q, kcl, vcl, pos, max_len):
             # The shared grouped-GQA construction, on this rank's slice;
             # its flat [B, 1, Hq_l*Dh] output feeds out_proj directly.
-            o = grouped_decode_attend(q, kcl, vcl, pos, max_len, n_rep)
+            o = grouped_decode_attend(q, kcl, vcl, pos, max_len, n_rep,
+                                      flash=cfg.decode_flash)
             return mlp(lp, out_proj(lp, o, x))
 
         def finish(x):
@@ -666,7 +668,8 @@ def _tp_family_ops(cfg, tp: int, axis: str, ffn=None,
 
     def make_attend(max_len):
         def attend_fn(lp, x, q, kcl, vcl, pos):
-            o = grouped_decode_attend(q, kcl, vcl, pos, max_len, n_rep=1)
+            o = grouped_decode_attend(q, kcl, vcl, pos, max_len, n_rep=1,
+                                      flash=cfg.decode_flash)
             return mlp(lp, out_proj(lp, o, x))
         return attend_fn
 
@@ -737,7 +740,8 @@ def _llama_tp_family_ops(cfg, tp: int, axis: str,
 
     def make_attend(max_len):
         def attend_fn(lp, x, q, kcl, vcl, pos):
-            o = grouped_decode_attend(q, kcl, vcl, pos, max_len, n_rep)
+            o = grouped_decode_attend(q, kcl, vcl, pos, max_len, n_rep,
+                                      flash=cfg.decode_flash)
             return mlp(lp, out_proj(lp, o, x))
         return attend_fn
 

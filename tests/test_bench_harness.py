@@ -229,6 +229,56 @@ def test_outage_attaches_banked_rows(bench, capsys):
     assert row["ts"] and row["rev"]
 
 
+def test_outage_refuses_cross_rev_speedups(bench, capsys, monkeypatch):
+    """A `*_speedup` ratio only attaches when it AND both component rows
+    carry the same recorded rev; mixed (or missing) revs land under
+    banked_speedups_dropped instead — the stale pre-factoring 0.73x
+    int8-KV row survived exactly because both sides defaulted to
+    "unrecorded" and compared equal."""
+    monkeypatch.setattr(bench, "_code_rev", lambda: "rev-a")
+    bench._bank({"decode_tokens_per_s": 5000.0,
+                 "decode_flash_tokens_per_s": 9000.0,
+                 "decode_flash_speedup": 1.8, "device": "tpu"},
+                group="decode")
+    bench._run_tpu_child = lambda mode, **kw: (None, "timeout (probe)")
+
+    def last_out():
+        return json.loads(
+            [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")][-1])
+
+    assert _run_main(bench, full=False) == 0
+    out = last_out()
+    assert out["banked_tpu_rows"]["decode_flash_speedup"]["value"] == 1.8
+    assert "decode_flash_speedup" not in out.get(
+        "banked_speedups_dropped", {})
+
+    # The variant row re-measured on different code: refuse the ratio
+    # (the plain component rows still attach).
+    monkeypatch.setattr(bench, "_code_rev", lambda: "rev-b")
+    bench._bank({"decode_flash_tokens_per_s": 9500.0, "device": "tpu"},
+                group="decode")
+    assert _run_main(bench, full=False) == 0
+    out = last_out()
+    assert "decode_flash_speedup" not in out["banked_tpu_rows"]
+    assert "decode_flash_tokens_per_s" in out["banked_tpu_rows"]
+    assert "different revs" in \
+        out["banked_speedups_dropped"]["decode_flash_speedup"]
+
+    # Rows predating rev stamping never count as matching.
+    bank_path = os.path.join(bench.REPO, "BENCH_BANK.json")
+    bank = json.load(open(bank_path))
+    for k in ("decode_tokens_per_s", "decode_flash_tokens_per_s",
+              "decode_flash_speedup"):
+        del bank[k]["rev"]
+    json.dump(bank, open(bank_path, "w"))
+    assert _run_main(bench, full=False) == 0
+    out = last_out()
+    assert "decode_flash_speedup" not in out.get("banked_tpu_rows", {})
+    assert "unrecorded" in \
+        out["banked_speedups_dropped"]["decode_flash_speedup"]
+
+
 def test_midrun_outage_artifact_carries_banked_rows(bench):
     """Tunnel dies mid --full run: BENCH_FULL.json itself (not just the
     stdout line) must carry the banked evidence."""
