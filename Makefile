@@ -123,6 +123,7 @@ check: ctest itest tools
 	@echo "== acxrun -np 2 ring (fault: 5ms delay on rank 1's first recv)"
 	@$(BUILD)/acxrun -np 2 -fault delay:rank=1:kind=recv:nth=1:us=5000 $(BUILD)/itests/ring || exit 1
 	@$(MAKE) --no-print-directory chaos-check || exit 1
+	@$(MAKE) --no-print-directory membership-check || exit 1
 	@$(MAKE) --no-print-directory metrics-check || exit 1
 	@$(MAKE) --no-print-directory doctor-check || exit 1
 	@$(MAKE) --no-print-directory decode-check || exit 1
@@ -148,6 +149,13 @@ chaos-check: itest tools
 	  -fault close_link_once:rank=0:nth=6 $(BUILD)/itests/chaos-ring || exit 1
 	@echo "== chaos-check: drain-on-death (survivors drain and exit 0)"
 	@$(BUILD)/acxrun -np 3 $(BUILD)/itests/drain-on-death || exit 1
+	@echo "== chaos-check: fault placement sweep (3 fixed seeds)"
+	@$(BUILD)/acxrun -np 2 -transport socket \
+	  -fault drop_frame:rank=1:nth=7:count=1 $(BUILD)/itests/chaos-ring || exit 1
+	@$(BUILD)/acxrun -np 2 -transport socket \
+	  -fault corrupt_frame:rank=0:nth=9:count=2 $(BUILD)/itests/chaos-ring || exit 1
+	@$(BUILD)/acxrun -np 2 -transport socket \
+	  -fault stall_link_ms:rank=1:nth=3:ms=60 $(BUILD)/itests/chaos-ring || exit 1
 	@rm -rf $(BUILD)/chaos-metrics && mkdir -p $(BUILD)/chaos-metrics
 	@echo "== chaos-check: corrupt_frame with ACX_METRICS + ACX_TRACE"
 	@ACX_METRICS=$(BUILD)/chaos-metrics/run ACX_TRACE=$(BUILD)/chaos-metrics/run \
@@ -159,6 +167,34 @@ chaos-check: itest tools
 	  $(BUILD)/chaos-metrics/run.rank*.trace.json \
 	  $(BUILD)/chaos-metrics/run.rank*.metrics.json || exit 1
 	@echo "CHAOS CHECK PASSED"
+
+# --- elastic fleet / membership plane end-to-end (DESIGN.md §12) ---
+# rolling-restart replaces every rank of the fleet one at a time under
+# load (socket plane: the only one a joiner can dial into), at two fleet
+# sizes, then deliberately wedges a join (ACX_RR_WEDGE=1): survivors must
+# time the join out with exit 7 and flight dumps, and acx_doctor.py must
+# attribute the hang to the victim even with its dump deleted — the gap
+# itself is the evidence.
+.PHONY: membership-check
+membership-check: itest tools
+	@echo "== membership-check: rolling-restart -np 2 (socket)"
+	@$(BUILD)/acxrun -np 2 -timeout 120 -transport socket \
+	  $(BUILD)/itests/rolling-restart || exit 1
+	@echo "== membership-check: rolling-restart -np 3 (socket)"
+	@$(BUILD)/acxrun -np 3 -timeout 120 -transport socket \
+	  $(BUILD)/itests/rolling-restart || exit 1
+	@rm -rf $(BUILD)/membership-check && mkdir -p $(BUILD)/membership-check
+	@echo "== membership-check: wedged join (exit 7 + doctor attribution)"
+	@ACX_RR_WEDGE=1 ACX_FLEET_JOIN_TIMEOUT_MS=8000 \
+	  ACX_FLIGHT=$(BUILD)/membership-check/rr \
+	  $(BUILD)/acxrun -np 3 -timeout 120 -transport socket \
+	  $(BUILD)/itests/rolling-restart; \
+	  st=$$?; [ $$st -eq 7 ] || { echo "wedge leg: want exit 7, got $$st"; exit 1; }
+	@rm -f $(BUILD)/membership-check/rr.rank1.flight.json
+	@python3 tools/acx_doctor.py \
+	  --expect-anomaly dead_link --expect-culprit 1 \
+	  $(BUILD)/membership-check/rr.rank*.flight.json || exit 1
+	@echo "MEMBERSHIP CHECK PASSED"
 
 # --- metrics plane end-to-end ---
 # 2-rank ping-pong with metrics + tracing on, then validate every artifact
@@ -231,4 +267,7 @@ tsan:
 	@for t in $(ITEST_BINS:$(BUILD)/%=build-tsan/%); do \
 	  echo "== tsan acxrun -np 2 $$t"; \
 	  TSAN_OPTIONS=halt_on_error=1 build-tsan/acxrun -np 2 -timeout 600 $$t || exit 1; done
+	@echo "== tsan acxrun -np 2 rolling-restart (socket, membership plane)"
+	@TSAN_OPTIONS=halt_on_error=1 build-tsan/acxrun -np 2 -timeout 600 \
+	  -transport socket build-tsan/itests/rolling-restart || exit 1
 	@echo "TSAN CLEAN"

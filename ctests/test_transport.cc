@@ -21,6 +21,7 @@
 #include <thread>
 #include <vector>
 
+#include "acx/fault.h"
 #include "acx/net.h"
 #include "acx/proxy.h"
 #include "acx/state.h"
@@ -313,6 +314,71 @@ void test_truncated_recv(Wire w) {
   std::printf("  truncated recv, direct + unexpected (%s): ok\n", WireName(w));
 }
 
+// Drain while a link is mid-recovery (DESIGN.md §9): an op parked on a
+// RECOVERING link must cancel in bounded time with the typed peer error,
+// and repeated drains must not re-count it — the cancelled op's flag left
+// the in-flight states, so a second CancelInflight finds nothing.
+void test_drain_while_recovering() {
+  // Arm recovery: socket plane + job id (binds this rank's rendezvous
+  // listener) + a long-pinned ladder so the link stays RECOVERING for the
+  // whole test — the redial target (rank 1's listener) never exists.
+  char job[64];
+  std::snprintf(job, sizeof job, "acx-ctest-drainrec-%d", getpid());
+  setenv("ACX_JOB_ID", job, 1);
+  setenv("ACX_RECONNECT_MAX", "8", 1);
+  setenv("ACX_RECONNECT_BACKOFF_MS", "500", 1);
+  {
+    int a[2];
+    CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, a) == 0);
+    std::unique_ptr<acx::Transport> t0(
+        acx::CreateSocketTransport(0, 2, {-1, a[0]}));
+    acx::FlagTable ft(64);
+    acx::Proxy px(&ft, t0.get());
+    px.Start();
+
+    int rv = -1;
+    int ri = ft.Allocate();
+    CHECK(ri >= 0);
+    acx::Op& ro = ft.op(ri);
+    ro.kind = acx::OpKind::kIrecv;
+    ro.rbuf = &rv;
+    ro.bytes = sizeof rv;
+    ro.peer = 1;
+    ro.tag = 4;
+    ft.Store(ri, acx::kPending);
+    px.Kick();
+    const uint64_t deadline = acx::NowNs() + 10ull * 1000 * 1000 * 1000;
+    while (ft.Load(ri) == acx::kPending) {
+      CHECK(acx::NowNs() < deadline);
+      std::this_thread::yield();
+    }
+    // Cut the wire from the far end. With a recv in flight and the ladder
+    // armed, the transport enters RECOVERING instead of the dead-latch.
+    close(a[1]);
+    while (t0->peer_health(1) != acx::PeerHealth::kRecovering) {
+      CHECK(acx::NowNs() < deadline);
+      CHECK(t0->peer_health(1) != acx::PeerHealth::kDead);
+      std::this_thread::yield();
+    }
+    // First drain cancels the parked op — exactly one, typed as a peer
+    // failure because the peer is unhealthy at cancel time.
+    CHECK(px.CancelInflight() == 1);
+    CHECK(ft.Load(ri) == acx::kCompleted);
+    CHECK(ro.status.error == acx::kErrPeerDead);
+    // Second drain of the (still recovering) link finds nothing left in
+    // flight: drained counts must not double.
+    CHECK(px.CancelInflight() == 0);
+    ft.Store(ri, acx::kCleanup);
+    px.Kick();
+    while (ft.active.load() != 0) std::this_thread::yield();
+    px.Stop();
+  }
+  unsetenv("ACX_JOB_ID");
+  unsetenv("ACX_RECONNECT_MAX");
+  unsetenv("ACX_RECONNECT_BACKOFF_MS");
+  std::printf("  drain while link RECOVERING: ok\n");
+}
+
 }  // namespace
 
 int main() {
@@ -327,6 +393,7 @@ int main() {
     test_partitioned_round_trip(w);
     test_proxy_over_wire(w);
   }
+  test_drain_while_recovering();
   std::printf("test_transport: ALL OK\n");
   return 0;
 }

@@ -1,0 +1,89 @@
+// tpu-acx: fleet membership — the epoch-versioned runtime object that makes
+// "who is in the job" first-class instead of a fixed world baked in at init
+// (DESIGN.md §12).
+//
+// PRs 1-4 let a rank's death be *survived*; this table lets a rank be
+// *replaced* (or leave voluntarily) while the job runs. Every rank keeps a
+// local view: one MemberState per rank slot plus a monotonically increasing
+// *fleet epoch* that bumps on every membership transition (join, leave,
+// death). Views on different ranks converge through three feeds:
+//   * the transport's JOIN handshake (a late joiner dialing the ACX_JOB_ID
+//     rendezvous listener) marks the joiner ACTIVE on every acceptor;
+//   * VIEW control frames fan a transition out over existing links;
+//   * the heartbeat monitor / EOF dead-latch feeds crash verdicts, so
+//     crash-leave and graceful-leave converge on one state machine.
+// Epochs are per-rank monotone, not globally agreed — a view adoption takes
+// max(local, remote), which is all the rolling-restart invariant (strictly
+// increasing across the run) needs.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <vector>
+
+namespace acx {
+
+// Lifecycle: JOINING -> ACTIVE -> DRAINING -> LEFT | DEAD -> (ACTIVE again
+// when a replacement re-occupies the slot). Values are part of the C API
+// (MPIX_Fleet_view) and the Python bindings — do not renumber.
+enum class MemberState : int32_t {
+  kMemberUnknown = 0,
+  kMemberJoining = 1,
+  kMemberActive = 2,
+  kMemberDraining = 3,
+  kMemberLeft = 4,
+  kMemberDead = 5,
+};
+
+// Snapshot for acx_fleet_stats (order is the C ABI).
+struct FleetStats {
+  uint64_t epoch = 0;   // current fleet epoch
+  uint64_t joins = 0;   // ranks that (re)joined after init
+  uint64_t leaves = 0;  // graceful departures observed
+  uint64_t deaths = 0;  // crash verdicts observed
+  uint64_t active = 0;  // slots currently ACTIVE (includes self)
+};
+
+class Membership {
+ public:
+  // (Re)shape the table: `size` slots, everyone ACTIVE, epoch 1. Called by
+  // the transport factories — the transport is the authority on fleet shape.
+  void Reset(int size, int self_rank);
+
+  int size() const;
+  uint64_t epoch() const {  // lock-free; hot paths may poll it
+    return epoch_.load(std::memory_order_acquire);
+  }
+  MemberState state(int rank) const;
+
+  // Local transitions; each returns the (bumped) fleet epoch. A transition
+  // to the state a slot is already in does not bump.
+  uint64_t OnJoin(int rank);    // slot re-occupied: -> ACTIVE
+  uint64_t OnLeave(int rank);   // graceful: -> LEFT
+  uint64_t OnDeath(int rank);   // crash verdict: -> DEAD
+  void OnDraining(int rank);    // transient; no epoch bump
+
+  // Remote feeds. AdoptEpoch folds a peer's fleet epoch into ours
+  // (max-merge); AdoptView additionally applies the peer-reported state.
+  void AdoptEpoch(uint64_t remote_epoch);
+  uint64_t AdoptView(int rank, MemberState st, uint64_t remote_epoch);
+
+  FleetStats stats() const;
+  // Copy up to `cap` per-rank states into out; returns the fleet size.
+  int View(int32_t* out, int cap) const;
+
+ private:
+  uint64_t BumpLocked();  // callers hold mu_
+
+  mutable std::mutex mu_;
+  std::atomic<uint64_t> epoch_{0};
+  std::vector<MemberState> state_;
+  int self_ = -1;
+  uint64_t joins_ = 0, leaves_ = 0, deaths_ = 0;
+};
+
+// Process-wide membership table (one fleet per process, like GS()).
+Membership& Fleet();
+
+}  // namespace acx

@@ -52,6 +52,10 @@ enum Counter : int {
   kCrcRejects,         // payload CRC mismatches detected on receive
   kNaksSent,           // re-pull requests sent (gap / CRC / tail loss)
   kDrainedSlots,       // in-flight ops cancelled by MPIX_Drain
+  kFleetEpoch,         // current fleet epoch (membership plane, §12)
+  kFleetJoins,         // ranks that (re)joined after init
+  kFleetLeaves,        // graceful departures observed
+  kFleetDeaths,        // crash verdicts observed
   kNumCounters
 };
 

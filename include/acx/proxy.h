@@ -94,6 +94,11 @@ class Proxy {
   std::atomic<uint64_t> slots_reclaimed_{0};
   std::atomic<uint64_t> retries_{0};
   std::atomic<uint64_t> timeouts_{0};
+  // Membership plane (DESIGN.md §12): last fleet epoch the run loop saw —
+  // a bump while idle means a join/leave/death verdict landed, and the
+  // proxy resweeps immediately so parked ops observe the new view instead
+  // of napping through it. Touched only by the proxy thread.
+  uint64_t fleet_epoch_seen_ = 0;
 };
 
 }  // namespace acx

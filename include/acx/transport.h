@@ -121,6 +121,12 @@ class Transport {
   // snapshot without blocking — callers on the dump/signal path must
   // tolerate a refusal, never retry-spin on it.
   virtual bool link_clock(int /*rank*/, LinkClock* /*out*/) { return false; }
+
+  // Graceful departure (MPIX_Fleet_leave, DESIGN.md §12): announce LEFT to
+  // the fleet and surrender the rendezvous listener so a replacement can
+  // take the slot. Called after the caller has drained in-flight work; a
+  // no-op on transports without a membership plane (self/shm).
+  virtual void FleetLeave() {}
 };
 
 }  // namespace acx

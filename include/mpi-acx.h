@@ -18,6 +18,7 @@
 
 #include <mpi.h>
 #include <cuda_runtime.h>
+#include <stdint.h>  /* MPIX_Fleet_epoch / MPIX_Fleet_view */
 
 #ifdef __cplusplus
 extern "C" {
@@ -113,6 +114,33 @@ int MPIX_Op_status(MPIX_Request request, int *state, int *error,
  * or -1 before MPIX_Init. Survivors of a peer loss call this to unblock
  * every waiter in bounded time and keep running. */
 int MPIX_Drain(double timeout_ms);
+
+/* FLEET MEMBERSHIP (tpu-acx extension, docs/DESIGN.md "Elastic fleet"):
+ * the rank set is an epoch-versioned runtime object, not a fixed world —
+ * ranks can leave gracefully, crash, and be replaced live (ACX_JOIN=1). */
+
+/* Per-rank membership states as reported by MPIX_Fleet_view. */
+#define MPIX_FLEET_UNKNOWN  0
+#define MPIX_FLEET_JOINING  1
+#define MPIX_FLEET_ACTIVE   2
+#define MPIX_FLEET_DRAINING 3
+#define MPIX_FLEET_LEFT     4
+#define MPIX_FLEET_DEAD     5
+
+/* Current fleet epoch: 1 at init, bumps on every membership transition
+ * (join/leave/death), max-merges with peer views — strictly increasing on
+ * every rank across a rolling restart. 0 before MPIX_Init. */
+uint64_t MPIX_Fleet_epoch(void);
+
+/* Copy up to cap per-rank MPIX_FLEET_* states into `states`; returns the
+ * fleet size (call with (NULL, 0) to size the buffer). 0 before init. */
+int MPIX_Fleet_view(int32_t *states, int cap);
+
+/* Graceful departure: drain in-flight work for up to timeout_ms, announce
+ * LEFT to every peer, and surrender the rendezvous listener so a
+ * replacement process can take this rank slot. Returns the number of ops
+ * the drain cancelled (0 = clean), or -1 before init. */
+int MPIX_Fleet_leave(double timeout_ms);
 
 /* Dump this rank's runtime state — flight-recorder events, live slot
  * table, per-peer link clocks — to <prefix>.rank<r>.flight.json, where

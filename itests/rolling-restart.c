@@ -1,0 +1,400 @@
+/* tpu-acx integration test: rolling restart of the whole fleet under load
+ * (DESIGN.md §12 — the elastic-fleet capstone).
+ *
+ * Every rank is replaced one at a time, rank 0 last. In each round the
+ * victim drains and leaves gracefully (MPIX_Fleet_leave), then forks a
+ * replacement that execs this same binary with ACX_JOIN=1 and no inherited
+ * fds — the replacement bootstraps every link through the peers'
+ * ACX_JOB_ID rendezvous listeners with a JOIN handshake while the
+ * original process stays behind as a supervisor, waiting to chain the
+ * replacement's verdict up to acxrun. Meanwhile the survivors keep
+ * traffic flowing among themselves (continuous service through the
+ * outage), wait for their own adoption of the new incarnation, and then
+ * the FULL ring — replacement included — exchanges byte-verified
+ * payloads. Asserted every round, on every rank: zero payload loss or
+ * corruption, the local fleet epoch strictly increasing, and a
+ * fully-ACTIVE membership view after the join settles.
+ *
+ * Wedged-join leg (ACX_RR_WEDGE=1): the first replacement execs with a
+ * poisoned ACX_JOB_ID so its JOIN can never rendezvous. Survivors time
+ * out waiting for the slot to come back, dump flight state
+ * (MPIX_Dump_state) and exit 7; the replacement exits 13 without ever
+ * writing a dump — which is exactly the missing-dump-as-evidence case
+ * tools/acx_doctor.py must attribute (tests/test_fleet.py drives this).
+ *
+ * Needs the socket plane and an ACX_JOB_ID (the rendezvous namespace);
+ * on any other configuration it reports OK and exits 0 so the
+ * all-planes `make check` matrix can run it unconditionally.
+ * Run under `acxrun -np N -transport socket`.
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <mpi.h>
+#include <mpi-acx.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+void acx_fleet_stats(uint64_t *out);
+#ifdef __cplusplus
+}
+#endif
+
+extern char **environ;
+
+#define N_PAYLOAD 256
+#define MAX_RANKS 16
+
+static int expect(int rank, int round, int i) {
+    return rank * 1000003 + round * 8191 + i * 7 + 1;
+}
+
+static uint64_t now_ms(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1000u + (uint64_t)(ts.tv_nsec / 1000000);
+}
+
+/* Build the replacement's environment: strip the inherited wiring
+ * (ACX_FDS / ACX_SHM_FD — the fds themselves are CLOEXEC and will not
+ * survive the exec), arm the JOIN path, and tell the new incarnation
+ * which round it is joining into. Built in the parent BEFORE fork so the
+ * post-fork child only execs (no allocation in the forked child of a
+ * multithreaded process). */
+static char **make_join_env(int round, int wedge) {
+    int n = 0;
+    while (environ[n] != NULL) n++;
+    char **env = (char **)malloc((size_t)(n + 4) * sizeof(char *));
+    int m = 0;
+    for (int i = 0; i < n; i++) {
+        const char *e = environ[i];
+        if (strncmp(e, "ACX_FDS=", 8) == 0) continue;
+        if (strncmp(e, "ACX_SHM_FD=", 11) == 0) continue;
+        if (strncmp(e, "ACX_JOIN=", 9) == 0) continue;
+        if (strncmp(e, "ACX_RR_RESUME=", 14) == 0) continue;
+        if (wedge && strncmp(e, "ACX_JOB_ID=", 11) == 0) continue;
+        env[m++] = (char *)e;
+    }
+    static char join_kv[] = "ACX_JOIN=1";
+    static char resume_kv[32];
+    static char wedge_kv[64];
+    snprintf(resume_kv, sizeof resume_kv, "ACX_RR_RESUME=%d", round);
+    env[m++] = join_kv;
+    env[m++] = resume_kv;
+    if (wedge) {
+        /* A job id nobody listens on: the JOIN can never rendezvous. */
+        snprintf(wedge_kv, sizeof wedge_kv, "ACX_JOB_ID=wedged-%d",
+                 (int)getpid());
+        env[m++] = wedge_kv;
+    }
+    env[m] = NULL;
+    return env;
+}
+
+/* Full-fleet ring exchange for `round`, byte-verified. Returns 0 on
+ * success. */
+static int full_ring(int rank, int size, int round) {
+    const int right = (rank + 1) % size;
+    const int left = (rank + size - 1) % size;
+    int sbuf[N_PAYLOAD], rbuf[N_PAYLOAD];
+    for (int i = 0; i < N_PAYLOAD; i++) {
+        sbuf[i] = expect(rank, round, i);
+        rbuf[i] = -1;
+    }
+    cudaStream_t stream = 0;
+    MPIX_Request req[2];
+    MPI_Status st;
+    MPIX_Isend_enqueue(sbuf, N_PAYLOAD, MPI_INT, right, 100 + round,
+                       MPI_COMM_WORLD, &req[0], MPIX_QUEUE_XLA_STREAM,
+                       &stream);
+    MPIX_Irecv_enqueue(rbuf, N_PAYLOAD, MPI_INT, left, 100 + round,
+                       MPI_COMM_WORLD, &req[1], MPIX_QUEUE_XLA_STREAM,
+                       &stream);
+    MPIX_Wait(&req[0], MPI_STATUS_IGNORE);
+    MPIX_Wait(&req[1], &st);
+    if (st.MPI_ERROR != MPI_SUCCESS) {
+        printf("[%d] round %d: verify recv error %d\n", rank, round,
+               st.MPI_ERROR);
+        return 1;
+    }
+    for (int i = 0; i < N_PAYLOAD; i++) {
+        if (rbuf[i] != expect(left, round, i)) {
+            printf("[%d] round %d: rbuf[%d] = %d, want %d\n", rank, round,
+                   i, rbuf[i], expect(left, round, i));
+            return 1;
+        }
+    }
+    return 0;
+}
+
+/* Ring among the survivors of `victim` — the injected load that must keep
+ * completing while the slot is empty. Fixed iteration count so every
+ * survivor posts exactly the same ops. */
+static int survivor_ring(int rank, int size, int victim, int round) {
+    int alive[MAX_RANKS], nsurv = 0, idx = -1;
+    for (int r = 0; r < size; r++) {
+        if (r == victim) continue;
+        if (r == rank) idx = nsurv;
+        alive[nsurv++] = r;
+    }
+    if (nsurv < 2) return 0;
+    const int right = alive[(idx + 1) % nsurv];
+    const int left = alive[(idx + nsurv - 1) % nsurv];
+    cudaStream_t stream = 0;
+    for (int it = 0; it < 3; it++) {
+        int sv = rank * 31 + round * 7 + it, rv = -1;
+        MPIX_Request req[2];
+        MPI_Status st;
+        MPIX_Isend_enqueue(&sv, 1, MPI_INT, right, 200 + round * 8 + it,
+                           MPI_COMM_WORLD, &req[0], MPIX_QUEUE_XLA_STREAM,
+                           &stream);
+        MPIX_Irecv_enqueue(&rv, 1, MPI_INT, left, 200 + round * 8 + it,
+                           MPI_COMM_WORLD, &req[1], MPIX_QUEUE_XLA_STREAM,
+                           &stream);
+        MPIX_Wait(&req[0], MPI_STATUS_IGNORE);
+        MPIX_Wait(&req[1], &st);
+        if (st.MPI_ERROR != MPI_SUCCESS || rv != left * 31 + round * 7 + it) {
+            printf("[%d] round %d: survivor ring broken (err %d, got %d)\n",
+                   rank, round, st.MPI_ERROR, rv);
+            return 1;
+        }
+    }
+    return 0;
+}
+
+/* All-to-all token exchange: returns 0 when every peer's token arrived.
+ * Used (twice) to fence the final membership assertion: after one round
+ * everyone has reached the fence; after the check a second round keeps
+ * every process alive until every CHECK has run, so nobody's teardown EOF
+ * flips a slot to LEFT under a peer still asserting all-ACTIVE. */
+static int token_fence(int rank, int size, int tag) {
+    cudaStream_t stream = 0;
+    static int token;
+    token = tag;
+    MPIX_Request req[2 * MAX_RANKS];
+    int rbuf[MAX_RANKS];
+    int n = 0;
+    for (int r = 0; r < size; r++) {
+        if (r == rank) continue;
+        MPIX_Isend_enqueue(&token, 1, MPI_INT, r, tag, MPI_COMM_WORLD,
+                           &req[n++], MPIX_QUEUE_XLA_STREAM, &stream);
+        rbuf[r] = -1;
+        MPIX_Irecv_enqueue(&rbuf[r], 1, MPI_INT, r, tag, MPI_COMM_WORLD,
+                           &req[n++], MPIX_QUEUE_XLA_STREAM, &stream);
+    }
+    for (int i = 0; i < n; i++) {
+        MPI_Status st;
+        MPIX_Wait(&req[i], &st);
+        if (st.MPI_ERROR != MPI_SUCCESS) {
+            printf("[%d] fence %d: op error %d\n", rank, tag, st.MPI_ERROR);
+            return 1;
+        }
+    }
+    for (int r = 0; r < size; r++) {
+        if (r != rank && rbuf[r] != tag) {
+            printf("[%d] fence %d: token from %d = %d\n", rank, tag, r,
+                   rbuf[r]);
+            return 1;
+        }
+    }
+    return 0;
+}
+
+/* The replacement announces itself to every survivor right after its JOIN
+ * completes. A DELIVERED hello is the race-free adoption signal: frames
+ * from the new incarnation can only arrive over the link our transport
+ * installed when it accepted the JOIN dial, so receiving one proves our
+ * slot points at the replacement — membership polling alone cannot (a
+ * fanned-out VIEW can mark the slot ACTIVE before the joiner dials us). */
+static void send_join_hellos(int rank, int size, int round) {
+    cudaStream_t stream = 0;
+    static int token;
+    token = round;
+    for (int r = 0; r < size; r++) {
+        if (r == rank) continue;
+        MPIX_Request req;
+        MPIX_Isend_enqueue(&token, 1, MPI_INT, r, 900 + round,
+                           MPI_COMM_WORLD, &req, MPIX_QUEUE_XLA_STREAM,
+                           &stream);
+        MPIX_Wait(&req, MPI_STATUS_IGNORE);
+    }
+}
+
+/* Survivor side: wait (bounded) for the replacement's hello. While the
+ * victim's graceful LEFT is still latched, posts against the slot complete
+ * immediately with MPIX_ERR_PEER_DEAD — retry until the JOIN lands. On a
+ * wedged join nothing ever arrives: dump flight state for the hang doctor
+ * and fail. */
+static void await_join_hello(int rank, int round, int victim,
+                             uint64_t wait_ms) {
+    cudaStream_t stream = 0;
+    const uint64_t deadline = now_ms() + wait_ms;
+    for (;;) {
+        const uint64_t left_ms = deadline > now_ms() ? deadline - now_ms() : 1;
+        MPIX_Set_deadline((double)left_ms);
+        int token = -1;
+        MPIX_Request req;
+        MPI_Status st;
+        MPIX_Irecv_enqueue(&token, 1, MPI_INT, victim, 900 + round,
+                           MPI_COMM_WORLD, &req, MPIX_QUEUE_XLA_STREAM,
+                           &stream);
+        MPIX_Wait(&req, &st);
+        if (st.MPI_ERROR == MPI_SUCCESS) {
+            if (token != round) {
+                printf("[%d] round %d: join hello token %d, want %d\n",
+                       rank, round, token, round);
+                fflush(stdout);
+                _exit(9);
+            }
+            MPIX_Set_deadline(30000); /* restore the failsafe */
+            return;
+        }
+        if (now_ms() >= deadline) {
+            printf("[%d] round %d: replacement for rank %d never joined "
+                   "(%llums, last err %d); dumping flight state\n",
+                   rank, round, victim, (unsigned long long)wait_ms,
+                   st.MPI_ERROR);
+            fflush(stdout);
+            MPIX_Dump_state();
+            _exit(7);
+        }
+        usleep(5000); /* slot still LEFT-latched; retry until adoption */
+    }
+}
+
+int main(int argc, char **argv) {
+    (void)argc;
+    /* Socket plane + a rendezvous namespace or there is nothing to test;
+     * report OK so the all-planes `make check` matrix can include us. */
+    const char *want = getenv("ACX_TRANSPORT");
+    const int socket_plane =
+        (want != NULL && strcmp(want, "socket") == 0) ||
+        getenv("ACX_SHM_FD") == NULL;
+    if (!socket_plane || getenv("ACX_JOB_ID") == NULL) {
+        const char *r_s = getenv("ACX_RANK");
+        if (r_s == NULL || atoi(r_s) == 0)
+            printf("rolling-restart: OK (skipped: needs socket plane + "
+                   "ACX_JOB_ID)\n");
+        return 0;
+    }
+
+    int provided, rank, size, errs = 0;
+    MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided);
+    if (provided < MPI_THREAD_MULTIPLE) MPI_Abort(MPI_COMM_WORLD, 1);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    if (size < 2 || size > MAX_RANKS) {
+        printf("rolling-restart: needs 2..%d ranks\n", MAX_RANKS);
+        MPI_Abort(MPI_COMM_WORLD, 1);
+    }
+    if (MPIX_Init()) MPI_Abort(MPI_COMM_WORLD, 2);
+    MPIX_Set_deadline(30000); /* failsafe under acxrun's job timeout */
+
+    const int wedge = getenv("ACX_RR_WEDGE") != NULL &&
+                      atoi(getenv("ACX_RR_WEDGE")) != 0;
+    const char *jw_s = getenv("ACX_RR_JOIN_WAIT_MS");
+    const uint64_t join_wait_ms =
+        jw_s != NULL && atoi(jw_s) > 0 ? (uint64_t)atoi(jw_s)
+                                       : (wedge ? 6000u : 20000u);
+    const char *resume_s = getenv("ACX_RR_RESUME");
+    const int resume = resume_s != NULL ? atoi(resume_s) : -1;
+
+    /* Round r replaces victim (r + 1) % size — rank 0 goes last. A
+     * replacement (resume >= 0) joined DURING round `resume`: it skips
+     * the leave/outage phases of that round, announces itself, and goes
+     * straight to the full-ring verify. */
+    for (int round = resume >= 0 ? resume : 0; round < size; round++) {
+        const int victim = (round + 1) % size;
+        const int joined_this_round = (resume == round);
+
+        if (joined_this_round) {
+            send_join_hellos(rank, size, round);
+        } else if (rank == victim) {
+            /* Graceful exit: drain, announce LEFT, surrender the
+             * listener — then hand the slot to a fresh incarnation and
+             * stay behind only to chain its verdict to acxrun (which
+             * waits on its direct children, not grandchildren). */
+            const int cancelled = MPIX_Fleet_leave(2000);
+            if (cancelled != 0) {
+                printf("[%d] round %d: leave cancelled %d ops, want 0\n",
+                       rank, round, cancelled);
+                fflush(stdout);
+                _exit(3);
+            }
+            char **env = make_join_env(round, wedge && round == 0);
+            fflush(stdout);
+            fflush(stderr);
+            pid_t pid = fork();
+            if (pid < 0) _exit(4);
+            if (pid == 0) {
+                execve(argv[0], argv, env);
+                _exit(127);
+            }
+            int st = 0;
+            while (waitpid(pid, &st, 0) < 0) {
+            }
+            _exit(WIFEXITED(st) ? WEXITSTATUS(st) : 128 + WTERMSIG(st));
+        } else {
+            /* Injected load: service among survivors keeps completing
+             * while the victim's slot is down. */
+            if (survivor_ring(rank, size, victim, round)) {
+                fflush(stdout);
+                _exit(5);
+            }
+            /* Then wait for our own adoption of the replacement. */
+            await_join_hello(rank, round, victim, join_wait_ms);
+        }
+
+        /* Full fleet back: verify service with every rank, replacement
+         * included, with a round-unique byte-checked payload. */
+        if (full_ring(rank, size, round)) {
+            fflush(stdout);
+            _exit(8);
+        }
+
+        /* Fleet epoch floor: every completed round contributes exactly
+         * two bumps to every live rank's view (the victim's LEFT — via
+         * VIEW frame, quiet EOF latch, or the supersede step of JOIN
+         * adoption — and the replacement's join), and a replacement
+         * adopts at least its first acceptor's post-join epoch. So after
+         * round r every rank must be at >= 1 + 2*(r+1). */
+        const uint64_t e = MPIX_Fleet_epoch();
+        const uint64_t floor_e = 1 + 2u * (uint64_t)(round + 1);
+        if (e < floor_e) {
+            printf("[%d] round %d: fleet epoch %llu below floor %llu\n",
+                   rank, round, (unsigned long long)e,
+                   (unsigned long long)floor_e);
+            errs++;
+            break;
+        }
+
+        /* After the LAST join settles the local view is fully ACTIVE.
+         * (Intermediate rounds can't assert this: the next victim's leave
+         * races with this read. And even the final read must be fenced on
+         * both sides — a peer's teardown EOF flips its slot to LEFT.) */
+        if (round == size - 1) {
+            errs += token_fence(rank, size, 980);
+            uint64_t fs[5];
+            acx_fleet_stats(fs);
+            if (errs == 0 && fs[4] != (uint64_t)size) {
+                printf("[%d] round %d: %llu ACTIVE slots, want %d\n", rank,
+                       round, (unsigned long long)fs[4], size);
+                errs++;
+            }
+            errs += token_fence(rank, size, 981);
+        }
+    }
+
+    MPIX_Finalize(); /* local teardown; no barrier — peers are chains of
+                        supervisors and replacements, not one rank set */
+    if (rank == 0 && errs == 0) printf("rolling-restart: OK\n");
+    fflush(stdout);
+    fflush(stderr);
+    _exit(errs != 0);
+}

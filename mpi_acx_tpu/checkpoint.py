@@ -87,6 +87,31 @@ class Checkpointer:
         return False
 
 
+def warm_start(directory: str, like: Any,
+               step: Optional[int] = None):
+    """Join-warm restore for a replacement rank (docs/DESIGN.md §12).
+
+    A rank joining a serving fleet mid-job must come up with the SAME
+    weights the fleet is serving, not re-initialized ones — restore the
+    latest step (or ``step``) into ``like``'s structure/shardings and
+    return ``(state, step)``. Returns ``(None, None)`` when the directory
+    holds no checkpoint yet (a fleet that never saved: the joiner keeps
+    its freshly built state, which is what the others are running too).
+
+    >>> state, step = warm_start(ckpt_dir, like=init_state)
+    >>> if state is None: state = init_state
+    """
+    ckpt = Checkpointer(directory)
+    try:
+        if step is None:
+            step = ckpt.latest_step()
+        if step is None:
+            return None, None
+        return ckpt.restore(step, like=like), step
+    finally:
+        ckpt.close()
+
+
 def _abstractify(x):
     """Target entry for StandardRestore: keep jax.Arrays as abstract
     shape/dtype/sharding descriptors, leave scalars and numpy as-is."""

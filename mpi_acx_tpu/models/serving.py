@@ -75,6 +75,7 @@ class ServingMetrics:
     requeues: int = 0             # failure-path restarts
     peer_requeues: int = 0        # requeues from peer loss (uncharged)
     slots_shed: int = 0           # slots retired to match lost capacity
+    slots_revived: int = 0        # shed slots returned after a fleet join
     hang_dumps: int = 0           # flight dumps written on step failure
     ttft_p50_s: float = 0.0
     ttft_p99_s: float = 0.0
@@ -114,6 +115,24 @@ def _peer_dead(exc: BaseException) -> bool:
         return True
     msg = str(exc).lower()
     return "peer dead" in msg or "peer_dead" in msg
+
+
+def _fleet_active() -> Optional[int]:
+    """Best-effort count of ACTIVE rank slots in this process's fleet view
+    (docs/DESIGN.md §12), or None when the native runtime isn't loaded —
+    same no-build/no-load discipline as ``_flight_dump_best_effort``. The
+    serving loop polls this to notice capacity RETURNING: a replacement
+    rank joining raises the count, and shed slots come back."""
+    try:
+        import ctypes
+        import mpi_acx_tpu.runtime as _rt
+        if _rt._lib is None:
+            return None
+        out = (ctypes.c_uint64 * 5)()
+        _rt._lib.acx_fleet_stats(out)
+        return int(out[4])
+    except Exception:  # pragma: no cover — diagnostics must never raise
+        return None
 
 
 def _flight_dump_best_effort() -> bool:
@@ -325,7 +344,13 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
     n_requeues = 0
     n_peer_requeues = 0
     n_shed = 0
+    n_revived = 0
     n_hang_dumps = 0
+    # Fleet-elastic capacity (docs/DESIGN.md §12): remember how many rank
+    # slots were ACTIVE at entry; a later rise (a replacement joined)
+    # revives shed serving slots so queued requests rebalance onto the
+    # restored capacity. None = no native runtime loaded, feature dormant.
+    fleet_active_seen = _fleet_active()
 
     def _requeue(rid, prompt, exc, charge=True):
         """Put a failed request back on the queue for a bit-equal
@@ -348,6 +373,29 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
         ttft[rid] = None   # the replayed attempt re-earns its first token
         n_requeues += 1
         queue.append((rid, prompt))
+
+    def _check_fleet_rejoin():
+        """Revive shed slots when the fleet view shows capacity back: a
+        joined replacement returns the serving width a peer loss took
+        away. Returns the revived slot indices so the caller rebalances
+        queued requests onto exactly those — the rest of the schedule is
+        untouched. A drop in ACTIVE slots just lowers the watermark, so
+        the NEXT join (not the leave that preceded it) triggers revival."""
+        nonlocal fleet_active_seen, n_revived
+        if fleet_active_seen is None:
+            return []
+        act = _fleet_active()
+        if act is None:
+            return []
+        revived = []
+        if act > fleet_active_seen:
+            for b in range(n_slots):
+                if owner[b] == -2:
+                    owner[b] = -1
+                    revived.append(b)
+            n_revived += len(revived)
+        fleet_active_seen = act
+        return revived
 
     def _shed_slot():
         """Retire one idle slot for good (owner -2): a lost rank shrank
@@ -426,6 +474,12 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
     while any(o >= 0 for o in owner) or queue:
         qd_samples.append(len(queue))
         occ_samples.append(sum(o >= 0 for o in owner) / n_slots)
+        if queue:
+            # Capacity may have returned (a replacement rank joined):
+            # revive shed slots and rebalance the backlog onto them.
+            for b in _check_fleet_rejoin():
+                if queue and refill(b) and slot_finished(b):
+                    retire(b)
         if not any(o >= 0 for o in owner):
             # All slots idle with requests still queued: only reachable
             # after a failure re-queued them — reseed and keep serving.
@@ -516,6 +570,7 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
         requeues=n_requeues,
         peer_requeues=n_peer_requeues,
         slots_shed=n_shed,
+        slots_revived=n_revived,
         hang_dumps=n_hang_dumps,
         ttft_p50_s=_pct([r.ttft_s for r in per_request], 0.50),
         ttft_p99_s=_pct([r.ttft_s for r in per_request], 0.99),

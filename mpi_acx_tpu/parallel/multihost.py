@@ -125,6 +125,31 @@ def recovery_budget_s(max_attempts: Optional[int] = None,
     return total_ms / 1000.0 + margin_s
 
 
+def fleet_join_budget_s(timeout_ms: Optional[float] = None,
+                        margin_s: float = 1.0) -> float:
+    """Worst-case seconds a joining replacement rank may spend dialing the
+    fleet's rendezvous listeners before the native transport gives up
+    (exit 13) — ACX_FLEET_JOIN_TIMEOUT_MS plus a fixed ``margin_s`` for
+    the per-peer JOIN handshakes. The rolling-restart counterpart of
+    ``recovery_budget_s``: a coordinator (or serving loop) replacing a
+    rank should wait at least this long for the new incarnation's slot to
+    come back ACTIVE before escalating to the hang doctor."""
+    if timeout_ms is None:
+        timeout_ms = float(os.environ.get("ACX_FLEET_JOIN_TIMEOUT_MS",
+                                          "10000"))
+    return timeout_ms / 1000.0 + margin_s
+
+
+def fleet_snapshot(runtime) -> dict:
+    """One-call fleet summary off a ``Runtime``: ``{"epoch", "view",
+    "stats"}`` (docs/DESIGN.md §12). The view is THIS process's — epochs
+    converge by max-merge, so treat it as a local observation, not a
+    global agreement."""
+    return {"epoch": runtime.fleet_epoch(),
+            "view": runtime.fleet_view(),
+            "stats": runtime.fleet_stats()}
+
+
 def process_count() -> int:
     return jax.process_count()
 

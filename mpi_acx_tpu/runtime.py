@@ -99,6 +99,13 @@ def lib() -> ctypes.CDLL:
         _lib.acx_flight_dump.argtypes = [ctypes.c_char_p]
         _lib.acx_flight_stats.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
         _lib.MPIX_Dump_state.restype = ctypes.c_int
+        _lib.MPIX_Fleet_epoch.restype = ctypes.c_uint64
+        _lib.MPIX_Fleet_view.restype = ctypes.c_int
+        _lib.MPIX_Fleet_view.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+        _lib.MPIX_Fleet_leave.restype = ctypes.c_int
+        _lib.MPIX_Fleet_leave.argtypes = [ctypes.c_double]
+        _lib.acx_fleet_stats.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
     return _lib
 
 
@@ -131,6 +138,11 @@ _DTYPE_TO_MPI = {
 
 QUEUE_STREAM = 0
 QUEUE_GRAPH = 1
+
+# MemberState values of the fleet membership table (include/acx/membership.h;
+# MPIX_FLEET_* in include/mpi-acx.h). Indices are the C enum — do not reorder.
+FLEET_STATE_NAMES = ("unknown", "joining", "active", "draining", "left",
+                     "dead")
 
 
 class Runtime:
@@ -408,6 +420,47 @@ class Runtime:
             "links_recovering": out[5],
         }
 
+    # -- fleet membership (docs/DESIGN.md §12) ------------------------------
+
+    def fleet_epoch(self) -> int:
+        """Monotonically increasing fleet epoch: bumps on every membership
+        verdict this rank adopts (a join, a graceful leave, a death).
+        Epochs are per-rank views that converge by max-merge — compare for
+        ordering on one rank, not for equality across ranks."""
+        return int(self._lib.MPIX_Fleet_epoch())
+
+    def fleet_view(self) -> list:
+        """This rank's membership view, one state name per rank slot
+        (``FLEET_STATE_NAMES``): ``"active"``, ``"draining"``, ``"left"``,
+        ``"dead"``, ... A replaced rank's slot returns to ``"active"`` when
+        this rank adopts the new incarnation."""
+        states = (ctypes.c_int32 * max(self.size, 1))()
+        n = self._lib.MPIX_Fleet_view(states, self.size)
+        return [FLEET_STATE_NAMES[states[i]]
+                if 0 <= states[i] < len(FLEET_STATE_NAMES) else "unknown"
+                for i in range(max(n, 0))]
+
+    def fleet_stats(self) -> dict:
+        """Membership counters: current epoch, joins/leaves/deaths adopted
+        into this rank's view, and slots currently ACTIVE."""
+        out = (ctypes.c_uint64 * 5)()
+        self._lib.acx_fleet_stats(out)
+        return {"epoch": out[0], "joins": out[1], "leaves": out[2],
+                "deaths": out[3], "active": out[4]}
+
+    def fleet_leave(self, timeout_ms: float = 2000.0) -> int:
+        """Leave the fleet gracefully: drain in-flight work (up to
+        ``timeout_ms``), announce LEFT to every peer, and surrender the
+        rendezvous listener so a replacement can take this slot. Returns
+        the number of ops the drain had to cancel (0 = clean departure).
+        After leaving, ``finalize()`` skips the MPI_Finalize barrier —
+        this rank is no longer part of the rank set it would sync with."""
+        n = self._lib.MPIX_Fleet_leave(float(timeout_ms))
+        if n < 0:
+            raise RuntimeError("MPIX_Fleet_leave: runtime not initialized")
+        self._left = True
+        return n
+
     # -- metrics plane ------------------------------------------------------
 
     def metrics_enabled(self) -> bool:
@@ -481,5 +534,8 @@ class Runtime:
                       f"send(s) never drained (xla_triggers.drain_sends)",
                       file=sys.stderr)
             self._lib.MPIX_Finalize()
-            self._lib.MPI_Finalize()
+            if not getattr(self, "_left", False):
+                # MPI_Finalize barriers with the full rank set; a rank that
+                # announced LEFT is no longer in it and must not sync.
+                self._lib.MPI_Finalize()
             self._open = False

@@ -13,6 +13,7 @@
 #include "acx/api_internal.h"
 #include "acx/fault.h"
 #include "acx/flightrec.h"
+#include "acx/membership.h"
 #include "acx/metrics.h"
 
 namespace acx {
@@ -51,6 +52,11 @@ void RefreshRuntimeMetrics() {
   }
   metrics::Set(metrics::kDrainedSlots,
                g_drained.load(std::memory_order_relaxed));
+  const FleetStats fs = Fleet().stats();
+  metrics::Set(metrics::kFleetEpoch, fs.epoch);
+  metrics::Set(metrics::kFleetJoins, fs.joins);
+  metrics::Set(metrics::kFleetLeaves, fs.leaves);
+  metrics::Set(metrics::kFleetDeaths, fs.deaths);
   if (g.table != nullptr)
     metrics::MaxGauge(metrics::kSlotHighWater, g.table->watermark());
 }
@@ -170,6 +176,46 @@ int acx_drain(double timeout_ms) {
 }
 
 int MPIX_Drain(double timeout_ms) { return acx_drain(timeout_ms); }
+
+// ---- fleet membership (DESIGN.md §12) ------------------------------------
+
+// Current fleet epoch: starts at 1 when the transport comes up, bumps on
+// every membership transition (join / leave / death), max-merges with peer
+// views. Safe before init (0: no fleet yet).
+uint64_t MPIX_Fleet_epoch(void) { return acx::Fleet().epoch(); }
+
+// Copies up to `cap` per-rank MemberState values (acx/membership.h: 2 =
+// ACTIVE, 3 = DRAINING, 4 = LEFT, 5 = DEAD) into `states` and returns the
+// fleet size — call with (NULL, 0) to size the buffer. 0 before init.
+int MPIX_Fleet_view(int32_t* states, int cap) {
+  return acx::Fleet().View(states, states == nullptr ? 0 : cap);
+}
+
+// Graceful departure: mark self DRAINING, give in-flight work `timeout_ms`
+// to finish (acx_drain), then announce LEFT to every peer and surrender the
+// rendezvous listener so a replacement can take the slot. Returns the
+// number of ops the drain had to cancel (0 = clean), or -1 before init.
+// The process may keep the library loaded afterwards (e.g. a supervisor
+// parent waiting on the replacement it forked) but must not post new ops.
+int MPIX_Fleet_leave(double timeout_ms) {
+  acx::ApiState& g = acx::GS();
+  if (g.transport == nullptr) return -1;
+  acx::Fleet().OnDraining(g.transport->rank());
+  const int cancelled = acx_drain(timeout_ms);
+  g.transport->FleetLeave();
+  return cancelled < 0 ? 0 : cancelled;
+}
+
+// Fills out[5] = {epoch, joins, leaves, deaths, active}. Safe before init
+// (all zeros — a fleet of size 0).
+void acx_fleet_stats(uint64_t* out) {
+  const acx::FleetStats s = acx::Fleet().stats();
+  out[0] = s.epoch;
+  out[1] = s.joins;
+  out[2] = s.leaves;
+  out[3] = s.deaths;
+  out[4] = s.active;
+}
 
 // ---- flight recorder -----------------------------------------------------
 

@@ -1,0 +1,133 @@
+// tpu-acx: fleet membership table (DESIGN.md §12). See acx/membership.h for
+// the model; this file is deliberately boring — a mutex-guarded state vector
+// plus an atomic epoch, so the transport can feed it from under its own lock
+// and the C API can snapshot it from any thread.
+
+#include "acx/membership.h"
+
+namespace acx {
+
+Membership& Fleet() {
+  static Membership m;
+  return m;
+}
+
+void Membership::Reset(int size, int self_rank) {
+  std::lock_guard<std::mutex> lk(mu_);
+  state_.assign(size < 0 ? 0 : static_cast<size_t>(size),
+                MemberState::kMemberActive);
+  self_ = self_rank;
+  joins_ = leaves_ = deaths_ = 0;
+  epoch_.store(1, std::memory_order_release);
+}
+
+int Membership::size() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return static_cast<int>(state_.size());
+}
+
+MemberState Membership::state(int rank) const {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (rank < 0 || rank >= static_cast<int>(state_.size()))
+    return MemberState::kMemberUnknown;
+  return state_[rank];
+}
+
+uint64_t Membership::BumpLocked() {
+  // fetch_add under mu_ keeps the bump atomic with the state write while
+  // epoch() stays a lock-free read for pollers.
+  return epoch_.fetch_add(1, std::memory_order_acq_rel) + 1;
+}
+
+uint64_t Membership::OnJoin(int rank) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (rank < 0 || rank >= static_cast<int>(state_.size()))
+    return epoch_.load(std::memory_order_relaxed);
+  if (state_[rank] == MemberState::kMemberActive)
+    return epoch_.load(std::memory_order_relaxed);
+  state_[rank] = MemberState::kMemberActive;
+  joins_++;
+  return BumpLocked();
+}
+
+uint64_t Membership::OnLeave(int rank) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (rank < 0 || rank >= static_cast<int>(state_.size()))
+    return epoch_.load(std::memory_order_relaxed);
+  if (state_[rank] == MemberState::kMemberLeft ||
+      state_[rank] == MemberState::kMemberDead)
+    return epoch_.load(std::memory_order_relaxed);
+  state_[rank] = MemberState::kMemberLeft;
+  leaves_++;
+  return BumpLocked();
+}
+
+uint64_t Membership::OnDeath(int rank) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (rank < 0 || rank >= static_cast<int>(state_.size()))
+    return epoch_.load(std::memory_order_relaxed);
+  // A graceful LEFT verdict is final: the EOF that trails a clean leave
+  // must not re-classify the slot as crashed.
+  if (state_[rank] == MemberState::kMemberLeft ||
+      state_[rank] == MemberState::kMemberDead)
+    return epoch_.load(std::memory_order_relaxed);
+  state_[rank] = MemberState::kMemberDead;
+  deaths_++;
+  return BumpLocked();
+}
+
+void Membership::OnDraining(int rank) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (rank < 0 || rank >= static_cast<int>(state_.size())) return;
+  if (state_[rank] == MemberState::kMemberActive)
+    state_[rank] = MemberState::kMemberDraining;
+}
+
+void Membership::AdoptEpoch(uint64_t remote_epoch) {
+  uint64_t cur = epoch_.load(std::memory_order_acquire);
+  while (remote_epoch > cur &&
+         !epoch_.compare_exchange_weak(cur, remote_epoch,
+                                       std::memory_order_acq_rel,
+                                       std::memory_order_acquire)) {
+  }
+}
+
+uint64_t Membership::AdoptView(int rank, MemberState st,
+                               uint64_t remote_epoch) {
+  AdoptEpoch(remote_epoch);
+  switch (st) {
+    case MemberState::kMemberActive:
+      return OnJoin(rank);
+    case MemberState::kMemberLeft:
+      return OnLeave(rank);
+    case MemberState::kMemberDead:
+      return OnDeath(rank);
+    case MemberState::kMemberDraining:
+      OnDraining(rank);
+      return epoch_.load(std::memory_order_acquire);
+    default:
+      return epoch_.load(std::memory_order_acquire);
+  }
+}
+
+FleetStats Membership::stats() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  FleetStats s;
+  s.epoch = epoch_.load(std::memory_order_relaxed);
+  s.joins = joins_;
+  s.leaves = leaves_;
+  s.deaths = deaths_;
+  for (MemberState st : state_)
+    if (st == MemberState::kMemberActive) s.active++;
+  return s;
+}
+
+int Membership::View(int32_t* out, int cap) const {
+  std::lock_guard<std::mutex> lk(mu_);
+  const int n = static_cast<int>(state_.size());
+  for (int i = 0; i < n && i < cap; i++)
+    out[i] = static_cast<int32_t>(state_[i]);
+  return n;
+}
+
+}  // namespace acx

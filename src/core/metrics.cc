@@ -20,7 +20,8 @@ const char* const kCounterName[kNumCounters] = {
     "hb_recv",         "hb_misses",      "peers_dead",     "slot_hwm",
     "proxy_sweeps",    "ops_issued",     "ops_completed",  "slots_reclaimed",
     "proxy_busy_ns",   "proxy_idle_ns",  "reconnects",     "frames_replayed",
-    "crc_rejects",     "naks_sent",      "drained_slots",
+    "crc_rejects",     "naks_sent",      "drained_slots",  "fleet_epoch",
+    "fleet_joins",     "fleet_leaves",   "fleet_deaths",
 };
 
 const char* const kHistName[kNumHists] = {

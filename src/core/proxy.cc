@@ -7,6 +7,7 @@
 #include "acx/debug.h"
 #include "acx/fault.h"
 #include "acx/flightrec.h"
+#include "acx/membership.h"
 #include "acx/metrics.h"
 #include "acx/trace.h"
 
@@ -502,6 +503,23 @@ void Proxy::Run() {
       continue;
     }
     idle_sweeps++;
+    // Membership plane (DESIGN.md §12): a fleet-epoch bump means a peer
+    // joined, left, or was declared dead since the last pass — resweep
+    // right away so parked ops see the new verdict (e.g. a RECOVERING op
+    // whose peer's slot was taken over by a joining incarnation) instead
+    // of napping through it.
+    {
+      const uint64_t fe = Fleet().epoch();
+      if (fe != fleet_epoch_seen_) {
+        const bool first = fleet_epoch_seen_ == 0;
+        fleet_epoch_seen_ = fe;
+        if (!first) {
+          ACX_TRACE_EVENT("fleet_epoch", static_cast<size_t>(fe));
+          idle_sweeps = 0;
+          continue;
+        }
+      }
+    }
     if (table_->active.load(std::memory_order_relaxed) == 0) {
       // Nothing in flight: keep the transport's background protocol alive
       // (heartbeats, dead-peer checks), then park until work arrives. The

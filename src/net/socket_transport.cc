@@ -47,6 +47,7 @@
 
 #include "acx/fault.h"
 #include "acx/flightrec.h"
+#include "acx/membership.h"
 #include "acx/trace.h"
 #include "src/net/link.h"
 #include "src/net/wire.h"
@@ -124,7 +125,8 @@ inline size_t WirePayloadLen(const WireHeader& h) {
 inline bool KnownMagic(uint32_t m) {
   return m == wire::kMagic || m == wire::kMagicRts || m == wire::kMagicAck ||
          m == wire::kMagicHb || m == wire::kMagicSeqAck ||
-         m == wire::kMagicNak || m == wire::kMagicHello;
+         m == wire::kMagicNak || m == wire::kMagicHello ||
+         m == wire::kMagicView;
 }
 
 // Zero-copy send: the wire is fed straight from the user buffer (legal —
@@ -253,14 +255,25 @@ class StreamTransport : public Transport {
       const unsigned long long v = strtoull(rb, nullptr, 10);
       if (v > 0) replay_budget_ = static_cast<size_t>(v);
     }
+    // Fleet membership (DESIGN.md §12): the transport is the authority on
+    // fleet shape — every construction (re)seats the table at epoch 1 with
+    // every slot ACTIVE. Joiners and verdicts adjust it from there.
+    Fleet().Reset(size_, rank_);
     const char* job = getenv("ACX_JOB_ID");
     recovery_armed_ = sock_plane && size_ > 1 && job != nullptr;
     if (recovery_armed_) {
       job_id_ = job;
+      // Jitter seed for the reconnect/redial backoff ladder (cheap LCG; no
+      // cryptographic needs — just decorrelating sibling ranks' redials).
+      jitter_state_ = NowNs() ^ (static_cast<uint64_t>(rank_) << 32) ^
+                      static_cast<uint64_t>(getpid());
       // Abstract-namespace AF_UNIX listener: reconnecting peers dial
       // "\0acx-<job>-<rank>". Abstract names need no filesystem cleanup and
       // vanish with the process — a dead rank's name can't be dialed.
-      listen_fd_ = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
+      // CLOEXEC so a rank that execs a replacement of itself (rolling
+      // restart) releases the name for the replacement's own bind.
+      listen_fd_ = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC,
+                          0);
       if (listen_fd_ >= 0) {
         struct sockaddr_un sa;
         memset(&sa, 0, sizeof sa);
@@ -422,6 +435,72 @@ class StreamTransport : public Transport {
     return true;
   }
 
+  // Voluntary departure (MPIX_Fleet_leave, DESIGN.md §12). The caller has
+  // already drained; here we record LEFT locally, tell every healthy peer
+  // with an explicit VIEW frame — so their verdict is graceful-leave, not
+  // the crash the trailing EOF would otherwise suggest — and surrender the
+  // rendezvous listener so a replacement process can bind the abstract
+  // name while we are still alive (e.g. a supervisor parent waiting on the
+  // replacement it forked).
+  void FleetLeave() override {
+    if (size_ <= 1) return;
+    std::lock_guard<std::mutex> lk(mu_);
+    const uint64_t fepoch = Fleet().OnLeave(rank_);
+    for (int q = 0; q < size_; q++) {
+      if (q == rank_ || !links_[q] || peer_dead_[q]) continue;
+      if (peers_[q].health != 0) continue;
+      SendViewLocked(q, rank_, MemberState::kMemberLeft, fepoch);
+    }
+    ACX_TRACE_EVENT("fleet_leave", static_cast<size_t>(rank_));
+    if (listen_fd_ >= 0) {
+      close(listen_fd_);
+      listen_fd_ = -1;
+    }
+  }
+
+  // Late-joiner bootstrap (ACX_JOIN=1, DESIGN.md §12): we came up with
+  // every link null and dial each peer's rendezvous listener with a JOIN
+  // hello. Sweeps repeat on a jittered, growing pause until every slot is
+  // either linked or — only at budget expiry — latched dead: a peer may
+  // itself be mid-replacement, so "unreachable right now" is not a verdict
+  // until the deadline. Returns the number of live links established.
+  int JoinFleet(int budget_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    const uint64_t deadline =
+        NowNs() + static_cast<uint64_t>(budget_ms) * 1000000ull;
+    uint64_t pause_ms = 20;
+    for (;;) {
+      int missing = 0;
+      for (int p = 0; p < size_; p++) {
+        if (p == rank_ || links_[p] || peer_dead_[p]) continue;
+        if (!DialJoinLocked(p)) missing++;
+      }
+      if (missing == 0) break;
+      if (NowNs() >= deadline) {
+        for (int p = 0; p < size_; p++) {
+          if (p == rank_ || links_[p] || peer_dead_[p]) continue;
+          MarkPeerDeadLocked(p, "unreachable at join", /*hb_detected=*/true);
+        }
+        break;
+      }
+      const uint64_t wait_ns = JitteredWaitNs(pause_ms);
+      lk.unlock();
+      poll(nullptr, 0, static_cast<int>(wait_ns / 1000000ull) + 1);
+      lk.lock();
+      if (pause_ms < 200) pause_ms *= 2;
+    }
+    Fleet().OnJoin(rank_);  // no-op bump-wise if Reset left us ACTIVE
+    int linked = 0;
+    for (int p = 0; p < size_; p++)
+      if (p != rank_ && links_[p]) linked++;
+    std::fprintf(stderr,
+                 "tpu-acx[%d]: joined fleet (%d/%d peer link(s), fleet "
+                 "epoch %llu)\n",
+                 rank_, linked, size_ - 1,
+                 static_cast<unsigned long long>(Fleet().epoch()));
+    return linked;
+  }
+
   // Called from SockTicket::Test.
   bool TestReq(const std::shared_ptr<SendReq>& s,
                const std::shared_ptr<RecvReq>& r, Status* st) {
@@ -474,10 +553,13 @@ class StreamTransport : public Transport {
 
   Ticket* IsendLocked(const void* buf, size_t bytes, int dst, int tag,
                       int ctx) {
-    if (dst != rank_ && (dst < 0 || dst >= size_ || !links_[dst])) {
+    if (dst != rank_ && (dst < 0 || dst >= size_)) {
       std::fprintf(stderr, "tpu-acx[%d]: no wire to peer %d\n", rank_, dst);
       _exit(14);
     }
+    // Dead-check before the link check: a joiner that could not reach some
+    // peer has a dead latch and NO link for it — that is an error ticket,
+    // not a malformed environment.
     if (dst != rank_ && peer_dead_[dst]) {
       // Immediate-error ticket: blocking helpers and barriers that touch a
       // dead peer stay bounded instead of wedging.
@@ -485,6 +567,10 @@ class StreamTransport : public Transport {
       s->st = Status{rank_, tag, kErrPeerDead, 0};
       s->done = true;
       return new SockTicket(this, s);
+    }
+    if (dst != rank_ && !links_[dst]) {
+      std::fprintf(stderr, "tpu-acx[%d]: no wire to peer %d\n", rank_, dst);
+      _exit(14);
     }
     auto s = std::make_shared<SendReq>();
     s->st = Status{rank_, tag, 0, bytes};
@@ -549,15 +635,9 @@ class StreamTransport : public Transport {
   Ticket* IrecvLocked(void* buf, size_t bytes, int src, int tag, int ctx) {
     // Same loud failure as IsendLocked: a recv from a wireless peer would
     // otherwise sit in `posted` forever (ProgressLocked skips null links).
-    if (src != rank_ && (src < 0 || src >= size_ || !links_[src])) {
+    if (src != rank_ && (src < 0 || src >= size_)) {
       std::fprintf(stderr, "tpu-acx[%d]: no wire to peer %d\n", rank_, src);
       _exit(14);
-    }
-    if (src != rank_ && peer_dead_[src]) {
-      auto r = std::make_shared<RecvReq>();
-      r->st = Status{src, tag, kErrPeerDead, 0};
-      r->done = true;
-      return new SockTicket(this, r);
     }
     auto r = std::make_shared<RecvReq>();
     r->buf = buf;
@@ -565,10 +645,17 @@ class StreamTransport : public Transport {
     r->src = src;
     r->tag = tag;
     r->ctx = ctx;
-    // Try the unexpected queue first (FIFO per (src, tag, ctx)).
+    // Try the unexpected queue first (FIFO per (src, tag, ctx)) — and
+    // BEFORE any dead-peer verdict: a graceful leave (DESIGN.md §12)
+    // drains and then announces LEFT, so eager data it delivered ahead of
+    // the marker is still valid and must remain consumable after the
+    // latch. A rendezvous arrival is the exception — completing it needs
+    // the (possibly gone) sender's address space and its ack/fallback
+    // path, so a dead peer's RTS fails like any other post against it.
     auto& q = peers_[src].arrived;
     for (auto it = q.begin(); it != q.end(); ++it) {
       if (it->tag == tag && it->ctx == ctx) {
+        if (it->rv && src != rank_ && peer_dead_[src]) break;
         if (it->rv) {
           CompleteRvLocked(src, r, it->tag, it->rv_bytes, it->rv_desc);
         } else {
@@ -577,6 +664,15 @@ class StreamTransport : public Transport {
         q.erase(it);
         return new SockTicket(this, r);
       }
+    }
+    if (src != rank_ && peer_dead_[src]) {
+      r->st = Status{src, tag, kErrPeerDead, 0};
+      r->done = true;
+      return new SockTicket(this, r);
+    }
+    if (src != rank_ && !links_[src]) {
+      std::fprintf(stderr, "tpu-acx[%d]: no wire to peer %d\n", rank_, src);
+      _exit(14);
     }
     peers_[src].posted.push_back(r);
     return new SockTicket(this, r);
@@ -965,6 +1061,25 @@ class StreamTransport : public Transport {
           in.hdr_got = 0;
           continue;
         }
+        if (in.hdr.magic == wire::kMagicView) {
+          // Fleet view update (DESIGN.md §12): tag = subject rank, ctx = its
+          // new MemberState, bytes = sender's fleet epoch. Unsequenced so
+          // membership keeps converging while a data stream is stalled.
+          const int subject = in.hdr.tag;
+          const auto st = static_cast<MemberState>(in.hdr.ctx);
+          if (subject >= 0 && subject < size_)
+            Fleet().AdoptView(subject, st, in.hdr.bytes);
+          if (subject == p && st == MemberState::kMemberLeft) {
+            // The peer itself announced a graceful leave: it drained before
+            // sending this, so the quiet dead-latch retires its slots
+            // without failing work. A later JOIN re-arms the slot.
+            in.hdr_got = 0;
+            MarkPeerDeadLocked(p, "peer left", /*hb_detected=*/false);
+            return;
+          }
+          in.hdr_got = 0;
+          continue;
+        }
         if (in.hdr.magic == wire::kMagicHello) {
           // Handshake frames only ever travel on a fresh reconnect socket.
           StreamDesyncLocked(p);
@@ -1250,6 +1365,15 @@ class StreamTransport : public Transport {
       }
     }
     if (failed != 0) failed_ops_.fetch_add(failed, std::memory_order_relaxed);
+    // Membership verdict (DESIGN.md §12): a quiet latch (clean EOF, no
+    // heartbeat verdict, nothing in flight) is a graceful departure;
+    // anything loud is a crash. Both land in the same state machine — an
+    // explicit VIEW(left) recorded LEFT first and OnDeath never overrides
+    // it, so crash-leave and graceful-leave converge.
+    if (failed == 0 && !hb_detected)
+      Fleet().OnLeave(p);
+    else
+      Fleet().OnDeath(p);
     // Quiet latch on a clean EOF with nothing in flight: normal teardown
     // can observe a peer's close after the final barrier, and that is not
     // worth a scary message. Loud when real work was killed.
@@ -1287,6 +1411,9 @@ class StreamTransport : public Transport {
     return true;
   }
 
+  // Nominal ladder value: ACX_RECONNECT_BACKOFF_MS doubling per attempt,
+  // 2s cap. The wait actually scheduled is jittered (below); this nominal
+  // value is what deadline budgets are computed from.
   uint64_t DialBackoffMs(int attempt) const {
     uint64_t ms =
         Policy().reconnect_backoff_ms.load(std::memory_order_relaxed);
@@ -1295,11 +1422,28 @@ class StreamTransport : public Transport {
     return ms < 2000 ? ms : 2000;
   }
 
+  // ±25% jitter on a backoff wait. After a shared fault (a switch blip, a
+  // rank replaced under rolling restart) every surviving dialer otherwise
+  // redials on the identical deterministic schedule, thundering-herding the
+  // victim's rendezvous listener — worse now that late joiners share it.
+  // Cheap per-process LCG; NOT the ladder itself, so budget math
+  // (AcceptDeadlineNs, multihost.recovery_budget_s) stays deterministic.
+  uint64_t JitteredWaitNs(uint64_t nominal_ms) {
+    jitter_state_ =
+        jitter_state_ * 6364136223846793005ull + 1442695040888963407ull;
+    const uint64_t nominal_ns = nominal_ms * 1000000ull;
+    const uint64_t span = nominal_ns / 2;  // [0.75x, 1.25x)
+    if (span == 0) return nominal_ns;
+    return nominal_ns - span / 2 + (jitter_state_ >> 33) % span;
+  }
+
   uint64_t AcceptDeadlineNs() const {
     const uint32_t maxa =
         Policy().reconnect_max.load(std::memory_order_relaxed);
     uint64_t total_ms = 1000;  // handshake + scheduling margin
     for (uint32_t a = 1; a <= maxa; a++) total_ms += DialBackoffMs(a);
+    // Jitter headroom: every wait can land 25% past its nominal value.
+    total_ms += total_ms / 4;
     return total_ms * 1000000ull;
   }
 
@@ -1334,14 +1478,25 @@ class StreamTransport : public Transport {
   }
 
   // Pump every in-progress recovery: accept incoming dials, fire due
-  // outgoing dials, expire acceptor deadlines. Gated on recovering_count_
-  // so a healthy job pays zero syscalls here. (Safe: a failing dialer's
-  // ForceClose/exit propagates EOF to us long before its ladder expires,
-  // so by the time it dials, our count is nonzero and we are accepting.)
+  // outgoing dials, expire acceptor deadlines. With an outage in progress
+  // (something recovering or dead) the listener is polled every pass; on a
+  // fully healthy fleet it is still polled at a coarse 10ms cadence so a
+  // late JOINER (DESIGN.md §12) is never stuck waiting on a failure we
+  // haven't noticed — at ~100 cheap EAGAIN accepts/sec, not per-sweep.
   void PollRecoveryLocked() {
-    if (recovering_count_.load(std::memory_order_relaxed) == 0) return;
-    HandleDialLocked();
+    const bool urgent =
+        recovering_count_.load(std::memory_order_relaxed) != 0 ||
+        peers_dead_n_.load(std::memory_order_relaxed) != 0;
     const uint64_t now = NowNs();
+    if (!urgent) {
+      if (now - last_accept_poll_ns_ < 10000000ull) return;
+      last_accept_poll_ns_ = now;
+      HandleDialLocked();
+      return;
+    }
+    last_accept_poll_ns_ = now;
+    HandleDialLocked();
+    if (recovering_count_.load(std::memory_order_relaxed) == 0) return;
     for (int p = 0; p < size_; p++) {
       if (p == rank_ || peer_dead_[p] || peers_[p].health == 0) continue;
       if (rank_ < p) {
@@ -1363,8 +1518,8 @@ class StreamTransport : public Transport {
     }
     peer.rec_attempts++;
     peer.rec_next_ns =
-        NowNs() + DialBackoffMs(peer.rec_attempts) * 1000000ull;
-    int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+        NowNs() + JitteredWaitNs(DialBackoffMs(peer.rec_attempts));
+    int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
     if (fd < 0) return;
     struct sockaddr_un sa;
     memset(&sa, 0, sizeof sa);
@@ -1393,23 +1548,91 @@ class StreamTransport : public Transport {
     AdoptLinkLocked(p, fd, reply.seq, reply.epoch);
   }
 
+  // One JOIN dial to peer p's listener (JoinFleet only). Unlike
+  // DialPeerLocked this proposes a FRESH incarnation: seq 0, kHelloJoin
+  // set, our fleet epoch riding in bytes; the reply carries the acceptor's
+  // post-join fleet epoch the same way.
+  bool DialJoinLocked(int p) {
+    Peer& peer = peers_[p];
+    const int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (fd < 0) return false;
+    struct sockaddr_un sa;
+    memset(&sa, 0, sizeof sa);
+    sa.sun_family = AF_UNIX;
+    const int n = snprintf(sa.sun_path + 1, sizeof(sa.sun_path) - 1,
+                           "acx-%s-%d", job_id_.c_str(), p);
+    const socklen_t slen = static_cast<socklen_t>(
+        offsetof(struct sockaddr_un, sun_path) + 1 + n);
+    if (connect(fd, reinterpret_cast<struct sockaddr*>(&sa), slen) != 0) {
+      close(fd);  // peer not listening (yet) — JoinFleet sweeps again
+      return false;
+    }
+    WireHeader hello = MakeHdr(wire::kMagicHello, rank_, wire::kHelloJoin, 0);
+    hello.bytes = Fleet().epoch();
+    hello.seq = 0;
+    hello.epoch = peer.epoch + 1;  // proposal; the reply is authoritative
+    hello.hcrc = wire::HeaderCrc(hello);
+    WireHeader reply{};
+    if (!IoFullTimed(fd, &hello, sizeof hello, 1000, /*wr=*/true) ||
+        !IoFullTimed(fd, &reply, sizeof reply, 2000, /*wr=*/false) ||
+        reply.magic != wire::kMagicHello ||
+        reply.hcrc != wire::HeaderCrc(reply) || reply.tag != p ||
+        (reply.ctx & wire::kHelloJoin) == 0) {
+      close(fd);
+      return false;
+    }
+    peer.epoch = reply.epoch;
+    const int fl = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+    links_[p] = std::make_unique<SockLink>(fd, rank_, p);
+    last_rx_ns_[p] = NowNs();
+    Fleet().AdoptEpoch(reply.bytes);
+    ACX_TRACE_EVENT("fleet_join_link", static_cast<size_t>(p));
+    ACX_FLIGHT(kLinkUp, -1, p, -1, 0, reply.epoch);
+    return true;
+  }
+
   void HandleDialLocked() {
     if (listen_fd_ < 0) return;
     for (;;) {
-      const int fd = accept(listen_fd_, nullptr, nullptr);
+      const int fd = accept4(listen_fd_, nullptr, nullptr, SOCK_CLOEXEC);
       if (fd < 0) return;  // EAGAIN: no (more) pending dials
       WireHeader hello{};
-      // Only LOWER ranks dial us; anything else on the listener is noise.
       if (!IoFullTimed(fd, &hello, sizeof hello, 1000, /*wr=*/false) ||
           hello.magic != wire::kMagicHello ||
           hello.hcrc != wire::HeaderCrc(hello) || hello.tag < 0 ||
-          hello.tag >= size_ || hello.tag >= rank_ || peer_dead_[hello.tag]) {
+          hello.tag >= size_ || hello.tag == rank_) {
         close(fd);
         continue;
       }
       const int p = hello.tag;
+      const bool join = (hello.ctx & wire::kHelloJoin) != 0;
+      // Plain reconnects RESUME an incarnation: only LOWER ranks dial us
+      // (no connect race) and a dead peer cannot resume. JOIN hellos
+      // announce a FRESH incarnation re-occupying the slot: only the joiner
+      // dials (still no race), from any rank, dead latch or not.
+      if (!join && (hello.tag >= rank_ || peer_dead_[p])) {
+        close(fd);
+        continue;
+      }
       const uint32_t own = peers_[p].epoch + 1;
       const uint32_t agreed = hello.epoch > own ? hello.epoch : own;
+      if (join) {
+        // Adopt FIRST so the reply can carry the post-join fleet epoch. If
+        // the reply write then fails, the joiner retries and OnJoin is
+        // idempotent; the half-installed link heals through the normal
+        // EOF -> quiet-latch -> rejoin path.
+        AdoptJoinLocked(p, fd, agreed);
+        WireHeader reply = MakeHdr(wire::kMagicHello, rank_,
+                                   wire::kHelloJoin, 0);
+        reply.bytes = Fleet().epoch();
+        reply.seq = 0;
+        reply.epoch = agreed;
+        reply.hcrc = wire::HeaderCrc(reply);
+        if (!IoFullTimed(fd, &reply, sizeof reply, 1000, /*wr=*/true))
+          links_[p]->ForceClose();
+        continue;
+      }
       WireHeader reply = MakeHdr(wire::kMagicHello, rank_, 0, 0);
       reply.seq = peers_[p].rx_seq;
       reply.epoch = agreed;
@@ -1423,6 +1646,83 @@ class StreamTransport : public Transport {
       // haven't read yet).
       AdoptLinkLocked(p, fd, hello.seq, agreed);
     }
+  }
+
+  // A fresh incarnation of rank p re-occupies its slot (DESIGN.md §12):
+  // retire whatever the old incarnation left behind through the PR-3
+  // dead-latch (its in-flight work can never complete), then install the
+  // new socket with zeroed wire clocks, clear the dead latch, bump the
+  // fleet epoch, and fan the new view over the existing links.
+  void AdoptJoinLocked(int p, int fd, uint32_t agreed) {
+    Peer& peer = peers_[p];
+    if (!peer_dead_[p])
+      MarkPeerDeadLocked(p, "superseded by joining incarnation",
+                         /*hb_detected=*/false);
+    peer_dead_[p] = false;
+    peers_dead_n_.fetch_sub(1, std::memory_order_relaxed);
+    // Fresh wire clocks: the new incarnation never saw the old stream, so
+    // no WIRE state carries over — not the replay buffer, not a
+    // half-assembled inbound frame. Fully-delivered eager payloads in the
+    // unexpected queue DO survive: the old incarnation drained before it
+    // left, so data it landed ahead of its departure is valid app traffic
+    // a late recv must still match. Rendezvous arrivals cannot — their
+    // descriptors point into the dead incarnation's address space.
+    peer.epoch = agreed;
+    peer.tx_seq = 0;
+    peer.rx_seq = 0;
+    peer.acked_rx = 0;
+    peer.rx_since_ack = 0;
+    peer.last_nak_ns = 0;
+    peer.replay.clear();
+    peer.replay_bytes = 0;
+    peer.replay_broken = false;
+    for (auto it = peer.arrived.begin(); it != peer.arrived.end();)
+      it = it->rv ? peer.arrived.erase(it) : std::next(it);
+    peer.in = InState{};
+    peer.rec_attempts = 0;
+    peer.rec_next_ns = 0;
+    peer.rec_deadline_ns = 0;
+    peer.stall_until_ns = 0;
+    const int fl = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+    links_[p] = std::make_unique<SockLink>(fd, rank_, p);
+    last_rx_ns_[p] = NowNs();
+    const uint64_t fepoch = Fleet().OnJoin(p);
+    ACX_TRACE_EVENT("fleet_join", static_cast<size_t>(p));
+    ACX_FLIGHT(kLinkUp, -1, p, -1, 0, agreed);
+    std::fprintf(stderr,
+                 "tpu-acx[%d]: rank %d joined (link epoch %u, fleet epoch "
+                 "%llu)\n",
+                 rank_, p, agreed, static_cast<unsigned long long>(fepoch));
+    for (int q = 0; q < size_; q++) {
+      if (q == rank_ || q == p || !links_[q] || peer_dead_[q]) continue;
+      if (peers_[q].health != 0) continue;
+      SendViewLocked(q, p, MemberState::kMemberActive, fepoch);
+    }
+    // Catch the joiner up on everyone we already know to be gone — it came
+    // up assuming a full fleet and can only discover departures by dial
+    // timeout otherwise.
+    for (int q = 0; q < size_; q++) {
+      if (q == rank_ || q == p) continue;
+      const MemberState st = Fleet().state(q);
+      if (st == MemberState::kMemberLeft || st == MemberState::kMemberDead)
+        SendViewLocked(p, q, st, fepoch);
+    }
+  }
+
+  // Header-only unsequenced membership frame: tag = subject rank, ctx =
+  // its new state, bytes = our fleet epoch (see DrainInLocked's receive
+  // side). Rides outside the sequence space like heartbeats.
+  void SendViewLocked(int q, int subject, MemberState st, uint64_t fepoch) {
+    auto s = std::make_shared<SendReq>();
+    s->hdr = MakeHdr(wire::kMagicView, subject, static_cast<int>(st), 0);
+    s->hdr.bytes = fepoch;
+    SealHdrLocked(q, &s->hdr);
+    s->wire_payload = s->desc;
+    s->wire_bytes = 0;
+    s->dst = q;
+    peers_[q].outq.push_back(std::move(s));
+    FlushOutLocked(q);
   }
 
   // Install the reconnected socket as the live link to p and restore
@@ -1595,6 +1895,8 @@ class StreamTransport : public Transport {
   std::string job_id_;
   int listen_fd_ = -1;
   uint64_t last_ack_flush_ns_ = 0;  // idle SeqAck flush timer
+  uint64_t last_accept_poll_ns_ = 0;  // coarse listener poll when healthy
+  uint64_t jitter_state_ = 0;  // backoff-jitter LCG state (JitteredWaitNs)
   std::atomic<uint64_t> reconnects_{0};
   std::atomic<uint64_t> frames_replayed_{0};
   std::atomic<uint64_t> crc_rejects_{0};
@@ -1721,6 +2023,9 @@ Transport* CreateSocketTransport(int rank, int size,
     if (i == rank || fds[i] < 0) continue;
     const int fl = fcntl(fds[i], F_GETFL, 0);
     fcntl(fds[i], F_SETFL, fl | O_NONBLOCK);
+    // CLOEXEC: a rank that fork+execs its replacement (rolling restart)
+    // must not leak link fds into it — peers would never see EOF.
+    fcntl(fds[i], F_SETFD, FD_CLOEXEC);
     links[i] = std::make_unique<SockLink>(fds[i], rank, i);
   }
   return new StreamTransport(rank, size, std::move(links), nullptr, 0,
@@ -1758,6 +2063,32 @@ Transport* CreateTransportFromEnv() {
     exit(13);
   }
   const int rank = atoi(rank_s);
+
+  // Late joiner (DESIGN.md §12): no inherited fds at all — bootstrap every
+  // link through the peers' ACX_JOB_ID rendezvous listeners with a JOIN
+  // handshake. Used by a replacement process in a rolling restart.
+  const char* join_s = getenv("ACX_JOIN");
+  if (join_s != nullptr && atoi(join_s) != 0) {
+    if (getenv("ACX_JOB_ID") == nullptr) {
+      std::fprintf(stderr,
+                   "tpu-acx: ACX_JOIN=1 but ACX_JOB_ID unset (nothing to "
+                   "rendezvous on)\n");
+      exit(13);
+    }
+    const char* bud_s = getenv("ACX_FLEET_JOIN_TIMEOUT_MS");
+    const int budget_ms = bud_s != nullptr ? atoi(bud_s) : 10000;
+    std::vector<std::unique_ptr<Link>> links(size);
+    auto* t = new StreamTransport(rank, size, std::move(links), nullptr, 0,
+                                  /*sock_plane=*/true);
+    if (t->JoinFleet(budget_ms) == 0) {
+      std::fprintf(stderr,
+                   "tpu-acx[%d]: join failed: no peer reachable within "
+                   "%d ms\n",
+                   rank, budget_ms);
+      exit(13);
+    }
+    return t;
+  }
 
   // Same-host fast path: the memfd segment acxrun created, unless the user
   // forces the socket plane with ACX_TRANSPORT=socket.

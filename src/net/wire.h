@@ -21,15 +21,28 @@ constexpr uint32_t kMagicAck    = 0xAC0C0103;  // rendezvous ACK: header + RvAck
 constexpr uint32_t kMagicHb     = 0xAC0C0104;  // heartbeat: header only
 constexpr uint32_t kMagicSeqAck = 0xAC0C0105;  // cumulative receive ack: header only
 constexpr uint32_t kMagicNak    = 0xAC0C0106;  // negative ack / re-pull: header only
-constexpr uint32_t kMagicHello  = 0xAC0C0107;  // reconnect handshake: header only
+constexpr uint32_t kMagicHello  = 0xAC0C0107;  // reconnect/join handshake: header only
+constexpr uint32_t kMagicView   = 0xAC0C0108;  // fleet membership view: header only
+
+// kMagicHello ctx bits. A plain reconnect hello (ctx == 0) resumes the
+// existing link incarnation; a JOIN hello announces a FRESH incarnation of
+// the rank (a replacement process re-occupying the slot): the acceptor
+// resets the peer's wire state instead of resuming it, bumps the fleet
+// epoch, and fans the new view out (DESIGN.md §12).
+constexpr int32_t kHelloJoin = 0x1;
 
 #pragma pack(push, 1)
 struct WireHeader {
   uint32_t magic;  // frame class, above
-  int32_t  tag;    // message tag (kMagicHello: dialer's rank)
-  int32_t  ctx;    // context id (kCtrlCtx, kRvDataCtx, PartCtx(...))
+  int32_t  tag;    // message tag (kMagicHello: dialer's rank;
+                   //   kMagicView: the rank the view update is about)
+  int32_t  ctx;    // context id (kCtrlCtx, kRvDataCtx, PartCtx(...);
+                   //   kMagicHello: kHelloJoin flags; kMagicView: the
+                   //   subject rank's new MemberState)
   uint32_t crc;    // CRC32C of the payload; 0 = unchecked (ACX_CRC=0 / empty)
-  uint64_t bytes;  // payload length following the header
+  uint64_t bytes;  // payload length following the header (kMagicHello with
+                   //   kHelloJoin, and kMagicView: sender's fleet epoch —
+                   //   hello/view frames are header-only either way)
   uint64_t seq;    // per-link monotonic sequence (kMagicHb: tx high-water;
                    //   kMagicSeqAck/kMagicNak: cumulative rx; kMagicHello:
                    //   sender's rx high-water for resume)

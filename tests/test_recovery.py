@@ -73,6 +73,20 @@ def test_drain_unblocks_survivor_of_dead_peer():
 # -- recovery counters reach every stats surface ----------------------------
 
 
+def test_drain_while_recovering_no_double_count():
+    """Drain during the RECOVERING window (peer lost, reconnect ladder
+    pinned long so the link sits mid-recovery for seconds): the parked op
+    cancels in bounded time with a typed error, a second drain returns 0,
+    and drained_slots moves by exactly the first drain's count — no
+    double-charge across repeated drains."""
+    r = _run([_acxrun(), "-np", "2", "-transport", "socket",
+              sys.executable, __file__, "--drain-recovering-worker"],
+             env_extra={"ACX_RECONNECT_MAX": "8",
+                        "ACX_RECONNECT_BACKOFF_MS": "500"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "DRAIN RECOVERING OK" in r.stdout
+
+
 def test_recovery_counters_in_metrics_registry():
     """Runtime.metrics() (the ACX_METRICS registry) and
     Runtime.recovery_stats() both expose the survivable-link counters by
@@ -253,6 +267,44 @@ def _drain_socket_worker() -> int:
     os._exit(0)  # peer is gone; skip the finalize barrier entirely
 
 
+def _drain_recovering_worker() -> int:
+    sys.path.insert(0, REPO)
+    from mpi_acx_tpu import runtime
+    rt = runtime.Runtime()
+    if rt.rank == 1:
+        # Exit only after rank 0's recv is provably posted (its token
+        # send follows the irecv): an EOF with nothing in flight would
+        # dead-latch immediately instead of opening a RECOVERING window.
+        tok = np.zeros(1, dtype=np.int32)
+        rt.wait(rt.irecv_enqueue(tok, source=0, tag=22))
+        os._exit(0)      # die mid-flight: no finalize, no goodbye
+    dst = np.zeros(8, dtype=np.int32)
+    rv = rt.irecv_enqueue(dst, source=1, tag=21)
+    tok = np.ones(1, dtype=np.int32)
+    rt.wait(rt.isend_enqueue(tok, dest=1, tag=22))
+    # Wait for the cut wire to be noticed and the link to enter RECOVERING
+    # (the pinned 8 x 500ms ladder keeps the window open for ~10s).
+    deadline = time.monotonic() + 10
+    while rt.recovery_stats()["links_recovering"] < 1:
+        assert time.monotonic() < deadline, rt.recovery_stats()
+        time.sleep(0.01)
+    base = rt.recovery_stats()["drained_slots"]
+    t0 = time.monotonic()
+    n1 = rt.drain(300.0)
+    assert time.monotonic() - t0 < 30  # bounded, not a hang
+    assert n1 == 1, n1
+    try:
+        rt.wait(rv)
+        return 1  # a drained op must not look completed-clean
+    except (runtime.AcxPeerDeadError, runtime.AcxTimeoutError):
+        pass  # PEER_DEAD while the link recovers; TIMEOUT otherwise
+    assert rt.drain(100.0) == 0  # nothing left: the cancel latched
+    stats = rt.recovery_stats()
+    assert stats["drained_slots"] == base + 1, stats
+    print("DRAIN RECOVERING OK", flush=True)
+    os._exit(0)  # peer is gone; skip the finalize barrier entirely
+
+
 def _metrics_keys_worker() -> int:
     sys.path.insert(0, REPO)
     from mpi_acx_tpu import runtime
@@ -286,6 +338,8 @@ if __name__ == "__main__":
         raise SystemExit(_drain_loopback_worker())
     if "--drain-socket-worker" in sys.argv:
         raise SystemExit(_drain_socket_worker())
+    if "--drain-recovering-worker" in sys.argv:
+        raise SystemExit(_drain_recovering_worker())
     if "--metrics-keys-worker" in sys.argv:
         raise SystemExit(_metrics_keys_worker())
     raise SystemExit("unknown worker mode")

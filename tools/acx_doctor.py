@@ -15,6 +15,10 @@ This tool pairs the stuck operations across ranks — sends with recvs by
 entry count — and prints a diagnosis naming one of:
 
     dead_link                  a peer was declared dead (heartbeat loss)
+    missing_dump               a stuck op waits on a rank that produced
+                               no flight dump at all — the gap itself is
+                               the evidence (the rank died, or was killed,
+                               before its recorder could flush)
     never_published_partition  recv side polls a partition the send side
                                reserved but never MPIX_Pready'd
     tag_mismatch               both sides stuck on each other with
@@ -50,14 +54,27 @@ import sys
 STUCK_STATES = ("PENDING", "ISSUED", "RECOVERING")
 
 
-def load_dumps(paths):
-    """Parse flight dumps into {rank: dump} (later files win on dup)."""
+def load_dumps(paths, skipped=None):
+    """Parse flight dumps into {rank: dump} (later files win on dup).
+
+    A path that is missing, unreadable, or truncated mid-write — exactly
+    what a rank that died before flushing leaves behind — does NOT fail
+    the merge: it is recorded in ``skipped`` (a list of (path, reason)
+    tuples, when the caller passes one) and the diagnosis runs on the
+    dumps that DID land. The gap shows up as evidence in the report."""
     dumps = {}
     for p in paths:
-        with open(p) as f:
-            d = json.load(f)
+        try:
+            with open(p) as f:
+                d = json.load(f)
+            rank = int(d["rank"])
+        except (OSError, ValueError, KeyError, TypeError) as exc:
+            if skipped is None:
+                raise
+            skipped.append((p, "%s: %s" % (type(exc).__name__, exc)))
+            continue
         d["_path"] = p
-        dumps[int(d["rank"])] = d
+        dumps[rank] = d
     return dumps
 
 
@@ -123,12 +140,32 @@ def _reserved_send_partition(dump, peer, tag, partition):
     return False
 
 
+def _dump_gaps(dumps):
+    """Ranks other dumps point at (stuck-op peer, dead/recovering link, or
+    just `size` says the fleet is wider) for which no dump was loaded.
+    Each gap is evidence: every healthy rank's recorder flushes on the
+    watchdog / signal / dump-state paths, so a referenced-but-dumpless
+    rank most likely died before it could write."""
+    expected = set()
+    for rank, d in dumps.items():
+        for s in _stuck_slots(d):
+            peer = s.get("peer")
+            if isinstance(peer, int) and peer >= 0:
+                expected.add(peer)
+        for p in d.get("peers", []):
+            if p.get("health") in ("dead", "recovering"):
+                expected.add(int(p["rank"]))
+    return sorted(r for r in expected if r not in dumps)
+
+
 def diagnose(dumps):
     """Diagnose a set of per-rank flight dumps ({rank: dump}).
 
     Returns {"anomaly": str, "culprit": int|None, "detail": str,
-    "waits": [str, ...]} — `waits` is the who-waits-on-whom evidence,
-    one line per stuck operation."""
+    "waits": [str, ...], "missing_ranks": [int, ...]} — `waits` is the
+    who-waits-on-whom evidence, one line per stuck operation;
+    `missing_ranks` are ranks the dumps reference but that produced no
+    dump of their own (died before flushing)."""
     waits = []
     for rank in sorted(dumps):
         d = dumps[rank]
@@ -141,21 +178,44 @@ def diagnose(dumps):
                     s.get("tag"),
                     (" partition=%d" % part) if part >= 0 else "",
                     s.get("state"), s.get("age_ms", 0.0)))
+    gaps = _dump_gaps(dumps)
+    for g in gaps:
+        waits.append("rank %d produced no flight dump (died before "
+                     "flushing?) — the gap itself is evidence" % g)
+
+    def _result(anomaly, culprit, detail):
+        if anomaly != "missing_dump" and culprit is not None \
+                and culprit in gaps:
+            detail += ("; rank %d also produced no flight dump, which "
+                       "corroborates it died" % culprit)
+        return {"anomaly": anomaly, "culprit": culprit, "detail": detail,
+                "waits": waits, "missing_ranks": gaps}
 
     # 1. dead link: a declared-dead peer explains every stuck op on it.
     for rank in sorted(dumps):
         for p in dumps[rank].get("peers", []):
             if p.get("health") == "dead":
-                return {
-                    "anomaly": "dead_link",
-                    "culprit": int(p["rank"]),
-                    "detail": "rank %d declared rank %d dead (heartbeat "
-                              "loss); ops toward it cannot complete"
-                              % (rank, p["rank"]),
-                    "waits": waits,
-                }
+                return _result(
+                    "dead_link", int(p["rank"]),
+                    "rank %d declared rank %d dead (heartbeat "
+                    "loss); ops toward it cannot complete"
+                    % (rank, p["rank"]))
 
-    # 2. never-published partition: recv side polls partition p from S;
+    # 2. missing dump: a stuck op waits on a rank for which no dump was
+    # loaded. Nothing can be paired against it — and that IS the finding:
+    # the rank died (or was killed, or never got far enough to install a
+    # recorder) before it could flush, so its absence names the culprit.
+    for rank in sorted(dumps):
+        for s in _stuck_slots(dumps[rank]):
+            peer = s.get("peer")
+            if isinstance(peer, int) and peer in gaps:
+                return _result(
+                    "missing_dump", int(peer),
+                    "rank %d waits on rank %d, which produced no flight "
+                    "dump — it likely died before flushing; the missing "
+                    "dump is the evidence" % (rank, peer))
+
+    # 3. never-published partition: recv side polls partition p from S;
     # S holds the matching send partition RESERVED and never Pready'd it.
     for rank in sorted(dumps):
         for s in _stuck_slots(dumps[rank]):
@@ -169,17 +229,14 @@ def diagnose(dumps):
                 continue  # published; the data is merely late
             if _reserved_send_partition(peer_dump, rank, tag, part) or \
                     not _has_send_for(peer_dump, rank, tag):
-                return {
-                    "anomaly": "never_published_partition",
-                    "culprit": int(src),
-                    "detail": "rank %d polls partition %s of tag=%s from "
-                              "rank %s, but rank %s reserved that "
-                              "partition and never called MPIX_Pready"
-                              % (rank, part, tag, src, src),
-                    "waits": waits,
-                }
+                return _result(
+                    "never_published_partition", int(src),
+                    "rank %d polls partition %s of tag=%s from "
+                    "rank %s, but rank %s reserved that "
+                    "partition and never called MPIX_Pready"
+                    % (rank, part, tag, src, src))
 
-    # 3. tag mismatch: both sides stuck on each other, tags disagree.
+    # 4. tag mismatch: both sides stuck on each other, tags disagree.
     for rank in sorted(dumps):
         for s in _stuck_slots(dumps[rank]):
             if s.get("kind") != "isend":
@@ -191,16 +248,13 @@ def diagnose(dumps):
             for r in _stuck_slots(peer_dump):
                 if r.get("kind") == "irecv" and r.get("peer") == rank \
                         and r.get("tag") != s.get("tag"):
-                    return {
-                        "anomaly": "tag_mismatch",
-                        "culprit": int(rank),
-                        "detail": "rank %d sends tag=%s to rank %s, which "
-                                  "only has a recv posted for tag=%s"
-                                  % (rank, s.get("tag"), dst, r.get("tag")),
-                        "waits": waits,
-                    }
+                    return _result(
+                        "tag_mismatch", int(rank),
+                        "rank %d sends tag=%s to rank %s, which "
+                        "only has a recv posted for tag=%s"
+                        % (rank, s.get("tag"), dst, r.get("tag")))
 
-    # 4. unmatched send: the destination never posted a matching recv.
+    # 5. unmatched send: the destination never posted a matching recv.
     for rank in sorted(dumps):
         for s in _stuck_slots(dumps[rank]):
             if s.get("kind") != "isend":
@@ -209,16 +263,13 @@ def diagnose(dumps):
             peer_dump = dumps.get(dst)
             if peer_dump is not None and not _has_recv_for(peer_dump, rank,
                                                            tag):
-                return {
-                    "anomaly": "unmatched_send",
-                    "culprit": int(dst),
-                    "detail": "rank %d's send tag=%s to rank %s has no "
-                              "matching recv — rank %s never posted one"
-                              % (rank, tag, dst, dst),
-                    "waits": waits,
-                }
+                return _result(
+                    "unmatched_send", int(dst),
+                    "rank %d's send tag=%s to rank %s has no "
+                    "matching recv — rank %s never posted one"
+                    % (rank, tag, dst, dst))
 
-    # 5. unmatched recv: the source never produced a matching send.
+    # 6. unmatched recv: the source never produced a matching send.
     for rank in sorted(dumps):
         for s in _stuck_slots(dumps[rank]):
             if s.get("kind") != "irecv":
@@ -227,16 +278,13 @@ def diagnose(dumps):
             peer_dump = dumps.get(src)
             if peer_dump is not None and not _has_send_for(peer_dump, rank,
                                                            tag):
-                return {
-                    "anomaly": "unmatched_recv",
-                    "culprit": int(src),
-                    "detail": "rank %d's recv tag=%s from rank %s has no "
-                              "matching send — rank %s never sent it"
-                              % (rank, tag, src, src),
-                    "waits": waits,
-                }
+                return _result(
+                    "unmatched_recv", int(src),
+                    "rank %d's recv tag=%s from rank %s has no "
+                    "matching send — rank %s never sent it"
+                    % (rank, tag, src, src))
 
-    # 6. barrier skew: some ranks sit inside barrier k (enter without
+    # 7. barrier skew: some ranks sit inside barrier k (enter without
     # exit) while another rank never reached it. The rank with the fewest
     # barrier entries is the one the others wait for.
     entered = {r: len(_events(d, "barrier_enter")) for r, d in dumps.items()}
@@ -246,27 +294,25 @@ def diagnose(dumps):
         straggler = min(dumps, key=lambda r: entered[r])
         if straggler not in in_barrier \
                 and entered[straggler] < max(entered.values()):
-            return {
-                "anomaly": "barrier_skew",
-                "culprit": int(straggler),
-                "detail": "rank(s) %s wait inside barrier %d; rank %d has "
-                          "only entered %d barrier(s)"
-                          % (sorted(in_barrier), max(entered.values()),
-                             straggler, entered[straggler]),
-                "waits": waits,
-            }
+            return _result(
+                "barrier_skew", int(straggler),
+                "rank(s) %s wait inside barrier %d; rank %d has "
+                "only entered %d barrier(s)"
+                % (sorted(in_barrier), max(entered.values()),
+                   straggler, entered[straggler]))
 
-    return {"anomaly": "none", "culprit": None,
-            "detail": "no anomaly detected", "waits": waits}
+    return _result("none", None, "no anomaly detected")
 
 
-def format_report(dumps, diag):
+def format_report(dumps, diag, skipped=()):
     lines = []
     lines.append("acx doctor: %d rank dump(s): %s" % (
         len(dumps),
         ", ".join("rank %d (%s, %d events)" % (
             r, dumps[r].get("reason", "?"), len(dumps[r].get("events", [])))
             for r in sorted(dumps))))
+    for path, reason in skipped:
+        lines.append("  skipped unreadable dump %s (%s)" % (path, reason))
     for w in diag["waits"]:
         lines.append("  " + w)
     lines.append("diagnosis: %s" % diag["detail"])
@@ -289,12 +335,21 @@ def main(argv=None):
                     help="exit nonzero unless the culprit is rank N")
     args = ap.parse_args(argv)
 
-    dumps = load_dumps(args.files)
+    skipped = []
+    dumps = load_dumps(args.files, skipped=skipped)
+    if not dumps:
+        print("acx doctor: no readable flight dumps among %d input(s)"
+              % len(args.files), file=sys.stderr)
+        for path, reason in skipped:
+            print("  %s: %s" % (path, reason), file=sys.stderr)
+        return 2
     diag = diagnose(dumps)
     if args.json:
-        print(json.dumps({k: v for k, v in diag.items()}, indent=1))
+        out = dict(diag)
+        out["skipped_files"] = ["%s (%s)" % (p, r) for p, r in skipped]
+        print(json.dumps(out, indent=1))
     else:
-        print(format_report(dumps, diag))
+        print(format_report(dumps, diag, skipped))
 
     if args.expect_anomaly is not None and \
             diag["anomaly"] != args.expect_anomaly:

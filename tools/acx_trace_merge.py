@@ -36,6 +36,13 @@ Inputs are classified by filename (``.trace.json`` / ``.metrics.json``);
 the rank is parsed from the ``.rank<r>.`` filename component (falling back
 to input order). Prints one JSON summary line; exits non-zero if any
 ``--validate`` check fails.
+
+A missing or truncated input — what a rank that died before flushing
+leaves behind — does NOT fail the merge: the gap is reported in the
+summary (``missing``) and in the merged trace's
+``otherData.missing_ranks``, and the surviving ranks merge normally.
+The absent artifact is evidence of which rank went down, not an error
+in the ones that landed.
 """
 
 import argparse
@@ -195,12 +202,17 @@ def main():
                     help="check artifact invariants; exit 1 on failure")
     args = ap.parse_args()
 
-    traces, metrics, errors = [], [], []
+    traces, metrics, errors, missing = [], [], [], []
     for i, path in enumerate(args.inputs):
         try:
             d = load(path)
         except (OSError, json.JSONDecodeError) as exc:
-            errors.append(f"{path}: {exc}")
+            # A rank that died before flushing leaves a missing or
+            # truncated artifact. That must not fail the merge of the
+            # ranks that DID flush — record the gap (it is evidence of
+            # which rank went down) and keep going.
+            missing.append({"path": path, "rank": parse_rank(path, i),
+                            "reason": str(exc)})
             continue
         if path.endswith(".metrics.json") or "histograms" in d:
             metrics.append((parse_rank(path, i), d))
@@ -212,8 +224,13 @@ def main():
                 validate_trace(path, d, errors)
 
     summary = {"traces": len(traces), "metrics": len(metrics)}
+    if missing:
+        summary["missing"] = missing
     if traces and args.out:
         merged, skew = merge_traces(traces)
+        if missing:
+            merged["otherData"]["missing_ranks"] = sorted(
+                {m["rank"] for m in missing})
         with open(args.out, "w") as f:
             json.dump(merged, f)
         summary["out"] = args.out
@@ -228,6 +245,10 @@ def main():
         summary["errors"] = errors
         summary["valid"] = not errors
     print(json.dumps(summary))
+    for m in missing:
+        print(f"acx_trace_merge: missing artifact for rank {m['rank']}: "
+              f"{m['path']} ({m['reason']}) — merged without it",
+              file=sys.stderr)
     if errors:
         for e in errors:
             print(f"acx_trace_merge: {e}", file=sys.stderr)

@@ -150,10 +150,14 @@ int main(int argc, char** argv) {
         if (j) fds += ',';
         fds += std::to_string(fd_of[r][j]);
       }
+      // Close every fd that is not this rank's own end (row r). Keeping
+      // a peer's end of a pair involving r would hold that socket open
+      // from inside r itself: when the peer later dies, r's stray dup
+      // suppresses the EOF and the death is never detected.
       for (int i = 0; i < np; i++) {
         if (i == r) continue;
         for (int j = 0; j < np; j++) {
-          if (fd_of[i][j] >= 0 && i != r && j != r) close(fd_of[i][j]);
+          if (fd_of[i][j] >= 0) close(fd_of[i][j]);
         }
       }
       setenv("ACX_RANK", std::to_string(r).c_str(), 1);
