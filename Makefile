@@ -125,6 +125,7 @@ check: ctest itest tools
 	@$(MAKE) --no-print-directory chaos-check || exit 1
 	@$(MAKE) --no-print-directory membership-check || exit 1
 	@$(MAKE) --no-print-directory metrics-check || exit 1
+	@$(MAKE) --no-print-directory tseries-check || exit 1
 	@$(MAKE) --no-print-directory doctor-check || exit 1
 	@$(MAKE) --no-print-directory decode-check || exit 1
 	@echo "ALL NATIVE TESTS PASSED"
@@ -215,6 +216,30 @@ metrics-check: ctest tools
 	@echo "== metrics-check: flight-recorder hot-path overhead bound"
 	@$(BUILD)/ctests/test_flight || exit 1
 	@echo "METRICS CHECK PASSED"
+
+# --- live telemetry plane end-to-end (DESIGN.md §13) ---
+# 2-rank ping-pong with periodic sampling on, then acx_top's CI mode
+# asserts series sanity (>= 2 samples/rank, monotone clocks, per-link
+# wire >= payload byte accounting), the name-table ctest runs, and the
+# merge tool folds the tseries stream in with barrier-anchored skew.
+.PHONY: tseries-check
+tseries-check: ctest tools
+	@rm -rf $(BUILD)/tseries-check && mkdir -p $(BUILD)/tseries-check
+	@echo "== tseries-check: acxrun -np 2 bench_pingpong (ACX_TSERIES)"
+	@ACX_TSERIES=$(BUILD)/tseries-check/run ACX_TSERIES_INTERVAL_MS=50 \
+	  ACX_TRACE=$(BUILD)/tseries-check/run \
+	  $(BUILD)/acxrun -np 2 $(BUILD)/bench_pingpong 8 > /dev/null || exit 1
+	@echo "== tseries-check: acx_top --once --json --check"
+	@python3 tools/acx_top.py --once --json --check \
+	  $(BUILD)/tseries-check/run > /dev/null || exit 1
+	@echo "== tseries-check: skew-corrected fleet merge"
+	@python3 tools/acx_trace_merge.py --validate \
+	  --tseries-out $(BUILD)/tseries-check/fleet.tseries.json \
+	  $(BUILD)/tseries-check/run.rank*.trace.json \
+	  $(BUILD)/tseries-check/run.rank*.tseries.jsonl || exit 1
+	@echo "== tseries-check: metrics name-table/enum agreement"
+	@$(BUILD)/ctests/test_metrics_names || exit 1
+	@echo "TSERIES CHECK PASSED"
 
 # --- stall watchdog + hang doctor end-to-end (DESIGN.md §10) ---
 # hang-doctor wedges ranks 0/1 on purpose (withheld Pready + unanswered

@@ -1,0 +1,126 @@
+// Name-table / enum agreement for the metrics registry.
+//
+// The compile-time half lives in src/core/metrics.cc: kCounterName and
+// kHistName are unsized arrays whose lengths static_assert against
+// kNumCounters / kNumHists, so adding an enum entry without a name (or a
+// name without an entry) fails the build. This test covers what the
+// static_assert cannot: every name is a real, distinct, non-placeholder
+// string (the tools key JSON objects by these names — a duplicate would
+// silently merge two counters), the gauge set is exactly the documented
+// one, and the snapshot JSON actually carries every name.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "acx/metrics.h"
+
+#define CHECK(cond)                                                        \
+  do {                                                                     \
+    if (!(cond)) {                                                         \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      std::exit(1);                                                        \
+    }                                                                      \
+  } while (0)
+
+using namespace acx::metrics;
+
+namespace {
+
+void test_counter_names() {
+  std::set<std::string> seen;
+  for (int i = 0; i < kNumCounters; i++) {
+    const char* n = CounterName(static_cast<Counter>(i));
+    CHECK(n != nullptr);
+    CHECK(n[0] != '\0');
+    CHECK(std::strcmp(n, "?") != 0);
+    // Names become JSON keys; keep them simple identifiers.
+    for (const char* p = n; *p; p++)
+      CHECK((*p >= 'a' && *p <= 'z') || (*p >= '0' && *p <= '9') ||
+            *p == '_');
+    CHECK(seen.insert(n).second);  // distinct
+  }
+  CHECK(static_cast<int>(seen.size()) == kNumCounters);
+  // Out-of-range lookups must not read past the table.
+  CHECK(std::strcmp(CounterName(static_cast<Counter>(-1)), "?") == 0);
+  CHECK(std::strcmp(CounterName(kNumCounters), "?") == 0);
+}
+
+void test_hist_names() {
+  std::set<std::string> seen;
+  for (int i = 0; i < kNumHists; i++) {
+    const char* n = HistName(static_cast<Hist>(i));
+    CHECK(n != nullptr);
+    CHECK(n[0] != '\0');
+    CHECK(std::strcmp(n, "?") != 0);
+    CHECK(seen.insert(n).second);
+  }
+  CHECK(static_cast<int>(seen.size()) == kNumHists);
+  CHECK(std::strcmp(HistName(static_cast<Hist>(-1)), "?") == 0);
+  CHECK(std::strcmp(HistName(kNumHists), "?") == 0);
+}
+
+void test_gauge_set() {
+  // Exactly the two documented gauges (metrics.h counters-vs-gauges note);
+  // everything else is a cumulative counter the fleet tools may sum.
+  for (int i = 0; i < kNumCounters; i++) {
+    Counter c = static_cast<Counter>(i);
+    bool want = (c == kFleetEpoch || c == kSlotHighWater);
+    CHECK(IsGauge(c) == want);
+  }
+}
+
+void test_snapshot_carries_every_name() {
+  // Populate a little so the snapshot is non-trivial.
+  Add(kTriggers, 3);
+  Set(kFleetEpoch, 7);
+  MaxGauge(kSlotHighWater, 5);
+  Observe(kProxySweepNs, 1024);
+
+  int need = SnapshotJson(nullptr, 0);
+  CHECK(need > 0);
+  std::vector<char> buf(need + 1);
+  int got = SnapshotJson(buf.data(), need + 1);
+  CHECK(got == need);
+  std::string js(buf.data());
+  for (int i = 0; i < kNumCounters; i++) {
+    std::string key = std::string("\"") +
+                      CounterName(static_cast<Counter>(i)) + "\":";
+    CHECK(js.find(key) != std::string::npos);
+  }
+  for (int i = 0; i < kNumHists; i++) {
+    std::string key = std::string("\"") +
+                      HistName(static_cast<Hist>(i)) + "\":";
+    CHECK(js.find(key) != std::string::npos);
+  }
+  CHECK(js.find("\"gauges\":[") != std::string::npos);
+  CHECK(js.find("\"fleet_epoch\"") != std::string::npos);
+  CHECK(js.find("\"slot_hwm\"") != std::string::npos);
+  CHECK(js.find("\"proxy_util_pct\":") != std::string::npos);
+
+  // Point reads agree with what was recorded above.
+  CHECK(Value(kTriggers) >= 3);
+  CHECK(Value(kFleetEpoch) == 7);
+  CHECK(Value(kSlotHighWater) >= 5);
+  uint64_t count = 0, sum = 0, buckets[kNumBuckets] = {0};
+  HistRead(kProxySweepNs, &count, &sum, buckets);
+  CHECK(count >= 1);
+  CHECK(sum >= 1024);
+  uint64_t bsum = 0;
+  for (int i = 0; i < kNumBuckets; i++) bsum += buckets[i];
+  CHECK(bsum == count);
+}
+
+}  // namespace
+
+int main() {
+  test_counter_names();
+  test_hist_names();
+  test_gauge_set();
+  test_snapshot_carries_every_name();
+  std::printf("test_metrics_names: all checks passed\n");
+  return 0;
+}

@@ -10,11 +10,23 @@
 // written to "<path>.rank<r>.metrics.json" at MPIX_Finalize.
 //
 // Gating: ACX_METRICS=<path> enables collection and the finalize dump;
-// ACX_METRICS=1 enables collection with snapshot-only export. Unset (the
-// default) every instrumented site pays one predictable branch — the
-// same discipline as ACX_TRACE — so the bench_pingpong hot path is
-// untouched. All mutation is relaxed atomics; there is no lock anywhere
-// on the record path.
+// ACX_METRICS=1 enables collection with snapshot-only export.
+// ACX_TSERIES=<prefix> (the live telemetry plane, acx/tseries.h) also
+// enables collection — a periodic sampler has nothing to sample from an
+// off registry — without enabling the finalize dump. Unset (the default)
+// every instrumented site pays one predictable branch — the same
+// discipline as ACX_TRACE — so the bench_pingpong hot path is untouched.
+// All mutation is relaxed atomics; there is no lock anywhere on the
+// record path.
+//
+// Counters vs gauges: most entries are monotonic cumulative counters
+// (difference two snapshots for a rate; fleet aggregation sums them).
+// Two are gauges and must not be summed or differenced as counters:
+// kFleetEpoch is the current epoch value (an absolute reading that can
+// only be compared for ordering on one rank), and kSlotHighWater is a
+// monotonic max watermark (aggregates across ranks as a max). The JSON
+// snapshot lists them under "gauges"; the tseries sampler reports them
+// absolute per sample instead of delta-encoded.
 
 #pragma once
 
@@ -23,7 +35,10 @@
 namespace acx {
 namespace metrics {
 
-// Fixed counter set. Names in kCounterName (metrics.cc) — keep in sync.
+// Fixed counter set. Names in kCounterName (metrics.cc) — the table is
+// unsized there and a static_assert pins its length to kNumCounters, so
+// adding a counter without naming it fails the build (ctests/
+// test_metrics_names.cc additionally checks the names are distinct).
 enum Counter : int {
   kTriggers = 0,       // ops made PENDING (host queue / graph / device mirror)
   kWaits,              // completions observed by a waiter
@@ -71,8 +86,23 @@ enum Hist : int {
 
 constexpr int kNumBuckets = 64;
 
-// True iff ACX_METRICS is set non-empty (checked once).
+// True iff ACX_METRICS or ACX_TSERIES is set non-empty and non-"0"
+// (checked once).
 bool Enabled();
+
+// Introspection for the live telemetry plane (acx/tseries.h) and tools:
+// stable name strings and point reads of the registry. Reads are relaxed
+// — same coherence as SnapshotJson.
+const char* CounterName(Counter c);
+const char* HistName(Hist h);
+uint64_t Value(Counter c);
+// Snapshot one histogram: count and sum always; all kNumBuckets bucket
+// counts too when `buckets` is non-null.
+void HistRead(Hist h, uint64_t* count, uint64_t* sum, uint64_t* buckets);
+
+// True for the gauge entries (kFleetEpoch, kSlotHighWater — see the
+// counters-vs-gauges note above); false for cumulative counters.
+bool IsGauge(Counter c);
 
 // Raw mutation (relaxed atomics; callers gate on Enabled()).
 void Add(Counter c, uint64_t v);
@@ -90,8 +120,16 @@ void MarkWait(int64_t slot);
 
 // JSON export. SnapshotJson serializes the full registry into buf (cap
 // bytes including the NUL) and returns the byte length needed excluding
-// the NUL (call with cap=0 to size). DumpJson writes the same JSON to a
-// file, returning 0 on success. FlushAtFinalize writes
+// the NUL (call with cap=0 to size). The snapshot schema is
+//   {"enabled":..., "counters":{...}, "histograms":{...},
+//    "gauges":["fleet_epoch","slot_hwm"],
+//    "derived":{"proxy_util_pct":...}}
+// where "gauges" names the counter entries that are absolute readings
+// (never sum or difference them) and "derived" carries rates computed
+// from counters at snapshot time — proxy_util_pct is
+// 100*busy/(busy+idle) over the whole run (the tseries sampler reports
+// the same ratio over each sample interval instead). DumpJson writes the
+// same JSON to a file, returning 0 on success. FlushAtFinalize writes
 // "<ACX_METRICS>.rank<rank>.metrics.json" iff ACX_METRICS is a path.
 int SnapshotJson(char* buf, int cap);
 int DumpJson(const char* path);

@@ -44,14 +44,21 @@ void SetRank(int rank);
 // so repeated flushes rewrite supersets.
 void Flush(int rank);
 
+// Nanoseconds since this process's trace-timeline zero (the steady-clock
+// origin all ring event timestamps are relative to; first call pins it).
+// The tseries sampler (acx/tseries.h) stamps its samples with this, so
+// tseries and trace share one per-rank timeline and acx_trace_merge's
+// barrier-anchored clock-skew correction applies to both artifact kinds.
+uint64_t NowSinceStartNs();
+
 // Shared crash-flush registry. Registers `fn` to run once when the process
 // dies on a fatal signal (SIGTERM/INT/ABRT/SEGV/BUS, claimed only over
 // SIG_DFL dispositions) and — when `on_exit` — also at normal exit via
 // atexit. First call installs the hooks. `fn` must be best-effort safe:
-// no locks it could already hold, no allocation it can avoid. At most 4
-// flushers (trace + flight today); extras are dropped. All registered
-// flushers run under one process-wide "already flushing" latch, so a crash
-// inside a flusher cannot recurse.
+// no locks it could already hold, no allocation it can avoid. At most 8
+// flushers (trace + flight + tseries today); extras are dropped. All
+// registered flushers run under one process-wide "already flushing" latch,
+// so a crash inside a flusher cannot recurse.
 void RegisterCrashFlusher(void (*fn)(), bool on_exit);
 
 }  // namespace trace

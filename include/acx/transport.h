@@ -77,6 +77,28 @@ struct LinkClock {
   uint64_t replay_bytes = 0;  // unacked bytes held in the replay buffer
 };
 
+// Per-link wire scope (DESIGN.md §13): cumulative payload-vs-on-wire byte
+// accounting for one peer's link, plus its health and recovery counters.
+// Payload is what the application asked to move; wire adds framing headers,
+// control frames, and replayed frames — the goodput-vs-overhead split that
+// striping and quantized-wire work is tuned against. All counters are
+// cumulative since link creation (they survive reconnects); rates come
+// from differencing consecutive snapshots, which is exactly what the
+// tseries sampler (acx/tseries.h) and tools/acx_top.py do.
+struct LinkScope {
+  int state = 0;                  // PeerHealth value at snapshot time
+  uint32_t epoch = 0;             // link incarnation (bumps per reconnect)
+  uint64_t tx_payload_bytes = 0;  // app bytes queued in eager data frames
+  uint64_t tx_wire_bytes = 0;     // every byte actually written to the link
+  uint64_t rx_payload_bytes = 0;  // app bytes delivered from data frames
+  uint64_t rx_wire_bytes = 0;     // every byte read off the link
+  uint64_t tx_frames = 0;         // frames fully written (incl. control)
+  uint64_t rx_frames = 0;         // data frames fully delivered
+  uint64_t naks = 0;              // re-pulls sent for this link
+  uint64_t crc_rejects = 0;       // frames from this peer dropped on CRC
+  uint64_t replayed = 0;          // frames re-sent to this peer
+};
+
 class Transport {
  public:
   virtual ~Transport() = default;
@@ -121,6 +143,11 @@ class Transport {
   // snapshot without blocking — callers on the dump/signal path must
   // tolerate a refusal, never retry-spin on it.
   virtual bool link_clock(int /*rank*/, LinkClock* /*out*/) { return false; }
+
+  // Best-effort snapshot of the wire-scope counters for peer `rank`'s link
+  // (same refusal contract as link_clock). False on transports without a
+  // framed wire (self/loopback-only).
+  virtual bool link_scope(int /*rank*/, LinkScope* /*out*/) { return false; }
 
   // Graceful departure (MPIX_Fleet_leave, DESIGN.md §12): announce LEFT to
   // the fleet and surrender the rendezvous listener so a replacement can

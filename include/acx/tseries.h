@@ -1,0 +1,96 @@
+// tpu-acx: live telemetry plane — periodic time-series sampling of the
+// metrics registry (docs/DESIGN.md §13).
+//
+// The metrics plane (acx/metrics.h) gives one cumulative snapshot per run;
+// the trace ring (acx/trace.h) gives per-op instants. Neither answers
+// "what is this rank doing RIGHT NOW" mid-run. This layer does: with
+// ACX_TSERIES=<prefix> set, the proxy sweep drives a sampler that every
+// ACX_TSERIES_INTERVAL_MS (default 250) appends one delta-encoded JSON
+// line to "<prefix>.rank<r>.tseries.jsonl":
+//
+//   first line   {"init":true,"rank":R,"interval_ms":N,"t_mono_ns":...,
+//                 "t_wall_ms":...,"epoch":E,"counters":{all, absolute},
+//                 "links":[...]}                    — the absolute baseline
+//   then         {"seq":n,"t_mono_ns":...,"t_wall_ms":...,"epoch":E,
+//                 "d":{changed counter deltas},     — gauges excluded
+//                 "g":{"fleet_epoch":..,"slot_hwm":..},   — absolute
+//                 "proxy_util_pct":...,             — over THIS interval
+//                 "h":{hist deltas, sparse buckets [[i,d],...]},
+//                 "links":[{peer,state,epoch,tx_pb,tx_wb,rx_pb,rx_wb,
+//                           tx_fr,rx_fr,naks,crc,replayed}],  — absolute
+//                 "app":{...}}                      — last Annotate fragment
+//
+// t_mono_ns is trace::NowSinceStartNs() — the same per-rank timeline as
+// the trace ring, so acx_trace_merge's barrier-anchored skew correction
+// aligns tseries across ranks. t_wall_ms is system_clock for humans.
+// Link counters are cumulative absolutes (readers difference consecutive
+// samples — deltas would go wrong across a torn tail line).
+//
+// Cost: disabled (the default), the proxy pays one latched-bool branch
+// per sweep — same discipline as ACX_TRACE / ACX_METRICS. Enabled, the
+// off-interval cost is one relaxed clock compare per sweep.
+//
+// Crash safety: Enabled()'s first true call registers a best-effort
+// flusher with trace::RegisterCrashFlusher (on_exit=true), so a dying
+// rank appends one final sample — the tail of the series survives
+// SIGSEGV/SIGABRT and normal exit alike.
+
+#pragma once
+
+#include <cstdint>
+
+namespace acx {
+
+class Transport;
+
+namespace tseries {
+
+// True iff ACX_TSERIES is set non-empty, non-"0", AND the interval parsed
+// valid (ACX_TSERIES_INTERVAL_MS=0 or garbage disables sampling with a
+// stderr warning). Checked once; first true call registers the crash
+// flusher.
+bool Enabled();
+
+// Sampling interval in nanoseconds (meaningful only when Enabled()).
+uint64_t IntervalNs();
+
+// Tell the sampler this process's rank so the output file is named
+// correctly (falls back to $ACX_RANK, then 0). Call before first sample.
+void SetRank(int rank);
+
+// Install a hook the sampler calls before each sample to fold externally
+// owned stats (proxy/net/fleet) into the metrics registry. Installed from
+// MPIX_Init with the C-API's RefreshRuntimeMetrics — the hook indirection
+// keeps src/core free of src/api dependencies.
+void SetRefreshHook(void (*fn)());
+
+// Proxy-sweep driver: cheap now-vs-next-due check; takes a sample when the
+// interval has elapsed. `t` may be null (links section skipped).
+void MaybeSample(Transport* t);
+
+// Take a sample immediately regardless of the interval (finalize path,
+// acx_tseries_sample_now).
+void SampleNow(Transport* t);
+
+// Forget the cached transport before its owner deletes it (the MPI shim's
+// MPI_Finalize). Samples taken afterwards (the atexit flusher's tail
+// sample) skip the links section instead of chasing a dangling pointer.
+void DetachTransport();
+
+// Attach an application-level JSON fragment (must be a complete JSON
+// object, "{...}", ≤ 8 KiB; anything else is ignored) to subsequent
+// samples under "app". The serving layer publishes rolling TTFT/ITL
+// percentiles and queue depth through this.
+void Annotate(const char* json);
+
+// Copy the most recent sample line into buf (cap bytes including NUL);
+// returns the byte length needed excluding the NUL (call with cap=0 to
+// size) — the SnapshotJson sizing contract. Returns 0 when no sample has
+// been taken yet.
+int LiveJson(char* buf, int cap);
+
+// Samples written so far (including the init line).
+uint64_t SamplesWritten();
+
+}  // namespace tseries
+}  // namespace acx

@@ -155,6 +155,81 @@ def _flight_dump_best_effort() -> bool:
         return False
 
 
+class RollingSLO:
+    """Sliding-window serving SLOs for the live telemetry plane
+    (docs/DESIGN.md §13): TTFT and inter-token-latency samples kept in a
+    time-bounded window (default 30 s) plus point-in-time queue-depth and
+    slot-occupancy gauges. ``live_slos()`` returns the rolling p50/p99 —
+    the numbers an operator watching acx_top needs mid-run, as opposed to
+    ServingMetrics' whole-batch aggregates computed at the end."""
+
+    def __init__(self, window_s: float = 30.0):
+        self.window_s = float(window_s)
+        self._ttft: deque = deque()  # (monotonic t, seconds)
+        self._itl: deque = deque()
+        self.queue_depth = 0
+        self.slot_occupancy = 0.0
+
+    def _trim(self, dq: deque, now: float) -> None:
+        cutoff = now - self.window_s
+        while dq and dq[0][0] < cutoff:
+            dq.popleft()
+
+    def note_ttft(self, seconds: float) -> None:
+        now = time.monotonic()
+        self._ttft.append((now, float(seconds)))
+        self._trim(self._ttft, now)
+
+    def note_itl(self, seconds: float) -> None:
+        now = time.monotonic()
+        self._itl.append((now, float(seconds)))
+        self._trim(self._itl, now)
+
+    def note_gauges(self, queue_depth: int, slot_occupancy: float) -> None:
+        self.queue_depth = int(queue_depth)
+        self.slot_occupancy = float(slot_occupancy)
+
+    def live_slos(self) -> dict:
+        """Rolling-window percentiles + live gauges, JSON-ready."""
+        now = time.monotonic()
+        self._trim(self._ttft, now)
+        self._trim(self._itl, now)
+        ttft = [v for _, v in self._ttft]
+        itl = [v for _, v in self._itl]
+        return {
+            "window_s": self.window_s,
+            "ttft_p50_s": _pct(ttft, 0.50),
+            "ttft_p99_s": _pct(ttft, 0.99),
+            "ttft_n": len(ttft),
+            "itl_p50_s": _pct(itl, 0.50),
+            "itl_p99_s": _pct(itl, 0.99),
+            "itl_n": len(itl),
+            "queue_depth": self.queue_depth,
+            "slot_occupancy": self.slot_occupancy,
+        }
+
+
+def _tseries_annotate_best_effort(fragment: dict) -> bool:
+    """Publish ``fragment`` to the native telemetry sampler (it rides along
+    under ``"app"`` in every subsequent ACX_TSERIES sample) — but only if
+    the native runtime is already loaded AND sampling is armed: same
+    no-build/no-load discipline as ``_flight_dump_best_effort``, plus the
+    JSON encode is skipped entirely when nobody is sampling. Returns True
+    iff the fragment was handed to the sampler."""
+    if not os.environ.get("ACX_TSERIES"):
+        return False
+    try:
+        import json as _json
+        import mpi_acx_tpu.runtime as _rt
+        if _rt._lib is None or not _rt._lib.acx_tseries_enabled():
+            return False
+        _rt._lib.acx_tseries_annotate(
+            _json.dumps(fragment, separators=(",", ":")).encode())
+        return True
+    except Exception:  # pragma: no cover — diagnostics must never raise
+        return False
+
+
 def _bucket(n: int, lo: int = 8) -> int:
     b = lo
     while b < n:
@@ -336,6 +411,10 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
     t0 = time.perf_counter()
     ttft = [None] * len(prompts)      # type: List[Optional[float]]
     finish = [None] * len(prompts)    # type: List[Optional[float]]
+    # Rolling-window SLOs for the live telemetry plane: fed alongside the
+    # whole-batch lists below, published to the ACX_TSERIES sampler once
+    # per scheduler iteration (a no-op unless sampling is armed).
+    slo = RollingSLO()
     itl_samples: List[float] = []
     qd_samples: List[int] = []
     occ_samples: List[float] = []
@@ -442,6 +521,7 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
         last_tok[b] = first
         n_prefills += 1
         ttft[rid] = time.perf_counter() - t0  # prefill emitted token one
+        slo.note_ttft(ttft[rid])
         return True
 
     def retire(b):
@@ -474,6 +554,8 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
     while any(o >= 0 for o in owner) or queue:
         qd_samples.append(len(queue))
         occ_samples.append(sum(o >= 0 for o in owner) / n_slots)
+        slo.note_gauges(qd_samples[-1], occ_samples[-1])
+        _tseries_annotate_best_effort(slo.live_slos())
         if queue:
             # Capacity may have returned (a replacement rank joined):
             # revive shed slots and rebalance the backlog onto them.
@@ -539,6 +621,7 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
                     break
                 emitted[owner[b]].append(int(block[c, b]))
                 itl_samples.append(step_dt / chunk)
+                slo.note_itl(step_dt / chunk)
         for b in range(n_slots):
             while owner[b] >= 0 and slot_finished(b):
                 retire(b)

@@ -106,6 +106,12 @@ def lib() -> ctypes.CDLL:
         _lib.MPIX_Fleet_leave.restype = ctypes.c_int
         _lib.MPIX_Fleet_leave.argtypes = [ctypes.c_double]
         _lib.acx_fleet_stats.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+        _lib.acx_tseries_enabled.restype = ctypes.c_int
+        _lib.acx_tseries_sample_now.restype = ctypes.c_int
+        _lib.acx_tseries_live_json.restype = ctypes.c_int
+        _lib.acx_tseries_live_json.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        _lib.acx_tseries_annotate.restype = None
+        _lib.acx_tseries_annotate.argtypes = [ctypes.c_char_p]
     return _lib
 
 
@@ -490,6 +496,41 @@ class Runtime:
         """Write the registry snapshot to ``path`` as JSON."""
         if self._lib.acx_metrics_dump_json(path.encode()) != 0:
             raise RuntimeError(f"acx_metrics_dump_json({path!r}) failed")
+
+    # -- live telemetry plane (ACX_TSERIES, docs/DESIGN.md §13) -------------
+
+    def tseries_enabled(self) -> bool:
+        """True iff ACX_TSERIES periodic sampling is armed."""
+        return bool(self._lib.acx_tseries_enabled())
+
+    def live_metrics(self) -> dict:
+        """Take a fresh telemetry sample and return it as a dict — the same
+        delta-encoded record the sampler appends to the per-rank
+        ``.tseries.jsonl`` (counter deltas since the previous sample, gauge
+        absolutes, interval proxy utilization, per-link wire scope, and the
+        last ``tseries_annotate`` fragment under ``"app"``). Readable
+        mid-run from any thread. Returns ``{"enabled": False}`` when
+        ACX_TSERIES is unset."""
+        import json as _json
+        if self._lib.acx_tseries_sample_now() < 0:
+            return {"enabled": False}
+        # Same retry-sizing discipline as metrics(): the live line can be
+        # replaced by a bigger sample between the probe and the fill.
+        n = self._lib.acx_tseries_live_json(None, 0)
+        while True:
+            cap = n + 256
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.acx_tseries_live_json(buf, cap)
+            if n < cap:
+                return _json.loads(buf.value.decode()) if n else {}
+
+    def tseries_annotate(self, fragment: dict) -> None:
+        """Attach an application-level JSON fragment (e.g. serving SLOs) to
+        subsequent telemetry samples under ``"app"``. No-op when sampling
+        is disabled; fragments over 8 KiB are ignored by the native side."""
+        import json as _json
+        self._lib.acx_tseries_annotate(
+            _json.dumps(fragment, separators=(",", ":")).encode())
 
     # -- flight recorder ----------------------------------------------------
 

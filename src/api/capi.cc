@@ -15,6 +15,7 @@
 #include "acx/flightrec.h"
 #include "acx/membership.h"
 #include "acx/metrics.h"
+#include "acx/tseries.h"
 
 namespace acx {
 
@@ -84,6 +85,33 @@ int acx_metrics_dump_json(const char* path) {
   acx::RefreshRuntimeMetrics();
   return acx::metrics::DumpJson(path);
 }
+
+// ---- live telemetry plane (DESIGN.md §13) --------------------------------
+
+// 1 iff ACX_TSERIES sampling is armed (prefix set, interval valid).
+int acx_tseries_enabled(void) { return acx::tseries::Enabled() ? 1 : 0; }
+
+// Take a sample immediately (outside the periodic cadence) so a subsequent
+// acx_tseries_live_json reads fresh state. Returns the total samples
+// written, or -1 when sampling is disabled.
+int acx_tseries_sample_now(void) {
+  if (!acx::tseries::Enabled()) return -1;
+  acx::RefreshRuntimeMetrics();
+  acx::tseries::SampleNow(acx::GS().transport);
+  return static_cast<int>(acx::tseries::SamplesWritten());
+}
+
+// Copies the most recent sample line (one JSON object, same schema as the
+// .tseries.jsonl rows) into buf. Sizing contract of acx_metrics_snapshot;
+// returns 0 when no sample exists yet.
+int acx_tseries_live_json(char* buf, int cap) {
+  return acx::tseries::LiveJson(buf, cap);
+}
+
+// Attach an application JSON fragment (a complete object, <= 8 KiB) to
+// subsequent samples under "app" — the serving layer publishes rolling
+// TTFT/ITL percentiles and queue depth this way. Invalid input is ignored.
+void acx_tseries_annotate(const char* json) { acx::tseries::Annotate(json); }
 
 // Fills out[4] = {sweeps, ops_issued, ops_completed, slots_reclaimed}.
 void acx_proxy_stats(uint64_t* out) {

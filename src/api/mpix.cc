@@ -28,6 +28,7 @@
 #include "acx/flightrec.h"
 #include "acx/metrics.h"
 #include "acx/trace.h"
+#include "acx/tseries.h"
 #include "acx/net.h"
 #include "acx/runtime.h"
 #include "mpi-acx.h"
@@ -379,6 +380,10 @@ int MPIX_Init(void) {
   trace::SetRank(g.transport->rank());
   flight::SetRank(g.transport->rank());
   SetDebugRank(g.transport->rank());
+  tseries::SetRank(g.transport->rank());
+  // The sampler folds proxy/net/fleet stats into the registry before each
+  // sample; the hook keeps src/core free of this layer.
+  tseries::SetRefreshHook(&RefreshRuntimeMetrics);
   ACX_FLIGHT(kInit, -1, g.transport->rank(), g.transport->size(), 0, 0);
   g.mpix_inited = true;
   ACX_DLOG("MPIX_Init: rank %d/%d, %zu flag slots", g.transport->rank(),
@@ -420,6 +425,10 @@ int MPIX_Finalize(void) {
     RefreshRuntimeMetrics();
     metrics::FlushAtFinalize(g.transport->rank());
   }
+  // Final tseries sample: guarantees the series tail (and, with the init
+  // baseline, >= 2 samples) even for runs shorter than one interval. The
+  // transport outlives finalize, so the link section stays valid.
+  if (tseries::Enabled()) tseries::SampleNow(g.transport);
   delete g.proxy;
   g.proxy = nullptr;
   delete g.table;

@@ -12,8 +12,11 @@ namespace acx {
 namespace metrics {
 namespace {
 
-// Keep in sync with enum Counter / enum Hist (acx/metrics.h).
-const char* const kCounterName[kNumCounters] = {
+// Keep in sync with enum Counter / enum Hist (acx/metrics.h). The arrays
+// are deliberately unsized: the static_asserts below turn a counter added
+// without a name (or vice versa) into a build error instead of an
+// out-of-bounds read at snapshot time.
+const char* const kCounterName[] = {
     "triggers",        "waits",          "ops_isend",      "ops_irecv",
     "ops_pready",      "ops_parrived",   "bytes_sent",     "bytes_recv",
     "retries",         "timeouts",       "faults_injected", "hb_sent",
@@ -24,12 +27,17 @@ const char* const kCounterName[kNumCounters] = {
     "fleet_joins",     "fleet_leaves",   "fleet_deaths",
 };
 
-const char* const kHistName[kNumHists] = {
+const char* const kHistName[] = {
     "trigger_to_issue_ns",
     "issue_to_complete_ns",
     "complete_to_wait_ns",
     "proxy_sweep_ns",
 };
+
+static_assert(sizeof(kCounterName) / sizeof(kCounterName[0]) == kNumCounters,
+              "kCounterName out of sync with enum Counter (acx/metrics.h)");
+static_assert(sizeof(kHistName) / sizeof(kHistName[0]) == kNumHists,
+              "kHistName out of sync with enum Hist (acx/metrics.h)");
 
 struct HistData {
   std::atomic<uint64_t> count{0};
@@ -125,6 +133,19 @@ std::string SnapshotString() {
     }
     out += "]}";
   }
+  // Schema tail: which counter entries are gauges (absolute readings —
+  // never summed or differenced), plus run-lifetime derived rates.
+  out += "},\"gauges\":[\"fleet_epoch\",\"slot_hwm\"],\"derived\":{";
+  const uint64_t busy =
+      s.counters[kProxyBusyNs].load(std::memory_order_relaxed);
+  const uint64_t idle =
+      s.counters[kProxyIdleNs].load(std::memory_order_relaxed);
+  std::snprintf(buf, sizeof buf, "\"proxy_util_pct\":%.2f",
+                busy + idle > 0
+                    ? 100.0 * static_cast<double>(busy) /
+                          static_cast<double>(busy + idle)
+                    : 0.0);
+  out += buf;
   out += "}}";
   return out;
 }
@@ -133,11 +154,40 @@ std::string SnapshotString() {
 
 bool Enabled() {
   static const bool on = [] {
-    const char* p = std::getenv("ACX_METRICS");
-    return p != nullptr && p[0] != '\0' && std::strcmp(p, "0") != 0;
+    const auto set = [](const char* name) {
+      const char* p = std::getenv(name);
+      return p != nullptr && p[0] != '\0' && std::strcmp(p, "0") != 0;
+    };
+    // ACX_TSERIES implies collection: the periodic sampler (acx/tseries.h)
+    // reads this registry, so arming it without ACX_METRICS must still
+    // turn the counters on. The finalize dump stays ACX_METRICS-gated.
+    return set("ACX_METRICS") || set("ACX_TSERIES");
   }();
   return on;
 }
+
+const char* CounterName(Counter c) {
+  return c >= 0 && c < kNumCounters ? kCounterName[c] : "?";
+}
+
+const char* HistName(Hist h) {
+  return h >= 0 && h < kNumHists ? kHistName[h] : "?";
+}
+
+uint64_t Value(Counter c) {
+  return S().counters[c].load(std::memory_order_relaxed);
+}
+
+void HistRead(Hist h, uint64_t* count, uint64_t* sum, uint64_t* buckets) {
+  const HistData& hd = S().hists[h];
+  if (count != nullptr) *count = hd.count.load(std::memory_order_relaxed);
+  if (sum != nullptr) *sum = hd.sum.load(std::memory_order_relaxed);
+  if (buckets != nullptr)
+    for (int b = 0; b < kNumBuckets; b++)
+      buckets[b] = hd.buckets[b].load(std::memory_order_relaxed);
+}
+
+bool IsGauge(Counter c) { return c == kFleetEpoch || c == kSlotHighWater; }
 
 void Add(Counter c, uint64_t v) {
   S().counters[c].fetch_add(v, std::memory_order_relaxed);

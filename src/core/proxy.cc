@@ -10,6 +10,7 @@
 #include "acx/membership.h"
 #include "acx/metrics.h"
 #include "acx/trace.h"
+#include "acx/tseries.h"
 
 namespace acx {
 
@@ -459,6 +460,10 @@ void Proxy::Run() {
   // Busy/idle split for the metrics plane ("proxy idle fraction"): clocks
   // are only read when ACX_METRICS is armed.
   const bool mx = metrics::Enabled();
+  // Live telemetry plane (DESIGN.md §13): the sweep loop is the sampler's
+  // clock. Disabled costs this one latched bool; enabled, the off-interval
+  // cost is one clock read + compare per pass inside MaybeSample.
+  const bool ts = tseries::Enabled();
   while (!exit_.load(std::memory_order_acquire)) {
     const uint64_t kicks_before = kicks_.load(std::memory_order_acquire);
     bool progressed;
@@ -472,6 +477,7 @@ void Proxy::Run() {
       metrics::Add(metrics::kProxyBusyNs, dt);
       metrics::Observe(metrics::kProxySweepNs, dt);
     }
+    if (ts) tseries::MaybeSample(transport_);
     sweeps_.fetch_add(1, std::memory_order_relaxed);
     // Watchdog: cheap modular tick so the hot sweep loop reads the clock
     // at most once per 64 iterations; the slow idle branches below nap

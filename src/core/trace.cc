@@ -97,7 +97,7 @@ void FlushBestEffort() {
 // one set of signal/atexit hooks, N best-effort flushers. Small fixed
 // array — registration happens a handful of times at startup, the signal
 // path just walks it.
-constexpr int kMaxFlushers = 4;
+constexpr int kMaxFlushers = 8;
 void (*g_flushers[kMaxFlushers])() = {};
 bool g_flusher_on_exit[kMaxFlushers] = {};
 std::atomic<int> g_nflushers{0};
@@ -296,6 +296,13 @@ void Emit(const char* name, int64_t slot) {
                                                            r.t0)
           .count());
   r.events.push_back(Event{ts, name, slot});
+}
+
+uint64_t NowSinceStartNs() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(Clock::now() -
+                                                           ring().t0)
+          .count());
 }
 
 void SetRank(int rank) {

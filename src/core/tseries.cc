@@ -1,0 +1,384 @@
+#include "acx/tseries.h"
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+#include "acx/fault.h"  // NowNs
+#include "acx/membership.h"
+#include "acx/metrics.h"
+#include "acx/trace.h"
+#include "acx/transport.h"
+
+namespace acx {
+namespace tseries {
+namespace {
+
+struct Config {
+  bool on = false;
+  const char* prefix = nullptr;
+  uint64_t interval_ns = 0;
+};
+
+const Config& cfg() {
+  static const Config c = [] {
+    Config c;
+    const char* p = std::getenv("ACX_TSERIES");
+    if (p == nullptr || p[0] == '\0' || std::strcmp(p, "0") == 0) return c;
+    uint64_t ms = 250;
+    const char* iv = std::getenv("ACX_TSERIES_INTERVAL_MS");
+    if (iv != nullptr && iv[0] != '\0') {
+      char* end = nullptr;
+      const unsigned long long v = std::strtoull(iv, &end, 10);
+      // strtoull silently wraps a leading '-' into a huge value; a
+      // negative interval is a config error like any other.
+      if (end == iv || *end != '\0' || v == 0 ||
+          std::strchr(iv, '-') != nullptr) {
+        // A zero or unparseable interval is a config error, not a "sample
+        // as fast as possible" request — refuse loudly rather than spin.
+        std::fprintf(stderr,
+                     "tpu-acx: ACX_TSERIES_INTERVAL_MS=\"%s\" invalid; "
+                     "sampling disabled\n",
+                     iv);
+        return c;
+      }
+      ms = static_cast<uint64_t>(v);
+    }
+    c.on = true;
+    c.prefix = p;
+    c.interval_ns = ms * 1000000ull;
+    return c;
+  }();
+  return c;
+}
+
+struct State {
+  std::mutex mu;  // serializes sampling + file writes
+  FILE* f = nullptr;
+  bool open_failed = false;  // latch: don't retry/ re-warn every interval
+  uint64_t seq = 0;          // delta samples written (init line is seq "0")
+  uint64_t prev_counters[metrics::kNumCounters] = {};
+  uint64_t prev_hcount[metrics::kNumHists] = {};
+  uint64_t prev_hsum[metrics::kNumHists] = {};
+  uint64_t prev_hbuckets[metrics::kNumHists][metrics::kNumBuckets] = {};
+  std::string live;  // most recent full sample line, for LiveJson
+
+  std::mutex ann_mu;
+  std::string annotation;  // last Annotate fragment, "" = none
+};
+
+State& S() {
+  static State* s = new State;
+  return *s;
+}
+
+std::atomic<int> g_rank{-1};
+std::atomic<uint64_t> g_next_due{0};
+std::atomic<uint64_t> g_samples{0};
+std::atomic<Transport*> g_transport{nullptr};
+std::atomic<void (*)()> g_refresh{nullptr};
+
+int RankForFile() {
+  int r = g_rank.load(std::memory_order_relaxed);
+  if (r >= 0) return r;
+  const char* e = std::getenv("ACX_RANK");
+  return e != nullptr ? std::atoi(e) : 0;
+}
+
+uint64_t WallMs() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::milliseconds>(
+          std::chrono::system_clock::now().time_since_epoch())
+          .count());
+}
+
+void AppendU64(std::string* out, const char* key, uint64_t v) {
+  char buf[64];
+  std::snprintf(buf, sizeof buf, "\"%s\":%llu", key,
+                (unsigned long long)v);
+  *out += buf;
+}
+
+// Links section: cumulative absolute wire-scope counters per peer. Best
+// effort — a peer whose scope can't be snapped without blocking is simply
+// absent from this sample (same contract as link_clock).
+void AppendLinks(std::string* out, Transport* t) {
+  *out += "\"links\":[";
+  bool first = true;
+  if (t != nullptr) {
+    const int self = t->rank();
+    const int n = t->size();
+    for (int p = 0; p < n; p++) {
+      if (p == self) continue;
+      LinkScope sc;
+      if (!t->link_scope(p, &sc)) continue;
+      if (!first) *out += ",";
+      first = false;
+      char buf[384];
+      std::snprintf(
+          buf, sizeof buf,
+          "{\"peer\":%d,\"state\":%d,\"epoch\":%u,\"tx_pb\":%llu,"
+          "\"tx_wb\":%llu,\"rx_pb\":%llu,\"rx_wb\":%llu,\"tx_fr\":%llu,"
+          "\"rx_fr\":%llu,\"naks\":%llu,\"crc\":%llu,\"replayed\":%llu}",
+          p, sc.state, sc.epoch, (unsigned long long)sc.tx_payload_bytes,
+          (unsigned long long)sc.tx_wire_bytes,
+          (unsigned long long)sc.rx_payload_bytes,
+          (unsigned long long)sc.rx_wire_bytes,
+          (unsigned long long)sc.tx_frames,
+          (unsigned long long)sc.rx_frames, (unsigned long long)sc.naks,
+          (unsigned long long)sc.crc_rejects,
+          (unsigned long long)sc.replayed);
+      *out += buf;
+    }
+  }
+  *out += "]";
+}
+
+// Caller holds s.mu.
+void SampleLocked(State& s, Transport* t) {
+  if (s.open_failed) return;
+  if (s.f == nullptr) {
+    const std::string fn = std::string(cfg().prefix) + ".rank" +
+                           std::to_string(RankForFile()) + ".tseries.jsonl";
+    s.f = std::fopen(fn.c_str(), "w");
+    if (s.f == nullptr) {
+      s.open_failed = true;
+      std::fprintf(stderr, "tpu-acx: ACX_TSERIES: cannot write %s\n",
+                   fn.c_str());
+      return;
+    }
+  }
+
+  const uint64_t mono = trace::NowSinceStartNs();
+  const uint64_t wall = WallMs();
+  const uint64_t epoch = Fleet().epoch();
+
+  uint64_t cur[metrics::kNumCounters];
+  for (int c = 0; c < metrics::kNumCounters; c++)
+    cur[c] = metrics::Value(static_cast<metrics::Counter>(c));
+
+  std::string line;
+  line.reserve(1024);
+  char buf[96];
+
+  if (s.seq == 0) {
+    // Baseline: every counter absolute, so a reader reconstructs the
+    // cumulative series from init + deltas alone.
+    std::snprintf(buf, sizeof buf,
+                  "{\"init\":true,\"rank\":%d,\"interval_ms\":%llu,",
+                  RankForFile(),
+                  (unsigned long long)(cfg().interval_ns / 1000000ull));
+    line += buf;
+    AppendU64(&line, "t_mono_ns", mono);
+    line += ",";
+    AppendU64(&line, "t_wall_ms", wall);
+    line += ",";
+    AppendU64(&line, "epoch", epoch);
+    line += ",\"counters\":{";
+    for (int c = 0; c < metrics::kNumCounters; c++) {
+      if (c) line += ",";
+      AppendU64(&line, metrics::CounterName(static_cast<metrics::Counter>(c)),
+                cur[c]);
+    }
+    line += "},";
+    AppendLinks(&line, t);
+    // An "app" fragment published before the first sample (a shim-only
+    // program with no proxy forcing one via sample_now) must not be
+    // dropped: the init line carries it like any other sample.
+    {
+      std::lock_guard<std::mutex> alk(s.ann_mu);
+      if (!s.annotation.empty()) {
+        line += ",\"app\":";
+        line += s.annotation;
+      }
+    }
+    line += "}";
+  } else {
+    std::snprintf(buf, sizeof buf, "{\"seq\":%llu,",
+                  (unsigned long long)s.seq);
+    line += buf;
+    AppendU64(&line, "t_mono_ns", mono);
+    line += ",";
+    AppendU64(&line, "t_wall_ms", wall);
+    line += ",";
+    AppendU64(&line, "epoch", epoch);
+    // Changed non-gauge counters, delta-encoded. Quiet intervals cost a
+    // few dozen bytes, busy ones stay proportional to what moved.
+    line += ",\"d\":{";
+    bool first = true;
+    for (int c = 0; c < metrics::kNumCounters; c++) {
+      const metrics::Counter cc = static_cast<metrics::Counter>(c);
+      if (metrics::IsGauge(cc) || cur[c] == s.prev_counters[c]) continue;
+      if (!first) line += ",";
+      first = false;
+      AppendU64(&line, metrics::CounterName(cc), cur[c] - s.prev_counters[c]);
+    }
+    // Gauges: absolute every sample (delta of an epoch or a watermark is
+    // meaningless).
+    line += "},\"g\":{";
+    AppendU64(&line, "fleet_epoch", cur[metrics::kFleetEpoch]);
+    line += ",";
+    AppendU64(&line, "slot_hwm", cur[metrics::kSlotHighWater]);
+    line += "},";
+    // Interval-local proxy utilization, from the busy/idle ns deltas.
+    const uint64_t db =
+        cur[metrics::kProxyBusyNs] - s.prev_counters[metrics::kProxyBusyNs];
+    const uint64_t di =
+        cur[metrics::kProxyIdleNs] - s.prev_counters[metrics::kProxyIdleNs];
+    std::snprintf(buf, sizeof buf, "\"proxy_util_pct\":%.2f,",
+                  db + di > 0 ? 100.0 * static_cast<double>(db) /
+                                    static_cast<double>(db + di)
+                              : 0.0);
+    line += buf;
+    // Histogram deltas, sparse buckets: only hists that moved, only
+    // buckets that moved.
+    line += "\"h\":{";
+    first = true;
+    for (int h = 0; h < metrics::kNumHists; h++) {
+      const metrics::Hist hh = static_cast<metrics::Hist>(h);
+      uint64_t count = 0, sum = 0, buckets[metrics::kNumBuckets];
+      metrics::HistRead(hh, &count, &sum, buckets);
+      if (count == s.prev_hcount[h]) {
+        s.prev_hsum[h] = sum;
+        continue;
+      }
+      if (!first) line += ",";
+      first = false;
+      line += "\"";
+      line += metrics::HistName(hh);
+      line += "\":{";
+      AppendU64(&line, "count", count - s.prev_hcount[h]);
+      line += ",";
+      AppendU64(&line, "sum", sum - s.prev_hsum[h]);
+      line += ",\"b\":[";
+      bool bfirst = true;
+      for (int b = 0; b < metrics::kNumBuckets; b++) {
+        if (buckets[b] == s.prev_hbuckets[h][b]) continue;
+        if (!bfirst) line += ",";
+        bfirst = false;
+        std::snprintf(buf, sizeof buf, "[%d,%llu]", b,
+                      (unsigned long long)(buckets[b] -
+                                           s.prev_hbuckets[h][b]));
+        line += buf;
+      }
+      line += "]}";
+      s.prev_hcount[h] = count;
+      s.prev_hsum[h] = sum;
+      std::memcpy(s.prev_hbuckets[h], buckets, sizeof buckets);
+    }
+    line += "},";
+    AppendLinks(&line, t);
+    {
+      std::lock_guard<std::mutex> alk(s.ann_mu);
+      if (!s.annotation.empty()) {
+        line += ",\"app\":";
+        line += s.annotation;
+      }
+    }
+    line += "}";
+  }
+
+  std::memcpy(s.prev_counters, cur, sizeof cur);
+  s.seq++;
+  std::fwrite(line.data(), 1, line.size(), s.f);
+  std::fputc('\n', s.f);
+  std::fflush(s.f);  // per-line: the tail must be on disk when we die
+  s.live = line;
+  g_samples.fetch_add(1, std::memory_order_relaxed);
+}
+
+void Refresh() {
+  void (*fn)() = g_refresh.load(std::memory_order_acquire);
+  if (fn != nullptr) fn();
+}
+
+// Crash/exit flusher: one last best-effort sample. try_lock — if the
+// sampler itself crashed mid-write we must not deadlock the signal path.
+void FlushBestEffort() {
+  if (!Enabled()) return;
+  State& s = S();
+  std::unique_lock<std::mutex> lk(s.mu, std::try_to_lock);
+  if (!lk.owns_lock()) return;
+  Refresh();
+  SampleLocked(s, g_transport.load(std::memory_order_acquire));
+}
+
+}  // namespace
+
+bool Enabled() {
+  static const bool on = [] {
+    const bool v = cfg().on;
+    if (v) trace::RegisterCrashFlusher(FlushBestEffort, /*on_exit=*/true);
+    return v;
+  }();
+  return on;
+}
+
+uint64_t IntervalNs() { return cfg().interval_ns; }
+
+void SetRank(int rank) {
+  g_rank.store(rank, std::memory_order_relaxed);
+  (void)Enabled();  // arm the crash flusher as soon as the rank is known
+}
+
+void SetRefreshHook(void (*fn)()) {
+  g_refresh.store(fn, std::memory_order_release);
+}
+
+void MaybeSample(Transport* t) {
+  const uint64_t now = NowNs();
+  const uint64_t due = g_next_due.load(std::memory_order_relaxed);
+  if (now < due) return;
+  // Single writer (the proxy sweep) in steady state; a plain store is
+  // fine, racing SampleNow callers just take an extra sample.
+  g_next_due.store(now + IntervalNs(), std::memory_order_relaxed);
+  SampleNow(t);
+}
+
+void SampleNow(Transport* t) {
+  if (!Enabled()) return;
+  if (t != nullptr) g_transport.store(t, std::memory_order_release);
+  Refresh();
+  State& s = S();
+  std::lock_guard<std::mutex> lk(s.mu);
+  SampleLocked(s, t != nullptr
+                      ? t
+                      : g_transport.load(std::memory_order_acquire));
+}
+
+void DetachTransport() {
+  g_transport.store(nullptr, std::memory_order_release);
+}
+
+void Annotate(const char* json) {
+  if (!Enabled() || json == nullptr) return;
+  const size_t n = std::strlen(json);
+  if (n == 0 || n > 8192 || json[0] != '{') return;
+  State& s = S();
+  std::lock_guard<std::mutex> lk(s.ann_mu);
+  s.annotation.assign(json, n);
+}
+
+int LiveJson(char* buf, int cap) {
+  State& s = S();
+  std::lock_guard<std::mutex> lk(s.mu);
+  const std::string& l = s.live;
+  if (buf != nullptr && cap > 0) {
+    const size_t n =
+        l.size() < static_cast<size_t>(cap) - 1 ? l.size() : cap - 1;
+    std::memcpy(buf, l.data(), n);
+    buf[n] = '\0';
+  }
+  return static_cast<int>(l.size());
+}
+
+uint64_t SamplesWritten() {
+  return g_samples.load(std::memory_order_relaxed);
+}
+
+}  // namespace tseries
+}  // namespace acx

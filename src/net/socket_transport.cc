@@ -435,6 +435,31 @@ class StreamTransport : public Transport {
     return true;
   }
 
+  bool link_scope(int r, LinkScope* out) override {
+    if (r < 0 || r >= size_ || r == rank_) return false;
+    // Same best-effort contract as link_clock: the tseries sampler and the
+    // crash flusher must never block on mu_.
+    std::unique_lock<std::mutex> lk(mu_, std::try_to_lock);
+    for (int i = 0; i < 4 && !lk.owns_lock(); i++) {
+      sched_yield();
+      (void)lk.try_lock();
+    }
+    if (!lk.owns_lock()) return false;
+    const Peer& p = peers_[r];
+    out->state = peer_dead_[r] ? 2 : (p.health != 0 ? 1 : 0);
+    out->epoch = p.epoch;
+    out->tx_payload_bytes = p.sc_tx_payload;
+    out->tx_wire_bytes = p.sc_tx_wire;
+    out->rx_payload_bytes = p.sc_rx_payload;
+    out->rx_wire_bytes = p.sc_rx_wire;
+    out->tx_frames = p.sc_tx_frames;
+    out->rx_frames = p.sc_rx_frames;
+    out->naks = p.sc_naks;
+    out->crc_rejects = p.sc_crc_rejects;
+    out->replayed = p.sc_replayed;
+    return true;
+  }
+
   // Voluntary departure (MPIX_Fleet_leave, DESIGN.md §12). The caller has
   // already drained; here we record LEFT locally, tell every healthy peer
   // with an explicit VIEW frame — so their verdict is graceful-leave, not
@@ -549,6 +574,20 @@ class StreamTransport : public Transport {
     uint64_t rec_next_ns = 0;      // dialer: next connect attempt
     uint64_t rec_deadline_ns = 0;  // acceptor: give up waiting for a dial
     uint64_t stall_until_ns = 0;   // stall_link_ms fault gate
+
+    // -- wire scope (DESIGN.md §13) -- cumulative per-link accounting,
+    // written under mu_, exported via link_scope(). Peer objects persist
+    // across reconnects (only tx_seq resets on adoption), so these stay
+    // cumulative for the life of the process.
+    uint64_t sc_tx_payload = 0;  // app bytes queued in eager data frames
+    uint64_t sc_tx_wire = 0;     // every byte write(2) accepted for this link
+    uint64_t sc_rx_payload = 0;  // app bytes delivered from data frames
+    uint64_t sc_rx_wire = 0;     // every byte read(2) returned from this link
+    uint64_t sc_tx_frames = 0;   // frames fully written (incl. control)
+    uint64_t sc_rx_frames = 0;   // data frames fully delivered
+    uint64_t sc_naks = 0;        // re-pulls sent on this link
+    uint64_t sc_crc_rejects = 0; // frames from this peer dropped on CRC
+    uint64_t sc_replayed = 0;    // frames re-sent to this peer
   };
 
   Ticket* IsendLocked(const void* buf, size_t bytes, int dst, int tag,
@@ -859,6 +898,7 @@ class StreamTransport : public Transport {
     s->dst = p;
     peer.outq.push_back(std::move(s));
     naks_sent_.fetch_add(1, std::memory_order_relaxed);
+    peer.sc_naks++;  // wire scope
     FlushOutLocked(p);
   }
 
@@ -891,8 +931,10 @@ class StreamTransport : public Transport {
       ++ins;
       count++;
     }
-    if (count != 0)
+    if (count != 0) {
       frames_replayed_.fetch_add(count, std::memory_order_relaxed);
+      peer.sc_replayed += count;  // wire scope
+    }
     FlushOutLocked(p);
   }
 
@@ -943,6 +985,7 @@ class StreamTransport : public Transport {
             reinterpret_cast<const char*>(&s->hdr) + s->off, hdr_len - s->off);
         if (n == 0) return;  // wire full
         s->off += n;
+        peer.sc_tx_wire += n;  // wire scope: headers are overhead bytes
       }
       const size_t total = hdr_len + s->wire_bytes;
       while (s->off < total) {
@@ -950,7 +993,14 @@ class StreamTransport : public Transport {
                                         total - s->off);
         if (n == 0) return;
         s->off += n;
+        peer.sc_tx_wire += n;
       }
+      // Wire scope: frame fully written. Goodput (payload) is only the app
+      // bytes inside eager data frames; raw replays count as wire bytes +
+      // replayed frames (in HandleNak/AdoptLink), never as fresh payload.
+      peer.sc_tx_frames++;
+      if (!s->raw && s->hdr.magic == kMagic)
+        peer.sc_tx_payload += s->hdr.bytes;
       if (s->raw) {
         ClearQueuedLocked(p, s->hdr.seq);
       } else if (recovery_armed_ && wire::Sequenced(s->hdr.magic)) {
@@ -1023,7 +1073,7 @@ class StreamTransport : public Transport {
             links_[p]->ReadSome(reinterpret_cast<char*>(&in.hdr) + in.hdr_got,
                                 sizeof(WireHeader) - in.hdr_got);
         if (n == 0) return;
-        NoteRx(p);
+        NoteRx(p, n);
         in.hdr_got += n;
         if (in.hdr_got < sizeof(WireHeader)) return;
         // Header integrity gate: magic and header-CRC must both hold
@@ -1128,7 +1178,7 @@ class StreamTransport : public Transport {
           if (want > sizeof scratch) want = sizeof scratch;
           size_t n = links_[p]->ReadSome(scratch, want);
           if (n == 0) return;
-          NoteRx(p);
+          NoteRx(p, n);
           in.payload_got += n;
         }
         if (in.nak_after) MaybeNakLocked(p);
@@ -1143,7 +1193,7 @@ class StreamTransport : public Transport {
           char* dst = static_cast<char*>(r->buf) + in.payload_got;
           size_t n = links_[p]->ReadSome(dst, deliver - in.payload_got);
           if (n == 0) return;
-          NoteRx(p);
+          NoteRx(p, n);
           if (in.hdr.crc != 0) in.run_crc = wire::Crc32c(in.run_crc, dst, n);
           in.payload_got += n;
         }
@@ -1155,13 +1205,14 @@ class StreamTransport : public Transport {
           if (want > sizeof scratch) want = sizeof scratch;
           size_t n = links_[p]->ReadSome(scratch, want);
           if (n == 0) return;
-          NoteRx(p);
+          NoteRx(p, n);
           if (in.hdr.crc != 0)
             in.run_crc = wire::Crc32c(in.run_crc, scratch, n);
           in.payload_got += n;
         }
         if (in.hdr.crc != 0 && in.run_crc != in.hdr.crc) {
           crc_rejects_.fetch_add(1, std::memory_order_relaxed);
+          peer.sc_crc_rejects++;  // wire scope
           if (!recovery_armed_) {
             std::fprintf(stderr, "tpu-acx[%d]: payload CRC mismatch from %d\n",
                          rank_, p);
@@ -1177,6 +1228,10 @@ class StreamTransport : public Transport {
           continue;
         }
         if (recovery_armed_) BumpRxLocked(p, in.hdr.seq);
+        // Wire scope: goodput is what the app receives (delivered bytes,
+        // truncation excluded), not what crossed the wire.
+        peer.sc_rx_payload += deliver;
+        peer.sc_rx_frames++;
         r->st = Status{
             p, r->report_tag != INT_MIN ? r->report_tag : in.hdr.tag,
             in.hdr.bytes > r->bytes ? kErrTruncate : 0, deliver};
@@ -1189,13 +1244,14 @@ class StreamTransport : public Transport {
         size_t n = links_[p]->ReadSome(in.payload.data() + in.payload_got,
                                        in.payload.size() - in.payload_got);
         if (n == 0) return;
-        NoteRx(p);
+        NoteRx(p, n);
         in.payload_got += n;
       }
       if (in.hdr.crc != 0 &&
           wire::Crc32c(0, in.payload.data(), in.payload.size()) !=
               in.hdr.crc) {
         crc_rejects_.fetch_add(1, std::memory_order_relaxed);
+        peer.sc_crc_rejects++;  // wire scope
         if (!recovery_armed_) {
           std::fprintf(stderr, "tpu-acx[%d]: payload CRC mismatch from %d\n",
                        rank_, p);
@@ -1228,6 +1284,8 @@ class StreamTransport : public Transport {
         m.tag = in.hdr.tag;
         m.ctx = in.hdr.ctx;
         m.payload = std::move(in.payload);
+        peer.sc_rx_payload += m.payload.size();  // wire scope
+        peer.sc_rx_frames++;
         in.payload.clear();
         in.hdr_got = 0;
         DeliverLocked(p, std::move(m));
@@ -1267,8 +1325,11 @@ class StreamTransport : public Transport {
   // Liveness clock: ANY inbound bytes from p count (a multi-second bulk
   // transfer holds heartbeat frames behind it in the FIFO outq, so payload
   // bytes must refresh the clock or large messages would false-positive).
-  void NoteRx(int p) {
+  // Doubles as the rx side of the wire scope: every byte read off the link
+  // passes through here (caller holds mu_).
+  void NoteRx(int p, size_t n) {
     if (hb_interval_ns_ != 0) last_rx_ns_[p] = NowNs();
+    peers_[p].sc_rx_wire += n;
   }
 
   void HeartbeatLocked() {
@@ -1780,8 +1841,10 @@ class StreamTransport : public Transport {
       ++ins;
       count++;
     }
-    if (count != 0)
+    if (count != 0) {
       frames_replayed_.fetch_add(count, std::memory_order_relaxed);
+      peer.sc_replayed += count;  // wire scope
+    }
     // Inbound assembly state is a torn frame from the dead link: rewind.
     // A half-filled direct recv re-arms at the head of the posted queue;
     // the replayed copy will match it again and overwrite from byte 0.

@@ -16,6 +16,7 @@
 #include "acx/flightrec.h"
 #include "acx/net.h"
 #include "acx/trace.h"
+#include "acx/tseries.h"
 #include "compat/mpi.h"
 
 namespace acx {
@@ -80,6 +81,10 @@ int MPI_Finalize(void) {
     // The transport is deleted only if MPIX_Finalize already ran (it owns
     // nothing else at this point); otherwise leave it for process exit.
     if (!g.mpix_inited) {
+      // The tseries atexit flusher holds a cached pointer for its tail
+      // sample — detach it before the delete or it samples a dangling
+      // transport.
+      acx::tseries::DetachTransport();
       delete g.transport;
       g.transport = nullptr;
     }

@@ -1,0 +1,315 @@
+"""Live telemetry plane (include/acx/tseries.h, src/core/tseries.cc,
+tools/acx_top.py, docs/DESIGN.md §13): periodic delta-encoded sampling of
+the metrics registry, per-link wire scope, crash-flushed tails, the
+acx_top fleet console, and the skew-corrected tseries merge.
+
+Everything drives real 2-rank runs through acxrun and reads back the
+JSONL artifacts the way an operator's tools would.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOP = os.path.join(REPO, "tools", "acx_top.py")
+MERGE = os.path.join(REPO, "tools", "acx_trace_merge.py")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _built():
+    r = subprocess.run(["make", "-C", REPO, "itest", "tools"],
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def _acxrun(env_extra, *argv, np_ranks=2, timeout=300):
+    env = dict(os.environ)
+    env.update(env_extra)
+    return subprocess.run(
+        [os.path.join(REPO, "build", "acxrun"), "-np", str(np_ranks),
+         "-timeout", "120", *argv],
+        env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def _run_bench(env_extra):
+    r = _acxrun(env_extra, os.path.join(REPO, "build", "bench_pingpong"),
+                "8")
+    assert r.returncode == 0, r.stdout + r.stderr
+    return r
+
+
+def _load_samples(path):
+    samples = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                samples.append(json.loads(line))
+    return samples
+
+
+# -- sampling artifacts -----------------------------------------------------
+
+
+def test_tseries_jsonl_written_per_rank(tmp_path):
+    """ACX_TSERIES=<prefix> produces one delta-encoded JSONL per rank:
+    an init line carrying the full absolute registry, then delta lines,
+    every sample stamped with both clocks and the fleet epoch, and the
+    per-link wire scope obeying wire >= payload in both directions."""
+    _run_bench({"ACX_TSERIES": str(tmp_path / "run"),
+                "ACX_TSERIES_INTERVAL_MS": "50"})
+    for rank in (0, 1):
+        path = tmp_path / f"run.rank{rank}.tseries.jsonl"
+        assert path.exists(), f"rank {rank} wrote no tseries file"
+        samples = _load_samples(path)
+        assert len(samples) >= 2, f"rank {rank}: {len(samples)} samples"
+
+        init = samples[0]
+        assert init.get("init") is True
+        assert init["rank"] == rank
+        assert init["interval_ms"] == 50
+        assert len(init["counters"]) >= 8  # full absolute registry
+
+        prev_mono = -1
+        saw_links = False
+        for s in samples:
+            assert s["t_mono_ns"] > prev_mono  # strictly monotone
+            prev_mono = s["t_mono_ns"]
+            assert s["t_wall_ms"] > 0
+            assert "epoch" in s
+            for ln in s.get("links", []):
+                saw_links = True
+                assert ln["tx_wb"] >= ln["tx_pb"], ln
+                assert ln["rx_wb"] >= ln["rx_pb"], ln
+                assert ln["peer"] != rank
+        assert saw_links, f"rank {rank}: no sample carried a links section"
+        # The ping-pong moved real bytes: the newest links section shows
+        # payload flowing both ways, and header overhead makes wire
+        # STRICTLY larger.
+        last = next(s["links"] for s in reversed(samples) if s.get("links"))
+        tot_pb = sum(l["tx_pb"] + l["rx_pb"] for l in last)
+        tot_wb = sum(l["tx_wb"] + l["rx_wb"] for l in last)
+        assert tot_pb > 0 and tot_wb > tot_pb
+
+
+def test_tseries_disabled_by_default(tmp_path):
+    """Without ACX_TSERIES no artifact appears."""
+    env = {k: v for k, v in os.environ.items() if k != "ACX_TSERIES"}
+    r = subprocess.run(
+        [os.path.join(REPO, "build", "acxrun"), "-np", "2", "-timeout",
+         "120", os.path.join(REPO, "build", "itests", "ring")],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert not list(tmp_path.glob("*.tseries.jsonl"))
+
+
+@pytest.mark.parametrize("bad", ["0", "garbage", "-5"])
+def test_interval_env_parsing_rejects(tmp_path, bad):
+    """ACX_TSERIES_INTERVAL_MS that is zero or unparseable disables
+    sampling entirely (no files) and says so once on stderr, rather than
+    spinning the proxy at interval 0 or silently guessing."""
+    r = _acxrun({"ACX_TSERIES": str(tmp_path / "run"),
+                 "ACX_TSERIES_INTERVAL_MS": bad},
+                os.path.join(REPO, "build", "itests", "ring"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert not list(tmp_path.glob("*.tseries.jsonl"))
+    assert "ACX_TSERIES_INTERVAL_MS" in r.stderr
+    assert "sampling disabled" in r.stderr
+
+
+# -- acx_top ----------------------------------------------------------------
+
+
+def _top(*argv):
+    return subprocess.run([sys.executable, TOP, *argv],
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_acx_top_once_json_check(tmp_path):
+    """acx_top --once --json --check over a real run: per-rank rows carry
+    rates and link health, and the CI assertions (>= 2 samples, monotone
+    clocks, byte accounting) pass."""
+    _run_bench({"ACX_TSERIES": str(tmp_path / "run"),
+                "ACX_TSERIES_INTERVAL_MS": "50"})
+    r = _top("--once", "--json", "--check", str(tmp_path / "run"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = json.loads(r.stdout)
+    assert out["check"]["ok"], out["check"]["violations"]
+    assert [row["rank"] for row in out["ranks"]] == [0, 1]
+    for row in out["ranks"]:
+        assert row["samples"] >= 2
+        assert row["torn_lines"] == 0
+        assert row["goodput_mbps"] >= 0.0
+        assert row["wire_mbps"] >= row["goodput_mbps"]
+        assert row["link_health"] == "ok"
+        assert 0.0 <= row["proxy_util_pct"] <= 100.0
+
+
+def test_acx_top_tolerates_torn_last_line(tmp_path):
+    """A rank killed mid-write leaves a torn final line; the reader skips
+    it (counted in torn_lines) and the series still checks out."""
+    _run_bench({"ACX_TSERIES": str(tmp_path / "run"),
+                "ACX_TSERIES_INTERVAL_MS": "50"})
+    path = tmp_path / "run.rank0.tseries.jsonl"
+    with open(path, "a") as f:
+        f.write('{"seq":99999,"t_mono_ns":12345,"d":{"ops_comp')  # torn
+    r = _top("--once", "--json", "--check", str(tmp_path / "run"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = json.loads(r.stdout)
+    row0 = next(x for x in out["ranks"] if x["rank"] == 0)
+    assert row0["torn_lines"] == 1
+    assert out["check"]["ok"]
+
+
+def test_acx_top_check_fails_on_empty_series(tmp_path):
+    """--check is a real gate: a one-line series fails it."""
+    path = tmp_path / "x.rank0.tseries.jsonl"
+    path.write_text('{"init":true,"rank":0,"t_mono_ns":1,"t_wall_ms":1,'
+                    '"epoch":0,"counters":{}}\n')
+    r = _top("--once", "--json", "--check", str(tmp_path / "x"))
+    assert r.returncode == 1
+    assert "need >= 2" in r.stderr
+
+
+# -- crash flush ------------------------------------------------------------
+
+
+_CRASH_PROG = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, %r)
+    from mpi_acx_tpu import runtime
+    import numpy as np
+    rt = runtime.Runtime()
+    src = np.arange(4, dtype=np.int32)
+    dst = np.zeros(4, dtype=np.int32)
+    s = rt.isend_enqueue(src, dest=0)
+    r = rt.irecv_enqueue(dst, source=0)
+    rt.wait(r); rt.wait(s)
+    os.abort()   # no finalize: only the fatal-signal hook can flush
+""") % REPO
+
+
+def test_crash_flush_writes_final_sample(tmp_path):
+    """A rank that dies on SIGABRT still leaves its series: the
+    crash-flusher registered with the trace plane writes one last sample
+    on the way down, so the tail of the run is never lost."""
+    env = dict(os.environ)
+    env["ACX_TSERIES"] = str(tmp_path / "t")
+    env["ACX_TSERIES_INTERVAL_MS"] = "50"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", _CRASH_PROG], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == -signal.SIGABRT, (r.returncode, r.stderr)
+    f = tmp_path / "t.rank0.tseries.jsonl"
+    assert f.exists(), "crash flush did not write the series"
+    samples = _load_samples(f)
+    assert samples, "series is empty"
+    assert samples[0].get("init") is True
+    # Reconstruct the cumulative count: the init line may predate the
+    # isend (the very first proxy sweep samples immediately), in which
+    # case a later delta carries it — possibly only the crash-flushed
+    # tail sample itself.
+    isend = samples[0]["counters"].get("ops_isend", 0)
+    isend += sum(s.get("d", {}).get("ops_isend", 0) for s in samples[1:])
+    assert isend >= 1
+
+
+# -- live metrics through the Python runtime --------------------------------
+
+
+def test_python_runtime_live_metrics():
+    """Runtime.live_metrics() forces a sample mid-run through the
+    acx_tseries_* C API and returns the newest one, including the "app"
+    fragment published via tseries_annotate."""
+    prog = textwrap.dedent("""
+        import json, sys
+        import numpy as np
+        from mpi_acx_tpu import runtime
+        rt = runtime.Runtime()
+        assert rt.tseries_enabled()
+        src = np.arange(16, dtype=np.float32)
+        dst = np.zeros(16, dtype=np.float32)
+        s = rt.isend_enqueue(src, dest=0, tag=7)
+        r = rt.irecv_enqueue(dst, source=0, tag=7)
+        rt.wait(r); rt.wait(s)
+        rt.tseries_annotate({"queue_depth": 3, "ttft_p99_s": 0.25})
+        m = rt.live_metrics()
+        assert m, "no live sample"
+        assert m["epoch"] >= 0
+        assert m["t_mono_ns"] > 0
+        assert m["app"]["queue_depth"] == 3
+        rt.finalize()
+        print("LIVE_OK")
+    """)
+    env = dict(os.environ)
+    env["ACX_TSERIES"] = "/tmp/acx_live_metrics_test"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", prog], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "LIVE_OK" in r.stdout
+
+
+# -- merge tool -------------------------------------------------------------
+
+
+def test_merge_tool_tseries_alignment(tmp_path):
+    """Sibling traces give the tseries merge its barrier-anchored skew:
+    the merged stream is rank-tagged, time-sorted on corrected_us, and
+    reported aligned."""
+    _run_bench({"ACX_TSERIES": str(tmp_path / "run"),
+                "ACX_TSERIES_INTERVAL_MS": "50",
+                "ACX_TRACE": str(tmp_path / "run")})
+    fleet = tmp_path / "fleet.tseries.json"
+    r = subprocess.run(
+        [sys.executable, MERGE, "--validate", "--tseries-out", str(fleet)]
+        + [str(tmp_path / f"run.rank{k}.trace.json") for k in (0, 1)]
+        + [str(tmp_path / f"run.rank{k}.tseries.jsonl") for k in (0, 1)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    summary = json.loads(r.stdout)
+    assert summary["valid"] and summary["tseries"] == 2
+    assert summary["tseries_aligned"] is True
+
+    d = json.loads(fleet.read_text())
+    assert d["ranks"] == [0, 1]
+    assert d["aligned"] is True
+    assert {s["rank"] for s in d["samples"]} == {0, 1}
+    cs = [s["corrected_us"] for s in d["samples"]]
+    assert all(c is not None for c in cs)
+    assert cs == sorted(cs)
+
+
+def test_merge_tool_tseries_unaligned_without_traces(tmp_path):
+    """Without traces there is no skew anchor: samples merge with
+    corrected_us null and the stream is reported unaligned — never a
+    silently wrong alignment."""
+    _run_bench({"ACX_TSERIES": str(tmp_path / "run"),
+                "ACX_TSERIES_INTERVAL_MS": "50"})
+    fleet = tmp_path / "fleet.tseries.json"
+    r = subprocess.run(
+        [sys.executable, MERGE, "--tseries-out", str(fleet)]
+        + [str(tmp_path / f"run.rank{k}.tseries.jsonl") for k in (0, 1)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    d = json.loads(fleet.read_text())
+    assert d["aligned"] is False
+    assert all(s["corrected_us"] is None for s in d["samples"])
+
+
+# -- make target ------------------------------------------------------------
+
+
+def test_makefile_tseries_check_target():
+    """`make tseries-check` (wired into `make check`) goes green."""
+    r = subprocess.run(["make", "-C", REPO, "tseries-check"],
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "TSERIES CHECK PASSED" in r.stdout

@@ -1,0 +1,300 @@
+#!/usr/bin/env python3
+"""acx_top — live fleet console for the tpu-acx telemetry plane.
+
+Tails the per-rank time-series files a run writes under
+``ACX_TSERIES=<prefix>`` (``<prefix>.rank<r>.tseries.jsonl``, one
+delta-encoded JSON sample per line — docs/DESIGN.md §13) and renders a
+fleet table: per rank, the fleet epoch, op and byte rates over the most
+recent sample interval, goodput vs on-wire MB/s from the per-link wire
+scope, proxy utilization, live serving SLOs (rolling p99 TTFT, queue
+depth — published by the serving loop via acx_tseries_annotate), and
+link health.
+
+Modes:
+  acx_top.py <prefix>                 live console, refreshed every
+                                      --interval seconds (default 1.0)
+  acx_top.py --once <prefix>          render one table and exit
+  acx_top.py --once --json <prefix>   machine-readable snapshot for CI
+  acx_top.py --once --json --check <prefix>
+                                      additionally assert series sanity
+                                      (>= 2 samples/rank, monotone
+                                      clocks, wire >= payload per link)
+                                      and exit nonzero on violation
+
+The reader tolerates a torn final line (a rank mid-write or killed
+mid-sample): any line that fails to parse is skipped. Everything here is
+stdlib-only — the tool must run on a bare operator box.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+LINK_STATE = {0: "ok", 1: "rec", 2: "dead"}
+
+
+def load_series(path):
+    """Parse one .tseries.jsonl file into a reconstructed series.
+
+    Returns a dict with the rank, the raw samples, and per-sample
+    reconstructed cumulative counters (init line carries absolutes, later
+    lines carry deltas for counters and absolutes for gauges/links).
+    Undecodable lines — the torn tail of a crashed or mid-write rank —
+    are counted, not fatal.
+    """
+    samples = []
+    torn = 0
+    with open(path, "r", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                samples.append(json.loads(line))
+            except (json.JSONDecodeError, ValueError):
+                torn += 1
+    rank = None
+    interval_ms = None
+    running = {}
+    recon = []
+    for s in samples:
+        if s.get("init"):
+            rank = s.get("rank", rank)
+            interval_ms = s.get("interval_ms", interval_ms)
+            running = dict(s.get("counters", {}))
+        else:
+            for k, v in s.get("d", {}).items():
+                running[k] = running.get(k, 0) + v
+            for k, v in s.get("g", {}).items():
+                running[k] = v
+        recon.append(dict(running))
+    if rank is None:
+        m = re.search(r"\.rank(\d+)\.tseries\.jsonl$", path)
+        rank = int(m.group(1)) if m else -1
+    return {
+        "path": path,
+        "rank": rank,
+        "interval_ms": interval_ms,
+        "samples": samples,
+        "counters": recon,
+        "torn_lines": torn,
+    }
+
+
+def _latest(series, key, default=None):
+    for s in reversed(series["samples"]):
+        if key in s:
+            return s[key]
+    return default
+
+
+def _link_totals(sample):
+    """Sum cumulative link counters across peers for one sample."""
+    tot = {"tx_pb": 0, "tx_wb": 0, "rx_pb": 0, "rx_wb": 0}
+    for ln in sample.get("links", []):
+        for k in tot:
+            tot[k] += ln.get(k, 0)
+    return tot
+
+
+def summarize(series):
+    """Per-rank summary row: rates over the last sample interval, live
+    SLOs from the newest "app" fragment, link health from the newest
+    links section."""
+    samples = series["samples"]
+    counters = series["counters"]
+    row = {
+        "rank": series["rank"],
+        "samples": len(samples),
+        "torn_lines": series["torn_lines"],
+        "fleet_epoch": _latest(series, "epoch", 0),
+        "ops_per_s": 0.0,
+        "goodput_mbps": 0.0,
+        "wire_mbps": 0.0,
+        "proxy_util_pct": _latest(series, "proxy_util_pct", 0.0),
+        "queue_depth": None,
+        "ttft_p99_s": None,
+        "itl_p99_s": None,
+        "link_health": "-",
+    }
+    if len(samples) >= 2:
+        a, b = samples[-2], samples[-1]
+        dt = (b.get("t_mono_ns", 0) - a.get("t_mono_ns", 0)) / 1e9
+        if dt > 0:
+            ca, cb = counters[-2], counters[-1]
+            d_ops = cb.get("ops_completed", 0) - ca.get("ops_completed", 0)
+            row["ops_per_s"] = d_ops / dt
+        # Link sections are cumulative absolutes: rates come from
+        # differencing the two newest samples that CARRY a links section
+        # (the post-finalize tail sample has none — the transport is
+        # detached by then — and must not zero the rate).
+        with_links = [s for s in samples if s.get("links")]
+        if len(with_links) >= 2:
+            a, b = with_links[-2], with_links[-1]
+            ldt = (b.get("t_mono_ns", 0) - a.get("t_mono_ns", 0)) / 1e9
+            if ldt > 0:
+                la, lb = _link_totals(a), _link_totals(b)
+                good = (lb["tx_pb"] - la["tx_pb"]) + (lb["rx_pb"] - la["rx_pb"])
+                wire = (lb["tx_wb"] - la["tx_wb"]) + (lb["rx_wb"] - la["rx_wb"])
+                row["goodput_mbps"] = good / ldt / 1e6
+                row["wire_mbps"] = wire / ldt / 1e6
+    app = _latest(series, "app")
+    if isinstance(app, dict):
+        row["queue_depth"] = app.get("queue_depth")
+        row["ttft_p99_s"] = app.get("ttft_p99_s")
+        row["itl_p99_s"] = app.get("itl_p99_s")
+    # Newest non-empty links section (the tail sample's is empty).
+    links = next((s["links"] for s in reversed(samples) if s.get("links")),
+                 None)
+    if links:
+        worst = max(ln.get("state", 0) for ln in links)
+        row["link_health"] = LINK_STATE.get(worst, "?")
+    elif _latest(series, "links") == []:
+        row["link_health"] = "none"
+    return row
+
+
+def check_series(series):
+    """CI assertions over one rank's series. Returns a list of violation
+    strings (empty = healthy)."""
+    errs = []
+    samples = series["samples"]
+    r = series["rank"]
+    if len(samples) < 2:
+        errs.append(f"rank {r}: only {len(samples)} sample(s), need >= 2")
+        return errs
+    prev = -1
+    for i, s in enumerate(samples):
+        t = s.get("t_mono_ns")
+        if t is None:
+            errs.append(f"rank {r}: sample {i} missing t_mono_ns")
+            continue
+        if t <= prev:
+            errs.append(
+                f"rank {r}: t_mono_ns not monotone at sample {i} "
+                f"({t} <= {prev})")
+        prev = t
+    # Per-link byte accounting: wire >= payload in every direction, and
+    # cumulative counters never go backwards for a (peer, epoch) pair
+    # (an epoch bump means a reconnect, counters still persist).
+    last = {}
+    for i, s in enumerate(samples):
+        for ln in s.get("links", []):
+            peer = ln.get("peer")
+            if ln.get("tx_wb", 0) < ln.get("tx_pb", 0):
+                errs.append(
+                    f"rank {r}: sample {i} peer {peer}: tx wire bytes "
+                    f"{ln.get('tx_wb')} < payload {ln.get('tx_pb')}")
+            if ln.get("rx_wb", 0) < ln.get("rx_pb", 0):
+                errs.append(
+                    f"rank {r}: sample {i} peer {peer}: rx wire bytes "
+                    f"{ln.get('rx_wb')} < payload {ln.get('rx_pb')}")
+            for k in ("tx_pb", "tx_wb", "rx_pb", "rx_wb", "tx_fr",
+                      "rx_fr", "naks", "crc", "replayed"):
+                v = ln.get(k, 0)
+                if v < last.get((peer, k), 0):
+                    errs.append(
+                        f"rank {r}: sample {i} peer {peer}: {k} went "
+                        f"backwards ({v} < {last[(peer, k)]})")
+                last[(peer, k)] = v
+    return errs
+
+
+def collect(prefix):
+    paths = sorted(glob.glob(glob.escape(prefix) + ".rank*.tseries.jsonl"))
+    return [load_series(p) for p in paths]
+
+
+def _fmt(v, spec, dash="-"):
+    return dash if v is None else format(v, spec)
+
+
+def render_table(all_series):
+    rows = [summarize(s) for s in all_series]
+    rows.sort(key=lambda r: r["rank"])
+    hdr = (f"{'rank':>4} {'epoch':>5} {'smpls':>5} {'ops/s':>9} "
+           f"{'good MB/s':>9} {'wire MB/s':>9} {'proxy%':>6} "
+           f"{'qdepth':>6} {'p99 TTFT':>9} {'link':>5}")
+    lines = [hdr, "-" * len(hdr)]
+    for r in rows:
+        ttft = (_fmt(r["ttft_p99_s"], ".3f") + "s"
+                if r["ttft_p99_s"] is not None else "-")
+        lines.append(
+            f"{r['rank']:>4} {r['fleet_epoch']:>5} {r['samples']:>5} "
+            f"{r['ops_per_s']:>9.1f} {r['goodput_mbps']:>9.2f} "
+            f"{r['wire_mbps']:>9.2f} {r['proxy_util_pct']:>6.1f} "
+            f"{_fmt(r['queue_depth'], 'd'):>6} {ttft:>9} "
+            f"{r['link_health']:>5}")
+    if not rows:
+        lines.append("  (no .tseries.jsonl files yet)")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Live fleet console over ACX_TSERIES telemetry files.")
+    ap.add_argument("prefix",
+                    help="the ACX_TSERIES prefix the run was started with")
+    ap.add_argument("--once", action="store_true",
+                    help="render a single snapshot and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the snapshot as JSON (implies --once)")
+    ap.add_argument("--check", action="store_true",
+                    help="run CI series assertions; nonzero exit on failure")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="live-mode refresh period in seconds (default 1.0)")
+    args = ap.parse_args(argv)
+
+    if args.as_json or args.check:
+        args.once = True
+
+    if not args.once:
+        try:
+            while True:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+                print(f"acx_top — {args.prefix}  "
+                      f"({time.strftime('%H:%M:%S')})")
+                print(render_table(collect(args.prefix)))
+                sys.stdout.flush()
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+    all_series = collect(args.prefix)
+    violations = []
+    if args.check:
+        if not all_series:
+            violations.append(
+                f"no {args.prefix}.rank*.tseries.jsonl files found")
+        for s in all_series:
+            violations.extend(check_series(s))
+
+    if args.as_json:
+        out = {
+            "prefix": args.prefix,
+            "ranks": sorted((summarize(s) for s in all_series),
+                            key=lambda r: r["rank"]),
+        }
+        if args.check:
+            out["check"] = {"ok": not violations, "violations": violations}
+        json.dump(out, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        print(render_table(all_series))
+        for v in violations:
+            print(f"CHECK FAIL: {v}", file=sys.stderr)
+
+    if args.check and violations:
+        if args.as_json:
+            for v in violations:
+                print(f"CHECK FAIL: {v}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,14 @@ mid-way through rank 0's run. This tool:
     into one fleet file: counters sum (``slot_hwm`` maxes — a watermark
     across ranks is a max, not a sum), histogram counts/sums/buckets
     vector-add;
+  * merges sibling ``*.tseries.jsonl`` time-series files (src/core/tseries.cc,
+    docs/DESIGN.md §13) into one rank-tagged, time-sorted sample stream
+    (``--tseries-out``). Samples are stamped with the same
+    ns-since-trace-start monotonic clock the trace events use, so the
+    barrier-anchored skew computed for the traces applies verbatim:
+    ``corrected_us = t_mono_ns / 1000 + skew_us[rank]``. Without sibling
+    traces (or without common anchors) samples merge unaligned
+    (``corrected_us`` null);
   * validates (``--validate``): traces parse, timestamps are sorted, every
     span begin has a matching end (name+cat+id+pid, the Perfetto async-span
     contract) and span/instant counts match ``otherData``; metrics files
@@ -28,13 +36,15 @@ mid-way through rank 0's run. This tool:
 
 Usage:
     python3 tools/acx_trace_merge.py [--out merged.json]
-        [--metrics-out fleet.json] [--validate]
+        [--metrics-out fleet.json] [--tseries-out fleet.tseries.json]
+        [--validate]
         run.rank0.trace.json run.rank1.trace.json
         run.rank0.metrics.json run.rank1.metrics.json
+        run.rank0.tseries.jsonl run.rank1.tseries.jsonl
 
-Inputs are classified by filename (``.trace.json`` / ``.metrics.json``);
-the rank is parsed from the ``.rank<r>.`` filename component (falling back
-to input order). Prints one JSON summary line; exits non-zero if any
+Inputs are classified by filename (``.trace.json`` / ``.metrics.json`` /
+``.tseries.jsonl``); the rank is parsed from the ``.rank<r>.`` filename
+component (falling back to input order). Prints one JSON summary line; exits non-zero if any
 ``--validate`` check fails.
 
 A missing or truncated input — what a rank that died before flushing
@@ -59,6 +69,26 @@ def parse_rank(path, fallback):
 def load(path):
     with open(path) as f:
         return json.load(f)
+
+
+def load_tseries(path):
+    """Line-by-line JSONL loader for *.tseries.jsonl.
+
+    A rank killed mid-write leaves a torn final line; that line is
+    skipped and counted, never fatal — same contract as tools/acx_top.py.
+    Returns (samples, torn_line_count).
+    """
+    samples, torn = [], 0
+    with open(path, errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                samples.append(json.loads(line))
+            except (json.JSONDecodeError, ValueError):
+                torn += 1
+    return samples, torn
 
 
 # ---- validation -----------------------------------------------------------
@@ -99,6 +129,26 @@ def validate_trace(path, d, errors):
     if other.get("spans", n_span) != n_span:
         errors.append(f"{path}: otherData.spans={other.get('spans')} "
                       f"but {n_span} span begins")
+
+
+def validate_tseries(path, samples, torn, errors):
+    if len(samples) < 2:
+        errors.append(f"{path}: wants >= 2 samples, got {len(samples)}")
+        return
+    if torn:
+        # Informational only via the summary; a torn tail is expected
+        # from a crashed rank and must not fail validation.
+        pass
+    prev = -1
+    for i, s in enumerate(samples):
+        t = s.get("t_mono_ns")
+        if t is None:
+            errors.append(f"{path}: sample {i} missing t_mono_ns")
+            continue
+        if t <= prev:
+            errors.append(f"{path}: t_mono_ns not monotone at sample {i} "
+                          f"({t} <= {prev})")
+        prev = t
 
 
 def validate_metrics(path, d, errors):
@@ -161,6 +211,40 @@ def merge_traces(traces):
             skew)
 
 
+# ---- time-series merge ----------------------------------------------------
+
+def merge_tseries(tseries, skew):
+    """tseries: list of (rank, samples, torn). skew: the per-rank trace
+    skew (µs) from merge_traces, or {} when no sibling traces were given.
+
+    Samples stamp t_mono_ns on the SAME ns-since-trace-start clock the
+    trace events use (src/core/tseries.cc uses trace::NowSinceStartNs),
+    so the barrier-anchored per-rank shift applies verbatim:
+    corrected_us = t_mono_ns/1000 + skew. Ranks without a skew (no common
+    barrier anchors, or no traces at all) merge unaligned with
+    corrected_us null — their samples sort on the raw per-rank clock.
+    """
+    merged = []
+    for r, samples, _torn in tseries:
+        sk = skew.get(r)
+        for s in samples:
+            e = dict(s)
+            e["rank"] = r
+            t = s.get("t_mono_ns")
+            e["corrected_us"] = (t / 1000.0 + sk
+                                 if t is not None and sk is not None else None)
+            merged.append(e)
+    merged.sort(key=lambda e: (
+        e["corrected_us"] if e["corrected_us"] is not None
+        else e.get("t_mono_ns", 0) / 1000.0,
+        e["rank"]))
+    return {"ranks": sorted(r for r, _, _ in tseries),
+            "skew_us": {str(r): skew.get(r) for r, _, _ in tseries},
+            "aligned": all(skew.get(r) is not None for r, _, _ in tseries),
+            "torn_lines": {str(r): t for r, _, t in tseries},
+            "samples": merged}
+
+
 # ---- metrics aggregation --------------------------------------------------
 
 # Watermarks: a per-rank max aggregates across ranks as a max.
@@ -195,15 +279,32 @@ def main():
         description="merge/aggregate/validate per-rank ACX observability "
                     "artifacts")
     ap.add_argument("inputs", nargs="+",
-                    help="*.trace.json and/or *.metrics.json files")
+                    help="*.trace.json, *.metrics.json and/or "
+                         "*.tseries.jsonl files")
     ap.add_argument("--out", help="write the merged Perfetto trace here")
     ap.add_argument("--metrics-out", help="write the fleet metrics here")
+    ap.add_argument("--tseries-out",
+                    help="write the merged, skew-corrected time-series here")
     ap.add_argument("--validate", action="store_true",
                     help="check artifact invariants; exit 1 on failure")
     args = ap.parse_args()
 
-    traces, metrics, errors, missing = [], [], [], []
+    traces, metrics, tseries, errors, missing = [], [], [], [], []
     for i, path in enumerate(args.inputs):
+        # Time-series files are JSONL — one JSON object per line — so the
+        # whole-file json.load below would choke on line two. Classify
+        # them by suffix BEFORE loading.
+        if path.endswith(".tseries.jsonl"):
+            try:
+                samples, torn = load_tseries(path)
+            except OSError as exc:
+                missing.append({"path": path, "rank": parse_rank(path, i),
+                                "reason": str(exc)})
+                continue
+            tseries.append((parse_rank(path, i), samples, torn))
+            if args.validate:
+                validate_tseries(path, samples, torn, errors)
+            continue
         try:
             d = load(path)
         except (OSError, json.JSONDecodeError) as exc:
@@ -223,19 +324,31 @@ def main():
             if args.validate:
                 validate_trace(path, d, errors)
 
-    summary = {"traces": len(traces), "metrics": len(metrics)}
+    summary = {"traces": len(traces), "metrics": len(metrics),
+               "tseries": len(tseries)}
     if missing:
         summary["missing"] = missing
-    if traces and args.out:
+    # The tseries merge reuses the traces' barrier-anchored skew, so run
+    # the trace merge whenever either output wants it.
+    skew = {}
+    if traces and (args.out or (tseries and args.tseries_out)):
         merged, skew = merge_traces(traces)
-        if missing:
-            merged["otherData"]["missing_ranks"] = sorted(
-                {m["rank"] for m in missing})
-        with open(args.out, "w") as f:
-            json.dump(merged, f)
-        summary["out"] = args.out
-        summary["events"] = len(merged["traceEvents"])
+        if args.out:
+            if missing:
+                merged["otherData"]["missing_ranks"] = sorted(
+                    {m["rank"] for m in missing})
+            with open(args.out, "w") as f:
+                json.dump(merged, f)
+            summary["out"] = args.out
+            summary["events"] = len(merged["traceEvents"])
         summary["skew_us"] = {str(r): skew[r] for r in skew}
+    if tseries and args.tseries_out:
+        fleet_ts = merge_tseries(tseries, skew)
+        with open(args.tseries_out, "w") as f:
+            json.dump(fleet_ts, f)
+        summary["tseries_out"] = args.tseries_out
+        summary["tseries_samples"] = len(fleet_ts["samples"])
+        summary["tseries_aligned"] = fleet_ts["aligned"]
     if metrics and args.metrics_out:
         fleet = merge_metrics(metrics)
         with open(args.metrics_out, "w") as f:
