@@ -127,6 +127,7 @@ check: ctest itest tools
 	@$(MAKE) --no-print-directory metrics-check || exit 1
 	@$(MAKE) --no-print-directory tseries-check || exit 1
 	@$(MAKE) --no-print-directory doctor-check || exit 1
+	@$(MAKE) --no-print-directory causality-check || exit 1
 	@$(MAKE) --no-print-directory decode-check || exit 1
 	@echo "ALL NATIVE TESTS PASSED"
 
@@ -257,6 +258,38 @@ doctor-check: ctest itest tools
 	  --expect-anomaly never_published_partition --expect-culprit 0 \
 	  $(BUILD)/doctor-check/hang.rank*.flight.json || exit 1
 	@echo "DOCTOR CHECK PASSED"
+
+# --- cross-rank causal tracing end-to-end (DESIGN.md §14) ---
+# causality-ping runs a strictly serialized 2-rank ping-pong on the
+# socket plane with tracing on; acx_critpath.py must span-pair >= 95% of
+# wire frames across the ranks (no heuristics), see non-negative one-way
+# transit after the barrier-anchored skew correction, and reconstruct a
+# non-empty critical path. The stall leg injects a 40 ms freeze on rank
+# 0's 5th frame and the analyzer must name the 0->1 link as the longest
+# edge of the step — the whole point of the plane.
+.PHONY: causality-check
+causality-check: itest tools
+	@rm -rf $(BUILD)/causality-check && mkdir -p $(BUILD)/causality-check
+	@echo "== causality-check: acxrun -np 2 causality-ping (socket, ACX_TRACE)"
+	@ACX_TRACE=$(BUILD)/causality-check/ping ACX_TRACE_CAP=2000000 \
+	  $(BUILD)/acxrun -np 2 -transport socket \
+	  $(BUILD)/itests/causality-ping || exit 1
+	@echo "== causality-check: merged trace validates"
+	@python3 tools/acx_trace_merge.py --validate \
+	  --out $(BUILD)/causality-check/merged.trace.json \
+	  $(BUILD)/causality-check/ping.rank*.trace.json > /dev/null || exit 1
+	@echo "== causality-check: span pairing + transit + critical path"
+	@python3 tools/acx_critpath.py --min-pair-rate 0.95 \
+	  --expect-nonneg-transit \
+	  $(BUILD)/causality-check/ping.rank*.trace.json || exit 1
+	@echo "== causality-check: injected stall names the 0->1 link"
+	@ACX_TRACE=$(BUILD)/causality-check/stall ACX_TRACE_CAP=2000000 \
+	  $(BUILD)/acxrun -np 2 -transport socket \
+	  -fault stall_link_ms:rank=0:nth=5:ms=40 \
+	  $(BUILD)/itests/causality-ping || exit 1
+	@python3 tools/acx_critpath.py --expect-edge "0->1" \
+	  $(BUILD)/causality-check/stall.rank*.trace.json || exit 1
+	@echo "CAUSALITY CHECK PASSED"
 
 # --- flash-decode kernel (ops/flash_decode.py, DESIGN.md §11) ---
 # Interpret-mode parity of the Pallas decode kernel vs the dense

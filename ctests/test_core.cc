@@ -83,7 +83,8 @@ struct FakeTransport : Transport {
   std::atomic<int> isends{0}, irecvs{0};
   int rank() const override { return 0; }
   int size() const override { return 1; }
-  Ticket* Isend(const void*, size_t bytes, int dst, int tag, int) override {
+  Ticket* Isend(const void*, size_t bytes, int dst, int tag, int,
+                uint64_t) override {
     isends.fetch_add(1);
     Status st;
     st.source = 0;
@@ -92,7 +93,8 @@ struct FakeTransport : Transport {
     (void)dst;
     return new FakeTicket(&sends_done, st);
   }
-  Ticket* Irecv(void*, size_t bytes, int src, int tag, int) override {
+  Ticket* Irecv(void*, size_t bytes, int src, int tag, int,
+                uint64_t) override {
     irecvs.fetch_add(1);
     Status st;
     st.source = src;

@@ -1,0 +1,90 @@
+// Regression test for trace::EnvRankOr (include/acx/trace.h): the strict
+// $ACX_RANK parse every crash-path artifact namer shares (trace flush,
+// flight dump, tseries file). Before this existed, a process that died
+// pre-SetRank with ACX_RANK="2junk" or unset would name its artifact
+// ".rank0." and silently collide with the real rank 0's dump — the
+// strict parse accepts ONLY a full non-negative decimal string and falls
+// back otherwise, loudly preserving the caller's default.
+// Also covers span::Make/Rank/Slot/Incarnation (include/acx/span.h): the
+// bit layout is wire protocol (WireHeader.span), so a packing change
+// must fail a test, not just reshuffle ids.
+// Plain asserts; exits nonzero on failure.
+#include <cstdio>
+#include <cstdlib>
+
+#include "acx/span.h"
+#include "acx/trace.h"
+
+using namespace acx;
+
+#define CHECK(cond)                                                        \
+  do {                                                                     \
+    if (!(cond)) {                                                         \
+      std::fprintf(stderr, "CHECK failed %s:%d: %s\n", __FILE__, __LINE__, \
+                   #cond);                                                 \
+      std::exit(1);                                                        \
+    }                                                                      \
+  } while (0)
+
+static void set_rank(const char* v) {
+  if (v == nullptr)
+    unsetenv("ACX_RANK");
+  else
+    setenv("ACX_RANK", v, 1);
+}
+
+int main() {
+  // Unset / empty: fallback, whatever it is.
+  set_rank(nullptr);
+  CHECK(trace::EnvRankOr(0) == 0);
+  CHECK(trace::EnvRankOr(7) == 7);
+  set_rank("");
+  CHECK(trace::EnvRankOr(3) == 3);
+
+  // Clean non-negative decimals parse, including multi-digit and zero.
+  set_rank("0");
+  CHECK(trace::EnvRankOr(9) == 0);
+  set_rank("2");
+  CHECK(trace::EnvRankOr(0) == 2);
+  set_rank("1024");
+  CHECK(trace::EnvRankOr(0) == 1024);
+
+  // Garbage, trailing junk, negatives, hex, whitespace: all fall back —
+  // a half-parsed rank is worse than the fallback (it picks a WRONG
+  // file name instead of the predictable one).
+  set_rank("garbage");
+  CHECK(trace::EnvRankOr(5) == 5);
+  set_rank("2junk");
+  CHECK(trace::EnvRankOr(5) == 5);
+  set_rank("-1");
+  CHECK(trace::EnvRankOr(5) == 5);
+  set_rank("0x10");
+  CHECK(trace::EnvRankOr(5) == 5);
+  set_rank(" 3");
+  CHECK(trace::EnvRankOr(5) == 5);
+  set_rank("3 ");
+  CHECK(trace::EnvRankOr(5) == 5);
+  set_rank("99999999999999999999");  // overflows int: fall back
+  CHECK(trace::EnvRankOr(5) == 5);
+  set_rank(nullptr);
+
+  // Span id packing: rank 16 bits << 48, slot 16 bits << 32, incarnation
+  // low 32 — and the decomposers invert Make exactly.
+  const uint64_t s = span::Make(3, 250, 0x12345678u);
+  CHECK(span::Rank(s) == 3);
+  CHECK(span::Slot(s) == 250);
+  CHECK(span::Incarnation(s) == 0x12345678u);
+  CHECK(s == ((3ull << 48) | (250ull << 32) | 0x12345678ull));
+  // Field masking at the edges: oversized inputs truncate, never bleed
+  // into the neighboring field.
+  const uint64_t t = span::Make(0x1ffff, 0x2ffff, 0xffffffffu);
+  CHECK(span::Rank(t) == 0xffff);
+  CHECK(span::Slot(t) == 0xffff);
+  CHECK(span::Incarnation(t) == 0xffffffffu);
+  // Span 0 is reserved for "unspanned"; any real (rank, slot, inc>0)
+  // combination is nonzero.
+  CHECK(span::Make(0, 0, 1) != 0);
+
+  std::printf("test_envrank: OK\n");
+  return 0;
+}

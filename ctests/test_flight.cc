@@ -34,8 +34,9 @@ static std::string slurp(const std::string& path) {
 }
 
 int main() {
-  // The event layout is part of the dump contract (32-byte packed record).
-  static_assert(sizeof(flight::Event) == 32, "flight event layout");
+  // The event layout is part of the dump contract (40-byte packed record
+  // since the causal span id landed, DESIGN.md §14).
+  static_assert(sizeof(flight::Event) == 40, "flight event layout");
 
   CHECK(flight::Enabled());  // default ring: ACX_FLIGHT_EVENTS unset
   const flight::Stats s0 = flight::stats();

@@ -90,4 +90,12 @@ void RefreshRuntimeMetrics();
 // Element size for a compat MPI_Datatype id (include/compat/mpi.h).
 size_t DatatypeSize(int datatype);
 
+// Causal tracing (DESIGN.md §14): process-global application span id. The
+// serving layer brackets each request's enqueue burst with
+// acx_span_app_begin/end; while set, every op minted inside the bracket
+// emits a "req_op" trace event tying the op's native span to the request,
+// so a request's TTFT splits into queue vs compute vs wire offline.
+void SetAppSpan(uint64_t id);
+uint64_t AppSpan();
+
 }  // namespace acx

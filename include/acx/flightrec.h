@@ -70,6 +70,8 @@ enum Kind : uint16_t {
   kTxSeqAck,        // cumulative seq-ack written (seq = acked rx seq)
   kTxNak,           // re-pull request written (seq = first missing seq)
   kRxData,          // in-order data frame delivered (seq = rx seq)
+  kRxFrame,         // span-tagged frame fully received (span = sender op's
+                    //   span id; recorded on every plane, recovery or not)
   kRxSeqAck,        // peer's cumulative ack arrived (seq = acked tx seq)
   kRxNak,           // peer requested replay (seq = first seq to resend)
   kLinkRecovering,  // peer entered the reconnect ladder
@@ -88,18 +90,20 @@ enum Kind : uint16_t {
 // Name for a kind (static string; "unknown" out of range).
 const char* KindName(uint16_t k);
 
-// One ring record. Exactly 32 bytes so the ring stays cache-friendly and
-// a torn concurrent write can't straddle more than two lines.
+// One ring record. Exactly 40 bytes (grew from 32 when the causal span id
+// landed, DESIGN.md §14) so the ring stays cache-friendly and a torn
+// concurrent write can't straddle more than a couple of lines.
 struct Event {
   uint64_t t_ns;  // steady-clock ns (acx::NowNs)
   uint64_t seq;   // wire sequence / attempt count / kind-specific ordinal
+  uint64_t span;  // causal span id (acx/span.h); 0 = untagged
   int32_t slot;   // flag-table slot, -1 for process scope
   int32_t peer;   // peer rank, -1 if n/a
   int32_t tag;    // op tag, -1 if n/a
   uint16_t kind;  // Kind
   int16_t aux;    // partition index / error code / epoch, kind-specific
 };
-static_assert(sizeof(Event) == 32, "flight Event must stay 32 bytes");
+static_assert(sizeof(Event) == 40, "flight Event must stay 40 bytes");
 
 // True iff the ring exists (ACX_FLIGHT_EVENTS != 0; checked once, first
 // true call sizes the ring and registers the crash-dump hook).
@@ -107,8 +111,9 @@ bool Enabled();
 
 // Record one event. Lock-free: relaxed head bump + plain stores. Safe from
 // any thread; a dump racing a write reads one torn record at worst.
+// `span` tags the event with the op's causal span id (0 = untagged).
 void Record(uint16_t kind, int32_t slot, int32_t peer, int32_t tag,
-            uint64_t seq, int16_t aux);
+            uint64_t seq, int16_t aux, uint64_t span = 0);
 
 // Tell the recorder this process's rank so dumps name their file correctly
 // (falls back to $ACX_RANK, then 0).
@@ -152,4 +157,16 @@ Stats stats();
           (uint16_t)(::acx::flight::kind), (int32_t)(slot),             \
           (int32_t)(peer), (int32_t)(tag), (uint64_t)(seq),             \
           (int16_t)(aux));                                              \
+  } while (0)
+
+// Span-tagged variant: same record plus the op's causal span id, so dumps
+// from different ranks pair exactly by id (tools/acx_doctor.py,
+// tools/acx_critpath.py).
+#define ACX_FLIGHT_SPAN(kind, slot, peer, tag, seq, aux, span)          \
+  do {                                                                  \
+    if (::acx::flight::Enabled())                                       \
+      ::acx::flight::Record(                                            \
+          (uint16_t)(::acx::flight::kind), (int32_t)(slot),             \
+          (int32_t)(peer), (int32_t)(tag), (uint64_t)(seq),             \
+          (int16_t)(aux), (uint64_t)(span));                            \
   } while (0)

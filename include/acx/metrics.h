@@ -81,6 +81,10 @@ enum Hist : int {
   kIssueToComplete,     // posted -> completion observed (wire + peer)
   kCompleteToWait,      // completed -> waiter consumed it (waiter pickup)
   kProxySweepNs,        // duration of one proxy-thread sweep
+  kWireQueueNs,         // data frame enqueued -> fully on the wire (§14)
+  kWireTransitNs,       // sender tx stamp -> local delivery, RAW clock
+                        // delta clamped at 0 (includes inter-host skew;
+                        // the skew-corrected figure is offline, §14)
   kNumHists
 };
 

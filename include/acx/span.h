@@ -1,0 +1,36 @@
+// tpu-acx: causal span identity (DESIGN.md §14).
+//
+// Every MPIX op gets a 64-bit span id at enqueue time; the id rides every
+// wire frame the op generates (src/net/wire.h WireHeader::span) and is
+// stamped into the trace ring and flight recorder at each lifecycle
+// transition on BOTH ranks, so offline tools (tools/acx_critpath.py,
+// tools/acx_doctor.py) can pair the two sides of a message exactly instead
+// of heuristically.
+//
+// Layout:  [63:48] origin rank   [47:32] op slot   [31:0] incarnation
+//
+// The incarnation is a process-global counter bumped once per enqueue, so a
+// reused slot never reuses a span. Span 0 is reserved for "unspanned":
+// control traffic (barrier tokens, heartbeats, acks) and transport-internal
+// frames carry no causal identity.
+#pragma once
+
+#include <cstdint>
+
+namespace acx {
+namespace span {
+
+inline uint64_t Make(int rank, int slot, uint32_t incarnation) {
+  return (static_cast<uint64_t>(rank) & 0xffffu) << 48 |
+         (static_cast<uint64_t>(slot) & 0xffffu) << 32 |
+         static_cast<uint64_t>(incarnation);
+}
+
+inline int Rank(uint64_t s) { return static_cast<int>((s >> 48) & 0xffffu); }
+inline int Slot(uint64_t s) { return static_cast<int>((s >> 32) & 0xffffu); }
+inline uint32_t Incarnation(uint64_t s) {
+  return static_cast<uint32_t>(s & 0xffffffffu);
+}
+
+}  // namespace span
+}  // namespace acx

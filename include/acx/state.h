@@ -109,6 +109,11 @@ struct Op {
   PartitionedChan* chan = nullptr;
   int partition = -1;
 
+  // Causal span id (acx/span.h), minted at enqueue; rides every wire frame
+  // this op generates and stamps every lifecycle trace/flight event. 0 for
+  // ops that predate span minting (partitioned internals, shim control).
+  uint64_t span = 0;
+
   // -- resilience bookkeeping (proxy-private; reset with the op) --
   uint64_t deadline_ns = 0;    // absolute op deadline, 0 = none
   uint64_t retry_at_ns = 0;    // earliest re-post time for a lost issue

@@ -35,9 +35,21 @@ bool Enabled();
 // slot (or -1 for process-scope events).
 void Emit(const char* name, int64_t slot);
 
+// Same, tagged with a causal span id (acx/span.h). Span-tagged instants are
+// written with "args":{"span":...} so cross-rank tools (acx_critpath.py)
+// can chain the two sides of a message; span 0 degrades to plain Emit.
+void Emit(const char* name, int64_t slot, uint64_t span);
+
 // Tell the trace layer this process's rank so the crash-path flush names
 // its file correctly (falls back to $ACX_RANK, then 0).
 void SetRank(int rank);
+
+// Strict $ACX_RANK parse for pre-SetRank crash paths (trace, flight, and
+// tseries file naming all use this so per-rank dumps never collide on
+// rank 0 when a process dies before MPIX_Init): accepts only a full
+// non-negative decimal string; anything else — unset, empty, garbage,
+// trailing junk, negative — returns `fallback`.
+int EnvRankOr(int fallback);
 
 // Write the ring (instants + synthesized spans) to
 // ACX_TRACE.rank<rank>.trace.json. Snapshot semantics: the ring is kept,
@@ -68,4 +80,11 @@ void RegisterCrashFlusher(void (*fn)(), bool on_exit);
   do {                                                    \
     if (::acx::trace::Enabled())                          \
       ::acx::trace::Emit((name), (int64_t)(slot));        \
+  } while (0)
+
+#define ACX_TRACE_SPAN(name, slot, span)                  \
+  do {                                                    \
+    if (::acx::trace::Enabled())                          \
+      ::acx::trace::Emit((name), (int64_t)(slot),         \
+                         (uint64_t)(span));               \
   } while (0)

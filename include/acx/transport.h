@@ -97,6 +97,17 @@ struct LinkScope {
   uint64_t naks = 0;              // re-pulls sent for this link
   uint64_t crc_rejects = 0;       // frames from this peer dropped on CRC
   uint64_t replayed = 0;          // frames re-sent to this peer
+
+  // -- causal timing (DESIGN.md §14) -- cumulative sums/counts so consumers
+  // can difference snapshots into window averages, same contract as the
+  // byte counters above. Transit is RAW receiver-minus-sender clock delta
+  // (includes inter-host skew; clamped at 0); the skew-corrected per-link
+  // number is computed offline by acx_trace_merge/acx_critpath from the
+  // barrier anchors.
+  uint64_t tx_queue_ns_sum = 0;   // enqueue -> fully-on-wire, data frames
+  uint64_t tx_queue_frames = 0;   //   frames contributing to the sum
+  uint64_t rx_transit_ns_sum = 0; // sender tx_ns -> local delivery, clamped
+  uint64_t rx_transit_frames = 0; //   stamped data frames delivered
 };
 
 class Transport {
@@ -108,9 +119,13 @@ class Transport {
 
   // Nonblocking point-to-point. ctx is the communicator context id; matching
   // is FIFO per (src, tag, ctx). Returned Ticket is owned by the caller.
+  // `span` is the op's causal span id (acx/span.h); transports with a framed
+  // wire carry it on every frame the op generates so the receiving rank can
+  // attribute the arrival to the same span. 0 = unspanned control traffic.
   virtual Ticket* Isend(const void* buf, size_t bytes, int dst, int tag,
-                        int ctx) = 0;
-  virtual Ticket* Irecv(void* buf, size_t bytes, int src, int tag, int ctx) = 0;
+                        int ctx, uint64_t span = 0) = 0;
+  virtual Ticket* Irecv(void* buf, size_t bytes, int src, int tag, int ctx,
+                        uint64_t span = 0) = 0;
 
   // Partitioned channels (persistent, restartable).
   virtual PartitionedChan* PsendInit(const void* buf, int partitions,

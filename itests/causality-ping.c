@@ -1,0 +1,110 @@
+/* tpu-acx integration test: sequenced ping-pong for the causal-tracing
+ * plane (docs/DESIGN.md §14).
+ *
+ * Rank 0 sends a patterned payload to rank 1, rank 1 verifies and sends
+ * it back, for ACX_PING_ROUNDS rounds — a strictly serialized causal
+ * chain, so the cross-rank critical path of the run IS the ping-pong
+ * itself. Every k rounds both ranks cross an MPI_Barrier: the shim's
+ * barrier_exit instants are the anchors tools/acx_trace_merge.py (and
+ * tools/acx_critpath.py through it) align the per-rank clocks on.
+ *
+ * Run under `acxrun -np 2 -transport socket` with ACX_TRACE set; `make
+ * causality-check` then asserts that every data frame's span id shows up
+ * on both ranks, that one-way transit is non-negative after skew
+ * correction, and — with `-fault stall_link_ms:rank=0:nth=5:ms=40` —
+ * that acx_critpath.py names the stalled 0->1 link as the dominant edge.
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include <mpi.h>
+#include <mpi-acx.h>
+
+#define N 256
+#define BARRIER_EVERY 8
+
+static int expect(int round, int i) {
+    return round * 131071 + i * 13 + 5;
+}
+
+int main(int argc, char **argv) {
+    int provided, rank, size, errs = 0;
+    MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided);
+    if (provided < MPI_THREAD_MULTIPLE) MPI_Abort(MPI_COMM_WORLD, 1);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    if (size != 2) {
+        /* The causal chain this test builds is a strict 2-rank relay;
+         * under the generic np-4 sweep there is nothing to assert. */
+        if (rank == 0) printf("causality-ping: OK (skipped: needs exactly 2 ranks)\n");
+        MPI_Finalize();
+        return 0;
+    }
+
+    if (MPIX_Init()) MPI_Abort(MPI_COMM_WORLD, 2);
+
+    /* Failsafe well under acxrun's job timeout: a wedged link fails ops
+     * with TIMEOUT and the test reports instead of hanging. */
+    MPIX_Set_deadline(20000);
+
+    int rounds = 40;
+    const char *r_s = getenv("ACX_PING_ROUNDS");
+    if (r_s != NULL && atoi(r_s) > 0) rounds = atoi(r_s);
+
+    const int peer = 1 - rank;
+    int buf[N];
+    cudaStream_t stream = 0;
+
+    for (int round = 0; round < rounds && errs == 0; round++) {
+        MPIX_Request req;
+        MPI_Status st;
+        int i;
+        if (rank == 0) {
+            for (i = 0; i < N; i++) buf[i] = expect(round, i);
+            MPIX_Isend_enqueue(buf, N, MPI_INT, peer, round, MPI_COMM_WORLD,
+                               &req, MPIX_QUEUE_XLA_STREAM, &stream);
+            MPIX_Wait(&req, MPI_STATUS_IGNORE);
+            for (i = 0; i < N; i++) buf[i] = -1;
+            MPIX_Irecv_enqueue(buf, N, MPI_INT, peer, round, MPI_COMM_WORLD,
+                               &req, MPIX_QUEUE_XLA_STREAM, &stream);
+            MPIX_Wait(&req, &st);
+        } else {
+            for (i = 0; i < N; i++) buf[i] = -1;
+            MPIX_Irecv_enqueue(buf, N, MPI_INT, peer, round, MPI_COMM_WORLD,
+                               &req, MPIX_QUEUE_XLA_STREAM, &stream);
+            MPIX_Wait(&req, &st);
+            MPIX_Isend_enqueue(buf, N, MPI_INT, peer, round, MPI_COMM_WORLD,
+                               &req, MPIX_QUEUE_XLA_STREAM, &stream);
+            MPIX_Wait(&req, MPI_STATUS_IGNORE);
+        }
+        if (st.MPI_ERROR != MPI_SUCCESS) {
+            printf("[%d] round %d: status error %d\n", rank, round,
+                   st.MPI_ERROR);
+            errs++;
+            break;
+        }
+        /* The echoed payload must round-trip byte-exactly. */
+        for (i = 0; i < N; i++) {
+            if (buf[i] != expect(round, i)) {
+                printf("[%d] round %d: buf[%d] = %d, want %d\n", rank,
+                       round, i, buf[i], expect(round, i));
+                errs++;
+                break;
+            }
+        }
+        /* Periodic barrier = clock anchor for the offline skew fit. */
+        if ((round + 1) % BARRIER_EVERY == 0)
+            MPI_Barrier(MPI_COMM_WORLD);
+    }
+
+    MPI_Allreduce(MPI_IN_PLACE, &errs, 1, MPI_INT, MPI_MAX, MPI_COMM_WORLD);
+    /* One final anchor AFTER all traffic: compute_skew aligns on the
+     * LAST common barrier_exit, so this pins the whole spanned window. */
+    MPI_Barrier(MPI_COMM_WORLD);
+    MPIX_Set_deadline(0);
+    MPIX_Finalize();
+    MPI_Finalize();
+    if (rank == 0 && errs == 0) printf("causality-ping: OK\n");
+    return errs != 0;
+}

@@ -25,6 +25,7 @@ schedules.
 
 from __future__ import annotations
 
+import ctypes
 import math
 import os
 import time
@@ -228,6 +229,37 @@ def _tseries_annotate_best_effort(fragment: dict) -> bool:
         return True
     except Exception:  # pragma: no cover — diagnostics must never raise
         return False
+
+
+def _span_app_begin_best_effort(request_id: int) -> bool:
+    """Bracket-open for causal tracing (docs/DESIGN.md §14): ties every
+    native op enqueued until the matching end-call to ``request_id``, so
+    an offline acx_critpath.py run splits this request's TTFT into queue
+    vs compute vs wire. Same no-build/no-load discipline as the
+    annotate helper: only if the native runtime is ALREADY loaded and
+    tracing is armed (ACX_TRACE). The id is offset by 1 — request ids
+    start at 0 and span id 0 means "unspanned" on the native side.
+    Returns True iff the bracket was opened (the caller must then close
+    it)."""
+    if not os.environ.get("ACX_TRACE"):
+        return False
+    try:
+        import mpi_acx_tpu.runtime as _rt
+        if _rt._lib is None:
+            return False
+        _rt._lib.acx_span_app_begin(ctypes.c_uint64(request_id + 1))
+        return True
+    except Exception:  # pragma: no cover — diagnostics must never raise
+        return False
+
+
+def _span_app_end_best_effort() -> None:
+    try:
+        import mpi_acx_tpu.runtime as _rt
+        if _rt._lib is not None:
+            _rt._lib.acx_span_app_end()
+    except Exception:  # pragma: no cover — diagnostics must never raise
+        pass
 
 
 def _bucket(n: int, lo: int = 8) -> int:
@@ -501,6 +533,11 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
         padded = np.zeros((1, min(_bucket(S), max_len, cfg.max_seq)),
                           np.int32)
         padded[0, :S] = prompt
+        # Causal-tracing bracket: any native op the prefill triggers
+        # (multihost sharded serving pushes activations through MPIX
+        # enqueues) is span-tagged with this request's id, so the
+        # request's TTFT decomposes offline (acx_critpath.py).
+        spanned = _span_app_begin_best_effort(rid)
         try:
             logits, one = prefill_fn(jnp.asarray(padded), S - 1)
             if sample_cfg is None:
@@ -516,6 +553,9 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
         except Exception as exc:  # noqa: BLE001 — any device failure
             _requeue(rid, prompt, exc, charge=not _peer_dead(exc))
             return False
+        finally:
+            if spanned:
+                _span_app_end_best_effort()
         owner[b] = rid
         emitted[rid].append(first)
         last_tok[b] = first

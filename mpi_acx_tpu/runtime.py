@@ -112,6 +112,10 @@ def lib() -> ctypes.CDLL:
         _lib.acx_tseries_live_json.argtypes = [ctypes.c_char_p, ctypes.c_int]
         _lib.acx_tseries_annotate.restype = None
         _lib.acx_tseries_annotate.argtypes = [ctypes.c_char_p]
+        _lib.acx_span_app_begin.restype = None
+        _lib.acx_span_app_begin.argtypes = [ctypes.c_uint64]
+        _lib.acx_span_app_end.restype = None
+        _lib.acx_span_app_end.argtypes = []
     return _lib
 
 
@@ -531,6 +535,20 @@ class Runtime:
         import json as _json
         self._lib.acx_tseries_annotate(
             _json.dumps(fragment, separators=(",", ":")).encode())
+
+    # -- causal tracing (docs/DESIGN.md §14) --------------------------------
+
+    def span_app_begin(self, request_id: int) -> None:
+        """Open an application span bracket: every op enqueued until
+        ``span_app_end`` emits a ``req_op`` trace event tying the op's
+        native causal span to ``request_id``, so tools/acx_critpath.py
+        can split the request's latency into queue vs compute vs wire.
+        Latest begin wins (no nesting); ``request_id`` must be nonzero."""
+        self._lib.acx_span_app_begin(ctypes.c_uint64(request_id))
+
+    def span_app_end(self) -> None:
+        """Close the application span bracket opened by span_app_begin."""
+        self._lib.acx_span_app_end()
 
     # -- flight recorder ----------------------------------------------------
 

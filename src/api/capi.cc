@@ -245,6 +245,19 @@ void acx_fleet_stats(uint64_t* out) {
   out[4] = s.active;
 }
 
+// ---- causal tracing (DESIGN.md §14) --------------------------------------
+
+// Open an application span bracket: every MPIX op enqueued on any thread
+// until the matching acx_span_app_end() emits a "req_op" trace event tying
+// its native causal span to this request id, so offline tools can split a
+// request's latency into queue vs compute vs wire. Nesting is not
+// supported — the latest begin wins. `id` must be nonzero (0 is reserved
+// for "no bracket open").
+void acx_span_app_begin(uint64_t id) { acx::SetAppSpan(id); }
+
+// Close the application span bracket opened by acx_span_app_begin.
+void acx_span_app_end(void) { acx::SetAppSpan(0); }
+
 // ---- flight recorder -----------------------------------------------------
 
 // Writes this rank's flight dump to <prefix>.rank<r>.flight.json. A NULL

@@ -19,11 +19,13 @@
 
 #include <sched.h>
 
+#include <atomic>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 
 #include "acx/api_internal.h"
+#include "acx/span.h"
 #include "acx/debug.h"
 #include "acx/flightrec.h"
 #include "acx/metrics.h"
@@ -37,6 +39,18 @@ namespace acx {
 namespace {
 
 constexpr int kErr = 1;
+
+// Causal tracing (DESIGN.md §14): every enqueued op gets one process-unique
+// incarnation number; span::Make folds it with rank + slot into the 64-bit
+// span id that rides the op's wire frames and tags its lifecycle events.
+// Starts at 1 so a span is never the reserved 0 ("unspanned").
+std::atomic<uint32_t> g_span_incarnation{0};
+
+// Application span bracket (see api_internal.h). Relaxed: the serving layer
+// sets it on the thread that enqueues, and a racy read from another
+// enqueuer only mislabels the request attribution of one op, never the
+// op's own span.
+std::atomic<uint64_t> g_app_span{0};
 
 // Spin until the slot reaches `want` (host- and node-side waits). The
 // waiting thread drives the progress engine itself (Proxy::TryProgress) so
@@ -133,6 +147,9 @@ int EnqueueSendRecv(bool is_send, void* buf, int count, MPI_Datatype datatype,
   op.peer = peer;
   op.tag = tag;
   op.ctx = comm;
+  op.span = span::Make(
+      g.transport->rank(), idx,
+      g_span_incarnation.fetch_add(1, std::memory_order_relaxed) + 1);
 
   auto* req = static_cast<MpixRequest*>(std::calloc(1, sizeof(MpixRequest)));
   req->magic = kReqMagic;
@@ -142,13 +159,14 @@ int EnqueueSendRecv(bool is_send, void* buf, int count, MPI_Datatype datatype,
 
   FlagTable* table = g.table;
   Proxy* proxy = g.proxy;
+  const uint64_t op_span = op.span;
   // The trigger: "the queue reached this point". First firing moves
   // RESERVED->PENDING; graph relaunches re-fire COMPLETED->PENDING
   // (reference state doc, mpi-acx-internal.h:176-189).
-  auto trigger = [table, proxy, idx] {
+  auto trigger = [table, proxy, idx, op_span] {
     table->Store(idx, kPending);
-    ACX_TRACE_EVENT("trigger_fired", idx);
-    ACX_FLIGHT(kTriggerFired, idx, -1, -1, 0, 0);
+    ACX_TRACE_SPAN("trigger_fired", idx, op_span);
+    ACX_FLIGHT_SPAN(kTriggerFired, idx, -1, -1, 0, 0, op_span);
     if (metrics::Enabled()) metrics::MarkTrigger(idx);
     // Post the transfer inline if no one else is sweeping (saves the
     // proxy-thread handoff); Kick still wakes a parked proxy to poll the
@@ -175,11 +193,16 @@ int EnqueueSendRecv(bool is_send, void* buf, int count, MPI_Datatype datatype,
     std::free(req);
     return kErr;
   }
-  ACX_TRACE_EVENT(is_send ? "isend_enqueue" : "irecv_enqueue", idx);
+  // "req_op" ties this op's span to the application request bracket (if
+  // one is open): slot-keyed, span = the app's request id. Offline tools
+  // pair it with the enqueue event below (same slot, next in the ring).
+  const uint64_t app = AppSpan();
+  if (app != 0) ACX_TRACE_SPAN("req_op", idx, app);
+  ACX_TRACE_SPAN(is_send ? "isend_enqueue" : "irecv_enqueue", idx, op_span);
   if (is_send)
-    ACX_FLIGHT(kIsendEnqueue, idx, peer, tag, op.bytes, 0);
+    ACX_FLIGHT_SPAN(kIsendEnqueue, idx, peer, tag, op.bytes, 0, op_span);
   else
-    ACX_FLIGHT(kIrecvEnqueue, idx, peer, tag, op.bytes, 0);
+    ACX_FLIGHT_SPAN(kIrecvEnqueue, idx, peer, tag, op.bytes, 0, op_span);
   *request = req;
   return MPI_SUCCESS;
 }
@@ -193,8 +216,11 @@ std::function<void()> MakeWaiter(int idx, MPI_Status* status,
   Proxy* proxy = GS().proxy;
   return [table, proxy, idx, status, graph_owned] {
     SpinUntil(table, proxy, idx, kCompleted);
-    ACX_TRACE_EVENT("wait_observed", idx);
-    ACX_FLIGHT(kWaitObserved, idx, -1, -1, 0, 0);
+    // Safe to read the op here: the slot is COMPLETED and this waiter owns
+    // the transition to CLEANUP (graph waiters only observe).
+    const uint64_t span = table->op(idx).span;
+    ACX_TRACE_SPAN("wait_observed", idx, span);
+    ACX_FLIGHT_SPAN(kWaitObserved, idx, -1, -1, 0, 0, span);
     if (metrics::Enabled()) metrics::MarkWait(idx);
     CopyStatus(table->op(idx).status, status);
     if (!graph_owned) {
@@ -219,7 +245,9 @@ int EnqueueWait(MPIX_Request* reqp, MPI_Status* status, int qtype,
         g.table->Load(idx) == kCompleted) {
       // Fast path (reference try_complete_wait_op, sendrecv.cu:82-104):
       // already complete — consume inline, no queue hop.
-      ACX_FLIGHT(kWaitObserved, idx, -1, -1, 0, 0);
+      ACX_TRACE_SPAN("wait_observed", idx, g.table->op(idx).span);
+      ACX_FLIGHT_SPAN(kWaitObserved, idx, -1, -1, 0, 0,
+                      g.table->op(idx).span);
       if (metrics::Enabled()) metrics::MarkWait(idx);
       CopyStatus(g.table->op(idx).status, status);
       g.table->Store(idx, kCleanup);
@@ -261,8 +289,9 @@ int HostWaitBasic(MpixRequest* req, MPI_Status* status) {
     return kErr;
   }
   SpinUntil(g.table, g.proxy, idx, kCompleted);
-  ACX_TRACE_EVENT("wait_observed", idx);
-  ACX_FLIGHT(kWaitObserved, idx, -1, -1, 0, 0);
+  const uint64_t span = g.table->op(idx).span;
+  ACX_TRACE_SPAN("wait_observed", idx, span);
+  ACX_FLIGHT_SPAN(kWaitObserved, idx, -1, -1, 0, 0, span);
   if (metrics::Enabled()) metrics::MarkWait(idx);
   CopyStatus(g.table->op(idx).status, status);
   g.table->Store(idx, kCleanup);  // proxy frees request + ticket + slot
@@ -350,6 +379,13 @@ int PartitionedInit(bool is_send, void* buf, int partitions, MPI_Count count,
 }
 
 }  // namespace
+
+void SetAppSpan(uint64_t id) {
+  g_app_span.store(id, std::memory_order_relaxed);
+}
+
+uint64_t AppSpan() { return g_app_span.load(std::memory_order_relaxed); }
+
 }  // namespace acx
 
 using namespace acx;

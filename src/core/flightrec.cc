@@ -72,8 +72,7 @@ std::atomic<uint64_t> g_dumps_written{0};
 int RankForDump() {
   int r = g_rank.load(std::memory_order_relaxed);
   if (r >= 0) return r;
-  const char* e = std::getenv("ACX_RANK");
-  return e != nullptr ? std::atoi(e) : 0;
+  return trace::EnvRankOr(0);
 }
 
 uint64_t EnvMsToNs(const char* name, uint64_t def_ms) {
@@ -91,7 +90,7 @@ const char* kKindNames[] = {
     "op_fault",
     "psend_slot", "precv_slot", "pready_mark", "pready_wire", "parrived",
     "tx_data", "tx_rts", "tx_ack", "tx_seqack", "tx_nak",
-    "rx_data", "rx_seqack", "rx_nak",
+    "rx_data", "rx_frame", "rx_seqack", "rx_nak",
     "link_recovering", "link_up", "peer_dead",
     "barrier_enter", "barrier_exit", "stall_warn", "hang_dump",
     "init", "finalize",
@@ -142,13 +141,14 @@ bool Enabled() {
 
 ACX_NO_TSAN
 void Record(uint16_t kind, int32_t slot, int32_t peer, int32_t tag,
-            uint64_t seq, int16_t aux) {
+            uint64_t seq, int16_t aux, uint64_t span) {
   Ring& r = ring();
   if (r.cap == 0) return;
   const uint64_t i = r.head.fetch_add(1, std::memory_order_relaxed) & r.mask;
   Event& e = r.buf[i];
   e.t_ns = NowNs();
   e.seq = seq;
+  e.span = span;
   e.slot = slot;
   e.peer = peer;
   e.tag = tag;
@@ -242,10 +242,12 @@ int Dump(const char* prefix, const char* reason) {
       std::fprintf(f,
                    "%s\n {\"slot\":%zu,\"state\":\"%s\",\"kind\":\"%s\","
                    "\"peer\":%d,\"tag\":%d,\"bytes\":%zu,\"partition\":%d,"
-                   "\"attempts\":%u,\"error\":%d,\"age_ms\":%.1f}",
+                   "\"attempts\":%u,\"error\":%d,\"age_ms\":%.1f,"
+                   "\"span\":%llu}",
                    first ? "" : ",", i, FlagName(st), OpKindName(op.kind),
                    op.peer, op.tag, op.bytes, op.partition, op.attempts,
-                   op.status.error, age_ms);
+                   op.status.error, age_ms,
+                   (unsigned long long)op.span);
       first = false;
     }
   }
@@ -289,10 +291,12 @@ int Dump(const char* prefix, const char* reason) {
       const Event e = r.buf[(head - n + k) & r.mask];
       std::fprintf(f,
                    "%s\n {\"t_ns\":%llu,\"kind\":\"%s\",\"slot\":%d,"
-                   "\"peer\":%d,\"tag\":%d,\"seq\":%llu,\"aux\":%d}",
+                   "\"peer\":%d,\"tag\":%d,\"seq\":%llu,\"aux\":%d,"
+                   "\"span\":%llu}",
                    first ? "" : ",", (unsigned long long)e.t_ns,
                    KindName(e.kind), e.slot, e.peer, e.tag,
-                   (unsigned long long)e.seq, (int)e.aux);
+                   (unsigned long long)e.seq, (int)e.aux,
+                   (unsigned long long)e.span);
       first = false;
     }
   }

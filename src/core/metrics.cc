@@ -32,6 +32,8 @@ const char* const kHistName[] = {
     "issue_to_complete_ns",
     "complete_to_wait_ns",
     "proxy_sweep_ns",
+    "wire_queue_ns",
+    "wire_transit_ns",
 };
 
 static_assert(sizeof(kCounterName) / sizeof(kCounterName[0]) == kNumCounters,

@@ -63,9 +63,10 @@ int Proxy::CancelInflight() {
     // Flight events that read op fields must be recorded BEFORE the release
     // store of COMPLETED: once the waiter observes it, it may Free() the
     // slot and Op::Reset() races with any later read of the op.
-    ACX_FLIGHT(kOpDrained, i, op.peer, op.tag, op.attempts, err);
+    ACX_FLIGHT_SPAN(kOpDrained, i, op.peer, op.tag, op.attempts, err,
+                    op.span);
     table_->Store(i, kCompleted);
-    ACX_TRACE_EVENT("op_drained", i);
+    ACX_TRACE_SPAN("op_drained", i, op.span);
     if (metrics::Enabled()) metrics::MarkComplete(i);
     count++;
   }
@@ -153,9 +154,10 @@ bool Proxy::IssueOp(size_t i, Op& op, Stats& local, bool from_pending) {
         op.status = Status{op.peer, op.tag, err, 0};
         ACX_FLIGHT(kOpFault, i, op.peer, op.tag, op.attempts,
                    (int16_t)fault::Action::kFail);
-        ACX_FLIGHT(kOpCompleted, i, op.peer, op.tag, op.attempts, err);
+        ACX_FLIGHT_SPAN(kOpCompleted, i, op.peer, op.tag, op.attempts, err,
+                        op.span);
         table_->Store(i, kCompleted);
-        ACX_TRACE_EVENT("fault_fail", i);
+        ACX_TRACE_SPAN("fault_fail", i, op.span);
         if (metrics::Enabled()) metrics::MarkComplete(i);
         local.ops_completed++;
         return true;
@@ -185,18 +187,22 @@ bool Proxy::IssueOp(size_t i, Op& op, Stats& local, bool from_pending) {
   if (is_send) {
     ACX_DLOG("slot %zu: isend %zuB -> peer %d tag %d", i, op.bytes, op.peer,
              op.tag);
-    op.ticket = transport_->Isend(op.sbuf, op.bytes, op.peer, op.tag, op.ctx);
+    op.ticket = transport_->Isend(op.sbuf, op.bytes, op.peer, op.tag, op.ctx,
+                                  op.span);
     if (from_pending) table_->Store(i, kIssued);
-    ACX_TRACE_EVENT("isend_issued", i);
-    ACX_FLIGHT(kIsendIssued, i, op.peer, op.tag, op.attempts, op.partition);
+    ACX_TRACE_SPAN("isend_issued", i, op.span);
+    ACX_FLIGHT_SPAN(kIsendIssued, i, op.peer, op.tag, op.attempts,
+                    op.partition, op.span);
     if (metrics::Enabled()) metrics::MarkIssue(i, true, op.bytes);
   } else {
     ACX_DLOG("slot %zu: irecv %zuB <- peer %d tag %d", i, op.bytes, op.peer,
              op.tag);
-    op.ticket = transport_->Irecv(op.rbuf, op.bytes, op.peer, op.tag, op.ctx);
+    op.ticket = transport_->Irecv(op.rbuf, op.bytes, op.peer, op.tag, op.ctx,
+                                  op.span);
     if (from_pending) table_->Store(i, kIssued);
-    ACX_TRACE_EVENT("irecv_issued", i);
-    ACX_FLIGHT(kIrecvIssued, i, op.peer, op.tag, op.attempts, op.partition);
+    ACX_TRACE_SPAN("irecv_issued", i, op.span);
+    ACX_FLIGHT_SPAN(kIrecvIssued, i, op.peer, op.tag, op.attempts,
+                    op.partition, op.span);
     if (metrics::Enabled()) metrics::MarkIssue(i, false, op.bytes);
   }
   local.ops_issued++;
@@ -211,9 +217,10 @@ bool Proxy::CheckStalled(size_t i, Op& op, Stats& local) {
   const uint64_t now = NowNs();
   if (op.deadline_ns != 0 && now >= op.deadline_ns) {
     op.status = Status{op.peer, op.tag, kErrTimeout, 0};
-    ACX_FLIGHT(kOpTimeout, i, op.peer, op.tag, op.attempts, kErrTimeout);
+    ACX_FLIGHT_SPAN(kOpTimeout, i, op.peer, op.tag, op.attempts, kErrTimeout,
+                    op.span);
     table_->Store(i, kCompleted);
-    ACX_TRACE_EVENT("op_timeout", i);
+    ACX_TRACE_SPAN("op_timeout", i, op.span);
     if (metrics::Enabled()) metrics::MarkComplete(i);
     local.timeouts++;
     local.ops_completed++;
@@ -225,17 +232,18 @@ bool Proxy::CheckStalled(size_t i, Op& op, Stats& local) {
   if (!unposted || now < op.retry_at_ns) return false;
   if (op.attempts > Policy().max_retries.load(std::memory_order_relaxed)) {
     op.status = Status{op.peer, op.tag, kErrTimeout, 0};
-    ACX_FLIGHT(kOpTimeout, i, op.peer, op.tag, op.attempts, kErrTimeout);
+    ACX_FLIGHT_SPAN(kOpTimeout, i, op.peer, op.tag, op.attempts, kErrTimeout,
+                    op.span);
     table_->Store(i, kCompleted);
-    ACX_TRACE_EVENT("op_timeout", i);
+    ACX_TRACE_SPAN("op_timeout", i, op.span);
     if (metrics::Enabled()) metrics::MarkComplete(i);
     local.timeouts++;
     local.ops_completed++;
     return true;
   }
   local.retries++;
-  ACX_TRACE_EVENT("op_retry", i);
-  ACX_FLIGHT(kOpRetry, i, op.peer, op.tag, op.attempts, 0);
+  ACX_TRACE_SPAN("op_retry", i, op.span);
+  ACX_FLIGHT_SPAN(kOpRetry, i, op.peer, op.tag, op.attempts, 0, op.span);
   return IssueOp(i, op, local, false);
 }
 
@@ -261,9 +269,10 @@ bool Proxy::Sweep() {
             // Send-side partition became ready (host call or device-mirrored
             // flag write): push it to the wire and complete the slot.
             op.chan->Pready(op.partition);
-            ACX_FLIGHT(kPreadyWire, i, op.peer, op.tag, 0, op.partition);
+            ACX_FLIGHT_SPAN(kPreadyWire, i, op.peer, op.tag, 0, op.partition,
+                            op.span);
             table_->Store(i, kCompleted);
-            ACX_TRACE_EVENT("pready_wire", i);
+            ACX_TRACE_SPAN("pready_wire", i, op.span);
             if (metrics::Enabled())
               metrics::Add(metrics::kOpsPready, 1);
             local.ops_completed++;
@@ -285,10 +294,10 @@ bool Proxy::Sweep() {
             // any thread that acquires COMPLETED sees a coherent status (the
             // reference needed a mutex here; see its init.cpp:119-141).
             if (op.ticket != nullptr && op.ticket->Test(&op.status)) {
-              ACX_FLIGHT(kOpCompleted, i, op.peer, op.tag, op.attempts,
-                         op.status.error);
+              ACX_FLIGHT_SPAN(kOpCompleted, i, op.peer, op.tag, op.attempts,
+                              op.status.error, op.span);
               table_->Store(i, kCompleted);
-              ACX_TRACE_EVENT("op_completed", i);
+              ACX_TRACE_SPAN("op_completed", i, op.span);
               if (metrics::Enabled()) metrics::MarkComplete(i);
               local.ops_completed++;
               progressed = true;
@@ -300,8 +309,9 @@ bool Proxy::Sweep() {
               // Parked time is credited back when the op resumes.
               op.parked_at_ns = NowNs();
               table_->Store(i, kRecovering);
-              ACX_TRACE_EVENT("op_parked", i);
-              ACX_FLIGHT(kOpParked, i, op.peer, op.tag, op.attempts, 0);
+              ACX_TRACE_SPAN("op_parked", i, op.span);
+              ACX_FLIGHT_SPAN(kOpParked, i, op.peer, op.tag, op.attempts, 0,
+                              op.span);
               progressed = true;
             } else if (CheckStalled(i, op, local)) {
               progressed = true;
@@ -310,9 +320,10 @@ bool Proxy::Sweep() {
           }
           case OpKind::kParrived: {
             if (op.chan->Parrived(op.partition)) {
-              ACX_FLIGHT(kParrived, i, op.peer, op.tag, 0, op.partition);
+              ACX_FLIGHT_SPAN(kParrived, i, op.peer, op.tag, 0, op.partition,
+                              op.span);
               table_->Store(i, kCompleted);
-              ACX_TRACE_EVENT("parrived", i);
+              ACX_TRACE_SPAN("parrived", i, op.span);
               if (metrics::Enabled())
                 metrics::Add(metrics::kOpsParrived, 1);
               local.ops_completed++;
@@ -330,10 +341,10 @@ bool Proxy::Sweep() {
         // can complete the op mid-recovery, and a failed recovery completes
         // the ticket with kErrPeerDead — both surface here.
         if (op.ticket != nullptr && op.ticket->Test(&op.status)) {
-          ACX_FLIGHT(kOpCompleted, i, op.peer, op.tag, op.attempts,
-                     op.status.error);
+          ACX_FLIGHT_SPAN(kOpCompleted, i, op.peer, op.tag, op.attempts,
+                          op.status.error, op.span);
           table_->Store(i, kCompleted);
-          ACX_TRACE_EVENT("op_completed", i);
+          ACX_TRACE_SPAN("op_completed", i, op.span);
           if (metrics::Enabled()) metrics::MarkComplete(i);
           local.ops_completed++;
           progressed = true;
@@ -345,20 +356,23 @@ bool Proxy::Sweep() {
             op.deadline_ns += NowNs() - op.parked_at_ns;
           op.parked_at_ns = 0;
           table_->Store(i, kIssued);
-          ACX_TRACE_EVENT("op_resumed", i);
-          ACX_FLIGHT(kOpResumed, i, op.peer, op.tag, op.attempts, 0);
+          ACX_TRACE_SPAN("op_resumed", i, op.span);
+          ACX_FLIGHT_SPAN(kOpResumed, i, op.peer, op.tag, op.attempts, 0,
+                          op.span);
           progressed = true;
         }
         break;
       }
       case kCleanup: {
         // First-class reclaim state (fixes the reference's slot leak).
-        // op.ticket is deleted inside FlagTable::Free.
+        // op.ticket is deleted inside FlagTable::Free. Capture the span
+        // first — Free resets the Op.
+        const uint64_t reclaimed_span = op.span;
         std::free(op.owner);
         op.owner = nullptr;
         table_->Free(static_cast<int>(i));
-        ACX_TRACE_EVENT("slot_reclaimed", i);
-        ACX_FLIGHT(kSlotReclaimed, i, -1, -1, 0, 0);
+        ACX_TRACE_SPAN("slot_reclaimed", i, reclaimed_span);
+        ACX_FLIGHT_SPAN(kSlotReclaimed, i, -1, -1, 0, 0, reclaimed_span);
         local.slots_reclaimed++;
         progressed = true;
         break;

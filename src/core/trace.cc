@@ -3,6 +3,7 @@
 #include <signal.h>
 
 #include <algorithm>
+#include <climits>
 #include <csignal>
 #include <atomic>
 #include <chrono>
@@ -25,6 +26,7 @@ struct Event {
                     // a ~2.5 µs enqueue-latency budget segment-by-segment
   const char* name;
   int64_t slot;
+  uint64_t span;    // causal span id (acx/span.h); 0 = untagged
 };
 
 struct Ring {
@@ -60,8 +62,7 @@ std::atomic<bool> g_flushing{false};
 int RankForFlush() {
   int r = g_rank.load(std::memory_order_relaxed);
   if (r >= 0) return r;
-  const char* e = std::getenv("ACX_RANK");
-  return e != nullptr ? std::atoi(e) : 0;
+  return EnvRankOr(0);
 }
 
 // Snapshot the ring without draining it (a later flush rewrites a
@@ -194,19 +195,26 @@ size_t SynthesizeSpans(const std::vector<Event>& events, int rank,
         continue;
       }
       if (e.ts_ns < b_ts) continue;
-      char buf[192];
+      char buf[256];
+      char args[64] = "";
+      // The end instant carries the op's causal span id (the begin side
+      // always has the same id — both come from the same Op); propagate it
+      // so synthesized lifecycle bars stay joinable with the wire events.
+      if (e.span != 0)
+        std::snprintf(args, sizeof args, "\"args\":{\"span\":%llu},",
+                      (unsigned long long)e.span);
       const uint64_t id = next_id++;
       std::snprintf(buf, sizeof buf,
                     "{\"name\":\"%s\",\"cat\":\"acx\",\"ph\":\"b\","
-                    "\"id\":%llu,\"pid\":%d,\"tid\":%lld,",
+                    "\"id\":%llu,\"pid\":%d,\"tid\":%lld,%s",
                     rule.span, (unsigned long long)id, rank,
-                    (long long)e.slot);
+                    (long long)e.slot, args);
       out->push_back(Record{b_ts, buf});
       std::snprintf(buf, sizeof buf,
                     "{\"name\":\"%s\",\"cat\":\"acx\",\"ph\":\"e\","
-                    "\"id\":%llu,\"pid\":%d,\"tid\":%lld,",
+                    "\"id\":%llu,\"pid\":%d,\"tid\":%lld,%s",
                     rule.span, (unsigned long long)id, rank,
-                    (long long)e.slot);
+                    (long long)e.slot, args);
       out->push_back(Record{e.ts_ns, buf});
       spans++;
     }
@@ -228,11 +236,20 @@ void WriteFile(const std::vector<Event>& events, uint64_t dropped, int rank) {
   std::vector<Record> records;
   records.reserve(events.size() * 2);
   for (const Event& e : events) {
-    char buf[160];
-    std::snprintf(buf, sizeof buf,
-                  "{\"name\":\"%s\",\"ph\":\"i\",\"s\":\"t\","
-                  "\"pid\":%d,\"tid\":%lld,",
-                  e.name, rank, (long long)e.slot);
+    char buf[224];
+    if (e.span != 0) {
+      std::snprintf(buf, sizeof buf,
+                    "{\"name\":\"%s\",\"ph\":\"i\",\"s\":\"t\","
+                    "\"pid\":%d,\"tid\":%lld,"
+                    "\"args\":{\"span\":%llu},",
+                    e.name, rank, (long long)e.slot,
+                    (unsigned long long)e.span);
+    } else {
+      std::snprintf(buf, sizeof buf,
+                    "{\"name\":\"%s\",\"ph\":\"i\",\"s\":\"t\","
+                    "\"pid\":%d,\"tid\":%lld,",
+                    e.name, rank, (long long)e.slot);
+    }
     records.push_back(Record{e.ts_ns, buf});
   }
   const size_t spans = SynthesizeSpans(events, rank, &records);
@@ -282,7 +299,9 @@ void RegisterCrashFlusher(void (*fn)(), bool on_exit) {
   g_nflushers.store(n + 1, std::memory_order_release);
 }
 
-void Emit(const char* name, int64_t slot) {
+void Emit(const char* name, int64_t slot) { Emit(name, slot, 0); }
+
+void Emit(const char* name, int64_t slot, uint64_t span) {
   Ring& r = ring();
   // Timestamp under the lock: emitters race (app, trigger, proxy, and
   // waiter threads), and the file must be time-ordered.
@@ -295,7 +314,19 @@ void Emit(const char* name, int64_t slot) {
       std::chrono::duration_cast<std::chrono::nanoseconds>(Clock::now() -
                                                            r.t0)
           .count());
-  r.events.push_back(Event{ts, name, slot});
+  r.events.push_back(Event{ts, name, slot, span});
+}
+
+int EnvRankOr(int fallback) {
+  const char* e = std::getenv("ACX_RANK");
+  if (e == nullptr || e[0] == '\0') return fallback;
+  // strtol alone would accept leading whitespace and '+'; the contract is
+  // a full bare decimal string, so the first byte must already be a digit.
+  if (e[0] < '0' || e[0] > '9') return fallback;
+  char* end = nullptr;
+  const long v = std::strtol(e, &end, 10);
+  if (end == e || *end != '\0' || v < 0 || v > INT_MAX) return fallback;
+  return static_cast<int>(v);
 }
 
 uint64_t NowSinceStartNs() {

@@ -85,8 +85,7 @@ std::atomic<void (*)()> g_refresh{nullptr};
 int RankForFile() {
   int r = g_rank.load(std::memory_order_relaxed);
   if (r >= 0) return r;
-  const char* e = std::getenv("ACX_RANK");
-  return e != nullptr ? std::atoi(e) : 0;
+  return trace::EnvRankOr(0);
 }
 
 uint64_t WallMs() {
@@ -118,12 +117,14 @@ void AppendLinks(std::string* out, Transport* t) {
       if (!t->link_scope(p, &sc)) continue;
       if (!first) *out += ",";
       first = false;
-      char buf[384];
+      char buf[576];
       std::snprintf(
           buf, sizeof buf,
           "{\"peer\":%d,\"state\":%d,\"epoch\":%u,\"tx_pb\":%llu,"
           "\"tx_wb\":%llu,\"rx_pb\":%llu,\"rx_wb\":%llu,\"tx_fr\":%llu,"
-          "\"rx_fr\":%llu,\"naks\":%llu,\"crc\":%llu,\"replayed\":%llu}",
+          "\"rx_fr\":%llu,\"naks\":%llu,\"crc\":%llu,\"replayed\":%llu,"
+          "\"txq_ns\":%llu,\"txq_fr\":%llu,\"rxt_ns\":%llu,"
+          "\"rxt_fr\":%llu}",
           p, sc.state, sc.epoch, (unsigned long long)sc.tx_payload_bytes,
           (unsigned long long)sc.tx_wire_bytes,
           (unsigned long long)sc.rx_payload_bytes,
@@ -131,7 +132,11 @@ void AppendLinks(std::string* out, Transport* t) {
           (unsigned long long)sc.tx_frames,
           (unsigned long long)sc.rx_frames, (unsigned long long)sc.naks,
           (unsigned long long)sc.crc_rejects,
-          (unsigned long long)sc.replayed);
+          (unsigned long long)sc.replayed,
+          (unsigned long long)sc.tx_queue_ns_sum,
+          (unsigned long long)sc.tx_queue_frames,
+          (unsigned long long)sc.rx_transit_ns_sum,
+          (unsigned long long)sc.rx_transit_frames);
       *out += buf;
     }
   }

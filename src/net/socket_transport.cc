@@ -48,6 +48,7 @@
 #include "acx/fault.h"
 #include "acx/flightrec.h"
 #include "acx/membership.h"
+#include "acx/metrics.h"
 #include "acx/trace.h"
 #include "src/net/link.h"
 #include "src/net/wire.h"
@@ -150,6 +151,9 @@ struct SendReq {
   // kept so the replay record (and any post-reconnect resend) is clean.
   bool corrupted = false;
   uint32_t good_crc = 0, good_hcrc = 0;
+  // Enqueue stamp on the trace timeline (trace::NowSinceStartNs), for the
+  // per-link tx-queue histogram; 0 on control frames (not measured).
+  uint64_t enq_ns = 0;
   int dst = -1;   // destination rank (dead-peer teardown scans rv_pending_)
   char desc[16];  // storage for RTS/ACK wire payloads
   Status st;
@@ -163,6 +167,7 @@ struct RecvReq {
   // report_tag preserves the user-visible tag for the Status.
   int report_tag = INT_MIN;
   bool done = false;
+  uint64_t span = 0;  // the LOCAL recv op's causal span id (acx/span.h)
   Status st;
 };
 
@@ -172,6 +177,7 @@ struct Msg {
   bool rv = false;  // unexpected RTS: payload empty, fields below valid
   RvDesc rv_desc{};
   uint64_t rv_bytes = 0;  // full message length advertised by the RTS
+  uint64_t span = 0;      // the SENDER op's span id, off the wire header
 };
 
 // Incoming-byte-stream assembly state for one peer link. When the header
@@ -321,15 +327,16 @@ class StreamTransport : public Transport {
   int rank() const override { return rank_; }
   int size() const override { return size_; }
 
-  Ticket* Isend(const void* buf, size_t bytes, int dst, int tag,
-                int ctx) override {
+  Ticket* Isend(const void* buf, size_t bytes, int dst, int tag, int ctx,
+                uint64_t span = 0) override {
     std::lock_guard<std::mutex> lk(mu_);
-    return IsendLocked(buf, bytes, dst, tag, ctx);
+    return IsendLocked(buf, bytes, dst, tag, ctx, span);
   }
 
-  Ticket* Irecv(void* buf, size_t bytes, int src, int tag, int ctx) override {
+  Ticket* Irecv(void* buf, size_t bytes, int src, int tag, int ctx,
+                uint64_t span = 0) override {
     std::lock_guard<std::mutex> lk(mu_);
-    return IrecvLocked(buf, bytes, src, tag, ctx);
+    return IrecvLocked(buf, bytes, src, tag, ctx, span);
   }
 
   PartitionedChan* PsendInit(const void* buf, int partitions,
@@ -457,6 +464,10 @@ class StreamTransport : public Transport {
     out->naks = p.sc_naks;
     out->crc_rejects = p.sc_crc_rejects;
     out->replayed = p.sc_replayed;
+    out->tx_queue_ns_sum = p.sc_tx_queue_ns;
+    out->tx_queue_frames = p.sc_tx_queue_frames;
+    out->rx_transit_ns_sum = p.sc_rx_transit_ns;
+    out->rx_transit_frames = p.sc_rx_transit_frames;
     return true;
   }
 
@@ -588,10 +599,19 @@ class StreamTransport : public Transport {
     uint64_t sc_naks = 0;        // re-pulls sent on this link
     uint64_t sc_crc_rejects = 0; // frames from this peer dropped on CRC
     uint64_t sc_replayed = 0;    // frames re-sent to this peer
+
+    // -- causal timing (DESIGN.md §14) -- cumulative, same lifecycle as the
+    // scope counters above. Transit is the RAW clock delta (includes
+    // inter-process timeline offset, clamped at 0); skew correction is an
+    // offline concern (tools/acx_trace_merge.py).
+    uint64_t sc_tx_queue_ns = 0;      // enqueue -> fully-on-wire, sequenced
+    uint64_t sc_tx_queue_frames = 0;
+    uint64_t sc_rx_transit_ns = 0;    // sender tx_ns -> delivery, clamped
+    uint64_t sc_rx_transit_frames = 0;
   };
 
   Ticket* IsendLocked(const void* buf, size_t bytes, int dst, int tag,
-                      int ctx) {
+                      int ctx, uint64_t span = 0) {
     if (dst != rank_ && (dst < 0 || dst >= size_)) {
       std::fprintf(stderr, "tpu-acx[%d]: no wire to peer %d\n", rank_, dst);
       _exit(14);
@@ -619,6 +639,7 @@ class StreamTransport : public Transport {
       Msg m;
       m.tag = tag;
       m.ctx = ctx;
+      m.span = span;
       m.payload.assign(static_cast<const char*>(buf),
                        static_cast<const char*>(buf) + bytes);
       DeliverLocked(rank_, std::move(m));
@@ -627,6 +648,7 @@ class StreamTransport : public Transport {
     }
     s->payload = static_cast<const char*>(buf);
     s->bytes = bytes;
+    s->enq_ns = trace::NowSinceStartNs();
     if (bytes >= rv_threshold_) {
       // Rendezvous: put a 16-byte RTS on the wire instead of the payload;
       // completion comes from the receiver's ACK (HandleAckLocked).
@@ -644,6 +666,7 @@ class StreamTransport : public Transport {
       s->wire_payload = s->payload;
       s->wire_bytes = bytes;
     }
+    s->hdr.span = span;
     s->hdr.crc = PayloadCrc(s->wire_payload, s->wire_bytes);
     StampSeqLocked(dst, &s->hdr);
     peers_[dst].outq.push_back(s);
@@ -671,7 +694,8 @@ class StreamTransport : public Transport {
     SealHdrLocked(dst, h);
   }
 
-  Ticket* IrecvLocked(void* buf, size_t bytes, int src, int tag, int ctx) {
+  Ticket* IrecvLocked(void* buf, size_t bytes, int src, int tag, int ctx,
+                      uint64_t span = 0) {
     // Same loud failure as IsendLocked: a recv from a wireless peer would
     // otherwise sit in `posted` forever (ProgressLocked skips null links).
     if (src != rank_ && (src < 0 || src >= size_)) {
@@ -684,6 +708,7 @@ class StreamTransport : public Transport {
     r->src = src;
     r->tag = tag;
     r->ctx = ctx;
+    r->span = span;
     // Try the unexpected queue first (FIFO per (src, tag, ctx)) — and
     // BEFORE any dead-peer verdict: a graceful leave (DESIGN.md §12)
     // drains and then announces LEFT, so eager data it delivered ahead of
@@ -695,8 +720,10 @@ class StreamTransport : public Transport {
     for (auto it = q.begin(); it != q.end(); ++it) {
       if (it->tag == tag && it->ctx == ctx) {
         if (it->rv && src != rank_ && peer_dead_[src]) break;
+        NoteMatchLocked(it->span, r->span);
         if (it->rv) {
-          CompleteRvLocked(src, r, it->tag, it->rv_bytes, it->rv_desc);
+          CompleteRvLocked(src, r, it->tag, it->rv_bytes, it->rv_desc,
+                           it->span);
         } else {
           CompleteRecv(r.get(), src, *it);
         }
@@ -729,8 +756,11 @@ class StreamTransport : public Transport {
   // Pull an RTS-advertised payload straight out of the sender's address
   // space (one copy), then ack. On pvread failure, nack and repost the recv
   // on the private fallback key the sender will use for the copy re-send.
+  // `span` is the sender op's span id off the RTS frame; it rides the ACK
+  // back so the sender's completion stays causally attributable.
   void CompleteRvLocked(int src, const std::shared_ptr<RecvReq>& r, int tag,
-                        uint64_t full_bytes, const RvDesc& d) {
+                        uint64_t full_bytes, const RvDesc& d,
+                        uint64_t span = 0) {
     const size_t deliver = r->bytes < full_bytes ? r->bytes : full_bytes;
     size_t got = 0;
     if (!rv_force_fallback_) {
@@ -756,10 +786,10 @@ class StreamTransport : public Transport {
       r->ctx = kRvDataCtx;
       peers_[src].posted.push_back(r);
     }
-    SendAckLocked(src, d.seq, ok);
+    SendAckLocked(src, d.seq, ok, span);
   }
 
-  void SendAckLocked(int dst, uint32_t seq, bool ok) {
+  void SendAckLocked(int dst, uint32_t seq, bool ok, uint64_t span = 0) {
     auto s = std::make_shared<SendReq>();
     s->hdr = MakeHdr(kMagicAck, 0, 0, 0);
     RvAck a{seq, ok ? 1 : 0};
@@ -767,6 +797,8 @@ class StreamTransport : public Transport {
     s->wire_payload = s->desc;
     s->wire_bytes = sizeof a;
     s->dst = dst;
+    s->hdr.span = span;
+    s->enq_ns = trace::NowSinceStartNs();
     s->hdr.crc = PayloadCrc(s->wire_payload, s->wire_bytes);
     StampSeqLocked(dst, &s->hdr);
     peers_[dst].outq.push_back(std::move(s));
@@ -785,12 +817,15 @@ class StreamTransport : public Transport {
     // Receiver couldn't pvread: re-send as a normal copy frame on the
     // fallback key it just posted.
     s->rv = false;
+    const uint64_t span = s->hdr.span;  // survives the header rebuild
     s->hdr = MakeHdr(kMagic, static_cast<int>(a.seq & 0x7fffffff), kRvDataCtx,
                      s->bytes);
+    s->hdr.span = span;
     s->wire_payload = s->payload;
     s->wire_bytes = s->bytes;
     s->off = 0;
     s->fault_checked = false;
+    s->enq_ns = trace::NowSinceStartNs();
     s->hdr.crc = PayloadCrc(s->wire_payload, s->wire_bytes);
     StampSeqLocked(src, &s->hdr);
     peers_[src].outq.push_back(std::move(s));
@@ -803,8 +838,9 @@ class StreamTransport : public Transport {
       if ((*it)->tag == m.tag && (*it)->ctx == m.ctx) {
         std::shared_ptr<RecvReq> r = *it;
         posted.erase(it);
+        NoteMatchLocked(m.span, r->span);
         if (m.rv) {
-          CompleteRvLocked(src, r, m.tag, m.rv_bytes, m.rv_desc);
+          CompleteRvLocked(src, r, m.tag, m.rv_bytes, m.rv_desc, m.span);
         } else {
           CompleteRecv(r.get(), src, m);
         }
@@ -812,6 +848,58 @@ class StreamTransport : public Transport {
       }
     }
     peers_[src].arrived.push_back(std::move(m));
+  }
+
+  // -- causal tracing hooks (DESIGN.md §14) ----------------------------------
+
+  // A message matched a local recv. Emits the rx_from/rx_match instant PAIR
+  // back-to-back under mu_ — rx_from carries the SENDER op's span (off the
+  // wire), rx_match the LOCAL recv op's span — so offline tools can bridge
+  // the sender's causal chain into the receiver's without heuristics: an
+  // rx_match always follows its rx_from immediately in this rank's ring.
+  void NoteMatchLocked(uint64_t wire_span, uint64_t recv_span) {
+    if (wire_span != 0) ACX_TRACE_SPAN("rx_from", -1, wire_span);
+    if (recv_span != 0) ACX_TRACE_SPAN("rx_match", -1, recv_span);
+  }
+
+  // A sequenced frame from p was fully received (not a discard): account
+  // one-way transit off the sender's tx stamp and emit the wire_rx instant
+  // under the sender's span. The transit figure is a RAW cross-process
+  // clock delta — both timelines are per-rank trace origins, so it embeds
+  // a constant offset; live consumers (tseries/acx_top) present it as raw,
+  // and acx_trace_merge/acx_critpath subtract the barrier-anchored skew.
+  void NoteFrameRxLocked(int p, const WireHeader& h) {
+    if (h.span != 0) {
+      ACX_TRACE_SPAN("wire_rx", -1, h.span);
+      ACX_FLIGHT_SPAN(kRxFrame, -1, p, h.tag, h.seq, 0, h.span);
+    }
+    if (h.tx_ns != 0) {
+      const uint64_t now = trace::NowSinceStartNs();
+      const uint64_t transit = now > h.tx_ns ? now - h.tx_ns : 0;
+      Peer& peer = peers_[p];
+      peer.sc_rx_transit_ns += transit;
+      peer.sc_rx_transit_frames++;
+      if (metrics::Enabled())
+        metrics::Observe(metrics::kWireTransitNs, transit);
+    }
+  }
+
+  // Handshake version gate: a hello whose magic is a coherent v1 value is
+  // an old-protocol peer, not line noise — say so before dropping the
+  // socket. Mixed wire versions can never interoperate (the header grew
+  // when the span id landed, §14); every rank must upgrade together.
+  void WarnIfLegacyHello(int p, uint32_t magic) {
+    if (!wire::KnownLegacyMagic(magic)) return;
+    char who[32];
+    if (p >= 0)
+      std::snprintf(who, sizeof who, "rank %d", p);
+    else
+      std::snprintf(who, sizeof who, "an unidentified peer");
+    std::fprintf(stderr,
+                 "tpu-acx: rank %d: hello from %s carries wire protocol v1 "
+                 "magic 0x%08x; this build is v2 (56-byte spanned header) — "
+                 "refusing the link, upgrade all ranks together\n",
+                 rank_, who, magic);
   }
 
   // Copy a fully-written frame into the bounded replay buffer. Called at
@@ -948,6 +1036,17 @@ class StreamTransport : public Transport {
     auto& q = peer.outq;
     while (!q.empty()) {
       auto& s = q.front();
+      if (s->off == 0 && !s->raw && s->hdr.tx_ns == 0 &&
+          wire::Sequenced(s->hdr.magic)) {
+        // Stamp the tx timestamp at the first write attempt and reseal the
+        // header CRC. Done BEFORE the fault consult so corrupt_frame's
+        // pristine-CRC capture sees the final header bytes; never redone
+        // (tx_ns != 0 guard), so the replay record stays byte-exact. A
+        // replayed frame therefore keeps its ORIGINAL stamp — transit
+        // measured across a loss/replay window is genuinely that long.
+        s->hdr.tx_ns = trace::NowSinceStartNs();
+        s->hdr.hcrc = wire::HeaderCrc(s->hdr);
+      }
       if (s->off == 0 && !s->raw && !s->fault_checked && recovery_armed_ &&
           fault::Enabled() && wire::Sequenced(s->hdr.magic)) {
         s->fault_checked = true;  // one consult per frame, whatever happens
@@ -1001,6 +1100,19 @@ class StreamTransport : public Transport {
       peer.sc_tx_frames++;
       if (!s->raw && s->hdr.magic == kMagic)
         peer.sc_tx_payload += s->hdr.bytes;
+      // Causal tracing (§14): queue time = enqueue -> fully on the wire,
+      // attributed per link and to the wire_queue_ns histogram; wire_tx
+      // marks the spanned frame's departure on this rank's trace timeline.
+      if (!s->raw && s->enq_ns != 0 && wire::Sequenced(s->hdr.magic)) {
+        const uint64_t now = trace::NowSinceStartNs();
+        const uint64_t queued = now > s->enq_ns ? now - s->enq_ns : 0;
+        peer.sc_tx_queue_ns += queued;
+        peer.sc_tx_queue_frames++;
+        if (metrics::Enabled())
+          metrics::Observe(metrics::kWireQueueNs, queued);
+      }
+      if (!s->raw && s->hdr.span != 0)
+        ACX_TRACE_SPAN("wire_tx", -1, s->hdr.span);
       if (s->raw) {
         ClearQueuedLocked(p, s->hdr.seq);
       } else if (recovery_armed_ && wire::Sequenced(s->hdr.magic)) {
@@ -1012,13 +1124,16 @@ class StreamTransport : public Transport {
       if (!s->raw) {
         switch (s->hdr.magic) {
           case kMagic:
-            ACX_FLIGHT(kTxData, -1, p, s->hdr.tag, s->hdr.seq, 0);
+            ACX_FLIGHT_SPAN(kTxData, -1, p, s->hdr.tag, s->hdr.seq, 0,
+                            s->hdr.span);
             break;
           case kMagicRts:
-            ACX_FLIGHT(kTxRts, -1, p, s->hdr.tag, s->hdr.seq, 0);
+            ACX_FLIGHT_SPAN(kTxRts, -1, p, s->hdr.tag, s->hdr.seq, 0,
+                            s->hdr.span);
             break;
           case kMagicAck:
-            ACX_FLIGHT(kTxAck, -1, p, s->hdr.tag, s->hdr.seq, 0);
+            ACX_FLIGHT_SPAN(kTxAck, -1, p, s->hdr.tag, s->hdr.seq, 0,
+                            s->hdr.span);
             break;
           case kMagicSeqAck:
             ACX_FLIGHT(kTxSeqAck, -1, p, -1, s->hdr.seq, 0);
@@ -1080,6 +1195,16 @@ class StreamTransport : public Transport {
         // before ANY field is trusted.
         if (!KnownMagic(in.hdr.magic) ||
             in.hdr.hcrc != wire::HeaderCrc(in.hdr)) {
+          // A v1 magic is a coherent OLD-protocol frame, not line noise:
+          // fail loudly with the version story instead of the generic
+          // desync path's "torn frame" framing. The link still tears down
+          // — mixed-version links can never resync (§14).
+          if (wire::KnownLegacyMagic(in.hdr.magic))
+            std::fprintf(stderr,
+                         "tpu-acx: rank %d: peer %d speaks wire protocol v1 "
+                         "(magic 0x%08x); this build is v2 (56-byte spanned "
+                         "header) — upgrade all ranks together\n",
+                         rank_, p, in.hdr.magic);
           StreamDesyncLocked(p);
           return;
         }
@@ -1228,6 +1353,8 @@ class StreamTransport : public Transport {
           continue;
         }
         if (recovery_armed_) BumpRxLocked(p, in.hdr.seq);
+        NoteFrameRxLocked(p, in.hdr);
+        NoteMatchLocked(in.hdr.span, r->span);
         // Wire scope: goodput is what the app receives (delivered bytes,
         // truncation excluded), not what crossed the wire.
         peer.sc_rx_payload += deliver;
@@ -1263,10 +1390,12 @@ class StreamTransport : public Transport {
         continue;
       }
       if (recovery_armed_) BumpRxLocked(p, in.hdr.seq);
+      NoteFrameRxLocked(p, in.hdr);
       if (in.hdr.magic == kMagicRts) {
         Msg m;
         m.tag = in.hdr.tag;
         m.ctx = in.hdr.ctx;
+        m.span = in.hdr.span;
         m.rv = true;
         memcpy(&m.rv_desc, in.payload.data(), sizeof m.rv_desc);
         m.rv_bytes = in.hdr.bytes;
@@ -1283,6 +1412,7 @@ class StreamTransport : public Transport {
         Msg m;
         m.tag = in.hdr.tag;
         m.ctx = in.hdr.ctx;
+        m.span = in.hdr.span;
         m.payload = std::move(in.payload);
         peer.sc_rx_payload += m.payload.size();  // wire scope
         peer.sc_rx_frames++;
@@ -1603,6 +1733,7 @@ class StreamTransport : public Transport {
         reply.magic != wire::kMagicHello ||
         reply.hcrc != wire::HeaderCrc(reply) || reply.tag != p ||
         reply.epoch < hello.epoch) {
+      WarnIfLegacyHello(p, reply.magic);
       close(fd);
       return;
     }
@@ -1639,6 +1770,7 @@ class StreamTransport : public Transport {
         reply.magic != wire::kMagicHello ||
         reply.hcrc != wire::HeaderCrc(reply) || reply.tag != p ||
         (reply.ctx & wire::kHelloJoin) == 0) {
+      WarnIfLegacyHello(p, reply.magic);
       close(fd);
       return false;
     }
@@ -1663,6 +1795,7 @@ class StreamTransport : public Transport {
           hello.magic != wire::kMagicHello ||
           hello.hcrc != wire::HeaderCrc(hello) || hello.tag < 0 ||
           hello.tag >= size_ || hello.tag == rank_) {
+        WarnIfLegacyHello(-1, hello.magic);
         close(fd);
         continue;
       }

@@ -14,15 +14,27 @@ namespace wire {
 
 // Frame classes. The low byte distinguishes them; the upper bytes are a
 // transport signature so a desynced stream is overwhelmingly likely to fail
-// the magic check even before the header CRC is consulted.
-constexpr uint32_t kMagic       = 0xAC0C0101;  // eager copy: header + payload
-constexpr uint32_t kMagicRts    = 0xAC0C0102;  // rendezvous RTS: header + RvDesc
-constexpr uint32_t kMagicAck    = 0xAC0C0103;  // rendezvous ACK: header + RvAck
-constexpr uint32_t kMagicHb     = 0xAC0C0104;  // heartbeat: header only
-constexpr uint32_t kMagicSeqAck = 0xAC0C0105;  // cumulative receive ack: header only
-constexpr uint32_t kMagicNak    = 0xAC0C0106;  // negative ack / re-pull: header only
-constexpr uint32_t kMagicHello  = 0xAC0C0107;  // reconnect/join handshake: header only
-constexpr uint32_t kMagicView   = 0xAC0C0108;  // fleet membership view: header only
+// the magic check even before the header CRC is consulted. The third byte is
+// the wire protocol VERSION: v2 (0xAC0C02xx) added the causal span id and tx
+// timestamp fields to the header (DESIGN.md §14). A v1 peer's frames still
+// pass the signature sieve — KnownLegacyMagic below — so version skew is
+// diagnosed loudly at the handshake/stream gate instead of desyncing.
+constexpr uint32_t kMagic       = 0xAC0C0201;  // eager copy: header + payload
+constexpr uint32_t kMagicRts    = 0xAC0C0202;  // rendezvous RTS: header + RvDesc
+constexpr uint32_t kMagicAck    = 0xAC0C0203;  // rendezvous ACK: header + RvAck
+constexpr uint32_t kMagicHb     = 0xAC0C0204;  // heartbeat: header only
+constexpr uint32_t kMagicSeqAck = 0xAC0C0205;  // cumulative receive ack: header only
+constexpr uint32_t kMagicNak    = 0xAC0C0206;  // negative ack / re-pull: header only
+constexpr uint32_t kMagicHello  = 0xAC0C0207;  // reconnect/join handshake: header only
+constexpr uint32_t kMagicView   = 0xAC0C0208;  // fleet membership view: header only
+
+// A frame class from the pre-span 40-byte protocol (v1, 0xAC0C01xx). Never
+// accepted — recognized only so the mismatch error can say "old peer"
+// instead of "stream desync".
+inline bool KnownLegacyMagic(uint32_t m) {
+  return (m & 0xFFFFFF00u) == 0xAC0C0100u && (m & 0xFFu) >= 0x01u &&
+         (m & 0xFFu) <= 0x08u;
+}
 
 // kMagicHello ctx bits. A plain reconnect hello (ctx == 0) resumes the
 // existing link incarnation; a JOIN hello announces a FRESH incarnation of
@@ -46,11 +58,16 @@ struct WireHeader {
   uint64_t seq;    // per-link monotonic sequence (kMagicHb: tx high-water;
                    //   kMagicSeqAck/kMagicNak: cumulative rx; kMagicHello:
                    //   sender's rx high-water for resume)
+  uint64_t span;   // causal span id of the op this frame serves (DESIGN.md
+                   //   §14): origin rank << 48 | slot << 32 | incarnation.
+                   //   0 = unspanned (control traffic, protocol internals)
+  uint64_t tx_ns;  // sender's trace::NowSinceStartNs() at the moment the
+                   //   frame's first byte went on the wire; 0 = unstamped
   uint32_t epoch;  // link incarnation (kMagicHello: proposed/agreed epoch)
   uint32_t hcrc;   // CRC32C of bytes [0, offsetof(hcrc)) of this header
 };
 #pragma pack(pop)
-static_assert(sizeof(WireHeader) == 40, "wire header is part of the protocol");
+static_assert(sizeof(WireHeader) == 56, "wire header is part of the protocol");
 
 // Incremental CRC32C (Castagnoli, reflected poly 0x82F63B78). Start with
 // crc=0; feeding a buffer in pieces gives the same result as one shot.

@@ -1,0 +1,344 @@
+"""Cross-rank causal tracing (include/acx/span.h, tools/acx_critpath.py,
+tools/acx_trace_merge.py, docs/DESIGN.md §14): span-exact wire pairing,
+barrier-anchored + link-refined clock alignment, critical-path
+reconstruction, and the dominant-edge report.
+
+The analyzer tests drive analyze() directly on hand-built traces with
+KNOWN clock offsets and transits, so every assertion has an exact
+expected value; the end-to-end behavior over real runs is covered by
+`make causality-check` (smoke-tested at the bottom) and the np=3 tests,
+which use real acxrun traces.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CRITPATH = os.path.join(REPO, "tools", "acx_critpath.py")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import acx_critpath  # noqa: E402
+import acx_trace_merge  # noqa: E402
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _built():
+    r = subprocess.run(["make", "-C", REPO, "itest", "tools"],
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# -- synthetic trace construction -------------------------------------------
+
+def _span(rank, slot, inc):
+    """include/acx/span.h layout."""
+    return (rank & 0xFFFF) << 48 | (slot & 0xFFFF) << 32 | inc
+
+
+def _ev(name, ts, slot=0, span=0):
+    e = {"name": name, "ph": "i", "s": "t", "pid": 0, "tid": slot,
+         "ts": float(ts)}
+    if span:
+        e["args"] = {"span": span}
+    return e
+
+
+S0 = _span(0, 0, 1)   # rank 0's send op
+S1 = _span(1, 0, 2)   # rank 1's reply op
+R1 = _span(1, 1, 1)   # rank 1's recv op (local span; wire carries S0)
+
+
+def _ping_traces(r1_clock_off=0.0, r0_barrier_early=0.0, stall_us=0.0,
+                 req_id=None):
+    """One serialized 0->1 ping and 1->0 reply with true one-way transit
+    10 µs, plus the barrier anchors the merge aligns on.
+
+    r1_clock_off:     added to every RAW rank-1 timestamp (clock skew the
+                      barrier anchor must recover).
+    r0_barrier_early: rank 0's barrier_exit instants fire this much
+                      BEFORE the true barrier release (the root-exits-
+                      first asymmetry the per-link refinement corrects).
+    stall_us:         extra send-side queueing before rank 0's wire_tx
+                      (and everything after it), like a stall fault.
+    req_id:           when set, a req_op instant brackets rank 0's send
+                      the way the serving layer's span_app_begin does.
+    """
+    st = stall_us
+    r0 = [_ev("barrier_exit", 0.0 - r0_barrier_early)]
+    if req_id is not None:
+        r0.append(_ev("req_op", 9, slot=0, span=req_id))
+    r0 += [
+        _ev("isend_enqueue", 10, slot=0, span=S0),
+        _ev("trigger_fired", 12, slot=0, span=S0),
+        _ev("isend_issued", 14, slot=0, span=S0),
+        _ev("wire_tx", 20 + st, slot=-1, span=S0),
+        _ev("wire_rx", 120 + st, slot=-1, span=S1),
+        _ev("op_completed", 122 + st, slot=0, span=S0),
+        _ev("wait_observed", 124 + st, slot=0, span=S0),
+        _ev("barrier_exit", 200 + st - r0_barrier_early),
+    ]
+    off = r1_clock_off
+    r1 = [
+        _ev("barrier_exit", 0 + off),
+        _ev("irecv_enqueue", 5 + off, slot=1, span=R1),
+        _ev("wire_rx", 30 + st + off, slot=-1, span=S0),
+        _ev("rx_from", 31 + st + off, slot=-1, span=S0),
+        _ev("rx_match", 31.5 + st + off, slot=1, span=R1),
+        _ev("op_completed", 32 + st + off, slot=1, span=R1),
+        _ev("wait_observed", 35 + st + off, slot=1, span=R1),
+        _ev("isend_enqueue", 40 + st + off, slot=0, span=S1),
+        _ev("isend_issued", 45 + st + off, slot=0, span=S1),
+        _ev("wire_tx", 110 + st + off, slot=-1, span=S1),
+        _ev("op_completed", 112 + st + off, slot=0, span=S1),
+        _ev("barrier_exit", 200 + st + off),
+    ]
+    return [(0, {"traceEvents": r0}), (1, {"traceEvents": r1})]
+
+
+# -- span pairing + transit -------------------------------------------------
+
+def test_pairing_and_transit_synced_clocks():
+    """With synced clocks both frames pair exactly (rate 1.0) and the
+    per-link medians are the true 10 µs transit in each direction."""
+    res = acx_critpath.analyze(_ping_traces())
+    assert res["paired_frames"] == 2
+    assert res["pair_rate"] == 1.0
+    assert res["unpaired_tx"] == 0 and res["unpaired_rx"] == 0
+    assert set(res["links"]) == {"0->1", "1->0"}
+    assert res["links"]["0->1"]["median_us"] == pytest.approx(10.0)
+    assert res["links"]["1->0"]["median_us"] == pytest.approx(10.0)
+    assert res["links"]["0->1"]["negative"] == 0
+    assert res["aligned"] is True
+
+
+def test_barrier_skew_recovers_clock_offset():
+    """A 5 ms raw clock offset on rank 1 disappears behind the barrier
+    anchor: transits still come out at the true 10 µs, not 5010."""
+    res = acx_critpath.analyze(_ping_traces(r1_clock_off=5000.0))
+    assert res["aligned"] is True
+    assert res["links"]["0->1"]["median_us"] == pytest.approx(10.0)
+    assert res["links"]["1->0"]["median_us"] == pytest.approx(10.0)
+
+
+def test_link_refinement_corrects_barrier_exit_asymmetry():
+    """When rank 0 exits the barrier 100 µs before the true release (the
+    root-exits-first bias), the anchor alone would make 0->1 transit
+    -90 µs. The per-link symmetric-median refinement must absorb the
+    bias: transits return to 10 µs and the fitted offset names it."""
+    res = acx_critpath.analyze(_ping_traces(r0_barrier_early=100.0))
+    assert res["links"]["0->1"]["median_us"] == pytest.approx(10.0)
+    assert res["links"]["1->0"]["median_us"] == pytest.approx(10.0)
+    assert res["links"]["0->1"]["negative"] == 0
+    assert res["link_offset_us"]["1"] == pytest.approx(100.0)
+
+
+def test_unpaired_frames_counted():
+    """A tx whose frame never showed up on the peer (dropped trace tail)
+    is reported as unpaired, not silently matched to something else."""
+    traces = _ping_traces()
+    r1 = traces[1][1]["traceEvents"]
+    traces[1] = (1, {"traceEvents":
+                     [e for e in r1 if e["name"] != "wire_rx"]})
+    res = acx_critpath.analyze(traces)
+    assert res["paired_frames"] == 1          # the 1->0 reply still pairs
+    assert res["unpaired_tx"] == 1            # S0's rx is gone
+    assert res["pair_rate"] == pytest.approx(0.5)
+
+
+# -- critical path ----------------------------------------------------------
+
+def test_critical_path_crosses_ranks():
+    """The serialized ping's path must cross 0->1 and back 1->0, and the
+    µs on the path equal the wall span it covers."""
+    res = acx_critpath.analyze(_ping_traces())
+    path = res["path"]
+    assert path, "empty path"
+    crossings = {e["link"] for e in path if e["kind"] == "transit"}
+    assert crossings == {"0->1", "1->0"}
+    # Contiguous walk: each edge starts where the previous ended.
+    for a, b in zip(path, path[1:]):
+        assert a["to"] == b["from"]
+    assert res["path_us"] == pytest.approx(
+        path[-1]["to"]["ts_us"] - path[0]["from"]["ts_us"])
+
+
+def test_stall_lands_on_tx_queue_edge_with_link():
+    """An injected 40 ms send-side stall surfaces as the longest single
+    edge: kind tx_queue, attributed to the 0->1 link via the paired rx
+    (the wire_tx instant fires at full write, AFTER the stall)."""
+    res = acx_critpath.analyze(_ping_traces(stall_us=40000.0))
+    le = res["longest_edge"]
+    assert le["kind"] == "tx_queue"
+    assert le["tx_link"] == "0->1"
+    assert le["dt_us"] == pytest.approx(40006.0)
+    assert res["dominant"][0]["edge"] == "txq 0->1"
+
+
+def test_request_split_brackets_ops():
+    """A req_op instant (the serving layer's request id) claims the next
+    enqueue on its slot; the report splits that op's latency into queue
+    (enqueue->issued) and wire (issued->completed) stages."""
+    res = acx_critpath.analyze(_ping_traces(req_id=77))
+    assert "77" in res["requests"]
+    req = res["requests"]["77"]
+    assert req["ops"] == 1
+    assert req["queue_us"] == pytest.approx(4.0)    # 14 - 10
+    assert req["wire_us"] == pytest.approx(108.0)   # 122 - 14
+
+
+# -- CLI contract -----------------------------------------------------------
+
+def _critpath(*argv):
+    return subprocess.run([sys.executable, CRITPATH, *argv],
+                          capture_output=True, text=True, timeout=120)
+
+
+def _write_traces(tmp_path, traces):
+    paths = []
+    for r, d in traces:
+        p = tmp_path / f"ping.rank{r}.trace.json"
+        p.write_text(json.dumps(d))
+        paths.append(str(p))
+    return paths
+
+
+def test_cli_missing_trace_is_skipped_not_fatal(tmp_path):
+    """A dead rank's missing trace is evidence, not an error: the
+    analyzer notes the skip on stderr and reports on the survivors."""
+    paths = _write_traces(tmp_path, _ping_traces())
+    r = _critpath("--json", paths[0], str(tmp_path / "ping.rank9.trace.json"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "skipping" in r.stderr
+    out = json.loads(r.stdout)
+    assert out["ranks"] == [0]
+    assert out["paired_frames"] == 0   # nothing to pair against
+
+
+def test_cli_expectation_flags_gate(tmp_path):
+    """--min-pair-rate / --expect-edge are real gates: they pass on the
+    good synthetic run and fail with a named reason when violated."""
+    paths = _write_traces(tmp_path, _ping_traces(stall_us=40000.0))
+    ok = _critpath("--min-pair-rate", "0.95", "--expect-nonneg-transit",
+                   "--expect-edge", "0->1", *paths)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = _critpath("--expect-edge", "1->0", *paths)
+    assert bad.returncode == 1
+    assert "expected link 1->0" in bad.stderr
+
+
+def test_cli_unspanned_traces_exit_2(tmp_path):
+    """Pre-span (v1) traces have no lifecycle spans at all: the analyzer
+    says so and exits 2 rather than printing an empty report."""
+    p = tmp_path / "old.rank0.trace.json"
+    p.write_text(json.dumps(
+        {"traceEvents": [_ev("barrier_exit", 1.0)]}))
+    r = _critpath(str(p))
+    assert r.returncode == 2
+    assert "no spanned lifecycle events" in r.stderr
+
+
+# -- np=3 barrier-skew alignment (acx_trace_merge) --------------------------
+
+def _np3_trace_files(tmp_path):
+    """Three synthetic rank traces whose clocks disagree by KNOWN
+    offsets (rank 1 +300 µs, rank 2 -40 µs), each with two barrier
+    anchors and one spanned instant between them."""
+    paths = []
+    for r, off in ((0, 0.0), (1, 300.0), (2, -40.0)):
+        d = {"traceEvents": [
+            _ev("barrier_exit", 10 + off),
+            _ev("isend_enqueue", 100 + off, slot=0, span=_span(r, 0, 1)),
+            _ev("barrier_exit", 500 + off),
+        ], "otherData": {"dropped": 0}}
+        p = tmp_path / f"run.rank{r}.trace.json"
+        p.write_text(json.dumps(d))
+        paths.append(p)
+    return paths
+
+
+def test_np3_merge_aligns_all_ranks(tmp_path):
+    """Three ranks with known clock offsets merge onto one timeline:
+    every rank gets the exact recovering skew and the merged stream is
+    time-sorted with the spanned instants landing at the same corrected
+    instant."""
+    paths = _np3_trace_files(tmp_path)
+    out = tmp_path / "merged.trace.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "acx_trace_merge.py"),
+         "--validate", "--out", str(out)] + [str(p) for p in paths],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    summary = json.loads(r.stdout)
+    assert summary["valid"], summary
+    # target = slowest last anchor (rank 1's 800): exact recovery.
+    assert summary["skew_us"] == {"0": pytest.approx(300.0),
+                                  "1": pytest.approx(0.0),
+                                  "2": pytest.approx(340.0)}
+    d = json.loads(out.read_text())
+    assert d["otherData"]["ranks"] == [0, 1, 2]
+    ts = [e["ts"] for e in d["traceEvents"] if "ts" in e]
+    assert ts == sorted(ts)
+    enq = [e["ts"] for e in d["traceEvents"]
+           if e.get("name") == "isend_enqueue"]
+    assert enq == [pytest.approx(400.0)] * 3
+
+
+def test_np3_merge_survives_missing_rank(tmp_path):
+    """Delete rank 2's trace (it 'died before flushing'): the survivors
+    still merge aligned, and the gap is recorded as evidence."""
+    paths = _np3_trace_files(tmp_path)
+    paths[2].unlink()
+    out = tmp_path / "merged.trace.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "acx_trace_merge.py"),
+         "--validate", "--out", str(out)] + [str(p) for p in paths],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    summary = json.loads(r.stdout)
+    assert summary["valid"], summary
+    assert summary["skew_us"] == {"0": pytest.approx(300.0),
+                                  "1": pytest.approx(0.0)}
+    assert [m["rank"] for m in summary["missing"]] == [2]
+    d = json.loads(out.read_text())
+    assert d["otherData"]["missing_ranks"] == [2]
+
+
+def test_compute_skew_exact_on_synthetic_np3():
+    """compute_skew anchors on the LAST common barrier_exit: known
+    per-rank offsets come back exactly, against the slowest rank."""
+    traces = []
+    for r, off in ((0, 0.0), (1, 300.0), (2, -40.0)):
+        traces.append((r, {"traceEvents": [
+            _ev("barrier_exit", 10 + off),
+            _ev("barrier_exit", 500 + off),
+        ]}))
+    skew = acx_trace_merge.compute_skew(traces)
+    # target = max anchor = rank 1's 800; skew[r] = target - anchor[r].
+    assert skew[1] == pytest.approx(0.0)
+    assert skew[0] == pytest.approx(300.0)
+    assert skew[2] == pytest.approx(340.0)
+
+
+def test_compute_skew_none_without_common_anchor():
+    """A rank that never reached a barrier (no common anchors) cannot be
+    aligned — skew is None for everyone, never silently wrong."""
+    traces = [(0, {"traceEvents": [_ev("barrier_exit", 10)]}),
+              (1, {"traceEvents": [_ev("isend_enqueue", 5, span=S0)]})]
+    skew = acx_trace_merge.compute_skew(traces)
+    assert skew == {0: None, 1: None}
+
+
+# -- make target ------------------------------------------------------------
+
+def test_makefile_causality_check_target():
+    """`make causality-check` (wired into `make check`) goes green: the
+    clean leg pairs >= 95% of frames with non-negative median transit,
+    and the stalled leg names the 0->1 link as dominant."""
+    r = subprocess.run(["make", "-C", REPO, "causality-check"],
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "CAUSALITY CHECK PASSED" in r.stdout

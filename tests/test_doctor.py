@@ -79,6 +79,74 @@ def test_posted_recv_is_not_unmatched():
     assert doctor.diagnose(dumps)["anomaly"] == "none"
 
 
+SP = (3 << 32) | 1  # span of rank 0, slot 3, incarnation 1
+
+
+def test_span_exact_unmatched_send():
+    # v2 dumps: rank 1 RECEIVED the frame carrying rank 0's send span
+    # (rx_frame row) yet never posted a recv — the diagnosis is
+    # span-exact, no heuristic involved.
+    dumps = {
+        0: _dump(0, slots=[
+            dict(_slot(3, "ISSUED", "isend", peer=1, tag=5), span=SP)]),
+        1: _dump(1, events=[
+            _event("init", -1, 1),
+            dict(_event("rx_frame", -1, 0, 5), span=SP)]),
+    }
+    diag = doctor.diagnose(dumps)
+    assert diag["anomaly"] == "unmatched_send"
+    assert diag["culprit"] == 1
+    assert "span-exact" in diag["detail"]
+    assert "no heuristic" in diag["detail"]
+
+
+def test_span_pair_conflict_when_heuristic_disagrees():
+    # Rank 1 posted a recv that matches (peer, tag) — the heuristic
+    # calls the op paired — but NO frame carrying the send's span ever
+    # arrived: the bytes are lost in flight, and the disagreement itself
+    # is the anomaly (a heuristic-only doctor would have mis-paired).
+    dumps = {
+        0: _dump(0, slots=[
+            dict(_slot(3, "ISSUED", "isend", peer=1, tag=5), span=SP)]),
+        1: _dump(1, slots=[
+            dict(_slot(0, "ISSUED", "irecv", peer=0, tag=5),
+                 span=(1 << 48) | 7)]),
+    }
+    diag = doctor.diagnose(dumps)
+    assert diag["anomaly"] == "span_pair_conflict"
+    assert diag["culprit"] == 0
+    assert "lost in flight" in diag["detail"]
+
+
+def test_span_arrived_and_matched_is_not_an_anomaly():
+    # Frame arrived AND the recv is posted: a slow run, nothing to report
+    # — the span evidence and the heuristic agree.
+    dumps = {
+        0: _dump(0, slots=[
+            dict(_slot(3, "ISSUED", "isend", peer=1, tag=5), span=SP)]),
+        1: _dump(1, slots=[
+            dict(_slot(0, "ISSUED", "irecv", peer=0, tag=5),
+                 span=(1 << 48) | 7)],
+                 events=[dict(_event("rx_frame", -1, 0, 5), span=SP)]),
+    }
+    assert doctor.diagnose(dumps)["anomaly"] == "none"
+
+
+def test_pre_span_dumps_keep_heuristic_fallback():
+    # The peer's dump is from a pre-span build (no span anywhere): the
+    # span-exact step must stand aside and the (peer, tag) heuristic
+    # still names the missing receiver, with its own wording.
+    dumps = {
+        0: _dump(0, slots=[
+            dict(_slot(3, "ISSUED", "isend", peer=1, tag=5), span=SP)]),
+        1: _dump(1, events=[_event("init", -1, 1)]),
+    }
+    diag = doctor.diagnose(dumps)
+    assert diag["anomaly"] == "unmatched_send"
+    assert diag["culprit"] == 1
+    assert "span-exact" not in diag["detail"]
+
+
 def test_never_published_partition_blames_sender():
     # Rank 1 polls partition 1 from rank 0; rank 0 holds the matching
     # send partition RESERVED with no pready_mark in its history.

@@ -304,6 +304,54 @@ def test_merge_tool_tseries_unaligned_without_traces(tmp_path):
     assert all(s["corrected_us"] is None for s in d["samples"])
 
 
+def test_merge_tool_tseries_app_by_rank(tmp_path):
+    """The application SLO fragment (tseries_annotate) survives the
+    fleet merge rank-tagged: each merged sample keeps its own "app"
+    section, and the NEWEST fragment per rank is surfaced as a
+    fleet-level app_by_rank summary — so "which rank's serving loop
+    reports the worst p99" is one lookup, not a scan."""
+    def _line(rank, seq, t_ns, app=None, init=False):
+        s = {"seq": seq, "t_mono_ns": t_ns, "t_wall_ms": t_ns // 10**6 + 1,
+             "epoch": 0}
+        if init:
+            s.update({"init": True, "rank": rank, "interval_ms": 50,
+                      "counters": {}})
+        else:
+            s["d"] = {}
+        if app is not None:
+            s["app"] = app
+        return json.dumps(s)
+
+    f0 = tmp_path / "run.rank0.tseries.jsonl"
+    f0.write_text("\n".join([
+        _line(0, 0, 1000, init=True),
+        _line(0, 1, 2000, app={"queue_depth": 9, "ttft_p99_s": 0.5}),
+        _line(0, 2, 3000, app={"queue_depth": 2, "ttft_p99_s": 0.1}),
+    ]) + "\n")
+    f1 = tmp_path / "run.rank1.tseries.jsonl"
+    f1.write_text("\n".join([
+        _line(1, 0, 1000, init=True),
+        _line(1, 1, 2500, app={"queue_depth": 7}),
+    ]) + "\n")
+
+    fleet = tmp_path / "fleet.tseries.json"
+    r = subprocess.run(
+        [sys.executable, MERGE, "--tseries-out", str(fleet),
+         str(f0), str(f1)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    summary = json.loads(r.stdout)
+    assert summary["tseries_app_ranks"] == [0, 1]
+
+    d = json.loads(fleet.read_text())
+    # Newest fragment per rank wins the summary...
+    assert d["app_by_rank"]["0"] == {"queue_depth": 2, "ttft_p99_s": 0.1}
+    assert d["app_by_rank"]["1"] == {"queue_depth": 7}
+    # ...and every sample still carries its own fragment verbatim.
+    r0_apps = [s.get("app") for s in d["samples"] if s["rank"] == 0]
+    assert {"queue_depth": 9, "ttft_p99_s": 0.5} in r0_apps
+
+
 # -- make target ------------------------------------------------------------
 
 

@@ -1,0 +1,521 @@
+#!/usr/bin/env python3
+"""acx_critpath — cross-rank critical-path analyzer for spanned ACX traces.
+
+Every op the runtime mints carries a 64-bit causal span id (origin rank,
+op slot, op incarnation — include/acx/span.h) that rides the wire in the
+frame header and is stamped into the trace ring at every lifecycle
+transition on BOTH sides (docs/DESIGN.md §14). That turns per-rank
+``<prefix>.rank<r>.trace.json`` files (src/core/trace.cc) into one causal
+graph:
+
+  * within a span, the lifecycle instants chain on the origin rank:
+    isend_enqueue → trigger_fired → isend_issued → wire_tx →
+    op_completed → wait_observed (recv flavor analogous);
+  * across ranks, each ``wire_tx`` pairs with the ``wire_rx`` carrying
+    the SAME span id on the peer (n-th with n-th in corrected-time order
+    — a rendezvous span has an RTS, ACK and DATA frame, causally
+    ordered); the edge weight is the one-way transit;
+  * on the receiver, the back-to-back ``rx_from``/``rx_match`` instant
+    pair (emitted under the transport lock, so each rx_from pairs with
+    the NEXT rx_match in that rank's stream) bridges the sender's span
+    chain into the local recv op's chain;
+  * ``req_op`` instants tie an application request id (the span the
+    serving layer brackets with acx_span_app_begin) to each native op
+    minted inside the bracket, so a request's latency decomposes into
+    queue vs compute vs wire.
+
+Clock alignment starts from the barrier-anchored skew that
+tools/acx_trace_merge.py owns (compute_skew — the LAST common
+barrier_exit is the anchor); this tool never re-derives that base. The
+barrier anchor is only as tight as the barrier's own exit asymmetry
+(the release reaches the root one op-latency before everyone else —
+several hundred µs through the proxy/wait machinery, dwarfing a
+localhost one-way transit), so a second, fine correction is fit from
+the span-paired frames themselves: per link, the median transit must be
+symmetric in the two directions (the NTP offset assumption), and the
+residual per-rank offset that symmetrizes each link is propagated over
+a BFS tree from the lowest rank. Both components are reported
+separately (``barrier_skew_us`` + ``link_offset_us`` = ``skew_us``).
+The median is robust to injected stalls — one 40 ms frame among a
+hundred does not move it.
+
+The critical path is reconstructed backward from the globally last event
+by last-arrival: at each cross-rank receive the predecessor is whichever
+of (previous local event, paired remote transmit) happened LATER on the
+corrected timeline — the classic message-passing critical-path walk. The
+not-chosen arrival's headroom is the edge's slack. The result is the
+longest causal chain of the step, each edge labeled with its stage
+(trigger / proxy_pickup / tx_queue / transit / match / deliver /
+wait_pickup / app) and, for wire edges, its link ("0->1").
+
+Usage:
+    python3 tools/acx_critpath.py [--top K] [--json]
+        [--min-pair-rate F] [--expect-nonneg-transit]
+        [--expect-edge A->B]
+        run.rank0.trace.json run.rank1.trace.json ...
+
+``--expect-*`` / ``--min-pair-rate`` make the tool a CI oracle (`make
+causality-check`): exit 0 iff the assertions hold. Exits 2 when no
+spanned events exist at all (tracing was off, or a pre-span build).
+"""
+
+import argparse
+import collections
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from acx_trace_merge import compute_skew, load, parse_rank  # noqa: E402
+
+# Lifecycle instants that participate in the causal graph. Everything
+# else (barrier_exit, heartbeats, fleet events) anchors clocks or is
+# noise for path purposes.
+CHAIN_EVENTS = frozenset([
+    "isend_enqueue", "irecv_enqueue", "req_op", "trigger_fired",
+    "isend_issued", "irecv_issued", "wire_tx", "wire_rx", "rx_from",
+    "rx_match", "op_completed", "wait_observed", "pready_marked",
+    "pready_wire", "parrived",
+])
+
+# Stage label for a same-rank edge, by (predecessor name, successor name).
+# Pairs not listed degrade to "local" — still on the path, just untyped.
+EDGE_KIND = {
+    ("isend_enqueue", "trigger_fired"): "trigger",
+    ("irecv_enqueue", "trigger_fired"): "trigger",
+    ("trigger_fired", "isend_issued"): "proxy_pickup",
+    ("trigger_fired", "irecv_issued"): "proxy_pickup",
+    ("isend_issued", "wire_tx"): "tx_queue",
+    ("irecv_issued", "wire_tx"): "tx_queue",
+    ("wire_rx", "rx_from"): "demux",
+    ("rx_from", "rx_match"): "match",
+    ("rx_match", "op_completed"): "deliver",
+    ("wire_rx", "op_completed"): "deliver",
+    ("op_completed", "wait_observed"): "wait_pickup",
+    ("wait_observed", "isend_enqueue"): "app",
+    ("wait_observed", "irecv_enqueue"): "app",
+}
+
+
+class Ev:
+    __slots__ = ("rank", "name", "ts", "slot", "span", "idx", "pair",
+                 "pair_rx")
+
+    def __init__(self, rank, name, ts, slot, span):
+        self.rank = rank
+        self.name = name
+        self.ts = ts          # corrected µs
+        self.slot = slot
+        self.span = span
+        self.idx = -1         # position in the per-rank chain
+        self.pair = None      # wire_rx -> its paired wire_tx Ev
+        self.pair_rx = None   # wire_tx -> its paired wire_rx Ev
+
+
+def span_rank(span):
+    """Origin-rank field of a span id (include/acx/span.h layout)."""
+    return (span >> 48) & 0xFFFF
+
+
+def extract_events(rank, trace, shift):
+    """Spanned + chain instants of one rank, time-shifted onto the
+    common timeline. Synthesized "b"/"e" lifecycle bars are skipped —
+    the instants they were derived from are already here."""
+    out = []
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "i" or e.get("name") not in CHAIN_EVENTS:
+            continue
+        span = int(e.get("args", {}).get("span", 0))
+        out.append(Ev(rank, e["name"], float(e["ts"]) + shift,
+                      int(e.get("tid", -1)), span))
+    out.sort(key=lambda ev: ev.ts)
+    for i, ev in enumerate(out):
+        ev.idx = i
+    return out
+
+
+def pair_wire(chains):
+    """Pair wire_tx with wire_rx per span, n-th with n-th in corrected
+    time order (the frames of one span — RTS, ACK, DATA — are causally
+    ordered, so index order IS causal order on each side). A pair must
+    straddle ranks; same-rank pseudo-pairs (shouldn't happen) are
+    rejected and counted as unpaired. Returns pairing stats."""
+    txs = collections.defaultdict(list)
+    rxs = collections.defaultdict(list)
+    for chain in chains.values():
+        for ev in chain:
+            if ev.span == 0:
+                continue
+            if ev.name == "wire_tx":
+                txs[ev.span].append(ev)
+            elif ev.name == "wire_rx":
+                rxs[ev.span].append(ev)
+    paired = unpaired_tx = unpaired_rx = cross_rank_reject = 0
+    transits = []  # (tx_ev, rx_ev, dt_us)
+    for span in set(txs) | set(rxs):
+        t, r = txs.get(span, []), rxs.get(span, [])
+        t.sort(key=lambda ev: ev.ts)
+        r.sort(key=lambda ev: ev.ts)
+        for i in range(min(len(t), len(r))):
+            if t[i].rank == r[i].rank:
+                cross_rank_reject += 1
+                continue
+            r[i].pair = t[i]
+            t[i].pair_rx = r[i]
+            paired += 1
+            transits.append((t[i], r[i], r[i].ts - t[i].ts))
+        unpaired_tx += max(0, len(t) - len(r))
+        unpaired_rx += max(0, len(r) - len(t))
+    return {"paired": paired, "unpaired_tx": unpaired_tx,
+            "unpaired_rx": unpaired_rx,
+            "cross_rank_reject": cross_rank_reject,
+            "transits": transits}
+
+
+def link_offsets(transits, ranks):
+    """Fine per-rank clock offsets (µs) on top of the barrier skew.
+
+    The barrier anchor leaves a residual equal to the barrier's exit
+    asymmetry; the wire pairs expose it: with symmetric true transit,
+    measured median(a->b) = true + bias and median(b->a) = true - bias,
+    so shifting b by (med(b->a) - med(a->b)) / 2 symmetrizes the link.
+    Offsets propagate from the lowest rank over a BFS tree of links that
+    saw traffic BOTH ways; a rank reachable by no such link keeps 0."""
+    by = collections.defaultdict(list)
+    for tx, rx, dt in transits:
+        by[(tx.rank, rx.rank)].append(dt)
+    med = {}
+    for k, v in by.items():
+        v.sort()
+        med[k] = v[len(v) // 2]
+    delta = {}
+    if ranks:
+        root = min(ranks)
+        delta[root] = 0.0
+        frontier = [root]
+        while frontier:
+            a = frontier.pop(0)
+            for b in ranks:
+                if b in delta or (a, b) not in med or (b, a) not in med:
+                    continue
+                delta[b] = delta[a] + (med[(b, a)] - med[(a, b)]) / 2.0
+                frontier.append(b)
+    for r in ranks:
+        delta.setdefault(r, 0.0)
+    return delta
+
+
+def link_stats(transits):
+    """Per-link one-way transit summary: {"0->1": {n, min/median/max µs,
+    negative-after-correction count}}. Negatives are skew-correction
+    residue — reported, and clamped to 0 only by consumers that need a
+    duration, never here."""
+    by_link = collections.defaultdict(list)
+    for tx, rx, dt in transits:
+        by_link[f"{tx.rank}->{rx.rank}"].append(dt)
+    out = {}
+    for link, dts in sorted(by_link.items()):
+        dts.sort()
+        out[link] = {
+            "frames": len(dts),
+            "min_us": dts[0],
+            "median_us": dts[len(dts) // 2],
+            "max_us": dts[-1],
+            "negative": sum(1 for d in dts if d < 0),
+        }
+    return out
+
+
+def critical_path(chains):
+    """Backward last-arrival walk from the globally latest event.
+
+    At a paired wire_rx the predecessor is whichever of (previous event
+    on this rank, the paired wire_tx on the sender) is LATER — the
+    later arrival is what the receive actually waited for; the earlier
+    one's headroom is recorded as the edge's slack. Everywhere else the
+    predecessor is simply the previous chain event on the same rank.
+    Returns the path as a list of edge dicts, earliest first."""
+    last = None
+    for chain in chains.values():
+        if chain and (last is None or chain[-1].ts > last.ts):
+            last = chain[-1]
+    if last is None:
+        return []
+    edges = []
+    cur = last
+    # Visited guard: with pathological skew residue a paired tx can sort
+    # AFTER its rx, which could otherwise cycle the walk. Real runs never
+    # trip this; a synthetic adversarial trace must still terminate.
+    seen = set()
+    while True:
+        if (cur.rank, cur.idx) in seen:
+            break
+        seen.add((cur.rank, cur.idx))
+        local = (chains[cur.rank][cur.idx - 1] if cur.idx > 0 else None)
+        remote = cur.pair
+        cand = [c for c in (local, remote) if c is not None]
+        if not cand:
+            break
+        pred = max(cand, key=lambda ev: ev.ts)
+        cross = pred.rank != cur.rank
+        if cross:
+            kind = "transit"
+            link = f"{pred.rank}->{cur.rank}"
+        else:
+            kind = EDGE_KIND.get((pred.name, cur.name), "local")
+            link = None
+            # Whatever preceded a wire_tx locally, the gap before it is
+            # send-side queueing of THAT frame (the instant fires when
+            # the frame is fully on the wire, so an injected stall or a
+            # backed-up socket lands here, on its link).
+            if cur.name == "wire_tx":
+                kind = "tx_queue"
+        edge = {
+            "from": {"rank": pred.rank, "name": pred.name, "ts_us": pred.ts,
+                     "span": pred.span},
+            "to": {"rank": cur.rank, "name": cur.name, "ts_us": cur.ts,
+                   "span": cur.span},
+            "dt_us": cur.ts - pred.ts,
+            "kind": kind,
+            "link": link,
+        }
+        # Any edge that ENDS at a paired receive was, one way or the
+        # other, time spent waiting for that link's frame — record the
+        # link even when the local predecessor won the last-arrival race
+        # (--expect-edge matches either attribution).
+        if cur.pair is not None:
+            edge["rx_link"] = f"{cur.pair.rank}->{cur.rank}"
+        if cur.pair_rx is not None:
+            edge["tx_link"] = f"{cur.rank}->{cur.pair_rx.rank}"
+        # Slack at the merge point: how much later the NOT-chosen
+        # arrival could have been without delaying this event.
+        if local is not None and remote is not None:
+            loser = remote if pred is local else local
+            edge["slack_us"] = pred.ts - loser.ts
+            if pred is local:
+                edge["slack_of"] = f"transit {remote.rank}->{cur.rank}"
+            else:
+                edge["slack_of"] = f"local {cur.rank}"
+        edges.append(edge)
+        cur = pred
+    edges.reverse()
+    return edges
+
+
+def dominant_edges(path, top):
+    """Aggregate on-path time by stage (wire edges keyed by link, local
+    edges by kind@rank); return the top-k plus the single longest edge."""
+    agg = collections.Counter()
+    for e in path:
+        if e["link"]:
+            key = e["link"]
+        elif e["kind"] == "tx_queue" and e.get("tx_link"):
+            key = "txq " + e["tx_link"]
+        else:
+            key = f"{e['kind']}@{e['to']['rank']}"
+        agg[key] += e["dt_us"]
+    ranked = [{"edge": k, "total_us": v} for k, v in agg.most_common(top)]
+    longest = max(path, key=lambda e: e["dt_us"], default=None)
+    return ranked, longest
+
+
+def request_split(chains):
+    """Per-application-request latency decomposition. A req_op instant
+    (span = the request id the serving layer bracketed) precedes the op
+    enqueue it annotates on the SAME slot; the op's span then owns the
+    stage timings. Returns {req_id: {ops, queue_us, wire_us}}."""
+    # req_op -> the next enqueue on the same (rank, slot).
+    op_to_req = {}
+    for chain in chains.values():
+        pending = {}  # slot -> req id
+        for ev in chain:
+            if ev.name == "req_op":
+                pending[ev.slot] = ev.span
+            elif ev.name in ("isend_enqueue", "irecv_enqueue") \
+                    and ev.slot in pending:
+                op_to_req[ev.span] = pending.pop(ev.slot)
+    if not op_to_req:
+        return {}
+    # Stage sums per op span: queue = enqueue->issued, wire = issued->
+    # completed (covers tx queue + transit + peer match).
+    stamps = collections.defaultdict(dict)
+    for chain in chains.values():
+        for ev in chain:
+            if ev.span in op_to_req and ev.name != "req_op":
+                stamps[ev.span].setdefault(ev.name, ev.ts)
+    out = collections.defaultdict(
+        lambda: {"ops": 0, "queue_us": 0.0, "wire_us": 0.0})
+    for span, st in stamps.items():
+        req = out[str(op_to_req[span])]
+        req["ops"] += 1
+        enq = st.get("isend_enqueue", st.get("irecv_enqueue"))
+        iss = st.get("isend_issued", st.get("irecv_issued"))
+        done = st.get("op_completed")
+        if enq is not None and iss is not None:
+            req["queue_us"] += max(0.0, iss - enq)
+        if iss is not None and done is not None:
+            req["wire_us"] += max(0.0, done - iss)
+    return dict(out)
+
+
+def format_report(result, top_edges, longest):
+    lines = ["acx critpath: %d rank(s), %d spanned events, "
+             "%d/%d frames paired (%.1f%%)" % (
+                 len(result["ranks"]), result["events"],
+                 result["paired_frames"], result["total_frames"],
+                 100.0 * result["pair_rate"])]
+    for link, st in result["links"].items():
+        lines.append(
+            "  link %s: %d frame(s), transit min/median/max "
+            "%.1f/%.1f/%.1f µs, %d negative after skew correction"
+            % (link, st["frames"], st["min_us"], st["median_us"],
+               st["max_us"], st["negative"]))
+    path = result["path"]
+    lines.append("critical path: %d edge(s), %.1f µs end to end"
+                 % (len(path), result["path_us"]))
+    for e in path[-min(len(path), 40):]:
+        where = e["link"] if e["link"] else "rank %d" % e["to"]["rank"]
+        slack = (", slack %.1f µs (%s)" % (e["slack_us"], e["slack_of"])
+                 if "slack_us" in e else "")
+        lines.append("  %-12s %-7s %10.1f µs  %s -> %s%s"
+                     % (e["kind"], where, e["dt_us"], e["from"]["name"],
+                        e["to"]["name"], slack))
+    lines.append("dominant edges:")
+    for d in top_edges:
+        lines.append("  %-16s %10.1f µs" % (d["edge"], d["total_us"]))
+    if longest is not None:
+        where = longest["link"] or "rank %d" % longest["to"]["rank"]
+        lines.append("longest single edge: %s (%s) %.1f µs"
+                     % (longest["kind"], where, longest["dt_us"]))
+    for req, split in sorted(result.get("requests", {}).items()):
+        lines.append("  request %s: %d op(s), queue %.1f µs, wire %.1f µs"
+                     % (req, split["ops"], split["queue_us"],
+                        split["wire_us"]))
+    return "\n".join(lines)
+
+
+def analyze(traces, top=5):
+    """traces: list of (rank, trace_dict). Returns the full result dict
+    (the --json output) — separated from main() so tests drive it
+    directly on synthetic traces."""
+    skew = compute_skew(traces)
+    # Pass 1 on the barrier-anchored timeline: pair the wire frames so
+    # the fine per-link offsets can be fit from them.
+    chains = {}
+    for r, d in traces:
+        chains[r] = extract_events(r, d, skew[r] or 0.0)
+    offsets = link_offsets(pair_wire(chains)["transits"],
+                           sorted(chains))
+    # Pass 2 on the refined timeline: everything reported below —
+    # transits, the path, the dominant edges — uses the combined shift.
+    chains = {}
+    for r, d in traces:
+        chains[r] = extract_events(r, d, (skew[r] or 0.0) + offsets[r])
+    n_events = sum(len(c) for c in chains.values())
+    wire = pair_wire(chains)
+    total = wire["paired"] + wire["unpaired_tx"] + wire["unpaired_rx"] \
+        + wire["cross_rank_reject"]
+    path = critical_path(chains)
+    top_edges, longest = dominant_edges(path, top)
+    result = {
+        "ranks": sorted(chains),
+        "barrier_skew_us": {str(r): skew[r] for r in skew},
+        "link_offset_us": {str(r): offsets[r] for r in offsets},
+        "skew_us": {str(r): (skew[r] or 0.0) + offsets[r] for r in skew},
+        "aligned": all(s is not None for s in skew.values())
+        if len(traces) > 1 else False,
+        "events": n_events,
+        "paired_frames": wire["paired"],
+        "total_frames": total,
+        "pair_rate": (wire["paired"] / total) if total else 0.0,
+        "unpaired_tx": wire["unpaired_tx"],
+        "unpaired_rx": wire["unpaired_rx"],
+        "links": link_stats(wire["transits"]),
+        "path": path,
+        "path_us": sum(e["dt_us"] for e in path),
+        "dominant": top_edges,
+        "longest_edge": longest,
+        "requests": request_split(chains),
+    }
+    return result
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Reconstruct the cross-rank critical path from "
+                    "spanned ACX traces.")
+    ap.add_argument("inputs", nargs="+",
+                    help="per-rank *.trace.json files")
+    ap.add_argument("--top", type=int, default=5,
+                    help="how many dominant edges to report (default 5)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full analysis as one JSON object")
+    ap.add_argument("--min-pair-rate", type=float, default=None,
+                    metavar="F",
+                    help="exit nonzero unless >= F of wire frames are "
+                         "span-paired across ranks (e.g. 0.95)")
+    ap.add_argument("--expect-nonneg-transit", action="store_true",
+                    help="exit nonzero if any link's MEDIAN one-way "
+                         "transit is negative after skew correction")
+    ap.add_argument("--expect-edge", default=None, metavar="A->B",
+                    help="exit nonzero unless the longest single "
+                         "critical-path edge is on link A->B")
+    args = ap.parse_args(argv)
+
+    traces = []
+    for i, p in enumerate(args.inputs):
+        try:
+            traces.append((parse_rank(p, i), load(p)))
+        except (OSError, json.JSONDecodeError) as exc:
+            # Same contract as the merge tool: a dead rank's missing
+            # trace is evidence, not an error in the survivors.
+            print("acx_critpath: skipping %s (%s)" % (p, exc),
+                  file=sys.stderr)
+    if not traces:
+        print("acx_critpath: no readable traces", file=sys.stderr)
+        return 2
+
+    result = analyze(traces, top=args.top)
+    if result["events"] == 0:
+        print("acx_critpath: no spanned lifecycle events in %d trace(s) "
+              "— was ACX_TRACE set, and is this a spanned (v2) build?"
+              % len(traces), file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(result, indent=1))
+    else:
+        print(format_report(result, result["dominant"],
+                            result["longest_edge"]))
+
+    fail = []
+    if args.min_pair_rate is not None \
+            and result["pair_rate"] < args.min_pair_rate:
+        fail.append("pair rate %.3f < required %.3f (%d unpaired tx, "
+                    "%d unpaired rx)"
+                    % (result["pair_rate"], args.min_pair_rate,
+                       result["unpaired_tx"], result["unpaired_rx"]))
+    if args.expect_nonneg_transit:
+        for link, st in result["links"].items():
+            if st["median_us"] < 0:
+                fail.append("link %s median transit %.1f µs < 0 after "
+                            "skew correction" % (link, st["median_us"]))
+        if not result["links"]:
+            fail.append("no cross-rank frame pairs to measure transit on")
+    if args.expect_edge is not None:
+        le = result["longest_edge"]
+        got = (le.get("link") or le.get("rx_link")
+               or le.get("tx_link")) if le else None
+        if got != args.expect_edge:
+            fail.append("longest edge is %s (%s), expected link %s"
+                        % (le["kind"] if le else "none", got,
+                           args.expect_edge))
+    if not result["path"]:
+        fail.append("critical path is empty")
+    for f in fail:
+        print("acx_critpath: FAIL " + f, file=sys.stderr)
+    return 1 if fail else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

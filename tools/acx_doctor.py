@@ -23,6 +23,12 @@ entry count — and prints a diagnosis naming one of:
                                reserved but never MPIX_Pready'd
     tag_mismatch               both sides stuck on each other with
                                different tags
+    span_pair_conflict         the (peer, tag) heuristic and the wire
+                               span ids disagree: the peer posted what
+                               LOOKS like a matching recv, but the frame
+                               carrying the send's span id never arrived
+                               — the bytes were lost in flight, and the
+                               heuristic alone would have mis-paired
     unmatched_send             a send in flight toward a rank that never
                                posted a matching recv
     unmatched_recv             a recv posted for a message the source
@@ -37,6 +43,16 @@ recv / never sent, the rank missing from the barrier. When several
 anomalies coexist the most causal one wins (a dead link explains stuck
 ops; a never-published partition explains a stuck parrived poll), in the
 priority order listed above.
+
+Pairing is span-exact when the dumps allow it: every op minted by a v2
+build carries a causal span id (docs/DESIGN.md §14) that rides the wire
+in the frame header, and the receiver records each arriving frame as an
+``rx_frame`` event tagged with the SENDER's span. So a stuck send with
+span S is matched against the peer's rx_frame spans — an exact identity
+check, no guessing. The (peer, tag, bytes) heuristic remains only as
+the fallback for dumps from pre-span builds (or spanless control ops),
+and when the two methods disagree the disagreement itself is reported
+(``span_pair_conflict``) instead of silently trusting either.
 
 Usage:
     python3 tools/acx_doctor.py [--json] [--expect-culprit N]
@@ -88,6 +104,27 @@ def _events(dump, kind=None):
     if kind is None:
         return evs
     return [e for e in evs if e.get("kind") == kind]
+
+
+def _carries_spans(dump):
+    """True iff this dump comes from a span-aware (v2) build: any event
+    or slot row with a nonzero span id. Dumps from older builds (all
+    spans absent or zero) keep the pure-heuristic diagnosis path."""
+    for e in dump.get("events", []):
+        if e.get("span"):
+            return True
+    for s in dump.get("slots", []):
+        if s.get("span"):
+            return True
+    return False
+
+
+def _rx_spans(dump):
+    """Span ids of every frame this rank RECEIVED (rx_frame is recorded
+    for each arriving sequenced frame with the sender's span off the
+    wire; rx_data covers the shm plane's direct deliveries)."""
+    return {e["span"] for e in dump.get("events", [])
+            if e.get("kind") in ("rx_frame", "rx_data") and e.get("span")}
 
 
 def _has_recv_for(dump, src, tag):
@@ -254,7 +291,43 @@ def diagnose(dumps):
                         "only has a recv posted for tag=%s"
                         % (rank, s.get("tag"), dst, r.get("tag")))
 
-    # 5. unmatched send: the destination never posted a matching recv.
+    # 5. span-exact send pairing (docs/DESIGN.md §14): a stuck send's
+    # span id either appears among the peer's received-frame spans (the
+    # bytes arrived — any hang is peer-side matching) or it does not
+    # (the bytes never landed). When the exact answer and the (peer,
+    # tag) heuristic disagree, that disagreement IS the finding: the
+    # heuristic would have called the op matched while the frame was in
+    # fact lost in flight — report it rather than silently trusting
+    # either method.
+    for rank in sorted(dumps):
+        for s in _stuck_slots(dumps[rank]):
+            if s.get("kind") != "isend" or not s.get("span"):
+                continue
+            dst, tag = s.get("peer"), s.get("tag")
+            peer_dump = dumps.get(dst)
+            if peer_dump is None or not _carries_spans(peer_dump):
+                continue
+            arrived = s["span"] in _rx_spans(peer_dump)
+            heur_matched = _has_recv_for(peer_dump, rank, tag)
+            if arrived and not heur_matched:
+                return _result(
+                    "unmatched_send", int(dst),
+                    "rank %d's send tag=%s reached rank %s (frame span "
+                    "%#x was received) but rank %s never posted a "
+                    "matching recv — span-exact evidence, no heuristic"
+                    % (rank, tag, dst, s["span"], dst))
+            if not arrived and heur_matched:
+                return _result(
+                    "span_pair_conflict", int(rank),
+                    "rank %d's send tag=%s to rank %s looks matched by "
+                    "the (peer, tag) heuristic, but no frame carrying "
+                    "its span %#x ever arrived at rank %s — the bytes "
+                    "were lost in flight, and the heuristic alone "
+                    "would have mis-paired this op"
+                    % (rank, tag, dst, s["span"], dst))
+
+    # 6. unmatched send (heuristic fallback, pre-span dumps): the
+    # destination never posted a matching recv.
     for rank in sorted(dumps):
         for s in _stuck_slots(dumps[rank]):
             if s.get("kind") != "isend":
@@ -269,7 +342,7 @@ def diagnose(dumps):
                     "matching recv — rank %s never posted one"
                     % (rank, tag, dst, dst))
 
-    # 6. unmatched recv: the source never produced a matching send.
+    # 7. unmatched recv: the source never produced a matching send.
     for rank in sorted(dumps):
         for s in _stuck_slots(dumps[rank]):
             if s.get("kind") != "irecv":
@@ -284,7 +357,7 @@ def diagnose(dumps):
                     "matching send — rank %s never sent it"
                     % (rank, tag, src, src))
 
-    # 7. barrier skew: some ranks sit inside barrier k (enter without
+    # 8. barrier skew: some ranks sit inside barrier k (enter without
     # exit) while another rank never reached it. The rank with the fewest
     # barrier entries is the one the others wait for.
     entered = {r: len(_events(d, "barrier_enter")) for r, d in dumps.items()}

@@ -6,9 +6,12 @@ Tails the per-rank time-series files a run writes under
 delta-encoded JSON sample per line — docs/DESIGN.md §13) and renders a
 fleet table: per rank, the fleet epoch, op and byte rates over the most
 recent sample interval, goodput vs on-wire MB/s from the per-link wire
-scope, proxy utilization, live serving SLOs (rolling p99 TTFT, queue
-depth — published by the serving loop via acx_tseries_annotate), and
-link health.
+scope, proxy utilization, per-frame wire latency (txq = send-side
+queueing enqueue->on-wire, rxt = raw one-way transit off the sender's
+in-header tx stamp — uncorrected for cross-process clock offset; the
+skew-corrected figure is tools/acx_critpath.py's job), live serving
+SLOs (rolling p99 TTFT, queue depth — published by the serving loop via
+acx_tseries_annotate), and link health.
 
 Modes:
   acx_top.py <prefix>                 live console, refreshed every
@@ -94,7 +97,8 @@ def _latest(series, key, default=None):
 
 def _link_totals(sample):
     """Sum cumulative link counters across peers for one sample."""
-    tot = {"tx_pb": 0, "tx_wb": 0, "rx_pb": 0, "rx_wb": 0}
+    tot = {"tx_pb": 0, "tx_wb": 0, "rx_pb": 0, "rx_wb": 0,
+           "txq_ns": 0, "txq_fr": 0, "rxt_ns": 0, "rxt_fr": 0}
     for ln in sample.get("links", []):
         for k in tot:
             tot[k] += ln.get(k, 0)
@@ -116,6 +120,8 @@ def summarize(series):
         "goodput_mbps": 0.0,
         "wire_mbps": 0.0,
         "proxy_util_pct": _latest(series, "proxy_util_pct", 0.0),
+        "txq_us": None,
+        "rxt_us": None,
         "queue_depth": None,
         "ttft_p99_s": None,
         "itl_p99_s": None,
@@ -142,6 +148,19 @@ def summarize(series):
                 wire = (lb["tx_wb"] - la["tx_wb"]) + (lb["rx_wb"] - la["rx_wb"])
                 row["goodput_mbps"] = good / ldt / 1e6
                 row["wire_mbps"] = wire / ldt / 1e6
+                # Per-frame wire latency over the same window: send-side
+                # queueing (enqueue -> fully on the wire) and raw one-way
+                # transit off the sender's tx stamp (cross-process clock
+                # delta included — see docs/DESIGN.md §14; the offline
+                # skew-corrected figure lives in acx_critpath.py).
+                dq_fr = lb["txq_fr"] - la["txq_fr"]
+                dt_fr = lb["rxt_fr"] - la["rxt_fr"]
+                if dq_fr > 0:
+                    row["txq_us"] = (lb["txq_ns"] - la["txq_ns"]) \
+                        / dq_fr / 1e3
+                if dt_fr > 0:
+                    row["rxt_us"] = (lb["rxt_ns"] - la["rxt_ns"]) \
+                        / dt_fr / 1e3
     app = _latest(series, "app")
     if isinstance(app, dict):
         row["queue_depth"] = app.get("queue_depth")
@@ -194,7 +213,8 @@ def check_series(series):
                     f"rank {r}: sample {i} peer {peer}: rx wire bytes "
                     f"{ln.get('rx_wb')} < payload {ln.get('rx_pb')}")
             for k in ("tx_pb", "tx_wb", "rx_pb", "rx_wb", "tx_fr",
-                      "rx_fr", "naks", "crc", "replayed"):
+                      "rx_fr", "naks", "crc", "replayed",
+                      "txq_ns", "txq_fr", "rxt_ns", "rxt_fr"):
                 v = ln.get(k, 0)
                 if v < last.get((peer, k), 0):
                     errs.append(
@@ -218,6 +238,7 @@ def render_table(all_series):
     rows.sort(key=lambda r: r["rank"])
     hdr = (f"{'rank':>4} {'epoch':>5} {'smpls':>5} {'ops/s':>9} "
            f"{'good MB/s':>9} {'wire MB/s':>9} {'proxy%':>6} "
+           f"{'txq µs':>7} {'rxt µs':>7} "
            f"{'qdepth':>6} {'p99 TTFT':>9} {'link':>5}")
     lines = [hdr, "-" * len(hdr)]
     for r in rows:
@@ -227,6 +248,7 @@ def render_table(all_series):
             f"{r['rank']:>4} {r['fleet_epoch']:>5} {r['samples']:>5} "
             f"{r['ops_per_s']:>9.1f} {r['goodput_mbps']:>9.2f} "
             f"{r['wire_mbps']:>9.2f} {r['proxy_util_pct']:>6.1f} "
+            f"{_fmt(r['txq_us'], '.1f'):>7} {_fmt(r['rxt_us'], '.1f'):>7} "
             f"{_fmt(r['queue_depth'], 'd'):>6} {ttft:>9} "
             f"{r['link_health']:>5}")
     if not rows:

@@ -27,7 +27,10 @@ mid-way through rank 0's run. This tool:
     barrier-anchored skew computed for the traces applies verbatim:
     ``corrected_us = t_mono_ns / 1000 + skew_us[rank]``. Without sibling
     traces (or without common anchors) samples merge unaligned
-    (``corrected_us`` null);
+    (``corrected_us`` null). Application SLO fragments (the ``"app"``
+    section each serving loop publishes via acx_tseries_annotate) ride
+    through rank-tagged, and the newest one per rank is summarized in
+    the output's ``app_by_rank``;
   * validates (``--validate``): traces parse, timestamps are sorted, every
     span begin has a matching end (name+cat+id+pid, the Perfetto async-span
     contract) and span/instant counts match ``otherData``; metrics files
@@ -175,22 +178,29 @@ def barrier_anchors(d):
             if e.get("ph") == "i" and e.get("name") == "barrier_exit"]
 
 
-def merge_traces(traces):
-    """traces: list of (rank, dict). Returns (merged_dict, skew_us)."""
+def compute_skew(traces):
+    """Barrier-anchored per-rank clock skew (µs) for a list of
+    (rank, trace_dict) pairs. This is THE skew definition for every
+    offline consumer (the trace merge, the tseries merge, and
+    tools/acx_critpath.py import it rather than re-deriving): anchor on
+    the LAST common barrier_exit (k = n_common-1) — late in the run the
+    clocks have drifted as far as they will, and a barrier releases only
+    when the last rank arrives, so its exit is the tightest shared
+    instant available. skew[r] = target - anchor[r]; adding skew[r] to
+    rank r's raw timestamps puts every rank on one timeline. Traces
+    without common anchors (or a single trace) get skew None."""
     anchors = {r: barrier_anchors(d) for r, d in traces}
     n_common = min((len(a) for a in anchors.values()), default=0)
-    skew = {}
     if n_common > 0 and len(traces) > 1:
-        # Anchor on the LAST common barrier (k = n_common-1): late in the
-        # run both clocks have drifted as far as they will, and a barrier
-        # releases only when the last rank arrives — its exit is the
-        # tightest shared instant available.
         k = n_common - 1
         target = max(a[k] for a in anchors.values())
-        for r, _ in traces:
-            skew[r] = target - anchors[r][k]
-    else:
-        skew = {r: None for r, _ in traces}
+        return {r: target - anchors[r][k] for r, _ in traces}
+    return {r: None for r, _ in traces}
+
+
+def merge_traces(traces):
+    """traces: list of (rank, dict). Returns (merged_dict, skew_us)."""
+    skew = compute_skew(traces)
 
     events = []
     for r, d in traces:
@@ -225,6 +235,12 @@ def merge_tseries(tseries, skew):
     corrected_us null — their samples sort on the raw per-rank clock.
     """
     merged = []
+    # Rank-tagged carry-through of the application SLO fragment: each
+    # sample keeps its own "app" section verbatim (the dict copy below),
+    # and the newest fragment per rank is ALSO surfaced as a fleet-level
+    # summary — so "which rank's serving loop reports the worst p99 TTFT"
+    # is one lookup, not a scan of the merged stream.
+    app_by_rank = {}
     for r, samples, _torn in tseries:
         sk = skew.get(r)
         for s in samples:
@@ -234,6 +250,8 @@ def merge_tseries(tseries, skew):
             e["corrected_us"] = (t / 1000.0 + sk
                                  if t is not None and sk is not None else None)
             merged.append(e)
+            if isinstance(s.get("app"), dict):
+                app_by_rank[str(r)] = s["app"]
     merged.sort(key=lambda e: (
         e["corrected_us"] if e["corrected_us"] is not None
         else e.get("t_mono_ns", 0) / 1000.0,
@@ -242,6 +260,7 @@ def merge_tseries(tseries, skew):
             "skew_us": {str(r): skew.get(r) for r, _, _ in tseries},
             "aligned": all(skew.get(r) is not None for r, _, _ in tseries),
             "torn_lines": {str(r): t for r, _, t in tseries},
+            "app_by_rank": app_by_rank,
             "samples": merged}
 
 
@@ -349,6 +368,8 @@ def main():
         summary["tseries_out"] = args.tseries_out
         summary["tseries_samples"] = len(fleet_ts["samples"])
         summary["tseries_aligned"] = fleet_ts["aligned"]
+        summary["tseries_app_ranks"] = sorted(
+            int(k) for k in fleet_ts["app_by_rank"])
     if metrics and args.metrics_out:
         fleet = merge_metrics(metrics)
         with open(args.metrics_out, "w") as f:
