@@ -129,6 +129,7 @@ check: ctest itest tools
 	@$(MAKE) --no-print-directory doctor-check || exit 1
 	@$(MAKE) --no-print-directory causality-check || exit 1
 	@$(MAKE) --no-print-directory decode-check || exit 1
+	@$(MAKE) --no-print-directory stripe-check || exit 1
 	@echo "ALL NATIVE TESTS PASSED"
 
 # --- survivable links end-to-end (DESIGN.md §9) ---
@@ -304,6 +305,37 @@ decode-check:
 	@echo "== decode-check: bench.py --dryrun-decode (A/B rows emitted)"
 	@JAX_PLATFORMS=cpu python3 bench.py --dryrun-decode || exit 1
 	@echo "DECODE CHECK PASSED"
+
+# --- multi-path striped transport end-to-end (DESIGN.md §15) ---
+# chaos-ring with 64 KiB messages fanned across subflows: healthy striped
+# traffic, a dropped chunk NAK-healed in its own lane's seq space, a
+# stalled lane forcing cross-lane chunk reorder, a killed lane redialing
+# (or degrading to survivors) under load — every payload byte-exact
+# throughout — plus a striped causality leg whose merged trace still
+# pairs every span and keeps one-way transit non-negative.
+.PHONY: stripe-check
+stripe-check: itest tools
+	@echo "== stripe-check: striped chaos-ring (4 lanes, 64KiB msgs, fault-free)"
+	@ACX_STRIPES=4 ACX_CHAOS_INTS=16384 $(BUILD)/acxrun -np 2 -transport socket \
+	  $(BUILD)/itests/chaos-ring || exit 1
+	@echo "== stripe-check: drop_frame on subflow 2 (per-lane NAK re-pull)"
+	@ACX_STRIPES=4 ACX_CHAOS_INTS=16384 $(BUILD)/acxrun -np 2 -transport socket \
+	  -fault drop_frame:rank=0:subflow=2:nth=4:count=2 $(BUILD)/itests/chaos-ring || exit 1
+	@echo "== stripe-check: stall_link_ms on subflow 1 (cross-lane reorder)"
+	@ACX_STRIPES=2 ACX_CHAOS_INTS=16384 $(BUILD)/acxrun -np 2 -transport socket \
+	  -fault stall_link_ms:rank=0:subflow=1:nth=3:ms=40 $(BUILD)/itests/chaos-ring || exit 1
+	@echo "== stripe-check: close_link_once on subflow 1 (lane redial under load)"
+	@ACX_STRIPES=2 ACX_CHAOS_INTS=16384 $(BUILD)/acxrun -np 2 -transport socket \
+	  -fault close_link_once:rank=0:subflow=1:nth=5 $(BUILD)/itests/chaos-ring || exit 1
+	@rm -rf $(BUILD)/stripe-check && mkdir -p $(BUILD)/stripe-check
+	@echo "== stripe-check: striped causality-ping (spans pair, transit >= 0)"
+	@ACX_STRIPES=2 ACX_PING_INTS=16384 ACX_TRACE=$(BUILD)/stripe-check/ping \
+	  ACX_TRACE_CAP=2000000 $(BUILD)/acxrun -np 2 -transport socket \
+	  $(BUILD)/itests/causality-ping || exit 1
+	@python3 tools/acx_critpath.py --min-pair-rate 0.95 \
+	  --expect-nonneg-transit \
+	  $(BUILD)/stripe-check/ping.rank*.trace.json || exit 1
+	@echo "STRIPE CHECK PASSED"
 
 # Header dependency tracking (-MMD): a header edit rebuilds its users.
 -include $(LIB_OBJS:.o=.d)

@@ -74,6 +74,53 @@ def native_bench(msg_bytes: int | None = None):
     return float(m.group(1)), float(m.group(2)), float(m.group(3))
 
 
+def native_stripe_sweep(lane_counts=(1, 2, 4)):
+    """Striped-wire bandwidth rows (DESIGN.md §15). ACX_STRIPES is fixed
+    at transport construction, so each lane count is its own acxrun on
+    the socket plane; ACX_RV_THRESHOLD=0 forces the eager path so large
+    messages actually stripe instead of taking rendezvous."""
+    subprocess.run(["make", "-C", REPO, "lib", "tools"], check=True,
+                   capture_output=True)
+    rows = []
+    for s in lane_counts:
+        env = dict(os.environ, ACX_BENCH_STRIPE_SWEEP="1",
+                   ACX_RV_THRESHOLD="0", ACX_STRIPES=str(s))
+        cmd = [os.path.join(REPO, "build", "acxrun"), "-np", "2",
+               "-timeout", "300", "-transport", "socket",
+               os.path.join(REPO, "build", "bench_pingpong"), "8"]
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=400, env=env)
+        got = re.findall(r"BENCH_STRIPE stripes=(\d+) msg_bytes=(\d+) "
+                         r"bw_gbps=([\d.]+)", r.stdout)
+        if not got:
+            raise RuntimeError(
+                f"stripe sweep stripes={s} produced no rows: "
+                f"{r.stdout[-300:]} {r.stderr[-300:]}")
+        for st, mb, g in got:
+            rows.append({"stripes": int(st), "msg_bytes": int(mb),
+                         "bw_gbps": float(g)})
+    return rows
+
+
+def _record_wire_rows(rows, part_bw):
+    """Fold the striped-wire rows into the newest MULTICHIP_r*.json so
+    the multichip artifact carries the wire-plane numbers alongside the
+    mesh result. The artifact belongs to the driver: merge, never fail."""
+    import glob
+    files = sorted(glob.glob(os.path.join(REPO, "MULTICHIP_r*.json")))
+    if not files:
+        return
+    try:
+        with open(files[-1]) as f:
+            d = json.load(f)
+        d["wire"] = {"partitioned_bw_gbps": part_bw, "stripe_sweep": rows}
+        with open(files[-1], "w") as f:
+            json.dump(d, f)
+            f.write("\n")
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def _code_rev():
     """Fingerprint of the MEASURED code: tree hashes of the source
     paths plus any uncommitted diff to them. Deliberately excludes the
@@ -887,6 +934,16 @@ def main(full: bool = False):
     provisional = dict(out)
     provisional["tpu_error"] = "provisional line: TPU measurement pending"
     print(json.dumps(provisional), flush=True)
+
+    # Striped-wire lane sweep (socket plane). The stripes=1 no-regression
+    # gate is the partitioned_bw_gbps check above: striping is off by
+    # default, so native_bench IS the unstriped measurement.
+    try:
+        srows = native_stripe_sweep()
+        out["stripe_sweep"] = srows
+        _record_wire_rows(srows, bw)
+    except Exception as e:  # noqa: BLE001 — report, don't crash
+        out["stripe_sweep_error"] = str(e)
 
     # Deterministic, chip-independent design metric (CPU-compiled HLO).
     qb, qerr = _run_cpu_child("quant")

@@ -114,17 +114,29 @@ void test_on_frame_window() {
   uint64_t us = 0;
   int err = 0;
   CHECK(fault::OnIssue(0, true, 1, &us, &err) == fault::Action::kNone);
-  CHECK(fault::OnFrame(1, 1, &us) == fault::Action::kNone);  // wrong rank
-  CHECK(fault::OnFrame(0, 0, &us) == fault::Action::kNone);  // wrong peer
-  CHECK(fault::OnFrame(0, 1, &us) == fault::Action::kNone);  // match 1
-  CHECK(fault::OnFrame(0, 1, &us) == fault::Action::kDropFrame);  // match 2
-  CHECK(fault::OnFrame(0, 1, &us) == fault::Action::kNone);  // window spent
+  CHECK(fault::OnFrame(1, 1, 0, &us) == fault::Action::kNone);  // wrong rank
+  CHECK(fault::OnFrame(0, 0, 0, &us) == fault::Action::kNone);  // wrong peer
+  CHECK(fault::OnFrame(0, 1, 0, &us) == fault::Action::kNone);  // match 1
+  CHECK(fault::OnFrame(0, 1, 0, &us) == fault::Action::kDropFrame);  // match 2
+  CHECK(fault::OnFrame(0, 1, 0, &us) == fault::Action::kNone);  // window spent
 
   CHECK(fault::ParseSpec("stall_link_ms:ms=7:nth=1", &c));
   fault::Configure(c);
   us = 0;
-  CHECK(fault::OnFrame(0, 1, &us) == fault::Action::kStallLink);
+  CHECK(fault::OnFrame(0, 1, 0, &us) == fault::Action::kStallLink);
   CHECK(us == 7000);  // ms -> us for the transport's stall gate
+
+  // subflow= filters before the window counter: only lane-2 frames count,
+  // so frames on other lanes neither fire nor burn the nth= budget.
+  CHECK(fault::ParseSpec("drop_frame:subflow=2:nth=2:count=1", &c));
+  fault::Configure(c);
+  CHECK(c.subflow == 2);
+  CHECK(fault::OnFrame(0, 1, 0, &us) == fault::Action::kNone);  // lane 0
+  CHECK(fault::OnFrame(0, 1, 1, &us) == fault::Action::kNone);  // lane 1
+  CHECK(fault::OnFrame(0, 1, 2, &us) == fault::Action::kNone);  // match 1
+  CHECK(fault::OnFrame(0, 1, 0, &us) == fault::Action::kNone);  // lane 0
+  CHECK(fault::OnFrame(0, 1, 2, &us) == fault::Action::kDropFrame);  // match 2
+  CHECK(fault::OnFrame(0, 1, 2, &us) == fault::Action::kNone);  // spent
   RestorePolicy();
   std::printf("on_frame_window: OK\n");
 }

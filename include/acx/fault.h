@@ -29,6 +29,8 @@
 //   rank=R   inject only on rank R               (default: every rank)
 //   kind=K   send | recv | any (issue actions)   (default: any)
 //   peer=P   only ops/frames to/from peer P      (default: any)
+//   subflow=S  only frames on striped subflow S (frame actions; subflow 0
+//              is the primary link — DESIGN.md §15)  (default: any)
 //   nth=N    first matching attempt/frame hit, 1-based    (default 1)
 //   count=C  how many consecutive matches are hit         (default 1)
 //   us=U     delay microseconds (delay action)            (default 1000)
@@ -74,6 +76,7 @@ struct Config {
   int rank = -1;   // -1 = any rank
   int kind = 0;    // 0 = any, 1 = send, 2 = recv
   int peer = -1;   // -1 = any peer
+  int subflow = -1;  // -1 = any subflow (frame actions only)
   int nth = 1;     // 1-based index of the first matching attempt hit
   int count = 1;   // how many consecutive matches are hit
   uint64_t delay_us = 1000;
@@ -100,11 +103,13 @@ void Configure(const Config& cfg);
 Action OnIssue(int rank, bool is_send, int peer, uint64_t* delay_us,
                int* err);
 
-// Consult the plane for one sequenced frame about to be written to peer's
-// link. Only frame actions (kDropFrame..kCloseLink) ever fire here; issue
-// actions return kNone without consuming a match. kStallLink fills
-// *stall_us with the stall duration in microseconds.
-Action OnFrame(int rank, int peer, uint64_t* stall_us);
+// Consult the plane for one sequenced frame about to be written on subflow
+// `subflow` of peer's link. Only frame actions (kDropFrame..kCloseLink)
+// ever fire here; issue actions return kNone without consuming a match. A
+// frame that fails the rank/peer/subflow filter does not consume a match
+// either. kStallLink fills *stall_us with the stall duration in
+// microseconds.
+Action OnFrame(int rank, int peer, int subflow, uint64_t* stall_us);
 
 struct Stats {
   uint64_t drops = 0;

@@ -59,6 +59,11 @@ struct NetStats {
   uint64_t crc_rejects = 0;      // frames dropped on payload CRC mismatch
   uint64_t naks_sent = 0;        // re-pull requests sent to peers
   uint64_t links_recovering = 0; // links currently in the reconnect ladder
+  // Links whose replay buffer evicted an unacked frame (ACX_REPLAY_BUF_BYTES
+  // overrun): they still move data but can no longer survive a reconnect —
+  // the next link loss is terminal for the peer. Nonzero here is the
+  // observable early warning (DESIGN.md §9).
+  uint64_t replay_broken_links = 0;
 };
 
 // Per-peer link health, surfaced so the proxy can park in-flight ops while
@@ -97,6 +102,11 @@ struct LinkScope {
   uint64_t naks = 0;              // re-pulls sent for this link
   uint64_t crc_rejects = 0;       // frames from this peer dropped on CRC
   uint64_t replayed = 0;          // frames re-sent to this peer
+  // Striped subflows (DESIGN.md §15): configured lane count for this link
+  // and how many are currently usable. subflows_up < subflows means a lane
+  // died and the link degraded to the survivors; 1/1 on unstriped links.
+  uint32_t subflows = 1;
+  uint32_t subflows_up = 1;
 
   // -- causal timing (DESIGN.md §14) -- cumulative sums/counts so consumers
   // can difference snapshots into window averages, same contract as the

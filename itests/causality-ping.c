@@ -51,9 +51,15 @@ int main(int argc, char **argv) {
     int rounds = 40;
     const char *r_s = getenv("ACX_PING_ROUNDS");
     if (r_s != NULL && atoi(r_s) > 0) rounds = atoi(r_s);
+    /* Payload size knob: `make stripe-check` pings 64 KiB payloads so the
+     * causal chain rides the striped (envelope + chunks) path. */
+    int n = N;
+    const char *n_s = getenv("ACX_PING_INTS");
+    if (n_s != NULL && atoi(n_s) > 0) n = atoi(n_s);
 
     const int peer = 1 - rank;
-    int buf[N];
+    int *buf = (int *)malloc((size_t)n * sizeof(int));
+    if (buf == NULL) MPI_Abort(MPI_COMM_WORLD, 3);
     cudaStream_t stream = 0;
 
     for (int round = 0; round < rounds && errs == 0; round++) {
@@ -61,20 +67,20 @@ int main(int argc, char **argv) {
         MPI_Status st;
         int i;
         if (rank == 0) {
-            for (i = 0; i < N; i++) buf[i] = expect(round, i);
-            MPIX_Isend_enqueue(buf, N, MPI_INT, peer, round, MPI_COMM_WORLD,
+            for (i = 0; i < n; i++) buf[i] = expect(round, i);
+            MPIX_Isend_enqueue(buf, n, MPI_INT, peer, round, MPI_COMM_WORLD,
                                &req, MPIX_QUEUE_XLA_STREAM, &stream);
             MPIX_Wait(&req, MPI_STATUS_IGNORE);
-            for (i = 0; i < N; i++) buf[i] = -1;
-            MPIX_Irecv_enqueue(buf, N, MPI_INT, peer, round, MPI_COMM_WORLD,
+            for (i = 0; i < n; i++) buf[i] = -1;
+            MPIX_Irecv_enqueue(buf, n, MPI_INT, peer, round, MPI_COMM_WORLD,
                                &req, MPIX_QUEUE_XLA_STREAM, &stream);
             MPIX_Wait(&req, &st);
         } else {
-            for (i = 0; i < N; i++) buf[i] = -1;
-            MPIX_Irecv_enqueue(buf, N, MPI_INT, peer, round, MPI_COMM_WORLD,
+            for (i = 0; i < n; i++) buf[i] = -1;
+            MPIX_Irecv_enqueue(buf, n, MPI_INT, peer, round, MPI_COMM_WORLD,
                                &req, MPIX_QUEUE_XLA_STREAM, &stream);
             MPIX_Wait(&req, &st);
-            MPIX_Isend_enqueue(buf, N, MPI_INT, peer, round, MPI_COMM_WORLD,
+            MPIX_Isend_enqueue(buf, n, MPI_INT, peer, round, MPI_COMM_WORLD,
                                &req, MPIX_QUEUE_XLA_STREAM, &stream);
             MPIX_Wait(&req, MPI_STATUS_IGNORE);
         }
@@ -85,7 +91,7 @@ int main(int argc, char **argv) {
             break;
         }
         /* The echoed payload must round-trip byte-exactly. */
-        for (i = 0; i < N; i++) {
+        for (i = 0; i < n; i++) {
             if (buf[i] != expect(round, i)) {
                 printf("[%d] round %d: buf[%d] = %d, want %d\n", rank,
                        round, i, buf[i], expect(round, i));
@@ -103,6 +109,7 @@ int main(int argc, char **argv) {
      * LAST common barrier_exit, so this pins the whole spanned window. */
     MPI_Barrier(MPI_COMM_WORLD);
     MPIX_Set_deadline(0);
+    free(buf);
     MPIX_Finalize();
     MPI_Finalize();
     if (rank == 0 && errs == 0) printf("causality-ping: OK\n");

@@ -1,7 +1,10 @@
 /* tpu-acx integration test: ring exchange under wire-level chaos.
  *
- * Every rank sends a 256-int patterned array right and receives from the
- * left for ACX_CHAOS_ROUNDS rounds, verifying every payload byte-exactly.
+ * Every rank sends a patterned int array (256 ints by default;
+ * ACX_CHAOS_INTS overrides — `make stripe-check` uses 16384 = 64 KiB so
+ * messages cross the striping floor and fan out across subflows) right
+ * and receives from the left for ACX_CHAOS_ROUNDS rounds, verifying
+ * every payload byte-exactly.
  * Run fault-free it is a plain stress ring; run with a wire-level
  * ACX_FAULT spec (drop_frame / corrupt_frame / stall_link_ms /
  * close_link_once, armed via `acxrun -fault ... -transport socket`) it
@@ -46,23 +49,28 @@ int main(int argc, char **argv) {
     int rounds = 30;
     const char *r_s = getenv("ACX_CHAOS_ROUNDS");
     if (r_s != NULL && atoi(r_s) > 0) rounds = atoi(r_s);
+    int n = N;
+    const char *n_s = getenv("ACX_CHAOS_INTS");
+    if (n_s != NULL && atoi(n_s) > 0) n = atoi(n_s);
 
     const int right = (rank + 1) % size;
     const int left = (rank + size - 1) % size;
-    int sbuf[N], rbuf[N];
+    int *sbuf = (int *)malloc((size_t)n * sizeof(int));
+    int *rbuf = (int *)malloc((size_t)n * sizeof(int));
+    if (sbuf == NULL || rbuf == NULL) MPI_Abort(MPI_COMM_WORLD, 3);
     cudaStream_t stream = 0;
 
     for (int round = 0; round < rounds; round++) {
         int i;
-        for (i = 0; i < N; i++) {
+        for (i = 0; i < n; i++) {
             sbuf[i] = expect(rank, round, i);
             rbuf[i] = -1;
         }
         MPIX_Request req[2];
         MPI_Status st;
-        MPIX_Isend_enqueue(sbuf, N, MPI_INT, right, round, MPI_COMM_WORLD,
+        MPIX_Isend_enqueue(sbuf, n, MPI_INT, right, round, MPI_COMM_WORLD,
                            &req[0], MPIX_QUEUE_XLA_STREAM, &stream);
-        MPIX_Irecv_enqueue(rbuf, N, MPI_INT, left, round, MPI_COMM_WORLD,
+        MPIX_Irecv_enqueue(rbuf, n, MPI_INT, left, round, MPI_COMM_WORLD,
                            &req[1], MPIX_QUEUE_XLA_STREAM, &stream);
         MPIX_Wait(&req[0], MPI_STATUS_IGNORE);
         MPIX_Wait(&req[1], &st);
@@ -74,7 +82,7 @@ int main(int argc, char **argv) {
         }
         /* Zero payload corruption, ever: a CRC-rejected or replayed frame
          * must deliver byte-identical data on the re-pull. */
-        for (i = 0; i < N; i++) {
+        for (i = 0; i < n; i++) {
             if (rbuf[i] != expect(left, round, i)) {
                 printf("[%d] round %d: rbuf[%d] = %d, want %d\n", rank,
                        round, i, rbuf[i], expect(left, round, i));
@@ -87,6 +95,8 @@ int main(int argc, char **argv) {
 
     MPI_Allreduce(MPI_IN_PLACE, &errs, 1, MPI_INT, MPI_MAX, MPI_COMM_WORLD);
     MPIX_Set_deadline(0);
+    free(sbuf);
+    free(rbuf);
     MPIX_Finalize();
     MPI_Finalize();
     if (rank == 0 && errs == 0) printf("chaos-ring: OK\n");

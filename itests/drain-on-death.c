@@ -108,7 +108,7 @@ int main(int argc, char **argv) {
         errs++;
     }
 
-    uint64_t rs[6];
+    uint64_t rs[7];
     acx_recovery_stats(rs);
     if (rs[4] < 1) {
         printf("[%d] drained_slots %llu, want >= 1\n", rank,

@@ -418,8 +418,11 @@ class Runtime:
     def recovery_stats(self) -> dict:
         """Process-wide survivable-link counters: link reconnects, frames
         replayed from the resend buffer, CRC-rejected frames, NAKs sent,
-        ops cancelled by drain, and links currently mid-reconnect."""
-        out = (ctypes.c_uint64 * 6)()
+        ops cancelled by drain, links currently mid-reconnect, and links
+        whose replay buffer has evicted an unacked frame (still moving
+        data, but their next link loss is terminal — the early warning to
+        raise ACX_REPLAY_BUF_BYTES)."""
+        out = (ctypes.c_uint64 * 7)()
         self._lib.acx_recovery_stats(out)
         return {
             "reconnects": out[0],
@@ -428,6 +431,7 @@ class Runtime:
             "naks_sent": out[3],
             "drained_slots": out[4],
             "links_recovering": out[5],
+            "replay_broken_links": out[6],
         }
 
     # -- fleet membership (docs/DESIGN.md §12) ------------------------------

@@ -154,9 +154,12 @@ void acx_resilience_stats(uint64_t* out) {
   }
 }
 
-// Fills out[6] = {reconnects, replayed_frames, crc_rejects, naks_sent,
-// drained_slots, links_recovering} — the survivable-link counters
-// (DESIGN.md §9). Safe before init (zeros).
+// Fills out[7] = {reconnects, replayed_frames, crc_rejects, naks_sent,
+// drained_slots, links_recovering, replay_broken_links} — the
+// survivable-link counters (DESIGN.md §9). replay_broken_links is the
+// early-warning gauge: links still moving data whose replay buffer evicted
+// an unacked frame, so their NEXT loss is terminal. Safe before init
+// (zeros).
 void acx_recovery_stats(uint64_t* out) {
   acx::ApiState& g = acx::GS();
   if (g.transport != nullptr) {
@@ -166,8 +169,9 @@ void acx_recovery_stats(uint64_t* out) {
     out[2] = n.crc_rejects;
     out[3] = n.naks_sent;
     out[5] = n.links_recovering;
+    out[6] = n.replay_broken_links;
   } else {
-    out[0] = out[1] = out[2] = out[3] = out[5] = 0;
+    out[0] = out[1] = out[2] = out[3] = out[5] = out[6] = 0;
   }
   out[4] = acx::g_drained.load(std::memory_order_relaxed);
 }

@@ -111,6 +111,7 @@ bool ParseSpec(const char* spec, Config* out) {
     const char* val = eq + 1;
     if (strcmp(tok, "rank") == 0) c.rank = atoi(val);
     else if (strcmp(tok, "peer") == 0) c.peer = atoi(val);
+    else if (strcmp(tok, "subflow") == 0) c.subflow = atoi(val);
     else if (strcmp(tok, "nth") == 0) c.nth = atoi(val);
     else if (strcmp(tok, "count") == 0) c.count = atoi(val);
     else if (strcmp(tok, "us") == 0) c.delay_us = strtoull(val, nullptr, 10);
@@ -174,12 +175,16 @@ Action OnIssue(int rank, bool is_send, int peer, uint64_t* delay_us,
   return c.action;
 }
 
-Action OnFrame(int rank, int peer, uint64_t* stall_us) {
+Action OnFrame(int rank, int peer, int subflow, uint64_t* stall_us) {
   State& s = S();
   const Config& c = s.cfg;
   if (c.action < Action::kDropFrame) return Action::kNone;
   if (c.rank >= 0 && rank != c.rank) return Action::kNone;
   if (c.peer >= 0 && peer != c.peer) return Action::kNone;
+  // Subflow filter sits with rank/peer, BEFORE the matched counter: a
+  // `subflow=` spec counts only that lane's frames, so nth= stays a stable
+  // coordinate regardless of how the other lanes interleave.
+  if (c.subflow >= 0 && subflow != c.subflow) return Action::kNone;
   const uint64_t m = s.matched.fetch_add(1, std::memory_order_relaxed) + 1;
   if (m < static_cast<uint64_t>(c.nth) ||
       m >= static_cast<uint64_t>(c.nth) + static_cast<uint64_t>(c.count))

@@ -117,15 +117,17 @@ void AppendLinks(std::string* out, Transport* t) {
       if (!t->link_scope(p, &sc)) continue;
       if (!first) *out += ",";
       first = false;
-      char buf[576];
+      char buf[640];
       std::snprintf(
           buf, sizeof buf,
-          "{\"peer\":%d,\"state\":%d,\"epoch\":%u,\"tx_pb\":%llu,"
+          "{\"peer\":%d,\"state\":%d,\"epoch\":%u,\"sf\":%u,\"sf_up\":%u,"
+          "\"tx_pb\":%llu,"
           "\"tx_wb\":%llu,\"rx_pb\":%llu,\"rx_wb\":%llu,\"tx_fr\":%llu,"
           "\"rx_fr\":%llu,\"naks\":%llu,\"crc\":%llu,\"replayed\":%llu,"
           "\"txq_ns\":%llu,\"txq_fr\":%llu,\"rxt_ns\":%llu,"
           "\"rxt_fr\":%llu}",
-          p, sc.state, sc.epoch, (unsigned long long)sc.tx_payload_bytes,
+          p, sc.state, sc.epoch, sc.subflows, sc.subflows_up,
+          (unsigned long long)sc.tx_payload_bytes,
           (unsigned long long)sc.tx_wire_bytes,
           (unsigned long long)sc.rx_payload_bytes,
           (unsigned long long)sc.rx_wire_bytes,

@@ -1,0 +1,205 @@
+// tpu-acx: frame encode/decode + replay records — the bottom layer of the
+// three-layer net split (DESIGN.md §15):
+//
+//   framing   (this file)  — what a frame IS: header construction/sealing,
+//                            wire payload lengths, the rendezvous/stripe
+//                            descriptor shapes, CRC policy, and the bounded
+//                            per-subflow replay buffer of byte-exact frames.
+//   link_state             — what a LINK is over time: per-subflow wire
+//                            clocks (epoch/seq/ack), the reconnect ladder
+//                            arithmetic, hello construction.
+//   socket_transport.cc    — who OWNS the sockets: matching queues, the
+//                            progress engine, striping policy application.
+//
+// Nothing here takes the transport lock or touches an fd; everything is
+// plain data + arithmetic so it is unit-testable in isolation
+// (ctests/test_framing.cc).
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+#include <deque>
+#include <vector>
+
+#include "src/net/wire.h"
+
+namespace acx {
+namespace framing {
+
+// -- wire payload shapes ----------------------------------------------------
+
+#pragma pack(push, 1)
+// kMagicRts wire payload: the sender advertises its buffer for a
+// process_vm_readv pull (rendezvous, DESIGN.md §5).
+struct RvDesc {
+  uint64_t addr;
+  uint32_t seq;
+  int32_t pid;
+};
+// kMagicAck wire payload.
+struct RvAck {
+  uint32_t seq;
+  int32_t ok;
+};
+// kMagicStripe wire payload: one per striped message, always on subflow 0.
+// The envelope is what occupies the message's position in the per-
+// (src,tag,ctx) FIFO matching order; chunks carry the bytes.
+struct StripeDesc {
+  uint32_t msg_id;      // per-peer-direction message id (chunk pairing key)
+  uint32_t nchunks;     // total chunk frames this message was split into
+  uint64_t total_bytes; // == envelope hdr.bytes; self-describing on replay
+};
+// kMagicChunk leading wire payload: 24 bytes of placement metadata, then
+// `len` payload bytes. Offset travels explicitly (not derived from index)
+// so chunks are self-contained: they reassemble correctly whatever subflow
+// they arrive on, in whatever order, including after a lane migration.
+struct ChunkHdr {
+  uint32_t msg_id;
+  uint32_t idx;     // chunk index in [0, nchunks)
+  uint64_t offset;  // byte offset of this slice in the full message
+  uint64_t len;     // slice length (== frame hdr.bytes)
+};
+#pragma pack(pop)
+static_assert(sizeof(RvDesc) == 16, "wire shape");
+static_assert(sizeof(RvAck) == 8, "wire shape");
+static_assert(sizeof(StripeDesc) == 16, "wire shape");
+static_assert(sizeof(ChunkHdr) == 24, "wire shape");
+
+inline wire::WireHeader MakeHdr(uint32_t magic, int tag, int ctx,
+                                uint64_t bytes) {
+  wire::WireHeader h{};
+  h.magic = magic;
+  h.tag = tag;
+  h.ctx = ctx;
+  h.bytes = bytes;
+  return h;
+}
+
+// Actual on-wire payload length of a frame. NOT hdr.bytes for RTS/ACK: an
+// RTS advertises the full message length in bytes while carrying only the
+// 16-byte descriptor, and an ACK advertises 0 while carrying 8. A chunk
+// frame advertises its slice length and carries ChunkHdr + slice.
+inline size_t WirePayloadLen(const wire::WireHeader& h) {
+  switch (h.magic) {
+    case wire::kMagicRts: return sizeof(RvDesc);
+    case wire::kMagicAck: return sizeof(RvAck);
+    case wire::kMagic: return static_cast<size_t>(h.bytes);
+    case wire::kMagicStripe: return sizeof(StripeDesc);
+    case wire::kMagicChunk: return sizeof(ChunkHdr) +
+                                   static_cast<size_t>(h.bytes);
+    default: return 0;
+  }
+}
+
+inline bool KnownMagic(uint32_t m) {
+  return m == wire::kMagic || m == wire::kMagicRts || m == wire::kMagicAck ||
+         m == wire::kMagicHb || m == wire::kMagicSeqAck ||
+         m == wire::kMagicNak || m == wire::kMagicHello ||
+         m == wire::kMagicView || m == wire::kMagicStripe ||
+         m == wire::kMagicChunk;
+}
+
+// Restamp a recorded frame blob ([header|payload]) in place with a new link
+// epoch — and, when `new_seq` is non-null, a new sequence number — then
+// reseal the header CRC. This is how reconnect adoption re-targets replay
+// records at the agreed post-outage epoch, and how lane degradation
+// migrates a dead subflow's unacked frames into a survivor's seq space.
+inline void RestampFrame(char* blob, uint32_t epoch,
+                         const uint64_t* new_seq = nullptr) {
+  memcpy(blob + offsetof(wire::WireHeader, epoch), &epoch, sizeof epoch);
+  if (new_seq != nullptr)
+    memcpy(blob + offsetof(wire::WireHeader, seq), new_seq, sizeof *new_seq);
+  const uint32_t hcrc =
+      wire::Crc32c(0, blob, offsetof(wire::WireHeader, hcrc));
+  memcpy(blob + offsetof(wire::WireHeader, hcrc), &hcrc, sizeof hcrc);
+}
+
+inline uint64_t FrameSeq(const char* blob) {
+  uint64_t seq;
+  memcpy(&seq, blob + offsetof(wire::WireHeader, seq), sizeof seq);
+  return seq;
+}
+
+// -- replay buffer ----------------------------------------------------------
+
+// One fully-written-but-unacked frame, byte-exact as it went on the wire
+// ([header|payload]). `queued` marks a record currently re-enqueued on an
+// outq as a raw frame (its blob is borrowed — the record must not be
+// popped or evicted until the write completes).
+struct ReplayRec {
+  uint64_t seq = 0;
+  std::vector<char> frame;
+  bool queued = false;
+};
+
+// Bounded FIFO of replay records for ONE subflow's seq space. Eviction of
+// an unacked record breaks replayability — latched in `broken` so a future
+// recovery fails loudly instead of replaying a gapped stream.
+struct ReplayBuffer {
+  std::deque<ReplayRec> recs;
+  size_t bytes = 0;
+  bool broken = false;
+
+  // Copy a frame in at full-write time. `hdr` is the header as the RECORD
+  // should remember it (the caller restores pristine CRCs a corrupt_frame
+  // fault poisoned on the wire copy). The payload may be two wire segments
+  // (a chunk frame's ChunkHdr + borrowed slice); either may be empty. This
+  // copy is the one place the zero-copy send path intentionally copies —
+  // replay must outlive the user's buffer. Returns true when the append
+  // evicted an unacked record (the broken latch just flipped or
+  // re-confirmed).
+  bool Record(const wire::WireHeader& hdr, const char* head,
+              size_t head_bytes, const char* payload, size_t payload_bytes,
+              size_t budget) {
+    ReplayRec rec;
+    rec.seq = hdr.seq;
+    rec.frame.resize(sizeof hdr + head_bytes + payload_bytes);
+    memcpy(rec.frame.data(), &hdr, sizeof hdr);
+    if (head_bytes != 0)
+      memcpy(rec.frame.data() + sizeof hdr, head, head_bytes);
+    if (payload_bytes != 0)
+      memcpy(rec.frame.data() + sizeof hdr + head_bytes, payload,
+             payload_bytes);
+    bytes += rec.frame.size();
+    recs.push_back(std::move(rec));
+    bool evicted = false;
+    // A record whose blob is borrowed by an in-flight raw frame pins
+    // everything behind it.
+    while (bytes > budget && !recs.empty() && !recs.front().queued) {
+      bytes -= recs.front().frame.size();
+      recs.pop_front();
+      broken = true;
+      evicted = true;
+    }
+    return evicted;
+  }
+
+  // Peer acknowledged delivery of everything up to `acked`: trim records.
+  void AckThrough(uint64_t acked) {
+    while (!recs.empty() && !recs.front().queued &&
+           recs.front().seq <= acked) {
+      bytes -= recs.front().frame.size();
+      recs.pop_front();
+    }
+  }
+
+  // A raw (replay) frame finished writing: release its record's blob.
+  void ClearQueued(uint64_t seq) {
+    for (auto& rec : recs) {
+      if (rec.seq == seq) {
+        rec.queued = false;
+        return;
+      }
+    }
+  }
+
+  void Clear() {
+    recs.clear();
+    bytes = 0;
+  }
+};
+
+}  // namespace framing
+}  // namespace acx

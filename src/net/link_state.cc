@@ -1,0 +1,44 @@
+#include "src/net/link_state.h"
+
+#include <errno.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace acx {
+namespace link_state {
+
+bool IoFullTimed(int fd, void* buf, size_t n, int timeout_ms, bool wr) {
+  char* pbuf = static_cast<char*>(buf);
+  size_t got = 0;
+  const uint64_t deadline =
+      NowNs() + static_cast<uint64_t>(timeout_ms) * 1000000ull;
+  while (got < n) {
+    const uint64_t now = NowNs();
+    if (now >= deadline) return false;
+    struct pollfd pf;
+    pf.fd = fd;
+    pf.events = wr ? POLLOUT : POLLIN;
+    pf.revents = 0;
+    const int pr =
+        poll(&pf, 1, static_cast<int>((deadline - now) / 1000000ull) + 1);
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    if (pr == 0) return false;
+    const ssize_t r = wr ? send(fd, pbuf + got, n - got, MSG_NOSIGNAL)
+                         : read(fd, pbuf + got, n - got);
+    if (r > 0) {
+      got += static_cast<size_t>(r);
+      continue;
+    }
+    if (r < 0 && (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK))
+      continue;
+    return false;  // EOF or hard error
+  }
+  return true;
+}
+
+}  // namespace link_state
+}  // namespace acx

@@ -1,0 +1,75 @@
+// tpu-acx: per-subflow wire clocks + the reconnect ladder arithmetic — the
+// middle layer of the three-layer net split (DESIGN.md §15). framing.h
+// defines what a frame is; this file defines what a link (and each of its
+// striped subflows) is OVER TIME: the epoch/seq/ack clock that survives
+// reconnects, and the deterministic backoff/deadline math both ends of an
+// outage use to agree on how long recovery may take. socket_transport.cc
+// owns the sockets and applies these.
+//
+// Everything here is lock-free plain data + arithmetic (except IoFullTimed,
+// which blocks on ONE fd with a deadline) — unit-testable in isolation.
+#pragma once
+
+#include <stdint.h>
+
+#include "include/acx/fault.h"
+
+namespace acx {
+namespace link_state {
+
+// The wire clock of ONE subflow of one link: epoch names the incarnation,
+// tx_seq/rx_seq the per-direction high-waters, acked_rx what we've told the
+// peer we have. With striping every subflow runs its own independent clock
+// (its own seq space, its own replay buffer) so each heals independently.
+struct WireClock {
+  uint32_t epoch = 1;
+  uint64_t tx_seq = 0;        // last sequence number stamped on a tx frame
+  uint64_t rx_seq = 0;        // last in-order sequence number received
+  uint64_t acked_rx = 0;      // rx high-water last advertised via SeqAck
+  uint32_t rx_since_ack = 0;  // sequenced frames since the last SeqAck
+  uint64_t last_nak_ns = 0;   // NAK rate limiter (1ms)
+};
+
+// Nominal ladder value for dial attempt `attempt` (1-based):
+// `backoff_ms` doubling per attempt, 2s cap. The wait actually scheduled
+// is jittered (below); this nominal value is what deadline budgets are
+// computed from, so both ends of an outage agree on the total budget.
+inline uint64_t DialBackoffMs(uint64_t backoff_ms, int attempt) {
+  uint64_t ms = backoff_ms;
+  if (ms == 0) ms = 1;
+  for (int i = 1; i < attempt && ms < 2000; i++) ms *= 2;
+  return ms < 2000 ? ms : 2000;
+}
+
+// ±25% jitter on a backoff wait. After a shared fault (a switch blip, a
+// rank replaced under rolling restart) every surviving dialer otherwise
+// redials on the identical deterministic schedule, thundering-herding the
+// victim's rendezvous listener. Cheap LCG on caller-owned state; NOT the
+// ladder itself, so budget math (AcceptDeadlineNs) stays deterministic.
+inline uint64_t JitteredWaitNs(uint64_t* state, uint64_t nominal_ms) {
+  *state = *state * 6364136223846793005ull + 1442695040888963407ull;
+  const uint64_t nominal_ns = nominal_ms * 1000000ull;
+  const uint64_t span = nominal_ns / 2;  // [0.75x, 1.25x)
+  if (span == 0) return nominal_ns;
+  return nominal_ns - span / 2 + (*state >> 33) % span;
+}
+
+// Total time an acceptor waits for the dialer's ladder to reach it before
+// declaring the peer dead: the sum of every nominal backoff plus handshake
+// margin plus 25% jitter headroom.
+inline uint64_t AcceptDeadlineNs(uint64_t backoff_ms, uint32_t max_attempts) {
+  uint64_t total_ms = 1000;  // handshake + scheduling margin
+  for (uint32_t a = 1; a <= max_attempts; a++)
+    total_ms += DialBackoffMs(backoff_ms, a);
+  total_ms += total_ms / 4;
+  return total_ms * 1000000ull;
+}
+
+// Exact-length IO with a poll-based deadline, for the header-sized
+// handshake on a fresh (blocking) reconnect socket. Safe under the
+// transport lock: the peer's handshake side runs under its OWN lock, so
+// there is no circular wait — worst case is the bounded timeout.
+bool IoFullTimed(int fd, void* buf, size_t n, int timeout_ms, bool wr);
+
+}  // namespace link_state
+}  // namespace acx

@@ -16,6 +16,24 @@
 // engine. A single mutex serializes the proxy thread and app threads — the
 // message-rate ceiling of this backend is host-side anyway (on-TPU traffic
 // rides ICI via XLA collectives, not this path).
+//
+// This file is the TOP layer of the three-layer net split (DESIGN.md §15):
+// src/net/framing.h owns frame shapes/CRC/replay records, src/net/
+// link_state.h owns the per-subflow wire clocks and reconnect arithmetic,
+// src/net/stripe.h owns the striping policy. This file owns sockets,
+// matching queues, and the progress engine that applies all three.
+//
+// Multi-path striping (DESIGN.md §15): with ACX_STRIPES=N > 1, each peer
+// link grows N-1 extra "subflow" sockets (lane 0 is the original link;
+// lanes 1..N-1 are dialed lazily against the peer's rendezvous listener).
+// Every lane runs its own epoch/seq/replay clock and heals independently;
+// a lane that cannot be revived degrades the link to the survivors instead
+// of killing it. Messages >= ACX_STRIPE_MIN_BYTES travel as a kMagicStripe
+// envelope on lane 0 (holding the message's FIFO matching slot) plus
+// kMagicChunk slices round-robin across all live lanes, reassembled by
+// explicit offset on the receive side. Everything below the threshold — and
+// everything at ACX_STRIPES=1, the default — is byte-identical to the
+// single-flow protocol.
 
 #include "acx/net.h"
 
@@ -33,7 +51,9 @@
 #include <unistd.h>
 
 #include <climits>
+#include <set>
 #include <unordered_map>
+#include <unordered_set>
 
 #include <atomic>
 #include <cstdint>
@@ -50,16 +70,26 @@
 #include "acx/membership.h"
 #include "acx/metrics.h"
 #include "acx/trace.h"
+#include "src/net/framing.h"
 #include "src/net/link.h"
+#include "src/net/link_state.h"
+#include "src/net/stripe.h"
 #include "src/net/wire.h"
 
 namespace acx {
 namespace {
 
-// Frame format lives in src/net/wire.h (40-byte header: magic, tag, ctx,
-// payload CRC32C, bytes, per-link seq, link epoch, header CRC32C). The
-// aliases keep this file's protocol code readable.
+// Frame format lives in src/net/wire.h (56-byte header); frame payload
+// shapes and the replay buffer live in src/net/framing.h. The aliases keep
+// this file's protocol code readable.
 using wire::WireHeader;
+using framing::ChunkHdr;
+using framing::MakeHdr;
+using framing::KnownMagic;
+using framing::RvAck;
+using framing::RvDesc;
+using framing::StripeDesc;
+using framing::WirePayloadLen;
 constexpr uint32_t kMagic = wire::kMagic;
 // Rendezvous frames (large-message single-copy path, same host only):
 // an RTS frame advertises {addr, seq, pid} of the sender's buffer; the
@@ -77,6 +107,8 @@ constexpr uint32_t kMagicAck = wire::kMagicAck;
 constexpr uint32_t kMagicHb = wire::kMagicHb;
 constexpr uint32_t kMagicSeqAck = wire::kMagicSeqAck;
 constexpr uint32_t kMagicNak = wire::kMagicNak;
+constexpr uint32_t kMagicStripe = wire::kMagicStripe;
+constexpr uint32_t kMagicChunk = wire::kMagicChunk;
 
 // Internal context ids. User contexts are >= 0; the control plane and the
 // partitioned layer get their own namespaces so they can never match user
@@ -90,63 +122,32 @@ inline int PartCtx(int ctx) { return -1000 - ctx; }
 // 4096, mpi-acx-internal.h:141, so this bounds nothing in practice).
 inline int PartTag(int tag, int p) { return tag * 4096 + p; }
 
-#pragma pack(push, 1)
-struct RvDesc {  // RTS wire payload
-  uint64_t addr;
-  uint32_t seq;
-  int32_t pid;
-};
-struct RvAck {  // ACK wire payload
-  uint32_t seq;
-  int32_t ok;
-};
-#pragma pack(pop)
-
-inline WireHeader MakeHdr(uint32_t magic, int tag, int ctx, uint64_t bytes) {
-  WireHeader h{};
-  h.magic = magic;
-  h.tag = tag;
-  h.ctx = ctx;
-  h.bytes = bytes;
-  return h;
-}
-
-// Actual on-wire payload length of a frame. NOT hdr.bytes for RTS/ACK: an
-// RTS advertises the full message length in bytes while carrying only the
-// 16-byte descriptor, and an ACK advertises 0 while carrying 8.
-inline size_t WirePayloadLen(const WireHeader& h) {
-  switch (h.magic) {
-    case wire::kMagicRts: return sizeof(RvDesc);
-    case wire::kMagicAck: return sizeof(RvAck);
-    case wire::kMagic: return static_cast<size_t>(h.bytes);
-    default: return 0;
-  }
-}
-
-inline bool KnownMagic(uint32_t m) {
-  return m == wire::kMagic || m == wire::kMagicRts || m == wire::kMagicAck ||
-         m == wire::kMagicHb || m == wire::kMagicSeqAck ||
-         m == wire::kMagicNak || m == wire::kMagicHello ||
-         m == wire::kMagicView;
-}
-
 // Zero-copy send: the wire is fed straight from the user buffer (legal —
 // the caller may not touch it until the ticket completes), so large
-// messages cost exactly one memcpy into the ring / socket.
+// messages cost exactly one gather-write into the ring / socket.
 struct SendReq {
   WireHeader hdr{};
   const char* payload = nullptr;  // user buffer, borrowed until done
   size_t bytes = 0;               // user message length (== hdr.bytes)
   const char* wire_payload = nullptr;  // what actually goes on the wire
   size_t wire_bytes = 0;               // (== payload/bytes except RTS/ACK)
-  size_t off = 0;  // progress over [header | wire payload]
+  // Chunk frames carry TWO wire segments after the header: the 24-byte
+  // ChunkHdr (stored in desc, pointed at by wire_head) and the borrowed
+  // payload slice. Zero/null on every other frame class.
+  const char* wire_head = nullptr;
+  size_t wire_head_bytes = 0;
+  size_t off = 0;  // progress over [header | wire head | wire payload]
   bool rv = false;  // rendezvous: wire completion != user completion
   bool done = false;
   // Replay frame: wire_payload is a complete [header|payload] blob borrowed
-  // from the peer's replay buffer; no separate header is written and no new
+  // from the lane's replay buffer; no separate header is written and no new
   // record is made (hdr.seq identifies the record to un-queue on write).
   bool raw = false;
   bool fault_checked = false;  // OnFrame consulted once per frame
+  // Deferred payload CRC (chunks only): computed at first write attempt of
+  // THIS frame, i.e. right after the previous chunk's writev returned —
+  // the CRC of chunk k+1 overlaps the kernel's handling of chunk k.
+  bool crc_deferred = false;
   // corrupt_frame poisons the on-wire crc field; the pristine values are
   // kept so the replay record (and any post-reconnect resend) is clean.
   bool corrupted = false;
@@ -155,7 +156,12 @@ struct SendReq {
   // per-link tx-queue histogram; 0 on control frames (not measured).
   uint64_t enq_ns = 0;
   int dst = -1;   // destination rank (dead-peer teardown scans rv_pending_)
-  char desc[16];  // storage for RTS/ACK wire payloads
+  char desc[24];  // storage for RTS/ACK/StripeDesc/ChunkHdr wire payloads
+  // Striped parent: the user-visible SendReq of a striped message. The
+  // parent itself is never queued; it completes when its `pending` child
+  // frames (envelope + chunks) have all fully written.
+  std::shared_ptr<SendReq> parent;
+  uint32_t pending = 0;
   Status st;
 };
 
@@ -178,12 +184,16 @@ struct Msg {
   RvDesc rv_desc{};
   uint64_t rv_bytes = 0;  // full message length advertised by the RTS
   uint64_t span = 0;      // the SENDER op's span id, off the wire header
+  // Unexpected stripe envelope: a PLACEHOLDER holding the message's FIFO
+  // matching slot while chunks land in the reassembly map. payload empty;
+  // stripe_id keys peers_[src].stripes. 0 = plain message.
+  uint32_t stripe_id = 0;
 };
 
-// Incoming-byte-stream assembly state for one peer link. When the header
-// matches an already-posted recv, payload bytes stream directly into the
-// recv buffer (`direct`); otherwise they assemble into `payload` and queue
-// as an unexpected message.
+// Incoming-byte-stream assembly state for ONE subflow of one peer link.
+// When the header matches an already-posted recv, payload bytes stream
+// directly into the recv buffer (`direct`); otherwise they assemble into
+// `payload` and queue as an unexpected message.
 struct InState {
   WireHeader hdr{};
   size_t hdr_got = 0;
@@ -193,6 +203,25 @@ struct InState {
   uint32_t run_crc = 0;    // incremental CRC32C over the streamed payload
   bool discard = false;    // stale/duplicate/out-of-order frame: drain+drop
   bool nak_after = false;  // sequence gap: re-pull once the frame is drained
+  // Chunk-frame assembly: the leading 24-byte ChunkHdr, read before the
+  // slice bytes are routed to their destination by explicit offset.
+  ChunkHdr chdr{};
+  size_t chdr_got = 0;
+};
+
+// Receive-side reassembly of one striped message. Chunks may arrive before
+// the envelope (lanes are independent streams), so the entry is created by
+// whichever lands first; `have_env` gates completion. Once a recv matches
+// (`direct`), further slices stream straight into the user buffer.
+struct StripeRx {
+  bool have_env = false;
+  int tag = 0, ctx = 0;
+  uint64_t total = 0;     // full message length (envelope hdr.bytes)
+  uint32_t nchunks = 0;
+  uint64_t span = 0;      // sender op's span id, off the envelope
+  std::shared_ptr<RecvReq> direct;
+  std::vector<char> assembly;           // pre-match landing zone
+  std::unordered_set<uint32_t> got;     // chunk indices received
 };
 
 class StreamTransport;
@@ -218,7 +247,7 @@ class StreamTransport : public Transport {
   StreamTransport(int rank, int size, std::vector<std::unique_ptr<Link>> links,
                   void* shm_base = nullptr, size_t shm_len = 0,
                   bool sock_plane = false)
-      : rank_(rank), size_(size), links_(std::move(links)), peers_(size),
+      : rank_(rank), size_(size), peers_(size),
         shm_base_(shm_base), shm_len_(shm_len) {
     const char* e = getenv("ACX_RV_THRESHOLD");
     if (e != nullptr) {
@@ -299,6 +328,28 @@ class StreamTransport : public Transport {
       // PR-1 fail-stop behavior rather than promise recovery we can't do.
       if (listen_fd_ < 0) recovery_armed_ = false;
     }
+    // Striping (DESIGN.md §15): subflows ride the same rendezvous listener
+    // the reconnect ladder uses, so lanes need recovery armed. Forcing
+    // stripes_ = 1 otherwise keeps shm/self/unmanaged runs on the proven
+    // single-flow path — loudly when the user explicitly asked for lanes.
+    stripe_cfg_ = stripe::ConfigFromEnv();
+    stripes_ = stripe_cfg_.stripes;
+    if (stripes_ > 1 && !recovery_armed_) {
+      if (sock_plane && size_ > 1)
+        std::fprintf(stderr,
+                     "tpu-acx[%d]: ACX_STRIPES=%d ignored (no ACX_JOB_ID "
+                     "rendezvous listener to dial subflows on)\n",
+                     rank_, stripes_);
+      stripes_ = 1;
+    }
+    // Seat every peer's lane array: lane 0 is the inherited link; lanes
+    // 1..N-1 start linkless and are dialed lazily by the lower rank.
+    for (int p = 0; p < size_; p++) {
+      Peer& peer = peers_[p];
+      peer.sf.resize(p == rank_ ? 1 : stripes_ < 1 ? 1 : stripes_);
+      if (p != rank_ && static_cast<size_t>(p) < links.size())
+        peer.sf[0].link = std::move(links[p]);
+    }
 #ifdef PR_SET_PTRACER
     // Let sibling ranks process_vm_readv our send buffers even under
     // Yama ptrace_scope=1 (no-op where Yama is absent; nack path covers
@@ -320,7 +371,7 @@ class StreamTransport : public Transport {
 
   ~StreamTransport() override {
     if (listen_fd_ >= 0) close(listen_fd_);
-    links_.clear();
+    peers_.clear();
     if (shm_base_ != nullptr) munmap(shm_base_, shm_len_);
   }
 
@@ -405,6 +456,8 @@ class StreamTransport : public Transport {
     ns.crc_rejects = crc_rejects_.load(std::memory_order_relaxed);
     ns.naks_sent = naks_sent_.load(std::memory_order_relaxed);
     ns.links_recovering = recovering_count_.load(std::memory_order_relaxed);
+    ns.replay_broken_links =
+        replay_broken_links_.load(std::memory_order_relaxed);
     return ns;
   }
 
@@ -434,11 +487,15 @@ class StreamTransport : public Transport {
     }
     if (!lk.owns_lock()) return false;
     const Peer& p = peers_[r];
-    out->epoch = p.epoch;
-    out->tx_seq = p.tx_seq;
-    out->rx_seq = p.rx_seq;
-    out->acked_rx = p.acked_rx;
-    out->replay_bytes = p.replay_bytes;
+    // Lane 0 is the link's identity clock; replay backlog is the SUM over
+    // lanes (the number a stall report cares about is total unacked bytes).
+    out->epoch = p.sf[0].clk.epoch;
+    out->tx_seq = p.sf[0].clk.tx_seq;
+    out->rx_seq = p.sf[0].clk.rx_seq;
+    out->acked_rx = p.sf[0].clk.acked_rx;
+    uint64_t rb = 0;
+    for (const Subflow& sf : p.sf) rb += sf.replay.bytes;
+    out->replay_bytes = rb;
     return true;
   }
 
@@ -454,7 +511,7 @@ class StreamTransport : public Transport {
     if (!lk.owns_lock()) return false;
     const Peer& p = peers_[r];
     out->state = peer_dead_[r] ? 2 : (p.health != 0 ? 1 : 0);
-    out->epoch = p.epoch;
+    out->epoch = p.sf[0].clk.epoch;
     out->tx_payload_bytes = p.sc_tx_payload;
     out->tx_wire_bytes = p.sc_tx_wire;
     out->rx_payload_bytes = p.sc_rx_payload;
@@ -464,6 +521,11 @@ class StreamTransport : public Transport {
     out->naks = p.sc_naks;
     out->crc_rejects = p.sc_crc_rejects;
     out->replayed = p.sc_replayed;
+    out->subflows = static_cast<uint32_t>(p.sf.size());
+    uint32_t up = 0;
+    for (const Subflow& sf : p.sf)
+      if (sf.link && !sf.down) up++;
+    out->subflows_up = up;
     out->tx_queue_ns_sum = p.sc_tx_queue_ns;
     out->tx_queue_frames = p.sc_tx_queue_frames;
     out->rx_transit_ns_sum = p.sc_rx_transit_ns;
@@ -483,7 +545,7 @@ class StreamTransport : public Transport {
     std::lock_guard<std::mutex> lk(mu_);
     const uint64_t fepoch = Fleet().OnLeave(rank_);
     for (int q = 0; q < size_; q++) {
-      if (q == rank_ || !links_[q] || peer_dead_[q]) continue;
+      if (q == rank_ || !peers_[q].sf[0].link || peer_dead_[q]) continue;
       if (peers_[q].health != 0) continue;
       SendViewLocked(q, rank_, MemberState::kMemberLeft, fepoch);
     }
@@ -508,13 +570,13 @@ class StreamTransport : public Transport {
     for (;;) {
       int missing = 0;
       for (int p = 0; p < size_; p++) {
-        if (p == rank_ || links_[p] || peer_dead_[p]) continue;
+        if (p == rank_ || peers_[p].sf[0].link || peer_dead_[p]) continue;
         if (!DialJoinLocked(p)) missing++;
       }
       if (missing == 0) break;
       if (NowNs() >= deadline) {
         for (int p = 0; p < size_; p++) {
-          if (p == rank_ || links_[p] || peer_dead_[p]) continue;
+          if (p == rank_ || peers_[p].sf[0].link || peer_dead_[p]) continue;
           MarkPeerDeadLocked(p, "unreachable at join", /*hb_detected=*/true);
         }
         break;
@@ -528,7 +590,7 @@ class StreamTransport : public Transport {
     Fleet().OnJoin(rank_);  // no-op bump-wise if Reset left us ACTIVE
     int linked = 0;
     for (int p = 0; p < size_; p++)
-      if (p != rank_ && links_[p]) linked++;
+      if (p != rank_ && peers_[p].sf[0].link) linked++;
     std::fprintf(stderr,
                  "tpu-acx[%d]: joined fleet (%d/%d peer link(s), fleet "
                  "epoch %llu)\n",
@@ -554,42 +616,56 @@ class StreamTransport : public Transport {
   friend class SockPsendChan;
   friend class SockPrecvChan;
 
-  // One fully-written-but-unacked frame, byte-exact as it went on the wire
-  // ([header|payload]). `queued` marks a record currently re-enqueued on the
-  // outq as a raw frame (its blob is borrowed — the record must not be
-  // popped or evicted until the write completes).
-  struct ReplayRec {
-    uint64_t seq = 0;
-    std::vector<char> frame;
-    bool queued = false;
+  // One lane of a peer link (DESIGN.md §15). Lane 0 is the link itself (the
+  // acxrun-inherited socket or shm ring); lanes >= 1 are striping subflows
+  // dialed lazily against the peer's rendezvous listener. Each lane is a
+  // full independent stream: its own outq, inbound assembly, wire clock,
+  // and replay buffer — so CRC rejects, NAK re-pulls, and epoch-bumped
+  // reconnects heal per lane without touching the others.
+  struct Subflow {
+    std::unique_ptr<Link> link;             // null: not (yet) established
+    std::deque<std::shared_ptr<SendReq>> outq;
+    InState in;
+    link_state::WireClock clk;
+    framing::ReplayBuffer replay;
+    uint64_t stall_until_ns = 0;  // stall_link_ms fault gate
+    // Lane lifecycle. `down` latches a lane the link DEGRADED away from
+    // (redial ladder exhausted / acceptor deadline expired): the link keeps
+    // moving on the survivors and never retries a down lane. Dial state is
+    // for lanes >= 1 only; lane 0 uses the peer-level recovery ladder.
+    bool down = false;
+    uint64_t next_dial_ns = 0;  // dialer: earliest next connect attempt
+    int dial_attempts = 0;      // dialer: attempts since lane was last up
+    uint64_t give_up_ns = 0;    // acceptor: degrade if no subflow hello by
   };
 
   struct Peer {
-    std::deque<std::shared_ptr<SendReq>> outq;
-    InState in;
+    std::vector<Subflow> sf;                     // lanes; sf[0] = the link
     std::deque<Msg> arrived;                     // unmatched arrivals, FIFO
     std::deque<std::shared_ptr<RecvReq>> posted; // unmatched recvs, FIFO
 
-    // -- survivable-link state (DESIGN.md §9) --
-    uint32_t epoch = 1;        // link incarnation; bumped per reconnect
-    uint64_t tx_seq = 0;       // last sequence number assigned
-    uint64_t rx_seq = 0;       // last in-order frame delivered
-    uint64_t acked_rx = 0;     // rx_seq we last advertised in a SeqAck
-    uint32_t rx_since_ack = 0; // delivered frames since the last SeqAck
-    uint64_t last_nak_ns = 0;  // NAK rate limit
-    std::deque<ReplayRec> replay;  // fully-written, unacked frames
-    size_t replay_bytes = 0;
-    bool replay_broken = false;    // an unacked record was evicted
-    int health = 0;                // 0 healthy, 1 recovering
+    // -- striped reassembly (DESIGN.md §15) --
+    std::unordered_map<uint32_t, StripeRx> stripes;
+    // Recently-completed stripe ids. A lane degradation migrates unacked
+    // chunk frames into a survivor's seq space with FRESH seqs, so a chunk
+    // for an already-delivered message passes the per-lane duplicate gate;
+    // this set is what recognizes (and drains) it instead of resurrecting
+    // a never-completing map entry. Bounded: pruned to the newest 1024.
+    std::set<uint32_t> done_stripes;
+    uint32_t next_stripe_id = 1;  // tx side: per-peer-direction id counter
+    int rr_cursor = 0;            // tx side: round-robin lane cursor
+    bool replay_broken_noted = false;  // this link counted in the gauge
+
+    int health = 0;                // 0 healthy, 1 recovering (lane 0)
     int rec_attempts = 0;          // dialer: connects attempted this outage
     uint64_t rec_next_ns = 0;      // dialer: next connect attempt
     uint64_t rec_deadline_ns = 0;  // acceptor: give up waiting for a dial
-    uint64_t stall_until_ns = 0;   // stall_link_ms fault gate
 
     // -- wire scope (DESIGN.md §13) -- cumulative per-link accounting,
-    // written under mu_, exported via link_scope(). Peer objects persist
-    // across reconnects (only tx_seq resets on adoption), so these stay
-    // cumulative for the life of the process.
+    // written under mu_, exported via link_scope(). Aggregated over lanes
+    // (the per-link goodput/overhead split is what tseries/acx_top read);
+    // Peer objects persist across reconnects, so these stay cumulative for
+    // the life of the process.
     uint64_t sc_tx_payload = 0;  // app bytes queued in eager data frames
     uint64_t sc_tx_wire = 0;     // every byte write(2) accepted for this link
     uint64_t sc_rx_payload = 0;  // app bytes delivered from data frames
@@ -610,6 +686,27 @@ class StreamTransport : public Transport {
     uint64_t sc_rx_transit_frames = 0;
   };
 
+  // Count of lanes currently usable for fresh traffic.
+  int LiveLanesLocked(const Peer& peer) const {
+    int n = 0;
+    for (const Subflow& sf : peer.sf)
+      if (sf.link && !sf.down) n++;
+    return n;
+  }
+
+  // Next live lane at or after peer.rr_cursor, advancing the cursor. Lane 0
+  // is always live when this is called (the link would be recovering/dead
+  // otherwise), so the loop terminates.
+  int NextLiveLaneLocked(Peer& peer) {
+    const int n = static_cast<int>(peer.sf.size());
+    for (int i = 0; i < n; i++) {
+      const int k = peer.rr_cursor;
+      peer.rr_cursor = (peer.rr_cursor + 1) % n;
+      if (peer.sf[k].link && !peer.sf[k].down) return k;
+    }
+    return 0;
+  }
+
   Ticket* IsendLocked(const void* buf, size_t bytes, int dst, int tag,
                       int ctx, uint64_t span = 0) {
     if (dst != rank_ && (dst < 0 || dst >= size_)) {
@@ -627,7 +724,7 @@ class StreamTransport : public Transport {
       s->done = true;
       return new SockTicket(this, s);
     }
-    if (dst != rank_ && !links_[dst]) {
+    if (dst != rank_ && !peers_[dst].sf[0].link) {
       std::fprintf(stderr, "tpu-acx[%d]: no wire to peer %d\n", rank_, dst);
       _exit(14);
     }
@@ -661,22 +758,91 @@ class StreamTransport : public Transport {
       s->wire_bytes = sizeof d;
       s->rv = true;
       rv_pending_[seq] = s;
-    } else {
-      s->hdr = MakeHdr(kMagic, tag, ctx, bytes);
-      s->wire_payload = s->payload;
-      s->wire_bytes = bytes;
+      s->hdr.span = span;
+      s->hdr.crc = PayloadCrc(s->wire_payload, s->wire_bytes);
+      StampSeqLocked(dst, 0, &s->hdr);
+      peers_[dst].sf[0].outq.push_back(s);
+      FlushOutLocked(dst, 0);
+      return new SockTicket(this, s);
     }
-    s->hdr.span = span;
-    s->hdr.crc = PayloadCrc(s->wire_payload, s->wire_bytes);
-    StampSeqLocked(dst, &s->hdr);
-    peers_[dst].outq.push_back(s);
-    FlushOutLocked(dst);
+    EnqueueEagerLocked(dst, s, tag, ctx, span);
     return new SockTicket(this, s);
+  }
+
+  // Eager path shared by IsendLocked and the rendezvous nack fallback: put
+  // the payload on the wire as one kMagic frame — or, when the striping
+  // policy says so, as a kMagicStripe envelope on lane 0 plus kMagicChunk
+  // slices round-robin over every live lane. The caller owns s->payload/
+  // s->bytes and has reset off/rv/fault state.
+  void EnqueueEagerLocked(int p, const std::shared_ptr<SendReq>& s, int tag,
+                          int ctx, uint64_t span) {
+    Peer& peer = peers_[p];
+    const int nlive = LiveLanesLocked(peer);
+    if (stripe::ShouldStripe(s->bytes, nlive, stripe_cfg_)) {
+      // 31-bit id (it travels in the chunk header's int32 tag field too);
+      // skip 0, which means "not a stripe" in Msg::stripe_id.
+      uint32_t msg_id = peer.next_stripe_id++ & 0x7fffffffu;
+      if (msg_id == 0) msg_id = peer.next_stripe_id++ & 0x7fffffffu;
+      const std::vector<stripe::ChunkSpan> plan =
+          stripe::PlanChunks(s->bytes, nlive);
+      // The parent never touches the wire; it completes when the envelope
+      // and every chunk have fully written.
+      s->pending = static_cast<uint32_t>(plan.size()) + 1;
+      // Envelope: holds the message's FIFO slot on lane 0.
+      auto env = std::make_shared<SendReq>();
+      env->hdr = MakeHdr(kMagicStripe, tag, ctx, s->bytes);
+      env->hdr.span = span;
+      StripeDesc sd{msg_id, static_cast<uint32_t>(plan.size()), s->bytes};
+      static_assert(sizeof sd <= sizeof env->desc, "desc too small");
+      memcpy(env->desc, &sd, sizeof sd);
+      env->wire_payload = env->desc;
+      env->wire_bytes = sizeof sd;
+      env->dst = p;
+      env->enq_ns = s->enq_ns;
+      env->parent = s;
+      env->hdr.crc = PayloadCrc(env->wire_payload, env->wire_bytes);
+      StampSeqLocked(p, 0, &env->hdr);
+      peer.sf[0].outq.push_back(std::move(env));
+      for (size_t i = 0; i < plan.size(); i++) {
+        auto c = std::make_shared<SendReq>();
+        c->hdr = MakeHdr(kMagicChunk, static_cast<int>(msg_id),
+                         static_cast<int>(i), plan[i].len);
+        c->hdr.span = span;
+        ChunkHdr ch{msg_id, static_cast<uint32_t>(i), plan[i].offset,
+                    plan[i].len};
+        static_assert(sizeof ch <= sizeof c->desc, "desc too small");
+        memcpy(c->desc, &ch, sizeof ch);
+        c->wire_head = c->desc;
+        c->wire_head_bytes = sizeof ch;
+        c->wire_payload = s->payload + plan[i].offset;
+        c->wire_bytes = static_cast<size_t>(plan[i].len);
+        c->dst = p;
+        c->enq_ns = s->enq_ns;
+        c->parent = s;
+        // CRC deferred to the first write attempt: chunk k+1's checksum
+        // computes while the kernel is still moving chunk k (FlushOut).
+        c->crc_deferred = true;
+        const int lane = NextLiveLaneLocked(peer);
+        StampSeqLocked(p, lane, &c->hdr);
+        peer.sf[lane].outq.push_back(std::move(c));
+      }
+      for (size_t k = 0; k < peer.sf.size(); k++) FlushOutLocked(p, k);
+      return;
+    }
+    s->hdr = MakeHdr(kMagic, tag, ctx, s->bytes);
+    s->hdr.span = span;
+    s->wire_payload = s->payload;
+    s->wire_bytes = s->bytes;
+    s->hdr.crc = PayloadCrc(s->wire_payload, s->wire_bytes);
+    StampSeqLocked(p, 0, &s->hdr);
+    peer.sf[0].outq.push_back(s);
+    FlushOutLocked(p, 0);
   }
 
   // -- wire stamping ---------------------------------------------------------
   // Sequence numbers are assigned at ENQUEUE time (all enqueues push_back and
-  // the outq drains front-to-back) so wire order equals sequence order.
+  // each lane's outq drains front-to-back) so wire order equals sequence
+  // order within every lane.
 
   uint32_t PayloadCrc(const char* p, size_t n) const {
     return (crc_on_ && n != 0) ? wire::Crc32c(0, p, n) : 0;
@@ -684,14 +850,14 @@ class StreamTransport : public Transport {
 
   // Epoch + header CRC for an unsequenced frame whose seq field the caller
   // already filled (heartbeat high-water, SeqAck/NAK cumulative rx).
-  void SealHdrLocked(int dst, WireHeader* h) {
-    h->epoch = peers_[dst].epoch;
+  void SealHdrLocked(int dst, size_t lane, WireHeader* h) {
+    h->epoch = peers_[dst].sf[lane].clk.epoch;
     h->hcrc = wire::HeaderCrc(*h);
   }
 
-  void StampSeqLocked(int dst, WireHeader* h) {
-    h->seq = ++peers_[dst].tx_seq;
-    SealHdrLocked(dst, h);
+  void StampSeqLocked(int dst, size_t lane, WireHeader* h) {
+    h->seq = ++peers_[dst].sf[lane].clk.tx_seq;
+    SealHdrLocked(dst, lane, h);
   }
 
   Ticket* IrecvLocked(void* buf, size_t bytes, int src, int tag, int ctx,
@@ -724,6 +890,10 @@ class StreamTransport : public Transport {
         if (it->rv) {
           CompleteRvLocked(src, r, it->tag, it->rv_bytes, it->rv_desc,
                            it->span);
+        } else if (it->stripe_id != 0) {
+          // Stripe placeholder: attach the recv to the in-progress
+          // reassembly (completing it if every chunk already landed).
+          AttachStripeLocked(src, it->stripe_id, r);
         } else {
           CompleteRecv(r.get(), src, *it);
         }
@@ -736,7 +906,7 @@ class StreamTransport : public Transport {
       r->done = true;
       return new SockTicket(this, r);
     }
-    if (src != rank_ && !links_[src]) {
+    if (src != rank_ && !peers_[src].sf[0].link) {
       std::fprintf(stderr, "tpu-acx[%d]: no wire to peer %d\n", rank_, src);
       _exit(14);
     }
@@ -751,6 +921,94 @@ class StreamTransport : public Transport {
     r->st =
         Status{src, r->report_tag != INT_MIN ? r->report_tag : m.tag, err, n};
     r->done = true;
+  }
+
+  // -- striped receive (DESIGN.md §15) ---------------------------------------
+
+  // A stripe envelope arrived on lane 0: create/complete the reassembly
+  // entry and give the message its slot in FIFO matching order — matching a
+  // posted recv directly, or queueing a placeholder Msg.
+  void HandleStripeEnvLocked(int p, const WireHeader& h, const StripeDesc& d) {
+    Peer& peer = peers_[p];
+    StripeRx& srx = peer.stripes[d.msg_id];  // chunks may have preceded us
+    srx.have_env = true;
+    srx.tag = h.tag;
+    srx.ctx = h.ctx;
+    srx.total = d.total_bytes;
+    srx.nchunks = d.nchunks;
+    srx.span = h.span;
+    auto& posted = peer.posted;
+    for (auto it = posted.begin(); it != posted.end(); ++it) {
+      if ((*it)->tag == h.tag && (*it)->ctx == h.ctx) {
+        std::shared_ptr<RecvReq> r = *it;
+        posted.erase(it);
+        NoteMatchLocked(h.span, r->span);
+        srx.direct = r;
+        if (!srx.assembly.empty()) {
+          // Chunks that landed pre-match copied into the assembly buffer;
+          // flush them into the user buffer and stream the rest direct.
+          const size_t n =
+              srx.assembly.size() < r->bytes ? srx.assembly.size() : r->bytes;
+          memcpy(r->buf, srx.assembly.data(), n);
+          srx.assembly.clear();
+          srx.assembly.shrink_to_fit();
+        }
+        if (srx.got.size() == srx.nchunks) CompleteStripeLocked(p, d.msg_id);
+        return;
+      }
+    }
+    Msg m;
+    m.tag = h.tag;
+    m.ctx = h.ctx;
+    m.span = h.span;
+    m.stripe_id = d.msg_id;
+    peer.arrived.push_back(std::move(m));
+  }
+
+  // A posted/late recv matched a stripe placeholder from the arrived queue.
+  void AttachStripeLocked(int p, uint32_t msg_id,
+                          const std::shared_ptr<RecvReq>& r) {
+    Peer& peer = peers_[p];
+    auto it = peer.stripes.find(msg_id);
+    if (it == peer.stripes.end()) {
+      // The reassembly was torn down (peer died mid-stripe) but the
+      // placeholder outlived it: fail like any other post against the gap.
+      r->st = Status{p, r->tag, kErrPeerDead, 0};
+      r->done = true;
+      return;
+    }
+    StripeRx& srx = it->second;
+    srx.direct = r;
+    if (!srx.assembly.empty()) {
+      const size_t n =
+          srx.assembly.size() < r->bytes ? srx.assembly.size() : r->bytes;
+      memcpy(r->buf, srx.assembly.data(), n);
+      srx.assembly.clear();
+      srx.assembly.shrink_to_fit();
+    }
+    if (srx.have_env && srx.got.size() == srx.nchunks)
+      CompleteStripeLocked(p, msg_id);
+  }
+
+  // Every chunk landed AND the envelope matched a recv: complete it and
+  // retire the reassembly entry into the done-set.
+  void CompleteStripeLocked(int p, uint32_t msg_id) {
+    Peer& peer = peers_[p];
+    auto it = peer.stripes.find(msg_id);
+    if (it == peer.stripes.end() || !it->second.direct) return;
+    StripeRx& srx = it->second;
+    RecvReq* r = srx.direct.get();
+    const size_t deliver =
+        srx.total < r->bytes ? static_cast<size_t>(srx.total) : r->bytes;
+    peer.sc_rx_payload += deliver;  // wire scope: goodput on completion
+    peer.sc_rx_frames++;
+    r->st = Status{p, r->report_tag != INT_MIN ? r->report_tag : srx.tag,
+                   srx.total > r->bytes ? kErrTruncate : 0, deliver};
+    r->done = true;
+    peer.stripes.erase(it);
+    peer.done_stripes.insert(msg_id);
+    while (peer.done_stripes.size() > 1024)
+      peer.done_stripes.erase(peer.done_stripes.begin());
   }
 
   // Pull an RTS-advertised payload straight out of the sender's address
@@ -800,9 +1058,9 @@ class StreamTransport : public Transport {
     s->hdr.span = span;
     s->enq_ns = trace::NowSinceStartNs();
     s->hdr.crc = PayloadCrc(s->wire_payload, s->wire_bytes);
-    StampSeqLocked(dst, &s->hdr);
-    peers_[dst].outq.push_back(std::move(s));
-    FlushOutLocked(dst);
+    StampSeqLocked(dst, 0, &s->hdr);
+    peers_[dst].sf[0].outq.push_back(std::move(s));
+    FlushOutLocked(dst, 0);
   }
 
   void HandleAckLocked(int src, const RvAck& a) {
@@ -815,21 +1073,15 @@ class StreamTransport : public Transport {
       return;
     }
     // Receiver couldn't pvread: re-send as a normal copy frame on the
-    // fallback key it just posted.
+    // fallback key it just posted. Goes through the shared eager path, so
+    // a big fallback payload stripes exactly like a first-try eager send.
     s->rv = false;
     const uint64_t span = s->hdr.span;  // survives the header rebuild
-    s->hdr = MakeHdr(kMagic, static_cast<int>(a.seq & 0x7fffffff), kRvDataCtx,
-                     s->bytes);
-    s->hdr.span = span;
-    s->wire_payload = s->payload;
-    s->wire_bytes = s->bytes;
     s->off = 0;
     s->fault_checked = false;
     s->enq_ns = trace::NowSinceStartNs();
-    s->hdr.crc = PayloadCrc(s->wire_payload, s->wire_bytes);
-    StampSeqLocked(src, &s->hdr);
-    peers_[src].outq.push_back(std::move(s));
-    FlushOutLocked(src);
+    EnqueueEagerLocked(src, s, static_cast<int>(a.seq & 0x7fffffff),
+                       kRvDataCtx, span);
   }
 
   void DeliverLocked(int src, Msg&& m) {
@@ -902,111 +1154,106 @@ class StreamTransport : public Transport {
                  rank_, who, magic);
   }
 
-  // Copy a fully-written frame into the bounded replay buffer. Called at
-  // full-write time (the payload is still borrowed, so the copy is legal);
-  // a corrupt_frame-poisoned header is recorded with its pristine CRCs so a
-  // replay heals rather than re-injects.
-  void RecordFrameLocked(int p, SendReq* s) {
+  // Copy a fully-written frame into the lane's bounded replay buffer.
+  // Called at full-write time (the payload is still borrowed, so the copy
+  // is legal); a corrupt_frame-poisoned header is recorded with its
+  // pristine CRCs so a replay heals rather than re-injects.
+  void RecordFrameLocked(int p, size_t lane, SendReq* s) {
     Peer& peer = peers_[p];
-    ReplayRec rec;
-    rec.seq = s->hdr.seq;
-    rec.frame.resize(sizeof(WireHeader) + s->wire_bytes);
     WireHeader h = s->hdr;
     if (s->corrupted) {
       h.crc = s->good_crc;
       h.hcrc = s->good_hcrc;
     }
-    memcpy(rec.frame.data(), &h, sizeof h);
-    if (s->wire_bytes != 0)
-      memcpy(rec.frame.data() + sizeof h, s->wire_payload, s->wire_bytes);
-    peer.replay_bytes += rec.frame.size();
-    peer.replay.push_back(std::move(rec));
-    // Bounded buffer: evict oldest while over budget. A record whose blob is
-    // borrowed by an in-flight raw frame pins everything behind it. Any
-    // eviction of an unacked record breaks replayability — latched so a
-    // future recovery fails loudly instead of replaying a gapped stream.
-    while (peer.replay_bytes > replay_budget_ && !peer.replay.empty() &&
-           !peer.replay.front().queued) {
-      peer.replay_bytes -= peer.replay.front().frame.size();
-      peer.replay.pop_front();
-      peer.replay_broken = true;
+    const bool evicted = peer.sf[lane].replay.Record(
+        h, s->wire_head, s->wire_head_bytes, s->wire_payload, s->wire_bytes,
+        replay_budget_);
+    if (evicted && !peer.replay_broken_noted) {
+      // First eviction on this link: count it in the fleet-visible gauge
+      // (NetStats.replay_broken_links) and say so once — the link still
+      // moves data, but its next loss is terminal (DESIGN.md §9).
+      peer.replay_broken_noted = true;
+      replay_broken_links_.fetch_add(1, std::memory_order_relaxed);
+      std::fprintf(stderr,
+                   "tpu-acx[%d]: replay buffer for peer %d overran "
+                   "ACX_REPLAY_BUF_BYTES; link can no longer survive a "
+                   "reconnect\n",
+                   rank_, p);
     }
   }
 
   // A raw (replay) frame finished writing: release its record's blob.
-  void ClearQueuedLocked(int p, uint64_t seq) {
-    for (auto& rec : peers_[p].replay) {
-      if (rec.seq == seq) {
-        rec.queued = false;
-        return;
-      }
-    }
+  void ClearQueuedLocked(int p, size_t lane, uint64_t seq) {
+    peers_[p].sf[lane].replay.ClearQueued(seq);
   }
 
-  // Peer acknowledged delivery of everything up to `acked`: trim records.
-  void HandleSeqAckLocked(int p, uint64_t acked) {
-    Peer& peer = peers_[p];
-    while (!peer.replay.empty() && !peer.replay.front().queued &&
-           peer.replay.front().seq <= acked) {
-      peer.replay_bytes -= peer.replay.front().frame.size();
-      peer.replay.pop_front();
-    }
+  // Peer acknowledged delivery of everything up to `acked` on this lane.
+  void HandleSeqAckLocked(int p, size_t lane, uint64_t acked) {
+    peers_[p].sf[lane].replay.AckThrough(acked);
   }
 
-  // Header-only cumulative ack of our delivered-in-order high water.
-  void SendSeqAckLocked(int p) {
+  // Header-only cumulative ack of our delivered-in-order high water on one
+  // lane (acks travel on the lane they acknowledge — each lane is its own
+  // seq space).
+  void SendSeqAckLocked(int p, size_t lane) {
     Peer& peer = peers_[p];
+    Subflow& sf = peer.sf[lane];
     auto s = std::make_shared<SendReq>();
     s->hdr = MakeHdr(kMagicSeqAck, 0, 0, 0);
-    s->hdr.seq = peer.rx_seq;
-    SealHdrLocked(p, &s->hdr);
+    s->hdr.seq = sf.clk.rx_seq;
+    SealHdrLocked(p, lane, &s->hdr);
     s->wire_payload = s->desc;
     s->wire_bytes = 0;
     s->dst = p;
-    peer.acked_rx = peer.rx_seq;
-    peer.rx_since_ack = 0;
-    peer.outq.push_back(std::move(s));
-    FlushOutLocked(p);
+    sf.clk.acked_rx = sf.clk.rx_seq;
+    sf.clk.rx_since_ack = 0;
+    sf.outq.push_back(std::move(s));
+    FlushOutLocked(p, lane);
   }
 
   // Rate-limited re-pull: "I have everything through rx_seq; resend from
-  // rx_seq+1". Fired on a sequence gap, a CRC reject, or a heartbeat whose
-  // tx high-water is ahead of us (tail loss).
-  void MaybeNakLocked(int p) {
+  // rx_seq+1" — per lane. Fired on a sequence gap, a CRC reject, or a
+  // heartbeat whose tx high-water is ahead of us (tail loss).
+  void MaybeNakLocked(int p, size_t lane) {
     Peer& peer = peers_[p];
+    Subflow& sf = peer.sf[lane];
     const uint64_t now = NowNs();
-    if (now - peer.last_nak_ns < 1000000) return;  // 1ms
-    peer.last_nak_ns = now;
+    if (now - sf.clk.last_nak_ns < 1000000) return;  // 1ms
+    sf.clk.last_nak_ns = now;
     auto s = std::make_shared<SendReq>();
     s->hdr = MakeHdr(kMagicNak, 0, 0, 0);
-    s->hdr.seq = peer.rx_seq;
-    SealHdrLocked(p, &s->hdr);
+    s->hdr.seq = sf.clk.rx_seq;
+    SealHdrLocked(p, lane, &s->hdr);
     s->wire_payload = s->desc;
     s->wire_bytes = 0;
     s->dst = p;
-    peer.outq.push_back(std::move(s));
+    sf.outq.push_back(std::move(s));
     naks_sent_.fetch_add(1, std::memory_order_relaxed);
     peer.sc_naks++;  // wire scope
-    FlushOutLocked(p);
+    FlushOutLocked(p, lane);
   }
 
-  // Peer asked for a resend from r+1. Requeue every unacked, not-already-
-  // queued record as a raw frame ahead of the unwritten tail of the outq
-  // (replayed seqs are lower than anything not yet written, so wire order
-  // stays sequence order). Duplicates are skip-consumed by the receiver.
-  void HandleNakLocked(int p, uint64_t r) {
+  // Peer asked for a resend from r+1 on this lane. Requeue every unacked,
+  // not-already-queued record as a raw frame ahead of the unwritten tail of
+  // the lane's outq (replayed seqs are lower than anything not yet written,
+  // so wire order stays sequence order). Duplicates are skip-consumed by
+  // the receiver.
+  void HandleNakLocked(int p, size_t lane, uint64_t r) {
     Peer& peer = peers_[p];
-    HandleSeqAckLocked(p, r);  // everything <= r is implicitly acked
-    if (peer.replay.empty()) return;  // raced with a covering ack
-    if (peer.replay.front().seq != r + 1) {
+    Subflow& sf = peer.sf[lane];
+    HandleSeqAckLocked(p, lane, r);  // everything <= r is implicitly acked
+    if (sf.replay.recs.empty()) return;  // raced with a covering ack
+    if (sf.replay.recs.front().seq != r + 1) {
+      // Lost frames we no longer hold are unrecoverable on ANY lane — the
+      // message stream has a permanent gap, so the whole link dies.
       MarkPeerDeadLocked(p, "replay buffer exhausted", /*hb_detected=*/true);
       return;
     }
-    auto& q = peer.outq;
+    auto& q = sf.outq;
     auto ins = q.begin();
     if (!q.empty() && q.front()->off > 0) ++ins;  // never tear a mid-write
     uint64_t count = 0;
-    for (auto& rec : peer.replay) {
+    for (auto& rec : sf.replay.recs) {
       if (rec.queued) continue;
       rec.queued = true;
       auto s = std::make_shared<SendReq>();
@@ -1023,19 +1270,34 @@ class StreamTransport : public Transport {
       frames_replayed_.fetch_add(count, std::memory_order_relaxed);
       peer.sc_replayed += count;  // wire scope
     }
-    FlushOutLocked(p);
+    FlushOutLocked(p, lane);
   }
 
-  void FlushOutLocked(int p) {
+  void FlushOutLocked(int p, size_t lane) {
     Peer& peer = peers_[p];
     if (peer.health != 0) return;  // reconnecting: no wire to write to
-    if (peer.stall_until_ns != 0) {
-      if (NowNs() < peer.stall_until_ns) return;  // stall_link_ms fault
-      peer.stall_until_ns = 0;
+    Subflow& sf = peer.sf[lane];
+    if (!sf.link || sf.down) return;
+    if (sf.stall_until_ns != 0) {
+      if (NowNs() < sf.stall_until_ns) return;  // stall_link_ms fault
+      sf.stall_until_ns = 0;
     }
-    auto& q = peer.outq;
+    auto& q = sf.outq;
     while (!q.empty()) {
       auto& s = q.front();
+      if (s->off == 0 && !s->raw && s->crc_deferred) {
+        // Deferred chunk CRC (DESIGN.md §15): computed at the FIRST write
+        // attempt, not at enqueue — so chunk k+1's checksum runs while the
+        // kernel is still draining chunk k's sendmsg. Covers the 24-byte
+        // placement header plus the borrowed payload slice, exactly what
+        // the receiver's running CRC will see.
+        if (crc_on_)
+          s->hdr.crc = wire::Crc32c(
+              wire::Crc32c(0, s->wire_head, s->wire_head_bytes),
+              s->wire_payload, s->wire_bytes);
+        s->crc_deferred = false;
+        s->hdr.hcrc = wire::HeaderCrc(s->hdr);
+      }
       if (s->off == 0 && !s->raw && s->hdr.tx_ns == 0 &&
           wire::Sequenced(s->hdr.magic)) {
         // Stamp the tx timestamp at the first write attempt and reseal the
@@ -1051,11 +1313,15 @@ class StreamTransport : public Transport {
           fault::Enabled() && wire::Sequenced(s->hdr.magic)) {
         s->fault_checked = true;  // one consult per frame, whatever happens
         uint64_t stall_us = 0;
-        switch (fault::OnFrame(rank_, p, &stall_us)) {
+        switch (fault::OnFrame(rank_, p, static_cast<int>(lane), &stall_us)) {
           case fault::Action::kDropFrame:
             // Swallowed — but recorded, so the receiver's NAK heals it.
-            RecordFrameLocked(p, s.get());
+            RecordFrameLocked(p, lane, s.get());
             if (!s->rv) {
+              if (s->parent && --s->parent->pending == 0) {
+                s->parent->done = true;
+                s->parent->payload = nullptr;
+              }
               s->done = true;
               s->payload = nullptr;
             }
@@ -1069,36 +1335,57 @@ class StreamTransport : public Transport {
             s->corrupted = true;
             break;
           case fault::Action::kStallLink:
-            peer.stall_until_ns = NowNs() + stall_us * 1000;
+            sf.stall_until_ns = NowNs() + stall_us * 1000;
             return;
           case fault::Action::kCloseLink:
-            links_[p]->ForceClose();
-            return;  // next Progress pass sees !alive and starts recovery
+            sf.link->ForceClose();
+            return;  // next Progress pass sees !alive and heals the lane
           default:
             break;
         }
       }
+      // Scatter/gather write: header, placement head (chunk frames), and
+      // the BORROWED user payload go to the kernel in one sendmsg — the
+      // partitioned/eager send path never stages payload bytes through an
+      // intermediate buffer (the replay record, taken at full write below,
+      // is the one deliberate copy).
       const size_t hdr_len = s->raw ? 0 : sizeof(WireHeader);
-      while (s->off < hdr_len) {
-        size_t n = links_[p]->WriteSome(
-            reinterpret_cast<const char*>(&s->hdr) + s->off, hdr_len - s->off);
+      const size_t head_end = hdr_len + s->wire_head_bytes;
+      const size_t total = head_end + s->wire_bytes;
+      while (s->off < total) {
+        struct iovec iov[3];
+        int niov = 0;
+        size_t off = s->off;
+        if (off < hdr_len) {
+          iov[niov].iov_base = reinterpret_cast<char*>(&s->hdr) + off;
+          iov[niov].iov_len = hdr_len - off;
+          niov++;
+          off = hdr_len;
+        }
+        if (off < head_end) {
+          iov[niov].iov_base =
+              const_cast<char*>(s->wire_head) + (off - hdr_len);
+          iov[niov].iov_len = head_end - off;
+          niov++;
+          off = head_end;
+        }
+        if (off < total) {
+          iov[niov].iov_base =
+              const_cast<char*>(s->wire_payload) + (off - head_end);
+          iov[niov].iov_len = total - off;
+          niov++;
+        }
+        const size_t n = sf.link->WriteVec(iov, niov);
         if (n == 0) return;  // wire full
         s->off += n;
-        peer.sc_tx_wire += n;  // wire scope: headers are overhead bytes
-      }
-      const size_t total = hdr_len + s->wire_bytes;
-      while (s->off < total) {
-        size_t n = links_[p]->WriteSome(s->wire_payload + (s->off - hdr_len),
-                                        total - s->off);
-        if (n == 0) return;
-        s->off += n;
-        peer.sc_tx_wire += n;
+        peer.sc_tx_wire += n;  // wire scope: all bytes, framing included
       }
       // Wire scope: frame fully written. Goodput (payload) is only the app
-      // bytes inside eager data frames; raw replays count as wire bytes +
-      // replayed frames (in HandleNak/AdoptLink), never as fresh payload.
+      // bytes inside eager data frames — a chunk's hdr.bytes is its slice
+      // of user payload; the stripe envelope is pure overhead. Raw replays
+      // count as wire bytes + replayed frames, never as fresh payload.
       peer.sc_tx_frames++;
-      if (!s->raw && s->hdr.magic == kMagic)
+      if (!s->raw && (s->hdr.magic == kMagic || s->hdr.magic == kMagicChunk))
         peer.sc_tx_payload += s->hdr.bytes;
       // Causal tracing (§14): queue time = enqueue -> fully on the wire,
       // attributed per link and to the wire_queue_ns histogram; wire_tx
@@ -1114,9 +1401,9 @@ class StreamTransport : public Transport {
       if (!s->raw && s->hdr.span != 0)
         ACX_TRACE_SPAN("wire_tx", -1, s->hdr.span);
       if (s->raw) {
-        ClearQueuedLocked(p, s->hdr.seq);
+        ClearQueuedLocked(p, lane, s->hdr.seq);
       } else if (recovery_armed_ && wire::Sequenced(s->hdr.magic)) {
-        RecordFrameLocked(p, s.get());
+        RecordFrameLocked(p, lane, s.get());
       }
       // Flight-record the frame at its full-write point — the moment it is
       // irrevocably on the wire (raw replays are already counted in
@@ -1124,6 +1411,8 @@ class StreamTransport : public Transport {
       if (!s->raw) {
         switch (s->hdr.magic) {
           case kMagic:
+          case kMagicStripe:
+          case kMagicChunk:
             ACX_FLIGHT_SPAN(kTxData, -1, p, s->hdr.tag, s->hdr.seq, 0,
                             s->hdr.span);
             break;
@@ -1147,7 +1436,13 @@ class StreamTransport : public Transport {
       }
       if (!s->rv) {
         // Rendezvous sends stay pending (and keep borrowing the user
-        // buffer) until the receiver's ACK arrives.
+        // buffer) until the receiver's ACK arrives. A striped message's
+        // parent stays pending (and keeps borrowing) until the envelope
+        // and every chunk are fully on the wire, whatever lane each took.
+        if (s->parent && --s->parent->pending == 0) {
+          s->parent->done = true;
+          s->parent->payload = nullptr;
+        }
         s->done = true;
         s->payload = nullptr;
       }
@@ -1159,34 +1454,38 @@ class StreamTransport : public Transport {
   // torn frame means nothing downstream can be trusted. With recovery armed
   // the link is torn down and rebuilt — the epoch/seq/replay machinery
   // restores exactly-once delivery. Disarmed, this stays PR-1 fail-stop.
+  // Desync on a SUBFLOW lane heals through the same lane-0 recovery: the
+  // whole link tears down and the dialer re-establishes every lane.
   void StreamDesyncLocked(int p) {
     std::fprintf(stderr, "tpu-acx[%d]: wire desync from %d (bad header)\n",
                  rank_, p);
     if (!recovery_armed_) _exit(14);
-    links_[p]->ForceClose();
+    peers_[p].sf[0].link->ForceClose();
     StartRecoveryLocked(p, "wire desync");
   }
 
-  // A sequenced frame was delivered in order: advance rx and ack every 16
-  // frames (the idle flush in ProgressLocked covers quiet tails).
-  void BumpRxLocked(int p, uint64_t seq) {
-    Peer& peer = peers_[p];
-    peer.rx_seq = seq;
+  // A sequenced frame was delivered in order on this lane: advance its rx
+  // clock and ack every 16 frames (the idle flush in ProgressLocked covers
+  // quiet tails).
+  void BumpRxLocked(int p, size_t lane, uint64_t seq) {
+    Subflow& sf = peers_[p].sf[lane];
+    sf.clk.rx_seq = seq;
     ACX_FLIGHT(kRxData, -1, p, -1, seq, 0);
-    if (++peer.rx_since_ack >= 16) SendSeqAckLocked(p);
+    if (++sf.clk.rx_since_ack >= 16) SendSeqAckLocked(p, lane);
   }
 
-  void DrainInLocked(int p) {
+  void DrainInLocked(int p, size_t lane) {
     Peer& peer = peers_[p];
-    InState& in = peer.in;
+    Subflow& sf = peer.sf[lane];
+    InState& in = sf.in;
     for (;;) {
       // A NAK/desync handled below can flip the peer into recovery (or
       // dead) mid-drain; stop touching the link the moment that happens.
       if (peer_dead_[p] || peer.health != 0) return;
       if (in.hdr_got < sizeof(WireHeader)) {
         size_t n =
-            links_[p]->ReadSome(reinterpret_cast<char*>(&in.hdr) + in.hdr_got,
-                                sizeof(WireHeader) - in.hdr_got);
+            sf.link->ReadSome(reinterpret_cast<char*>(&in.hdr) + in.hdr_got,
+                              sizeof(WireHeader) - in.hdr_got);
         if (n == 0) return;
         NoteRx(p, n);
         in.hdr_got += n;
@@ -1212,27 +1511,29 @@ class StreamTransport : public Transport {
         in.run_crc = 0;
         in.discard = false;
         in.nak_after = false;
+        in.chdr_got = 0;
         // -- unsequenced control frames (header-only) --
         if (in.hdr.magic == kMagicHb) {
           hb_recv_.fetch_add(1, std::memory_order_relaxed);
-          // Tail loss: the sender's tx high-water is ahead of what we've
-          // delivered and nothing behind the gap is coming (heartbeats are
-          // FIFO behind data, so everything written earlier was read).
-          if (recovery_armed_ && in.hdr.epoch == peer.epoch &&
-              in.hdr.seq > peer.rx_seq)
-            MaybeNakLocked(p);
+          // Tail loss: the sender's tx high-water FOR THIS LANE is ahead
+          // of what we've delivered and nothing behind the gap is coming
+          // (heartbeats are FIFO behind data, so everything written
+          // earlier was read).
+          if (recovery_armed_ && in.hdr.epoch == sf.clk.epoch &&
+              in.hdr.seq > sf.clk.rx_seq)
+            MaybeNakLocked(p, lane);
           in.hdr_got = 0;
           continue;
         }
         if (in.hdr.magic == kMagicSeqAck) {
           ACX_FLIGHT(kRxSeqAck, -1, p, -1, in.hdr.seq, 0);
-          HandleSeqAckLocked(p, in.hdr.seq);
+          HandleSeqAckLocked(p, lane, in.hdr.seq);
           in.hdr_got = 0;
           continue;
         }
         if (in.hdr.magic == kMagicNak) {
           ACX_FLIGHT(kRxNak, -1, p, -1, in.hdr.seq, 0);
-          HandleNakLocked(p, in.hdr.seq);
+          HandleNakLocked(p, lane, in.hdr.seq);
           in.hdr_got = 0;
           continue;
         }
@@ -1260,12 +1561,13 @@ class StreamTransport : public Transport {
           StreamDesyncLocked(p);
           return;
         }
-        // -- sequenced data frames --
+        // -- sequenced data frames (gated per LANE: each lane is its own
+        // epoch/seq space) --
         if (recovery_armed_) {
-          if (in.hdr.epoch != peer.epoch || in.hdr.seq <= peer.rx_seq) {
+          if (in.hdr.epoch != sf.clk.epoch || in.hdr.seq <= sf.clk.rx_seq) {
             // Stale epoch or duplicate (replay overshoot): consume quietly.
             in.discard = true;
-          } else if (in.hdr.seq > peer.rx_seq + 1) {
+          } else if (in.hdr.seq > sf.clk.rx_seq + 1) {
             // Gap: something was lost ahead of this frame. Consume it (the
             // replay will re-deliver it in order) and ask for a resend.
             in.discard = true;
@@ -1279,6 +1581,14 @@ class StreamTransport : public Transport {
           } else if (in.hdr.magic == kMagicAck) {
             in.direct.reset();
             in.payload.resize(sizeof(RvAck));
+          } else if (in.hdr.magic == kMagicStripe) {
+            in.direct.reset();
+            in.payload.resize(sizeof(StripeDesc));
+          } else if (in.hdr.magic == kMagicChunk) {
+            // Chunk frames have their own placement-directed read path
+            // below; in.direct is never used for them.
+            in.direct.reset();
+            in.payload.clear();
           } else {
             // Direct delivery: if a matching recv is already posted, stream
             // the payload straight into its buffer (one memcpy off the
@@ -1301,12 +1611,102 @@ class StreamTransport : public Transport {
           char scratch[4096];
           size_t want = wire_len - in.payload_got;
           if (want > sizeof scratch) want = sizeof scratch;
-          size_t n = links_[p]->ReadSome(scratch, want);
+          size_t n = sf.link->ReadSome(scratch, want);
           if (n == 0) return;
           NoteRx(p, n);
           in.payload_got += n;
         }
-        if (in.nak_after) MaybeNakLocked(p);
+        if (in.nak_after) MaybeNakLocked(p, lane);
+        in.hdr_got = 0;
+        continue;
+      }
+      if (in.hdr.magic == kMagicChunk) {
+        // -- chunk frame: [ChunkHdr][slice], placement-directed -----------
+        while (in.chdr_got < sizeof(ChunkHdr)) {
+          size_t n = sf.link->ReadSome(
+              reinterpret_cast<char*>(&in.chdr) + in.chdr_got,
+              sizeof(ChunkHdr) - in.chdr_got);
+          if (n == 0) return;
+          NoteRx(p, n);
+          in.chdr_got += n;
+          if (in.chdr_got == sizeof(ChunkHdr)) {
+            // The sender's CRC runs over ChunkHdr + slice as one stream.
+            if (in.hdr.crc != 0)
+              in.run_crc = wire::Crc32c(0, &in.chdr, sizeof in.chdr);
+            if (in.chdr.len != in.hdr.bytes) {
+              // Frame header and placement header disagree: torn stream.
+              StreamDesyncLocked(p);
+              return;
+            }
+          }
+        }
+        // Destination resolution happens per drain call, not per frame:
+        // the recv can attach (envelope match, late Irecv) while a chunk
+        // is mid-read, and the remainder then streams into the user
+        // buffer. Three cases: message already delivered (a degraded
+        // lane's migrated duplicate) -> drain; recv attached -> write in
+        // place at the chunk's offset; else -> assembly buffer.
+        const bool seen = peer.done_stripes.count(in.chdr.msg_id) != 0;
+        StripeRx* srx = nullptr;
+        RecvReq* r = nullptr;
+        if (!seen) {
+          srx = &peer.stripes[in.chdr.msg_id];  // chunks may precede the env
+          r = srx->direct ? srx->direct.get() : nullptr;
+          if (r == nullptr) {
+            const size_t need =
+                static_cast<size_t>(in.chdr.offset + in.chdr.len);
+            if (srx->assembly.size() < need) srx->assembly.resize(need);
+          }
+        }
+        while (in.payload_got < in.hdr.bytes) {
+          char scratch[4096];
+          const uint64_t pos = in.chdr.offset + in.payload_got;
+          size_t want = static_cast<size_t>(in.hdr.bytes - in.payload_got);
+          char* dst;
+          if (seen) {
+            dst = scratch;
+            if (want > sizeof scratch) want = sizeof scratch;
+          } else if (r != nullptr) {
+            if (pos < r->bytes) {
+              dst = static_cast<char*>(r->buf) + pos;
+              if (want > r->bytes - pos)
+                want = static_cast<size_t>(r->bytes - pos);
+            } else {
+              // Oversized tail (recv buffer smaller than the message):
+              // drain + drop, still CRC'd — the sender's checksum covers
+              // the whole slice.
+              dst = scratch;
+              if (want > sizeof scratch) want = sizeof scratch;
+            }
+          } else {
+            dst = srx->assembly.data() + pos;
+          }
+          size_t n = sf.link->ReadSome(dst, want);
+          if (n == 0) return;
+          NoteRx(p, n);
+          if (in.hdr.crc != 0) in.run_crc = wire::Crc32c(in.run_crc, dst, n);
+          in.payload_got += n;
+        }
+        if (in.hdr.crc != 0 && in.run_crc != in.hdr.crc) {
+          crc_rejects_.fetch_add(1, std::memory_order_relaxed);
+          peer.sc_crc_rejects++;  // wire scope
+          if (!recovery_armed_) {
+            std::fprintf(stderr, "tpu-acx[%d]: payload CRC mismatch from %d\n",
+                         rank_, p);
+            _exit(14);
+          }
+          // Do NOT mark the chunk received or advance this lane's rx_seq:
+          // the replayed copy overwrites the same placement range.
+          in.hdr_got = 0;
+          MaybeNakLocked(p, lane);
+          continue;
+        }
+        if (recovery_armed_) BumpRxLocked(p, lane, in.hdr.seq);
+        NoteFrameRxLocked(p, in.hdr);
+        if (!seen && srx->got.insert(in.chdr.idx).second) {
+          if (srx->have_env && srx->got.size() == srx->nchunks)
+            CompleteStripeLocked(p, in.chdr.msg_id);
+        }
         in.hdr_got = 0;
         continue;
       }
@@ -1316,7 +1716,7 @@ class StreamTransport : public Transport {
             r->bytes < in.hdr.bytes ? r->bytes : in.hdr.bytes;
         while (in.payload_got < deliver) {
           char* dst = static_cast<char*>(r->buf) + in.payload_got;
-          size_t n = links_[p]->ReadSome(dst, deliver - in.payload_got);
+          size_t n = sf.link->ReadSome(dst, deliver - in.payload_got);
           if (n == 0) return;
           NoteRx(p, n);
           if (in.hdr.crc != 0) in.run_crc = wire::Crc32c(in.run_crc, dst, n);
@@ -1328,7 +1728,7 @@ class StreamTransport : public Transport {
           char scratch[4096];
           size_t want = in.hdr.bytes - in.payload_got;
           if (want > sizeof scratch) want = sizeof scratch;
-          size_t n = links_[p]->ReadSome(scratch, want);
+          size_t n = sf.link->ReadSome(scratch, want);
           if (n == 0) return;
           NoteRx(p, n);
           if (in.hdr.crc != 0)
@@ -1349,10 +1749,10 @@ class StreamTransport : public Transport {
           peer.posted.push_front(in.direct);
           in.direct.reset();
           in.hdr_got = 0;
-          MaybeNakLocked(p);
+          MaybeNakLocked(p, lane);
           continue;
         }
-        if (recovery_armed_) BumpRxLocked(p, in.hdr.seq);
+        if (recovery_armed_) BumpRxLocked(p, lane, in.hdr.seq);
         NoteFrameRxLocked(p, in.hdr);
         NoteMatchLocked(in.hdr.span, r->span);
         // Wire scope: goodput is what the app receives (delivered bytes,
@@ -1368,8 +1768,8 @@ class StreamTransport : public Transport {
         continue;
       }
       while (in.payload_got < in.payload.size()) {
-        size_t n = links_[p]->ReadSome(in.payload.data() + in.payload_got,
-                                       in.payload.size() - in.payload_got);
+        size_t n = sf.link->ReadSome(in.payload.data() + in.payload_got,
+                                     in.payload.size() - in.payload_got);
         if (n == 0) return;
         NoteRx(p, n);
         in.payload_got += n;
@@ -1386,10 +1786,10 @@ class StreamTransport : public Transport {
         }
         in.payload.clear();
         in.hdr_got = 0;
-        MaybeNakLocked(p);
+        MaybeNakLocked(p, lane);
         continue;
       }
-      if (recovery_armed_) BumpRxLocked(p, in.hdr.seq);
+      if (recovery_armed_) BumpRxLocked(p, lane, in.hdr.seq);
       NoteFrameRxLocked(p, in.hdr);
       if (in.hdr.magic == kMagicRts) {
         Msg m;
@@ -1408,6 +1808,15 @@ class StreamTransport : public Transport {
         in.payload.clear();
         in.hdr_got = 0;
         HandleAckLocked(p, a);
+      } else if (in.hdr.magic == kMagicStripe) {
+        StripeDesc d;
+        memcpy(&d, in.payload.data(), sizeof d);
+        in.payload.clear();
+        in.hdr_got = 0;
+        // A migrated duplicate envelope for a delivered message (lane
+        // degradation window) must not resurrect a reassembly entry.
+        if (peer.done_stripes.count(d.msg_id) == 0)
+          HandleStripeEnvLocked(p, in.hdr, d);
       } else {
         Msg m;
         m.tag = in.hdr.tag;
@@ -1433,30 +1842,59 @@ class StreamTransport : public Transport {
       if (now - last_ack_flush_ns_ >= 5000000) {
         last_ack_flush_ns_ = now;
         for (int p = 0; p < size_; p++) {
-          if (p == rank_ || !links_[p] || peer_dead_[p]) continue;
+          if (p == rank_ || peer_dead_[p]) continue;
           Peer& peer = peers_[p];
-          if (peer.health == 0 && peer.rx_seq > peer.acked_rx)
-            SendSeqAckLocked(p);
+          if (peer.health != 0) continue;
+          for (size_t k = 0; k < peer.sf.size(); k++) {
+            Subflow& sf = peer.sf[k];
+            if (!sf.link || sf.down) continue;
+            if (sf.clk.rx_seq > sf.clk.acked_rx) SendSeqAckLocked(p, k);
+          }
         }
       }
     }
     for (int p = 0; p < size_; p++) {
-      if (p == rank_ || !links_[p]) continue;  // no wire (malformed env)
+      Peer& peer = peers_[p];
+      if (p == rank_ || !peer.sf[0].link) continue;  // no wire (malformed env)
       if (peer_dead_[p]) continue;
-      if (peers_[p].health != 0) continue;  // reconnecting: leave the link be
-      FlushOutLocked(p);
-      DrainInLocked(p);
-      if (peers_[p].health != 0 || peer_dead_[p]) continue;  // changed above
-      if (!links_[p]->alive())
-        StartRecoveryLocked(p, "connection closed");
+      if (peer.health != 0) continue;  // reconnecting: leave the link be
+      // Lane establishment: the LOWER rank dials every subflow (same no-race
+      // DAG as reconnects); lanes redial lazily after a loss.
+      if (recovery_armed_ && rank_ < p) EnsureSubflowsLocked(p);
+      for (size_t k = 0; k < peer.sf.size(); k++) {
+        Subflow& sf = peer.sf[k];
+        if (!sf.link || sf.down) continue;
+        FlushOutLocked(p, k);
+        DrainInLocked(p, k);
+        if (peer.health != 0 || peer_dead_[p]) break;
+        if (!sf.link->alive()) {
+          if (k == 0)
+            StartRecoveryLocked(p, "connection closed");
+          else
+            SubflowLostLocked(p, k);
+          if (peer.health != 0 || peer_dead_[p]) break;
+        }
+      }
+      // Acceptor side of a lost subflow: if the dialer's redial ladder
+      // never reaches us, stop waiting and degrade to the survivors.
+      if (rank_ > p && peer.sf.size() > 1 && !peer_dead_[p] &&
+          peer.health == 0) {
+        const uint64_t now = NowNs();
+        for (size_t k = 1; k < peer.sf.size(); k++) {
+          Subflow& sf = peer.sf[k];
+          if (!sf.link && !sf.down && sf.give_up_ns != 0 &&
+              now >= sf.give_up_ns)
+            DegradeSubflowLocked(p, k);
+        }
+      }
     }
   }
 
   // Liveness clock: ANY inbound bytes from p count (a multi-second bulk
   // transfer holds heartbeat frames behind it in the FIFO outq, so payload
   // bytes must refresh the clock or large messages would false-positive).
-  // Doubles as the rx side of the wire scope: every byte read off the link
-  // passes through here (caller holds mu_).
+  // Doubles as the rx side of the wire scope: every byte read off any of
+  // the peer's lanes passes through here (caller holds mu_).
   void NoteRx(int p, size_t n) {
     if (hb_interval_ns_ != 0) last_rx_ns_[p] = NowNs();
     peers_[p].sc_rx_wire += n;
@@ -1467,24 +1905,29 @@ class StreamTransport : public Transport {
     if (now - last_hb_send_ns_ >= hb_interval_ns_) {
       last_hb_send_ns_ = now;
       for (int p = 0; p < size_; p++) {
-        if (p == rank_ || !links_[p] || peer_dead_[p]) continue;
+        if (p == rank_ || !peers_[p].sf[0].link || peer_dead_[p]) continue;
         if (peers_[p].health != 0) continue;  // reconnecting: nothing to send on
-        auto s = std::make_shared<SendReq>();
-        s->hdr = MakeHdr(kMagicHb, 0, 0, 0);
-        // seq carries the tx high-water WITHOUT consuming a number, so the
-        // receiver can detect tail loss (see the kMagicHb comment up top).
-        s->hdr.seq = peers_[p].tx_seq;
-        SealHdrLocked(p, &s->hdr);
-        s->wire_payload = s->desc;
-        s->wire_bytes = 0;
-        s->dst = p;
-        peers_[p].outq.push_back(std::move(s));
-        hb_sent_.fetch_add(1, std::memory_order_relaxed);
+        // One heartbeat per LIVE LANE: each lane's seq field carries that
+        // lane's tx high-water (without consuming a number), so the
+        // receiver's tail-loss detection works per subflow.
+        for (size_t k = 0; k < peers_[p].sf.size(); k++) {
+          Subflow& sf = peers_[p].sf[k];
+          if (!sf.link || sf.down) continue;
+          auto s = std::make_shared<SendReq>();
+          s->hdr = MakeHdr(kMagicHb, 0, 0, 0);
+          s->hdr.seq = sf.clk.tx_seq;
+          SealHdrLocked(p, k, &s->hdr);
+          s->wire_payload = s->desc;
+          s->wire_bytes = 0;
+          s->dst = p;
+          sf.outq.push_back(std::move(s));
+          hb_sent_.fetch_add(1, std::memory_order_relaxed);
+        }
       }
     }
     if (now < grace_deadline_ns_) return;
     for (int p = 0; p < size_; p++) {
-      if (p == rank_ || !links_[p] || peer_dead_[p]) continue;
+      if (p == rank_ || !peers_[p].sf[0].link || peer_dead_[p]) continue;
       // A reconnecting peer is by definition not speaking; the reconnect
       // ladder's own deadline is the liveness verdict for it (satellite:
       // heartbeat monitor must not declare reconnecting links dead).
@@ -1509,22 +1952,30 @@ class StreamTransport : public Transport {
     peer_dead_[p] = true;
     peers_dead_n_.fetch_add(1, std::memory_order_relaxed);
     ACX_TRACE_EVENT("peer_dead", static_cast<size_t>(p));
-    ACX_FLIGHT(kPeerDead, -1, p, -1, peers_[p].rx_seq, peers_[p].epoch);
-    uint64_t failed = 0;
     Peer& peer = peers_[p];
+    ACX_FLIGHT(kPeerDead, -1, p, -1, peer.sf[0].clk.rx_seq,
+               peer.sf[0].clk.epoch);
+    uint64_t failed = 0;
     if (peer.health == 1) {
       peer.health = 0;
       recovering_count_.fetch_sub(1, std::memory_order_relaxed);
     }
-    peer.replay.clear();
-    peer.replay_bytes = 0;
-    if (peer.in.direct) {
-      RecvReq* r = peer.in.direct.get();
-      r->st = Status{p, r->report_tag != INT_MIN ? r->report_tag : r->tag,
-                     kErrPeerDead, 0};
-      r->done = true;
-      peer.in.direct.reset();
-      failed++;
+    if (peer.replay_broken_noted) {
+      // The link is gone; it no longer belongs in the "moving but fragile"
+      // gauge.
+      peer.replay_broken_noted = false;
+      replay_broken_links_.fetch_sub(1, std::memory_order_relaxed);
+    }
+    for (Subflow& sf : peer.sf) {
+      sf.replay.Clear();
+      if (sf.in.direct) {
+        RecvReq* r = sf.in.direct.get();
+        r->st = Status{p, r->report_tag != INT_MIN ? r->report_tag : r->tag,
+                       kErrPeerDead, 0};
+        r->done = true;
+        sf.in.direct.reset();
+        failed++;
+      }
     }
     for (auto& r : peer.posted) {
       r->st = Status{p, r->report_tag != INT_MIN ? r->report_tag : r->tag,
@@ -1533,17 +1984,52 @@ class StreamTransport : public Transport {
       failed++;
     }
     peer.posted.clear();
-    for (auto& s : peer.outq) {
-      if (s->done) continue;
-      s->st.error = kErrPeerDead;
-      s->st.bytes = 0;
-      s->done = true;
-      // Only user-visible ops count as failed work: raw replay frames and
-      // SeqAck/NAK/heartbeat control frames are protocol-internal.
-      if (!s->raw && (s->hdr.magic == kMagic || s->hdr.magic == kMagicRts))
-        failed++;
+    for (Subflow& sf : peer.sf) {
+      for (auto& s : sf.outq) {
+        if (s->done) continue;
+        if (s->parent) {
+          // Envelope/chunk frames of one striped message: fail the PARENT
+          // once, whatever lanes its pieces were queued on.
+          if (!s->parent->done) {
+            s->parent->st.error = kErrPeerDead;
+            s->parent->st.bytes = 0;
+            s->parent->done = true;
+            failed++;
+          }
+          s->done = true;
+          continue;
+        }
+        s->st.error = kErrPeerDead;
+        s->st.bytes = 0;
+        s->done = true;
+        // Only user-visible ops count as failed work: raw replay frames and
+        // SeqAck/NAK/heartbeat control frames are protocol-internal.
+        if (!s->raw && (s->hdr.magic == kMagic || s->hdr.magic == kMagicRts))
+          failed++;
+      }
+      sf.outq.clear();
     }
-    peer.outq.clear();
+    // In-progress striped receives: a reassembly with a recv attached fails
+    // that recv; one without loses its placeholder too (a recv posted later
+    // fails on the dead latch instead). Completed stripes already left the
+    // map and stay delivered.
+    for (auto it = peer.stripes.begin(); it != peer.stripes.end();) {
+      StripeRx& srx = it->second;
+      if (srx.direct) {
+        RecvReq* r = srx.direct.get();
+        r->st = Status{p,
+                       r->report_tag != INT_MIN ? r->report_tag : srx.tag,
+                       kErrPeerDead, 0};
+        r->done = true;
+        failed++;
+      } else {
+        const uint32_t id = it->first;
+        for (auto a = peer.arrived.begin(); a != peer.arrived.end();) {
+          a = a->stripe_id == id ? peer.arrived.erase(a) : std::next(a);
+        }
+      }
+      it = peer.stripes.erase(it);
+    }
     for (auto it = rv_pending_.begin(); it != rv_pending_.end();) {
       if (it->second->dst == p) {
         it->second->st.error = kErrPeerDead;
@@ -1582,10 +2068,13 @@ class StreamTransport : public Transport {
   // each other's connect. The dialer walks a bounded exponential ladder
   // (ACX_RECONNECT_MAX attempts, ACX_RECONNECT_BACKOFF_MS base, 2s cap);
   // the acceptor waits out the whole ladder plus margin before giving up.
-  // The 40-byte hello is a WireHeader (magic=kMagicHello): tag = sender's
-  // rank, seq = sender's delivered-in-order high water for this peer,
-  // epoch = proposed / agreed link epoch. The acceptor's reply is
-  // authoritative: agreed = max(proposal, own epoch + 1).
+  // The hello is a WireHeader (magic=kMagicHello): tag = sender's rank,
+  // seq = sender's delivered-in-order high water for this peer, epoch =
+  // proposed / agreed link epoch. The acceptor's reply is authoritative:
+  // agreed = max(proposal, own epoch + 1). Subflow lanes (ctx carries
+  // kHelloSubflow | index<<8) ride the SAME listener and the same ladder
+  // arithmetic, but heal per lane: only the lane's own clock and replay
+  // are touched.
 
   // True when nothing user-visible is pending against p — dying peers at
   // clean teardown then take the quiet dead-latch fast path instead of a
@@ -1593,49 +2082,36 @@ class StreamTransport : public Transport {
   // fully-delivered-but-unacked frames are not in-flight work.
   bool NothingInFlightLocked(int p) {
     Peer& peer = peers_[p];
-    if (peer.in.direct) return false;
     if (!peer.posted.empty()) return false;
-    for (auto& s : peer.outq)
-      if (!s->raw && !s->done && wire::Sequenced(s->hdr.magic)) return false;
+    for (const Subflow& sf : peer.sf) {
+      if (sf.in.direct) return false;
+      for (const auto& s : sf.outq)
+        if (!s->raw && !s->done && wire::Sequenced(s->hdr.magic))
+          return false;
+    }
+    for (const auto& kv : peer.stripes)
+      if (kv.second.direct) return false;
     for (auto& kv : rv_pending_)
       if (kv.second->dst == p) return false;
     return true;
   }
 
-  // Nominal ladder value: ACX_RECONNECT_BACKOFF_MS doubling per attempt,
-  // 2s cap. The wait actually scheduled is jittered (below); this nominal
-  // value is what deadline budgets are computed from.
+  // Ladder arithmetic lives in link_state (unit-tested in isolation); these
+  // wrappers bind it to the policy knobs and the per-process jitter state.
   uint64_t DialBackoffMs(int attempt) const {
-    uint64_t ms =
-        Policy().reconnect_backoff_ms.load(std::memory_order_relaxed);
-    if (ms == 0) ms = 1;
-    for (int i = 1; i < attempt && ms < 2000; i++) ms *= 2;
-    return ms < 2000 ? ms : 2000;
+    return link_state::DialBackoffMs(
+        Policy().reconnect_backoff_ms.load(std::memory_order_relaxed),
+        attempt);
   }
 
-  // ±25% jitter on a backoff wait. After a shared fault (a switch blip, a
-  // rank replaced under rolling restart) every surviving dialer otherwise
-  // redials on the identical deterministic schedule, thundering-herding the
-  // victim's rendezvous listener — worse now that late joiners share it.
-  // Cheap per-process LCG; NOT the ladder itself, so budget math
-  // (AcceptDeadlineNs, multihost.recovery_budget_s) stays deterministic.
   uint64_t JitteredWaitNs(uint64_t nominal_ms) {
-    jitter_state_ =
-        jitter_state_ * 6364136223846793005ull + 1442695040888963407ull;
-    const uint64_t nominal_ns = nominal_ms * 1000000ull;
-    const uint64_t span = nominal_ns / 2;  // [0.75x, 1.25x)
-    if (span == 0) return nominal_ns;
-    return nominal_ns - span / 2 + (jitter_state_ >> 33) % span;
+    return link_state::JitteredWaitNs(&jitter_state_, nominal_ms);
   }
 
   uint64_t AcceptDeadlineNs() const {
-    const uint32_t maxa =
-        Policy().reconnect_max.load(std::memory_order_relaxed);
-    uint64_t total_ms = 1000;  // handshake + scheduling margin
-    for (uint32_t a = 1; a <= maxa; a++) total_ms += DialBackoffMs(a);
-    // Jitter headroom: every wait can land 25% past its nominal value.
-    total_ms += total_ms / 4;
-    return total_ms * 1000000ull;
+    return link_state::AcceptDeadlineNs(
+        Policy().reconnect_backoff_ms.load(std::memory_order_relaxed),
+        Policy().reconnect_max.load(std::memory_order_relaxed));
   }
 
   // The link to p failed (EOF, desync, forced close). Either park the peer
@@ -1649,7 +2125,7 @@ class StreamTransport : public Transport {
       MarkPeerDeadLocked(p, why, /*hb_detected=*/false);
       return;
     }
-    if (!recovery_armed_ || peer.replay_broken) {
+    if (!recovery_armed_ || peer.sf[0].replay.broken) {
       MarkPeerDeadLocked(p, why, /*hb_detected=*/true);
       return;
     }
@@ -1662,7 +2138,8 @@ class StreamTransport : public Transport {
     else
       peer.rec_deadline_ns = now + AcceptDeadlineNs();
     ACX_TRACE_EVENT("link_recovering", static_cast<size_t>(p));
-    ACX_FLIGHT(kLinkRecovering, -1, p, -1, peer.rx_seq, peer.epoch);
+    ACX_FLIGHT(kLinkRecovering, -1, p, -1, peer.sf[0].clk.rx_seq,
+               peer.sf[0].clk.epoch);
     std::fprintf(stderr,
                  "tpu-acx[%d]: link to %d lost (%s); attempting reconnect\n",
                  rank_, p, why);
@@ -1698,6 +2175,25 @@ class StreamTransport : public Transport {
     }
   }
 
+  // One connect() against peer p's abstract-namespace rendezvous listener.
+  // Returns the connected fd, or -1 (not listening / no socket).
+  int ConnectListenerLocked(int p) {
+    int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (fd < 0) return -1;
+    struct sockaddr_un sa;
+    memset(&sa, 0, sizeof sa);
+    sa.sun_family = AF_UNIX;
+    const int n = snprintf(sa.sun_path + 1, sizeof(sa.sun_path) - 1,
+                           "acx-%s-%d", job_id_.c_str(), p);
+    const socklen_t slen = static_cast<socklen_t>(
+        offsetof(struct sockaddr_un, sun_path) + 1 + n);
+    if (connect(fd, reinterpret_cast<struct sockaddr*>(&sa), slen) != 0) {
+      close(fd);
+      return -1;
+    }
+    return fd;
+  }
+
   void DialPeerLocked(int p) {
     Peer& peer = peers_[p];
     const uint32_t maxa =
@@ -1710,26 +2206,17 @@ class StreamTransport : public Transport {
     peer.rec_attempts++;
     peer.rec_next_ns =
         NowNs() + JitteredWaitNs(DialBackoffMs(peer.rec_attempts));
-    int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
-    if (fd < 0) return;
-    struct sockaddr_un sa;
-    memset(&sa, 0, sizeof sa);
-    sa.sun_family = AF_UNIX;
-    const int n = snprintf(sa.sun_path + 1, sizeof(sa.sun_path) - 1,
-                           "acx-%s-%d", job_id_.c_str(), p);
-    const socklen_t slen = static_cast<socklen_t>(
-        offsetof(struct sockaddr_un, sun_path) + 1 + n);
-    if (connect(fd, reinterpret_cast<struct sockaddr*>(&sa), slen) != 0) {
-      close(fd);  // peer not listening (yet, or ever) — ladder retries
-      return;
-    }
+    const int fd = ConnectListenerLocked(p);
+    if (fd < 0) return;  // peer not listening (yet, or ever) — ladder retries
     WireHeader hello = MakeHdr(wire::kMagicHello, rank_, 0, 0);
-    hello.seq = peer.rx_seq;
-    hello.epoch = peer.epoch + 1;  // proposal; the reply is authoritative
+    hello.seq = peer.sf[0].clk.rx_seq;
+    hello.epoch = peer.sf[0].clk.epoch + 1;  // proposal; reply authoritative
     hello.hcrc = wire::HeaderCrc(hello);
     WireHeader reply{};
-    if (!IoFullTimed(fd, &hello, sizeof hello, 1000, /*wr=*/true) ||
-        !IoFullTimed(fd, &reply, sizeof reply, 1000, /*wr=*/false) ||
+    if (!link_state::IoFullTimed(fd, &hello, sizeof hello, 1000,
+                                 /*wr=*/true) ||
+        !link_state::IoFullTimed(fd, &reply, sizeof reply, 1000,
+                                 /*wr=*/false) ||
         reply.magic != wire::kMagicHello ||
         reply.hcrc != wire::HeaderCrc(reply) || reply.tag != p ||
         reply.epoch < hello.epoch) {
@@ -1746,27 +2233,18 @@ class StreamTransport : public Transport {
   // post-join fleet epoch the same way.
   bool DialJoinLocked(int p) {
     Peer& peer = peers_[p];
-    const int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
-    if (fd < 0) return false;
-    struct sockaddr_un sa;
-    memset(&sa, 0, sizeof sa);
-    sa.sun_family = AF_UNIX;
-    const int n = snprintf(sa.sun_path + 1, sizeof(sa.sun_path) - 1,
-                           "acx-%s-%d", job_id_.c_str(), p);
-    const socklen_t slen = static_cast<socklen_t>(
-        offsetof(struct sockaddr_un, sun_path) + 1 + n);
-    if (connect(fd, reinterpret_cast<struct sockaddr*>(&sa), slen) != 0) {
-      close(fd);  // peer not listening (yet) — JoinFleet sweeps again
-      return false;
-    }
+    const int fd = ConnectListenerLocked(p);
+    if (fd < 0) return false;  // peer not listening (yet) — sweeps again
     WireHeader hello = MakeHdr(wire::kMagicHello, rank_, wire::kHelloJoin, 0);
     hello.bytes = Fleet().epoch();
     hello.seq = 0;
-    hello.epoch = peer.epoch + 1;  // proposal; the reply is authoritative
+    hello.epoch = peer.sf[0].clk.epoch + 1;  // proposal; reply authoritative
     hello.hcrc = wire::HeaderCrc(hello);
     WireHeader reply{};
-    if (!IoFullTimed(fd, &hello, sizeof hello, 1000, /*wr=*/true) ||
-        !IoFullTimed(fd, &reply, sizeof reply, 2000, /*wr=*/false) ||
+    if (!link_state::IoFullTimed(fd, &hello, sizeof hello, 1000,
+                                 /*wr=*/true) ||
+        !link_state::IoFullTimed(fd, &reply, sizeof reply, 2000,
+                                 /*wr=*/false) ||
         reply.magic != wire::kMagicHello ||
         reply.hcrc != wire::HeaderCrc(reply) || reply.tag != p ||
         (reply.ctx & wire::kHelloJoin) == 0) {
@@ -1774,10 +2252,10 @@ class StreamTransport : public Transport {
       close(fd);
       return false;
     }
-    peer.epoch = reply.epoch;
+    peer.sf[0].clk.epoch = reply.epoch;
     const int fl = fcntl(fd, F_GETFL, 0);
     fcntl(fd, F_SETFL, fl | O_NONBLOCK);
-    links_[p] = std::make_unique<SockLink>(fd, rank_, p);
+    peer.sf[0].link = std::make_unique<SockLink>(fd, rank_, p);
     last_rx_ns_[p] = NowNs();
     Fleet().AdoptEpoch(reply.bytes);
     ACX_TRACE_EVENT("fleet_join_link", static_cast<size_t>(p));
@@ -1791,7 +2269,8 @@ class StreamTransport : public Transport {
       const int fd = accept4(listen_fd_, nullptr, nullptr, SOCK_CLOEXEC);
       if (fd < 0) return;  // EAGAIN: no (more) pending dials
       WireHeader hello{};
-      if (!IoFullTimed(fd, &hello, sizeof hello, 1000, /*wr=*/false) ||
+      if (!link_state::IoFullTimed(fd, &hello, sizeof hello, 1000,
+                                   /*wr=*/false) ||
           hello.magic != wire::kMagicHello ||
           hello.hcrc != wire::HeaderCrc(hello) || hello.tag < 0 ||
           hello.tag >= size_ || hello.tag == rank_) {
@@ -1800,6 +2279,37 @@ class StreamTransport : public Transport {
         continue;
       }
       const int p = hello.tag;
+      // Subflow hello (DESIGN.md §15): establish/re-establish ONE striping
+      // lane of an otherwise healthy link. Same dial DAG as reconnects
+      // (only the lower rank dials), same epoch agreement, scoped to the
+      // lane's own clock.
+      if ((hello.ctx & wire::kHelloSubflow) != 0) {
+        const int k = wire::HelloSubflowIndex(hello.ctx);
+        Peer& peer = peers_[p];
+        if (k < 1 || k >= stripe::kMaxStripes || hello.tag >= rank_ ||
+            peer_dead_[p] || !recovery_armed_ ||
+            (static_cast<size_t>(k) < peer.sf.size() && peer.sf[k].down)) {
+          close(fd);
+          continue;
+        }
+        if (static_cast<size_t>(k) >= peer.sf.size())
+          peer.sf.resize(static_cast<size_t>(k) + 1);
+        Subflow& sf = peer.sf[k];
+        const uint32_t own = sf.clk.epoch + 1;
+        const uint32_t agreed = hello.epoch > own ? hello.epoch : own;
+        WireHeader reply =
+            MakeHdr(wire::kMagicHello, rank_, wire::HelloSubflowCtx(k), 0);
+        reply.seq = sf.clk.rx_seq;
+        reply.epoch = agreed;
+        reply.hcrc = wire::HeaderCrc(reply);
+        if (!link_state::IoFullTimed(fd, &reply, sizeof reply, 1000,
+                                     /*wr=*/true)) {
+          close(fd);
+          continue;
+        }
+        AdoptSubflowLocked(p, k, fd, hello.seq, agreed);
+        continue;
+      }
       const bool join = (hello.ctx & wire::kHelloJoin) != 0;
       // Plain reconnects RESUME an incarnation: only LOWER ranks dial us
       // (no connect race) and a dead peer cannot resume. JOIN hellos
@@ -1809,7 +2319,7 @@ class StreamTransport : public Transport {
         close(fd);
         continue;
       }
-      const uint32_t own = peers_[p].epoch + 1;
+      const uint32_t own = peers_[p].sf[0].clk.epoch + 1;
       const uint32_t agreed = hello.epoch > own ? hello.epoch : own;
       if (join) {
         // Adopt FIRST so the reply can carry the post-join fleet epoch. If
@@ -1823,15 +2333,17 @@ class StreamTransport : public Transport {
         reply.seq = 0;
         reply.epoch = agreed;
         reply.hcrc = wire::HeaderCrc(reply);
-        if (!IoFullTimed(fd, &reply, sizeof reply, 1000, /*wr=*/true))
-          links_[p]->ForceClose();
+        if (!link_state::IoFullTimed(fd, &reply, sizeof reply, 1000,
+                                     /*wr=*/true))
+          peers_[p].sf[0].link->ForceClose();
         continue;
       }
       WireHeader reply = MakeHdr(wire::kMagicHello, rank_, 0, 0);
-      reply.seq = peers_[p].rx_seq;
+      reply.seq = peers_[p].sf[0].clk.rx_seq;
       reply.epoch = agreed;
       reply.hcrc = wire::HeaderCrc(reply);
-      if (!IoFullTimed(fd, &reply, sizeof reply, 1000, /*wr=*/true)) {
+      if (!link_state::IoFullTimed(fd, &reply, sizeof reply, 1000,
+                                   /*wr=*/true)) {
         close(fd);
         continue;
       }
@@ -1854,32 +2366,31 @@ class StreamTransport : public Transport {
                          /*hb_detected=*/false);
     peer_dead_[p] = false;
     peers_dead_n_.fetch_sub(1, std::memory_order_relaxed);
-    // Fresh wire clocks: the new incarnation never saw the old stream, so
-    // no WIRE state carries over — not the replay buffer, not a
-    // half-assembled inbound frame. Fully-delivered eager payloads in the
+    // Fresh wire state: the new incarnation never saw the old stream, so
+    // no WIRE state carries over — not the replay buffers, not a
+    // half-assembled inbound frame, not the stripe id spaces. The whole
+    // lane array rebuilds: lane 0 gets the join socket at the agreed
+    // epoch; lanes >= 1 start linkless at epoch 1 and the lower rank
+    // redials them lazily. Fully-delivered eager payloads in the
     // unexpected queue DO survive: the old incarnation drained before it
     // left, so data it landed ahead of its departure is valid app traffic
     // a late recv must still match. Rendezvous arrivals cannot — their
     // descriptors point into the dead incarnation's address space.
-    peer.epoch = agreed;
-    peer.tx_seq = 0;
-    peer.rx_seq = 0;
-    peer.acked_rx = 0;
-    peer.rx_since_ack = 0;
-    peer.last_nak_ns = 0;
-    peer.replay.clear();
-    peer.replay_bytes = 0;
-    peer.replay_broken = false;
+    peer.sf.clear();
+    peer.sf.resize(stripes_ < 1 ? 1 : stripes_);
+    peer.sf[0].clk.epoch = agreed;
+    peer.next_stripe_id = 1;
+    peer.rr_cursor = 0;
+    peer.done_stripes.clear();
+    peer.replay_broken_noted = false;  // gauge already settled by dead-latch
     for (auto it = peer.arrived.begin(); it != peer.arrived.end();)
       it = it->rv ? peer.arrived.erase(it) : std::next(it);
-    peer.in = InState{};
     peer.rec_attempts = 0;
     peer.rec_next_ns = 0;
     peer.rec_deadline_ns = 0;
-    peer.stall_until_ns = 0;
     const int fl = fcntl(fd, F_GETFL, 0);
     fcntl(fd, F_SETFL, fl | O_NONBLOCK);
-    links_[p] = std::make_unique<SockLink>(fd, rank_, p);
+    peer.sf[0].link = std::make_unique<SockLink>(fd, rank_, p);
     last_rx_ns_[p] = NowNs();
     const uint64_t fepoch = Fleet().OnJoin(p);
     ACX_TRACE_EVENT("fleet_join", static_cast<size_t>(p));
@@ -1889,7 +2400,8 @@ class StreamTransport : public Transport {
                  "%llu)\n",
                  rank_, p, agreed, static_cast<unsigned long long>(fepoch));
     for (int q = 0; q < size_; q++) {
-      if (q == rank_ || q == p || !links_[q] || peer_dead_[q]) continue;
+      if (q == rank_ || q == p || !peers_[q].sf[0].link || peer_dead_[q])
+        continue;
       if (peers_[q].health != 0) continue;
       SendViewLocked(q, p, MemberState::kMemberActive, fepoch);
     }
@@ -1906,71 +2418,71 @@ class StreamTransport : public Transport {
 
   // Header-only unsequenced membership frame: tag = subject rank, ctx =
   // its new state, bytes = our fleet epoch (see DrainInLocked's receive
-  // side). Rides outside the sequence space like heartbeats.
+  // side). Rides outside the sequence space like heartbeats; always lane 0.
   void SendViewLocked(int q, int subject, MemberState st, uint64_t fepoch) {
     auto s = std::make_shared<SendReq>();
     s->hdr = MakeHdr(wire::kMagicView, subject, static_cast<int>(st), 0);
     s->hdr.bytes = fepoch;
-    SealHdrLocked(q, &s->hdr);
+    SealHdrLocked(q, 0, &s->hdr);
     s->wire_payload = s->desc;
     s->wire_bytes = 0;
     s->dst = q;
-    peers_[q].outq.push_back(std::move(s));
-    FlushOutLocked(q);
+    peers_[q].sf[0].outq.push_back(std::move(s));
+    FlushOutLocked(q, 0);
   }
 
-  // Install the reconnected socket as the live link to p and restore
-  // exactly-once delivery: rewind the outq, replay every frame the peer
-  // hasn't delivered (epoch re-stamped in place), reset inbound assembly.
+  // Install the reconnected socket as the live LANE-0 link to p and restore
+  // exactly-once delivery on it: rewind the lane's outq, replay every frame
+  // the peer hasn't delivered (epoch re-stamped in place), reset inbound
+  // assembly. Subflow lanes are untouched — each heals through its own
+  // AdoptSubflowLocked.
   void AdoptLinkLocked(int p, int fd, uint64_t peer_rx, uint32_t agreed) {
     Peer& peer = peers_[p];
+    Subflow& sf = peer.sf[0];
     const int fl = fcntl(fd, F_GETFL, 0);
     fcntl(fd, F_SETFL, fl | O_NONBLOCK);
-    links_[p] = std::make_unique<SockLink>(fd, rank_, p);  // old fd closes
-    peer.epoch = agreed;
+    sf.link = std::make_unique<SockLink>(fd, rank_, p);  // old fd closes
+    sf.clk.epoch = agreed;
     // Purge the outq: raw replay frames are regenerated from the replay
     // buffer below; unsequenced control frames (HB/SeqAck/NAK) are stale
     // and cheap to regenerate; sequenced survivors rewind to byte 0 with
     // pristine CRCs and the new epoch.
-    for (auto it = peer.outq.begin(); it != peer.outq.end();) {
+    for (auto it = sf.outq.begin(); it != sf.outq.end();) {
       auto& s = *it;
       if (s->raw) {
-        ClearQueuedLocked(p, s->hdr.seq);
-        it = peer.outq.erase(it);
+        ClearQueuedLocked(p, 0, s->hdr.seq);
+        it = sf.outq.erase(it);
       } else if (!wire::Sequenced(s->hdr.magic)) {
-        it = peer.outq.erase(it);
+        it = sf.outq.erase(it);
       } else {
         s->off = 0;
         if (s->corrupted) {
           s->hdr.crc = s->good_crc;
           s->corrupted = false;
         }
-        SealHdrLocked(p, &s->hdr);
+        SealHdrLocked(p, 0, &s->hdr);
         ++it;
       }
     }
-    HandleSeqAckLocked(p, peer_rx);  // peer holds everything through peer_rx
-    if (!peer.replay.empty() && peer.replay.front().seq != peer_rx + 1) {
+    HandleSeqAckLocked(p, 0, peer_rx);  // peer holds everything thru peer_rx
+    if (!sf.replay.recs.empty() && sf.replay.recs.front().seq != peer_rx + 1) {
       // The peer needs a frame we no longer hold: recovery can't be
       // lossless, and a silent gap is worse than a dead link.
       MarkPeerDeadLocked(p, "replay buffer exhausted", /*hb_detected=*/true);
       return;
     }
     uint64_t count = 0;
-    auto ins = peer.outq.begin();
-    for (auto& rec : peer.replay) {
+    auto ins = sf.outq.begin();
+    for (auto& rec : sf.replay.recs) {
       rec.queued = true;
-      char* blob = rec.frame.data();
-      memcpy(blob + offsetof(WireHeader, epoch), &agreed, sizeof agreed);
-      const uint32_t hcrc = wire::Crc32c(0, blob, offsetof(WireHeader, hcrc));
-      memcpy(blob + offsetof(WireHeader, hcrc), &hcrc, sizeof hcrc);
+      framing::RestampFrame(rec.frame.data(), agreed);
       auto s = std::make_shared<SendReq>();
       s->raw = true;
       s->dst = p;
       s->hdr.seq = rec.seq;
-      s->wire_payload = blob;
+      s->wire_payload = rec.frame.data();
       s->wire_bytes = rec.frame.size();
-      ins = peer.outq.insert(ins, std::move(s));
+      ins = sf.outq.insert(ins, std::move(s));
       ++ins;
       count++;
     }
@@ -1981,17 +2493,12 @@ class StreamTransport : public Transport {
     // Inbound assembly state is a torn frame from the dead link: rewind.
     // A half-filled direct recv re-arms at the head of the posted queue;
     // the replayed copy will match it again and overwrite from byte 0.
-    InState& in = peer.in;
+    InState& in = sf.in;
     if (in.direct) {
       peer.posted.push_front(in.direct);
       in.direct.reset();
     }
-    in.hdr_got = 0;
-    in.payload.clear();
-    in.payload_got = 0;
-    in.run_crc = 0;
-    in.discard = false;
-    in.nak_after = false;
+    in = InState{};
     if (peer.health == 1) {
       peer.health = 0;
       recovering_count_.fetch_sub(1, std::memory_order_relaxed);
@@ -1999,54 +2506,264 @@ class StreamTransport : public Transport {
     peer.rec_attempts = 0;
     peer.rec_next_ns = 0;
     peer.rec_deadline_ns = 0;
-    peer.stall_until_ns = 0;
-    peer.last_nak_ns = 0;
+    sf.stall_until_ns = 0;
+    sf.clk.last_nak_ns = 0;
     last_rx_ns_[p] = NowNs();
     reconnects_.fetch_add(1, std::memory_order_relaxed);
     ACX_TRACE_EVENT("link_reconnected", static_cast<size_t>(p));
-    ACX_FLIGHT(kLinkUp, -1, p, -1, peer.rx_seq, agreed);
+    ACX_FLIGHT(kLinkUp, -1, p, -1, sf.clk.rx_seq, agreed);
     std::fprintf(stderr,
                  "tpu-acx[%d]: link to %d re-established (epoch %u, "
                  "replaying %llu frame(s))\n",
                  rank_, p, agreed, static_cast<unsigned long long>(count));
-    FlushOutLocked(p);
+    FlushOutLocked(p, 0);
   }
 
-  // Exact-length IO with a poll-based deadline, for the 40-byte handshake
-  // on a fresh (blocking) reconnect socket. Safe under mu_: the peer's
-  // handshake side runs under its OWN lock, so there is no circular wait —
-  // worst case is the bounded timeout.
-  static bool IoFullTimed(int fd, void* buf, size_t n, int timeout_ms,
-                          bool wr) {
-    char* pbuf = static_cast<char*>(buf);
-    size_t got = 0;
-    const uint64_t deadline =
-        NowNs() + static_cast<uint64_t>(timeout_ms) * 1000000ull;
-    while (got < n) {
-      const uint64_t now = NowNs();
-      if (now >= deadline) return false;
-      struct pollfd pf;
-      pf.fd = fd;
-      pf.events = wr ? POLLOUT : POLLIN;
-      pf.revents = 0;
-      const int pr =
-          poll(&pf, 1, static_cast<int>((deadline - now) / 1000000ull) + 1);
-      if (pr < 0) {
-        if (errno == EINTR) continue;
-        return false;
-      }
-      if (pr == 0) return false;
-      const ssize_t r = wr ? send(fd, pbuf + got, n - got, MSG_NOSIGNAL)
-                           : read(fd, pbuf + got, n - got);
-      if (r > 0) {
-        got += static_cast<size_t>(r);
-        continue;
-      }
-      if (r < 0 && (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK))
-        continue;
-      return false;  // EOF or hard error
+  // -- striping subflow lifecycle (DESIGN.md §15) ----------------------------
+
+  // Dialer side: fire any due subflow dials for an otherwise healthy link.
+  void EnsureSubflowsLocked(int p) {
+    Peer& peer = peers_[p];
+    if (peer.sf.size() <= 1) return;
+    const uint64_t now = NowNs();
+    for (size_t k = 1; k < peer.sf.size(); k++) {
+      Subflow& sf = peer.sf[k];
+      if (sf.link || sf.down) continue;
+      if (now < sf.next_dial_ns) continue;
+      DialSubflowLocked(p, static_cast<int>(k));
     }
-    return true;
+  }
+
+  // One connect attempt for lane k. Initial establishment (lane epoch still
+  // 1) retries forever at the capped backoff — the peer may simply not
+  // have its listener up yet, and the link is fully functional on lane 0
+  // meanwhile. A REDIAL (lane died after being up) walks the same bounded
+  // ladder as lane-0 recovery and then DEGRADES the lane instead of
+  // killing the link.
+  void DialSubflowLocked(int p, int k) {
+    Peer& peer = peers_[p];
+    Subflow& sf = peer.sf[k];
+    const bool redial = sf.clk.epoch > 1;
+    sf.dial_attempts++;
+    if (redial) {
+      const uint32_t maxa =
+          Policy().reconnect_max.load(std::memory_order_relaxed);
+      if (sf.dial_attempts > static_cast<int>(maxa)) {
+        DegradeSubflowLocked(p, static_cast<size_t>(k));
+        return;
+      }
+    }
+    sf.next_dial_ns = NowNs() + JitteredWaitNs(DialBackoffMs(
+                                    sf.dial_attempts < 16 ? sf.dial_attempts
+                                                          : 16));
+    const int fd = ConnectListenerLocked(p);
+    if (fd < 0) return;  // not listening yet — ladder retries
+    WireHeader hello =
+        MakeHdr(wire::kMagicHello, rank_, wire::HelloSubflowCtx(k), 0);
+    hello.seq = sf.clk.rx_seq;
+    hello.epoch = sf.clk.epoch + 1;  // proposal; the reply is authoritative
+    hello.hcrc = wire::HeaderCrc(hello);
+    WireHeader reply{};
+    if (!link_state::IoFullTimed(fd, &hello, sizeof hello, 500,
+                                 /*wr=*/true) ||
+        !link_state::IoFullTimed(fd, &reply, sizeof reply, 500,
+                                 /*wr=*/false) ||
+        reply.magic != wire::kMagicHello ||
+        reply.hcrc != wire::HeaderCrc(reply) || reply.tag != p ||
+        (reply.ctx & wire::kHelloSubflow) == 0 ||
+        wire::HelloSubflowIndex(reply.ctx) != k ||
+        reply.epoch < hello.epoch) {
+      WarnIfLegacyHello(p, reply.magic);
+      close(fd);
+      return;
+    }
+    AdoptSubflowLocked(p, static_cast<size_t>(k), fd, reply.seq, reply.epoch);
+  }
+
+  // Install a handshaken socket as lane k, replaying the lane's unacked
+  // frames — the per-lane mirror of AdoptLinkLocked, touching only this
+  // lane's clock/replay/assembly.
+  void AdoptSubflowLocked(int p, size_t k, int fd, uint64_t peer_rx,
+                          uint32_t agreed) {
+    Peer& peer = peers_[p];
+    Subflow& sf = peer.sf[k];
+    const bool redial = sf.clk.epoch > 1;
+    const int fl = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+    fcntl(fd, F_SETFD, FD_CLOEXEC);
+    sf.link = std::make_unique<SockLink>(fd, rank_, p);
+    sf.clk.epoch = agreed;
+    for (auto it = sf.outq.begin(); it != sf.outq.end();) {
+      auto& s = *it;
+      if (s->raw) {
+        sf.replay.ClearQueued(s->hdr.seq);
+        it = sf.outq.erase(it);
+      } else if (!wire::Sequenced(s->hdr.magic)) {
+        it = sf.outq.erase(it);
+      } else {
+        s->off = 0;
+        if (s->corrupted) {
+          s->hdr.crc = s->good_crc;
+          s->corrupted = false;
+        }
+        SealHdrLocked(p, k, &s->hdr);
+        ++it;
+      }
+    }
+    sf.replay.AckThrough(peer_rx);
+    if (!sf.replay.recs.empty() && sf.replay.recs.front().seq != peer_rx + 1) {
+      MarkPeerDeadLocked(p, "subflow replay exhausted", /*hb_detected=*/true);
+      return;
+    }
+    uint64_t count = 0;
+    auto ins = sf.outq.begin();
+    for (auto& rec : sf.replay.recs) {
+      rec.queued = true;
+      framing::RestampFrame(rec.frame.data(), agreed);
+      auto s = std::make_shared<SendReq>();
+      s->raw = true;
+      s->dst = p;
+      s->hdr.seq = rec.seq;
+      s->wire_payload = rec.frame.data();
+      s->wire_bytes = rec.frame.size();
+      ins = sf.outq.insert(ins, std::move(s));
+      ++ins;
+      count++;
+    }
+    if (count != 0) {
+      frames_replayed_.fetch_add(count, std::memory_order_relaxed);
+      peer.sc_replayed += count;  // wire scope
+    }
+    if (sf.in.direct) {
+      peer.posted.push_front(sf.in.direct);
+      sf.in.direct.reset();
+    }
+    sf.in = InState{};
+    sf.stall_until_ns = 0;
+    sf.down = false;
+    sf.dial_attempts = 0;
+    sf.next_dial_ns = 0;
+    sf.give_up_ns = 0;
+    last_rx_ns_[p] = NowNs();
+    // First establishment agrees epoch 2 (both sides proposed 1+1); any
+    // higher agreement means the lane was up before — a true reconnect.
+    if (redial || agreed > 2) {
+      reconnects_.fetch_add(1, std::memory_order_relaxed);
+      std::fprintf(stderr,
+                   "tpu-acx[%d]: subflow %zu to %d re-established (epoch "
+                   "%u, replaying %llu frame(s))\n",
+                   rank_, k, p, agreed,
+                   static_cast<unsigned long long>(count));
+    }
+    ACX_FLIGHT(kLinkUp, -1, p, -1, sf.clk.rx_seq, agreed);
+    FlushOutLocked(p, k);
+  }
+
+  // Lane k's socket died (EOF / forced close) on an otherwise healthy link:
+  // drop the fd and schedule the redial ladder (dialer) or the give-up
+  // deadline (acceptor). Traffic keeps flowing on the other lanes; the
+  // lane's unacked frames sit in its replay buffer until the redial
+  // resolves — replayed on success, migrated by DegradeSubflowLocked on
+  // failure.
+  void SubflowLostLocked(int p, size_t k) {
+    Peer& peer = peers_[p];
+    if (peer_dead_[p] || peer.health != 0) return;
+    Subflow& sf = peer.sf[k];
+    std::fprintf(stderr,
+                 "tpu-acx[%d]: subflow %zu to %d lost; %s\n", rank_, k, p,
+                 rank_ < p ? "redialing" : "awaiting redial");
+    sf.link.reset();
+    if (rank_ < p) {
+      sf.dial_attempts = 0;
+      sf.next_dial_ns = NowNs();
+    } else {
+      sf.give_up_ns = NowNs() + AcceptDeadlineNs();
+    }
+  }
+
+  // The redial ladder for lane k exhausted (or the acceptor's deadline
+  // expired): permanently fold the lane into the survivors. Its unacked
+  // frames migrate into lane 0's sequence space with FRESH seq numbers —
+  // the receiver's per-stripe got-set and done_stripes dedup absorb any
+  // frames that had actually been delivered but not yet acked.
+  void DegradeSubflowLocked(int p, size_t k) {
+    Peer& peer = peers_[p];
+    Subflow& sf = peer.sf[k];
+    Subflow& sf0 = peer.sf[0];
+    if (sf.replay.broken) {
+      // The lane evicted unacked frames: migration would leave a
+      // permanent gap in some striped message. Same verdict as a gapped
+      // lane-0 replay.
+      MarkPeerDeadLocked(p, "subflow replay exhausted", /*hb_detected=*/true);
+      return;
+    }
+    // (1) Unwritten frames waiting on the dead lane: carry the sequenced
+    // non-raw ones over (they get fresh lane-0 seqs below); raw frames are
+    // regenerated from the replay records; control frames are stale.
+    std::vector<std::shared_ptr<SendReq>> carry;
+    for (auto& s : sf.outq) {
+      if (s->raw) {
+        sf.replay.ClearQueued(s->hdr.seq);
+        continue;
+      }
+      if (!wire::Sequenced(s->hdr.magic)) continue;
+      carry.push_back(s);
+    }
+    sf.outq.clear();
+    // (2) Unacked-but-written frames FIRST (they precede the unwritten ones
+    // in message order), restamped into lane 0's epoch/seq space and
+    // appended — records move into lane 0's replay so a later lane-0
+    // reconnect can still replay them.
+    uint64_t moved = 0;
+    for (auto& rec : sf.replay.recs) {
+      const uint64_t newseq = ++sf0.clk.tx_seq;
+      char* blob = rec.frame.data();
+      framing::RestampFrame(blob, sf0.clk.epoch, &newseq);
+      rec.seq = newseq;
+      rec.queued = true;
+      auto s = std::make_shared<SendReq>();
+      s->raw = true;
+      s->dst = p;
+      s->hdr.seq = newseq;
+      s->wire_payload = blob;
+      s->wire_bytes = rec.frame.size();
+      sf0.replay.bytes += rec.frame.size();
+      sf0.replay.recs.push_back(std::move(rec));
+      sf0.outq.push_back(std::move(s));
+      moved++;
+    }
+    sf.replay.recs.clear();
+    sf.replay.bytes = 0;
+    // (3) Then the never-written carries, stamped after the migrated raws
+    // so lane-0 wire order stays sequence order.
+    for (auto& s : carry) {
+      s->off = 0;
+      if (s->corrupted) {
+        s->hdr.crc = s->good_crc;
+        s->corrupted = false;
+      }
+      StampSeqLocked(p, 0, &s->hdr);  // fresh lane-0 seq; tx_ns preserved
+      sf0.outq.push_back(s);
+    }
+    if (moved != 0) {
+      frames_replayed_.fetch_add(moved, std::memory_order_relaxed);
+      peer.sc_replayed += moved;
+    }
+    if (sf.in.direct) {
+      peer.posted.push_front(sf.in.direct);
+      sf.in.direct.reset();
+    }
+    sf.in = InState{};
+    sf.link.reset();
+    sf.down = true;
+    sf.next_dial_ns = 0;
+    sf.give_up_ns = 0;
+    std::fprintf(stderr,
+                 "tpu-acx[%d]: subflow %zu to %d degraded (%llu frame(s) "
+                 "migrated); continuing on %d lane(s)\n",
+                 rank_, k, p, static_cast<unsigned long long>(moved),
+                 LiveLanesLocked(peer));
+    FlushOutLocked(p, 0);
   }
 
   // Blocking control-plane helpers (used by Barrier/AllreduceInt only).
@@ -2062,7 +2779,6 @@ class StreamTransport : public Transport {
   }
 
   int rank_, size_;
-  std::vector<std::unique_ptr<Link>> links_;
   std::vector<Peer> peers_;
   std::mutex mu_;
   void* shm_base_;
@@ -2087,7 +2803,7 @@ class StreamTransport : public Transport {
   // -- survivable-link state (DESIGN.md §9) --
   bool recovery_armed_ = false;  // socket plane + ACX_JOB_ID + live listener
   bool crc_on_ = true;           // ACX_CRC (payload CRC32C stamping)
-  size_t replay_budget_ = 4u << 20;  // ACX_REPLAY_BUF_BYTES, per link
+  size_t replay_budget_ = 4u << 20;  // ACX_REPLAY_BUF_BYTES, per subflow
   std::string job_id_;
   int listen_fd_ = -1;
   uint64_t last_ack_flush_ns_ = 0;  // idle SeqAck flush timer
@@ -2098,6 +2814,11 @@ class StreamTransport : public Transport {
   std::atomic<uint64_t> crc_rejects_{0};
   std::atomic<uint64_t> naks_sent_{0};
   std::atomic<uint64_t> recovering_count_{0};
+  std::atomic<uint64_t> replay_broken_links_{0};
+
+  // -- striping (DESIGN.md §15) --
+  stripe::Config stripe_cfg_;  // ACX_STRIPES / ACX_STRIPE_MIN_BYTES
+  int stripes_ = 1;            // effective lane count (1 unless armed)
 };
 
 bool SockTicket::Test(Status* st) { return t_->TestReq(send_, recv_, st); }

@@ -1,0 +1,55 @@
+#include "src/net/stripe.h"
+
+#include <stdlib.h>
+
+namespace acx {
+namespace stripe {
+
+Config ConfigFromEnv() {
+  Config cfg;
+  if (const char* e = getenv("ACX_STRIPES")) {
+    const long v = strtol(e, nullptr, 10);
+    if (v < 1)
+      cfg.stripes = 1;
+    else if (v > kMaxStripes)
+      cfg.stripes = kMaxStripes;
+    else
+      cfg.stripes = static_cast<int>(v);
+  }
+  if (const char* e = getenv("ACX_STRIPE_MIN_BYTES")) {
+    const long long v = strtoll(e, nullptr, 10);
+    if (v > 0) cfg.min_bytes = static_cast<size_t>(v);
+  }
+  return cfg;
+}
+
+bool ShouldStripe(size_t bytes, int live_subflows, const Config& cfg) {
+  if (cfg.stripes <= 1 || live_subflows <= 1) return false;
+  if (bytes < cfg.min_bytes) return false;
+  // Need at least two chunks for striping to mean anything.
+  size_t chunk = bytes / static_cast<size_t>(live_subflows);
+  if (chunk > kChunkCap) chunk = kChunkCap;
+  if (chunk < kMinChunk) chunk = kMinChunk;
+  return bytes > chunk;
+}
+
+std::vector<ChunkSpan> PlanChunks(size_t bytes, int live_subflows) {
+  if (live_subflows < 1) live_subflows = 1;
+  // Even split across lanes, rounded up so the last chunk is the short one.
+  size_t chunk =
+      (bytes + static_cast<size_t>(live_subflows) - 1) /
+      static_cast<size_t>(live_subflows);
+  if (chunk > kChunkCap) chunk = kChunkCap;
+  if (chunk < kMinChunk) chunk = kMinChunk;
+  std::vector<ChunkSpan> out;
+  out.reserve(bytes / chunk + 1);
+  for (uint64_t off = 0; off < bytes; off += chunk) {
+    const uint64_t len =
+        (bytes - off < chunk) ? (bytes - off) : static_cast<uint64_t>(chunk);
+    out.push_back({off, len});
+  }
+  return out;
+}
+
+}  // namespace stripe
+}  // namespace acx

@@ -1,0 +1,53 @@
+// tpu-acx: striping policy — pure arithmetic deciding WHETHER a message
+// stripes across subflows and HOW it is cut into chunks (DESIGN.md §15).
+// No sockets, no locks; socket_transport.cc applies the plan this file
+// produces. Unit-tested in ctests/test_framing.cc.
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+
+#include <vector>
+
+namespace acx {
+namespace stripe {
+
+// Hard cap on subflows per peer: 8 lanes is already past the point of
+// diminishing returns for socket-buffer aggregation, and the subflow index
+// must fit the 8-bit hello ctx field (wire::HelloSubflowCtx).
+constexpr int kMaxStripes = 8;
+
+// Chunk sizing bounds. The cap keeps any single chunk's blocking writev
+// short enough that round-robin actually interleaves lanes; the floor keeps
+// per-chunk header overhead (56B header + 24B ChunkHdr) under ~2%.
+constexpr size_t kChunkCap = 1u << 20;   // 1 MiB
+constexpr size_t kMinChunk = 4096;
+
+struct Config {
+  int stripes = 1;                   // ACX_STRIPES, clamped [1, kMaxStripes]
+  size_t min_bytes = 64u << 10;      // ACX_STRIPE_MIN_BYTES
+};
+
+// Parse ACX_STRIPES / ACX_STRIPE_MIN_BYTES. Defaults keep the transport
+// byte-identical to the single-flow protocol.
+Config ConfigFromEnv();
+
+struct ChunkSpan {
+  uint64_t offset;
+  uint64_t len;
+};
+
+// A message stripes iff it meets the size threshold (inclusive: a message
+// of exactly min_bytes stripes), more than one lane is live, and the plan
+// yields at least two chunks (a single-chunk "stripe" would just be the
+// eager path with extra headers).
+bool ShouldStripe(size_t bytes, int live_subflows, const Config& cfg);
+
+// Cut `bytes` into chunks for `live_subflows` lanes. Chunk size targets an
+// even split across lanes, clamped to [kMinChunk, kChunkCap] — the cap, not
+// the lane count, bounds chunk size, so large messages produce MORE chunks
+// than lanes and round-robin keeps every lane busy for the whole message.
+std::vector<ChunkSpan> PlanChunks(size_t bytes, int live_subflows);
+
+}  // namespace stripe
+}  // namespace acx

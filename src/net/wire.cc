@@ -58,5 +58,10 @@ uint32_t Crc32c(uint32_t crc, const void* data, size_t n) {
   return state ^ 0xFFFFFFFFu;
 }
 
+uint32_t Crc32cSw(uint32_t crc, const void* data, size_t n) {
+  const unsigned char* p = static_cast<const unsigned char*>(data);
+  return SwUpdate(crc ^ 0xFFFFFFFFu, p, n) ^ 0xFFFFFFFFu;
+}
+
 }  // namespace wire
 }  // namespace acx

@@ -27,6 +27,13 @@ constexpr uint32_t kMagicSeqAck = 0xAC0C0205;  // cumulative receive ack: header
 constexpr uint32_t kMagicNak    = 0xAC0C0206;  // negative ack / re-pull: header only
 constexpr uint32_t kMagicHello  = 0xAC0C0207;  // reconnect/join handshake: header only
 constexpr uint32_t kMagicView   = 0xAC0C0208;  // fleet membership view: header only
+// Multi-path striping (DESIGN.md §15). A message above ACX_STRIPE_MIN_BYTES
+// travels as one kMagicStripe envelope on subflow 0 (it occupies the
+// message's slot in the per-(src,tag,ctx) FIFO matching order) plus
+// kMagicChunk frames carrying disjoint payload slices round-robin across
+// every live subflow. Both are sequenced in their own subflow's seq space.
+constexpr uint32_t kMagicStripe = 0xAC0C0209;  // stripe envelope: header + StripeDesc
+constexpr uint32_t kMagicChunk  = 0xAC0C020A;  // stripe chunk: header + ChunkHdr + slice
 
 // A frame class from the pre-span 40-byte protocol (v1, 0xAC0C01xx). Never
 // accepted — recognized only so the mismatch error can say "old peer"
@@ -42,6 +49,16 @@ inline bool KnownLegacyMagic(uint32_t m) {
 // resets the peer's wire state instead of resuming it, bumps the fleet
 // epoch, and fans the new view out (DESIGN.md §12).
 constexpr int32_t kHelloJoin = 0x1;
+// A SUBFLOW hello establishes (or resumes after a lane loss) one striped
+// subflow of an existing link: bits [8,16) of ctx carry the subflow index
+// (>= 1; subflow 0 is the primary link itself and is never dialed this
+// way). seq/epoch carry the dialer's per-SUBFLOW rx high-water and epoch
+// proposal, exactly like a plain resume hello does for the primary.
+constexpr int32_t kHelloSubflow = 0x2;
+inline int32_t HelloSubflowCtx(int subflow) {
+  return kHelloSubflow | (subflow << 8);
+}
+inline int HelloSubflowIndex(int32_t ctx) { return (ctx >> 8) & 0xFF; }
 
 #pragma pack(push, 1)
 struct WireHeader {
@@ -74,6 +91,12 @@ static_assert(sizeof(WireHeader) == 56, "wire header is part of the protocol");
 // Hardware SSE4.2 path when available, software table otherwise.
 uint32_t Crc32c(uint32_t crc, const void* data, size_t n);
 
+// The software table path, always — never dispatches to SSE4.2. Same
+// incremental contract and the same answer as Crc32c; exists so tests can
+// pin the fallback against the hardware path on hosts where the hardware
+// path is what Crc32c actually runs (ctests/test_framing.cc).
+uint32_t Crc32cSw(uint32_t crc, const void* data, size_t n);
+
 inline uint32_t HeaderCrc(const WireHeader& h) {
   return Crc32c(0, &h, offsetof(WireHeader, hcrc));
 }
@@ -81,8 +104,10 @@ inline uint32_t HeaderCrc(const WireHeader& h) {
 // Frames that consume a sequence number and are recorded for replay.
 // Control frames (hb/seqack/nak/hello) ride outside the sequence space so
 // they can flow while the data stream is stalled or being replayed.
+// Stripe envelopes and chunks are sequenced in their OWN subflow's space.
 inline bool Sequenced(uint32_t magic) {
-  return magic == kMagic || magic == kMagicRts || magic == kMagicAck;
+  return magic == kMagic || magic == kMagicRts || magic == kMagicAck ||
+         magic == kMagicStripe || magic == kMagicChunk;
 }
 
 }  // namespace wire

@@ -126,6 +126,7 @@ def summarize(series):
         "ttft_p99_s": None,
         "itl_p99_s": None,
         "link_health": "-",
+        "subflows": "-",
     }
     if len(samples) >= 2:
         a, b = samples[-2], samples[-1]
@@ -172,6 +173,12 @@ def summarize(series):
     if links:
         worst = max(ln.get("state", 0) for ln in links)
         row["link_health"] = LINK_STATE.get(worst, "?")
+        # Striping lanes (DESIGN.md §15): show the worst-off link's
+        # up/configured subflow counts — "3/4" flags a degraded lane at a
+        # glance. Absolutes, not rates, so the newest section suffices.
+        ratios = [(ln.get("sf_up", 1), ln.get("sf", 1)) for ln in links]
+        up, total = min(ratios, key=lambda r: (r[0] / max(r[1], 1), r[0]))
+        row["subflows"] = f"{up}/{total}"
     elif _latest(series, "links") == []:
         row["link_health"] = "none"
     return row
@@ -239,7 +246,7 @@ def render_table(all_series):
     hdr = (f"{'rank':>4} {'epoch':>5} {'smpls':>5} {'ops/s':>9} "
            f"{'good MB/s':>9} {'wire MB/s':>9} {'proxy%':>6} "
            f"{'txq µs':>7} {'rxt µs':>7} "
-           f"{'qdepth':>6} {'p99 TTFT':>9} {'link':>5}")
+           f"{'qdepth':>6} {'p99 TTFT':>9} {'link':>5} {'sf':>5}")
     lines = [hdr, "-" * len(hdr)]
     for r in rows:
         ttft = (_fmt(r["ttft_p99_s"], ".3f") + "s"
@@ -250,7 +257,7 @@ def render_table(all_series):
             f"{r['wire_mbps']:>9.2f} {r['proxy_util_pct']:>6.1f} "
             f"{_fmt(r['txq_us'], '.1f'):>7} {_fmt(r['rxt_us'], '.1f'):>7} "
             f"{_fmt(r['queue_depth'], 'd'):>6} {ttft:>9} "
-            f"{r['link_health']:>5}")
+            f"{r['link_health']:>5} {r['subflows']:>5}")
     if not rows:
         lines.append("  (no .tseries.jsonl files yet)")
     return "\n".join(lines)

@@ -110,6 +110,51 @@ int main(int argc, char** argv) {
                 iters, msg);
   }
 
+  // Striped-bandwidth sweep (ACX_BENCH_STRIPE_SWEEP=1, DESIGN.md §15):
+  // one-way windowed stream per message size, receiver preposted so every
+  // striped message takes the direct zero-copy delivery path. ACX_STRIPES
+  // is fixed at transport construction, so one process measures ONE lane
+  // count; the harness (tools/bench.py) sweeps lane counts across runs and
+  // pairs the rows. Run with ACX_RV_THRESHOLD=0 so large messages take the
+  // eager (striping) path rather than rendezvous.
+  if (getenv("ACX_BENCH_STRIPE_SWEEP") != nullptr) {
+    const char* stripes_s = getenv("ACX_STRIPES");
+    const size_t sizes[] = {256u << 10, 1u << 20, 4u << 20};
+    for (size_t mb : sizes) {
+      const int win = 16;                       // messages in flight
+      const int rounds = (int)((96u << 20) / (mb * win)) + 1;
+      std::vector<char> sb(mb, 5), rb(mb, 0);
+      double best = 0;
+      for (int set = 0; set < 3; set++) {       // best-of-3, cold set absorbed
+        MPI_Barrier(MPI_COMM_WORLD);
+        auto t0 = Clock::now();
+        for (int r = 0; r < rounds; r++) {
+          MPIX_Request req[16];
+          cudaStream_t s0 = 0;
+          for (int w = 0; w < win; w++) {
+            if (rank == 0)
+              MPIX_Isend_enqueue(sb.data(), (int)mb, MPI_BYTE, peer, 20 + w,
+                                 MPI_COMM_WORLD, &req[w],
+                                 MPIX_QUEUE_XLA_STREAM, &s0);
+            else
+              MPIX_Irecv_enqueue(rb.data(), (int)mb, MPI_BYTE, peer, 20 + w,
+                                 MPI_COMM_WORLD, &req[w],
+                                 MPIX_QUEUE_XLA_STREAM, &s0);
+          }
+          for (int w = 0; w < win; w++)
+            MPIX_Wait(&req[w], MPI_STATUS_IGNORE);
+        }
+        MPI_Barrier(MPI_COMM_WORLD);
+        const double secs = us_since(t0) / 1e6;
+        const double bw = (double)mb * win * rounds / secs / 1e9;
+        best = std::max(best, bw);
+      }
+      if (rank == 0)
+        std::printf("BENCH_STRIPE stripes=%s msg_bytes=%zu bw_gbps=%.3f\n",
+                    stripes_s != nullptr ? stripes_s : "1", mb, best);
+    }
+  }
+
   MPIX_Finalize();
   MPI_Finalize();
   return 0;
