@@ -169,7 +169,40 @@ chaos-check: itest tools
 	  --metrics-out $(BUILD)/chaos-metrics/fleet.metrics.json \
 	  $(BUILD)/chaos-metrics/run.rank*.trace.json \
 	  $(BUILD)/chaos-metrics/run.rank*.metrics.json || exit 1
+	@echo "== chaos-check: conductor kill + respawn + invariant oracle"
+	@rm -rf $(BUILD)/chaos-oracle
+	@python3 tools/acx_chaos.py run --np 3 --timeout 90 \
+	  --acxrun $(BUILD)/acxrun --out $(BUILD)/chaos-oracle/kill \
+	  --fault kill:rank=1:nth=7 \
+	  -- $(BUILD)/itests/chaos-conductor || exit 1
+	@echo "== chaos-check: conductor kill mid-stripe (2 lanes, big rounds)"
+	@ACX_STRIPES=2 ACX_CC_INTS=16384 \
+	  python3 tools/acx_chaos.py run --np 3 --timeout 90 \
+	  --acxrun $(BUILD)/acxrun --out $(BUILD)/chaos-oracle/stripe-kill \
+	  --fault kill:rank=1:nth=7 \
+	  -- $(BUILD)/itests/chaos-conductor || exit 1
+	@echo "== chaos-check: broken control (must fail, shrink, print replay)"
+	@python3 tools/acx_chaos.py run --np 3 --timeout 60 --expect-fail \
+	  --acxrun $(BUILD)/acxrun --out $(BUILD)/chaos-oracle/broken \
+	  --fault 'stall_link_ms:rank=0:nth=3:ms=20;drop_frame:rank=0:nth=500000' \
+	  -- $(BUILD)/itests/chaos-conductor || exit 1
+	@$(MAKE) --no-print-directory chaos-soak SEEDS=3 || exit 1
 	@echo "CHAOS CHECK PASSED"
+
+# --- seeded multi-fault soak (tentpole PR: chaos conductor) ---
+# N consecutive seeds from ACX_CHAOS_SEED_BASE (default 1000); each seed
+# deterministically expands (acxrun -print-chaos) into a multi-fault
+# schedule, runs the conductor under it, and is audited by the invariant
+# oracle — every scheduled fault must actually fire. A nightly rotation
+# just sets ACX_CHAOS_SEED_BASE=$(date +%j)000 or similar; any failure
+# prints a shrunken schedule and an exact replay command.
+.PHONY: chaos-soak
+SEEDS ?= 3
+chaos-soak: itest tools
+	@python3 tools/acx_chaos.py soak --np 3 --seeds $(SEEDS) \
+	  --faults 4 --mix issue,wire --timeout 90 \
+	  --acxrun $(BUILD)/acxrun --out $(BUILD)/chaos-soak \
+	  -- $(BUILD)/itests/chaos-conductor || exit 1
 
 # --- elastic fleet / membership plane end-to-end (DESIGN.md §12) ---
 # rolling-restart replaces every rank of the fleet one at a time under

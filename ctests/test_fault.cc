@@ -10,13 +10,16 @@
 
 #include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/wait.h>
 #include <unistd.h>
 
 #include <chrono>
+#include <csignal>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <memory>
+#include <string>
 #include <thread>
 
 #include "acx/fault.h"
@@ -326,6 +329,207 @@ void test_heartbeat_dead_peer() {
   std::printf("heartbeat_dead_peer: OK\n");
 }
 
+void test_parse_schedule() {
+  fault::Config cs[fault::kMaxSpecs];
+  int n = 0;
+  CHECK(fault::ParseSchedule("drop:rank=1;kill:rank=2:nth=5;delay:us=100",
+                             cs, fault::kMaxSpecs, &n));
+  CHECK(n == 3);
+  CHECK(cs[0].action == fault::Action::kDrop && cs[0].rank == 1);
+  CHECK(cs[1].action == fault::Action::kKill && cs[1].nth == 5);
+  CHECK(cs[2].action == fault::Action::kDelay && cs[2].delay_us == 100);
+
+  // Single spec is a 1-schedule; a trailing/empty segment is malformed.
+  CHECK(fault::ParseSchedule("drop", cs, fault::kMaxSpecs, &n) && n == 1);
+  CHECK(!fault::ParseSchedule("drop;;drop", cs, fault::kMaxSpecs, &n));
+  CHECK(!fault::ParseSchedule("drop;", cs, fault::kMaxSpecs, &n));
+  CHECK(!fault::ParseSchedule("", cs, fault::kMaxSpecs, &n));
+  CHECK(!fault::ParseSchedule("drop;explode", cs, fault::kMaxSpecs, &n));
+  // Over-cap schedules are refused outright, not truncated.
+  char big[512];
+  big[0] = '\0';
+  for (int i = 0; i < fault::kMaxSpecs + 1; i++)
+    strcat(big, i == 0 ? "drop" : ";drop");
+  CHECK(!fault::ParseSchedule(big, cs, fault::kMaxSpecs, &n));
+  std::printf("parse_schedule: OK\n");
+}
+
+void test_schedule_independent_windows() {
+  // Two specs on the SAME attempt stream keep independent matched
+  // counters: both advance every attempt, the first in-window spec fires.
+  fault::Config cs[2];
+  int n = 0;
+  CHECK(fault::ParseSchedule("drop:kind=send:nth=2;fail:kind=send:nth=4",
+                             cs, 2, &n) && n == 2);
+  fault::ConfigureSchedule(cs, n);
+  uint64_t us = 0;
+  int err = 0;
+  CHECK(fault::OnIssue(0, true, 1, &us, &err) == fault::Action::kNone);
+  CHECK(fault::OnIssue(0, true, 1, &us, &err) == fault::Action::kDrop);
+  CHECK(fault::OnIssue(0, true, 1, &us, &err) == fault::Action::kNone);
+  CHECK(fault::OnIssue(0, true, 1, &us, &err) == fault::Action::kFail);
+  CHECK(fault::ScheduleSize() == 2);
+  // Per-spec ledger: both specs matched all 4 attempts, each fired once.
+  CHECK(fault::SpecMatched(0) == 4 && fault::SpecFired(0) == 1);
+  CHECK(fault::SpecMatched(1) == 4 && fault::SpecFired(1) == 1);
+  CHECK(fault::SpecMatched(7) == 0 && fault::SpecFired(7) == 0);
+  RestorePolicy();
+  std::printf("schedule_independent_windows: OK\n");
+}
+
+void test_expand_chaos() {
+  char a[1024], b[1024];
+  // Deterministic: same (seed, np) -> byte-identical schedule, forever.
+  CHECK(fault::ExpandChaos("seed=42:faults=4:mix=issue,wire,kill", 3, a,
+                           sizeof a));
+  CHECK(fault::ExpandChaos("seed=42:faults=4:mix=issue,wire,kill", 3, b,
+                           sizeof b));
+  CHECK(strcmp(a, b) == 0);
+  // Different seed or np -> different schedule.
+  CHECK(fault::ExpandChaos("seed=43:faults=4:mix=issue,wire,kill", 3, b,
+                           sizeof b));
+  CHECK(strcmp(a, b) != 0);
+
+  // Every expansion parses back, has the asked-for spec count, at most one
+  // kill, and no two same-rank specs of the same match domain (issue-level
+  // vs wire-level) with overlapping [nth, nth+count) windows — an
+  // overlapped later spec could never fire (first in-window spec wins).
+  for (uint64_t seed = 1; seed <= 40; seed++) {
+    char spec[64], out[2048];
+    snprintf(spec, sizeof spec, "seed=%llu:faults=6:mix=issue,wire,kill",
+             (unsigned long long)seed);
+    CHECK(fault::ExpandChaos(spec, 3, out, sizeof out));
+    fault::Config cs[fault::kMaxSpecs];
+    int n = 0;
+    CHECK(fault::ParseSchedule(out, cs, fault::kMaxSpecs, &n));
+    CHECK(n == 6);
+    int kills = 0;
+    for (int i = 0; i < n; i++) {
+      if (cs[i].action == fault::Action::kKill) kills++;
+      const bool wi = cs[i].action >= fault::Action::kDropFrame &&
+                      cs[i].action <= fault::Action::kCloseLink;
+      for (int j = 0; j < i; j++) {
+        const bool wj = cs[j].action >= fault::Action::kDropFrame &&
+                        cs[j].action <= fault::Action::kCloseLink;
+        if (cs[i].rank != cs[j].rank || wi != wj) continue;
+        const bool overlap = cs[i].nth < cs[j].nth + cs[j].count &&
+                             cs[j].nth < cs[i].nth + cs[i].count;
+        CHECK(!overlap);
+      }
+    }
+    CHECK(kills <= 1);
+  }
+
+  // Malformed seed specs are refused, not guessed at.
+  CHECK(!fault::ExpandChaos("faults=3", 3, a, sizeof a));        // no seed
+  CHECK(!fault::ExpandChaos("seed=1:mix=zebra", 3, a, sizeof a));
+  CHECK(!fault::ExpandChaos("seed=1:faults=0", 3, a, sizeof a));
+  CHECK(!fault::ExpandChaos("seed=1:faults=17", 3, a, sizeof a));
+  CHECK(!fault::ExpandChaos("seed=1", 0, a, sizeof a));          // np < 1
+  CHECK(!fault::ExpandChaos("seed=1", 3, a, 4));                 // cap
+  std::printf("expand_chaos: OK\n");
+}
+
+void test_kill_action() {
+  // kill raises SIGKILL at the matching issue attempt — verify in a forked
+  // child so the test binary survives to report it.
+  const pid_t pid = fork();
+  CHECK(pid >= 0);
+  if (pid == 0) {
+    fault::Config c;
+    if (!fault::ParseSpec("kill:kind=send:nth=2", &c)) _exit(90);
+    fault::Configure(c);
+    uint64_t us = 0;
+    int err = 0;
+    if (fault::OnIssue(0, true, 1, &us, &err) != fault::Action::kNone)
+      _exit(91);           // attempt 1: window not yet reached
+    fault::OnIssue(0, true, 1, &us, &err);  // attempt 2: does not return
+    _exit(92);
+  }
+  int st = 0;
+  CHECK(waitpid(pid, &st, 0) == pid);
+  CHECK(WIFSIGNALED(st) && WTERMSIG(st) == SIGKILL);
+  RestorePolicy();
+  std::printf("kill_action: OK\n");
+}
+
+// Self-exec probes: the env-seeded schedule and policy parse exactly once
+// per process (function-local statics), so a FRESH process is the only
+// place their failure modes are observable.
+int SelfExecProbe(const char* self, const char* mode, const char* env_kv,
+                  std::string* err_out) {
+  int ep[2];
+  CHECK(pipe(ep) == 0);
+  const pid_t pid = fork();
+  CHECK(pid >= 0);
+  if (pid == 0) {
+    close(ep[0]);
+    dup2(ep[1], 2);
+    close(ep[1]);
+    char kv[256];
+    snprintf(kv, sizeof kv, "%s", env_kv);
+    putenv(kv);
+    execl(self, self, mode, (char*)nullptr);
+    _exit(127);
+  }
+  close(ep[1]);
+  if (err_out != nullptr) {
+    char buf[4096];
+    ssize_t n;
+    while ((n = read(ep[0], buf, sizeof buf)) > 0) err_out->append(buf, n);
+  }
+  close(ep[0]);
+  int st = 0;
+  CHECK(waitpid(pid, &st, 0) == pid);
+  return st;
+}
+
+void test_bad_env_aborts(const char* self) {
+  // S1: a malformed ACX_FAULT/ACX_CHAOS must abort LOUDLY at first use —
+  // running fault-free when the operator asked for faults would silently
+  // invalidate the whole experiment.
+  std::string err;
+  int st = SelfExecProbe(self, "--fault-probe", "ACX_FAULT=explode", &err);
+  CHECK(WIFSIGNALED(st) && WTERMSIG(st) == SIGABRT);
+  CHECK(err.find("ACX_FAULT") != std::string::npos);
+  CHECK(err.find("fatal") != std::string::npos);
+
+  err.clear();
+  st = SelfExecProbe(self, "--fault-probe", "ACX_CHAOS=seed=banana", &err);
+  CHECK(WIFSIGNALED(st) && WTERMSIG(st) == SIGABRT);
+  CHECK(err.find("ACX_CHAOS") != std::string::npos);
+
+  // A well-formed schedule in the same probe mode parses and arms.
+  err.clear();
+  st = SelfExecProbe(self, "--fault-probe",
+                     "ACX_FAULT=drop:rank=7;kill:rank=9", &err);
+  CHECK(WIFEXITED(st) && WEXITSTATUS(st) == 0);
+  std::printf("bad_env_aborts: OK\n");
+}
+
+void test_policy_env_refused(const char* self) {
+  // S2: malformed policy knobs are refused LOUDLY (stderr names the
+  // variable) and the default is kept — never half-applied.
+  std::string err;
+  int st = SelfExecProbe(self, "--policy-probe",
+                         "ACX_OP_TIMEOUT_MS=squid", &err);
+  CHECK(WIFEXITED(st) && WEXITSTATUS(st) == 0);
+  CHECK(err.find("ACX_OP_TIMEOUT_MS") != std::string::npos);
+
+  err.clear();
+  st = SelfExecProbe(self, "--policy-probe", "ACX_MAX_RETRIES=-3", &err);
+  CHECK(WIFEXITED(st) && WEXITSTATUS(st) == 0);
+  CHECK(err.find("ACX_MAX_RETRIES") != std::string::npos);
+
+  // A well-formed value IS applied (and quietly).
+  err.clear();
+  st = SelfExecProbe(self, "--policy-probe-good",
+                     "ACX_OP_TIMEOUT_MS=1500", &err);
+  CHECK(WIFEXITED(st) && WEXITSTATUS(st) == 0);
+  CHECK(err.find("ACX_OP_TIMEOUT_MS") == std::string::npos);
+  std::printf("policy_env_refused: OK\n");
+}
+
 void test_deadline_api() {
   double ms = -1;
   CHECK(MPIX_Set_deadline(1234.5) == 0);
@@ -345,10 +549,31 @@ void test_deadline_api() {
 
 }  // namespace
 
-int main() {
+int main(int argc, char** argv) {
+  if (argc > 1 && strcmp(argv[1], "--fault-probe") == 0) {
+    // Child mode for test_bad_env_aborts: force the env-seeded schedule
+    // parse. A bad ACX_FAULT/ACX_CHAOS aborts inside Enabled().
+    return fault::Enabled() || true ? 0 : 1;
+  }
+  if (argc > 1 && strcmp(argv[1], "--policy-probe") == 0) {
+    // Child mode for test_policy_env_refused: the malformed env value must
+    // be refused and the shipped default kept.
+    return Policy().timeout_ns.load() == 0 && Policy().max_retries.load() == 8
+               ? 0
+               : 1;
+  }
+  if (argc > 1 && strcmp(argv[1], "--policy-probe-good") == 0) {
+    return Policy().timeout_ns.load() == 1500ull * 1000000 ? 0 : 1;
+  }
   test_parse_spec();
   test_on_issue_window();
   test_on_frame_window();
+  test_parse_schedule();
+  test_schedule_independent_windows();
+  test_expand_chaos();
+  test_kill_action();
+  test_bad_env_aborts(argv[0]);
+  test_policy_env_refused(argv[0]);
   test_drop_retry_success();
   test_injected_fail();
   test_injected_delay();

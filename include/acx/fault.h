@@ -13,6 +13,9 @@
 //   * delay — the issue is postponed by `us` microseconds.
 //   * fail  — the op completes immediately with an error status (default
 //             kErrInjected) — the permanent-failure path.
+//   * kill  — the matching rank raises SIGKILL on itself mid-issue: abrupt
+//             death (no dump, no finalize, no graceful LEFT), the fault
+//             class the `acxrun -chaos` respawn supervisor exists for.
 //
 // Wire-level actions (consulted by the stream transport's OnFrame, not the
 // proxy's OnIssue — they hit sequenced frames about to enter the wire, so
@@ -38,10 +41,27 @@
 //   err=E    status error code (fail action)     (default kErrInjected)
 // Examples: ACX_FAULT=drop:rank=0:kind=send:nth=1
 //           ACX_FAULT=corrupt_frame:rank=1:nth=4:count=3
+//
+// Schedules (DESIGN.md §16): ACX_FAULT accepts up to kMaxSpecs specs
+// joined with ';'. Every spec carries its OWN matched-attempt counter, so
+// `nth=` stays a stable per-spec coordinate no matter how the other specs
+// interleave; when several specs' windows cover the same attempt, the
+// first armed spec in schedule order fires and the rest only count.
+//   ACX_FAULT='drop:rank=0:nth=2;stall_link_ms:rank=1:nth=5:ms=40;kill:rank=2:nth=9'
+//
+// Seeded schedules: ACX_CHAOS=seed=N[:faults=K][:mix=issue,wire,kill]
+// expands deterministically (splitmix64; same seed + same ACX_SIZE ==
+// same schedule, forever) into a K-spec schedule drawn from the named
+// classes — `issue` draws drop/delay (never fail: a seeded run must be
+// recoverable by construction), `wire` draws the four frame actions,
+// `kill` contributes at most ONE abrupt death per schedule. ACX_FAULT and
+// ACX_CHAOS compose additively. `acxrun -print-chaos SPEC` shows the
+// expansion; tools/acx_chaos.py replays and audits it.
 #pragma once
 
 #include <atomic>
 #include <chrono>
+#include <cstddef>
 #include <cstdint>
 
 namespace acx {
@@ -63,13 +83,20 @@ enum class Action : int32_t {
   kDrop = 1,
   kDelay = 2,
   kFail = 3,
-  // Wire-level (transport OnFrame); everything >= kDropFrame is a frame
-  // action and is invisible to OnIssue, and vice versa.
+  // Wire-level (transport OnFrame); a frame action is invisible to
+  // OnIssue, and vice versa.
   kDropFrame = 4,
   kCorruptFrame = 5,
   kStallLink = 6,
   kCloseLink = 7,
+  // Issue-level abrupt death (raises SIGKILL from inside OnIssue).
+  kKill = 8,
 };
+
+// Frame actions fire at OnFrame; everything else (incl. kKill) at OnIssue.
+inline bool IsFrameAction(Action a) {
+  return a >= Action::kDropFrame && a <= Action::kCloseLink;
+}
 
 struct Config {
   Action action = Action::kNone;
@@ -84,43 +111,88 @@ struct Config {
   int err = 0;     // 0 = kErrInjected
 };
 
-// True iff a fault spec is armed (ACX_FAULT at first use, or Configure()).
-// One relaxed load on the armed path; the proxy gates all fault work on it.
+// Hard cap on schedule length; ParseSchedule rejects longer schedules.
+constexpr int kMaxSpecs = 16;
+
+// True iff a fault spec is armed (ACX_FAULT/ACX_CHAOS at first use, or
+// Configure()). One relaxed load on the armed path; the proxy gates all
+// fault work on it.
 bool Enabled();
 
-// Parse an ACX_FAULT-style spec. Returns false (out untouched) on a
-// malformed spec.
+// Parse ONE ACX_FAULT-style spec (no ';'). Returns false (out untouched)
+// on a malformed spec.
 bool ParseSpec(const char* spec, Config* out);
 
-// Install a config programmatically (tests). Action::kNone disarms.
-// Resets the matched-attempt counter. Not safe against a concurrently
-// sweeping proxy — configure before ops are in flight.
+// Parse a ';'-separated schedule of up to `cap` specs into out[0..n).
+// Returns false (outputs untouched) if any segment is malformed, the
+// schedule is empty, or it exceeds cap.
+bool ParseSchedule(const char* spec, Config* out, int cap, int* n);
+
+// Render a Config back into canonical spec grammar (round-trips through
+// ParseSpec). Returns bytes written (excluding NUL), or -1 if cap is too
+// small.
+int FormatSpec(const Config& c, char* buf, size_t cap);
+
+// Spec-grammar name of an action ("drop", "kill", ...).
+const char* ActionName(Action a);
+
+// Expand an ACX_CHAOS seed spec ("seed=N[:faults=K][:mix=issue,wire,kill]")
+// into a ';'-joined schedule string for `np` ranks. Deterministic: the
+// same spec + np always yields the same schedule. Returns false on a
+// malformed spec or insufficient cap.
+bool ExpandChaos(const char* spec, int np, char* out, size_t cap);
+
+// Install a single-spec schedule programmatically (tests). Action::kNone
+// disarms. Resets all matched/fired counters. Not safe against a
+// concurrently sweeping proxy — configure before ops are in flight.
 void Configure(const Config& cfg);
 
-// Consult the plane for one issue attempt; counts matching attempts and
-// returns the armed action when this attempt falls in [nth, nth+count).
-// kDelay fills *delay_us; kFail fills *err.
+// Install an n-spec schedule programmatically. n == 0 disarms; n is
+// clamped to kMaxSpecs.
+void ConfigureSchedule(const Config* cfgs, int n);
+
+// Consult the plane for one issue attempt; every armed issue-level spec
+// counts its own matching attempts, and the first spec whose [nth,
+// nth+count) window covers this attempt fires. kDelay fills *delay_us;
+// kFail fills *err; kKill raises SIGKILL and does not return.
 Action OnIssue(int rank, bool is_send, int peer, uint64_t* delay_us,
                int* err);
 
 // Consult the plane for one sequenced frame about to be written on subflow
 // `subflow` of peer's link. Only frame actions (kDropFrame..kCloseLink)
-// ever fire here; issue actions return kNone without consuming a match. A
-// frame that fails the rank/peer/subflow filter does not consume a match
-// either. kStallLink fills *stall_us with the stall duration in
-// microseconds.
+// ever fire here; issue actions neither fire nor consume a match. A frame
+// that fails a spec's rank/peer/subflow filter does not advance that
+// spec's counter either. kStallLink fills *stall_us with the stall
+// duration in microseconds.
 Action OnFrame(int rank, int peer, int subflow, uint64_t* stall_us);
 
 struct Stats {
   uint64_t drops = 0;
   uint64_t delays = 0;
   uint64_t fails = 0;
+  uint64_t kills = 0;  // observable only by the raiser, pre-death
   uint64_t frame_drops = 0;
   uint64_t frame_corrupts = 0;
   uint64_t link_stalls = 0;
   uint64_t link_closes = 0;
 };
 Stats stats();
+
+// Number of armed specs (0 when disarmed).
+int ScheduleSize();
+
+// Per-spec accounting for the invariant oracle: how many filter-passing
+// attempts spec i has seen, and how many times it fired. Both 0 for an
+// out-of-range i.
+uint64_t SpecMatched(int i);
+uint64_t SpecFired(int i);
+
+// Write `<prefix>.rank<rank>.fault.json` — the per-spec fired/matched
+// ledger tools/acx_chaos.py audits ("a schedule that never fired is a
+// failure"). Gated on $ACX_FAULT_REPORT being set (the prefix); called
+// from MPIX_Finalize. Returns 0 on success, -1 on write failure, 1 when
+// disabled.
+int WriteReport(int rank);
 
 }  // namespace fault
 
@@ -129,7 +201,8 @@ Stats stats();
 // initial re-post backoff; ACX_MAX_RETRIES: re-post budget for an op whose
 // issue was lost; ACX_RECONNECT_MAX / ACX_RECONNECT_BACKOFF_MS: the stream
 // transport's link-reconnect ladder), mutable at runtime through
-// MPIX_Set_deadline.
+// MPIX_Set_deadline. Malformed values are refused LOUDLY (stderr, value
+// ignored, default kept) — same convention as ACX_TSERIES_INTERVAL_MS.
 struct RetryPolicy {
   std::atomic<uint64_t> timeout_ns{0};
   std::atomic<uint64_t> backoff_us{200};

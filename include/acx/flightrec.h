@@ -71,7 +71,8 @@ enum Kind : uint16_t {
   kTxNak,           // re-pull request written (seq = first missing seq)
   kRxData,          // in-order data frame delivered (seq = rx seq)
   kRxFrame,         // span-tagged frame fully received (span = sender op's
-                    //   span id; recorded on every plane, recovery or not)
+                    //   span id, aux = subflow lane; recorded on every
+                    //   plane, recovery or not)
   kRxSeqAck,        // peer's cumulative ack arrived (seq = acked tx seq)
   kRxNak,           // peer requested replay (seq = first seq to resend)
   kLinkRecovering,  // peer entered the reconnect ladder

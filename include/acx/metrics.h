@@ -51,6 +51,7 @@ enum Counter : int {
   kRetries,            // re-posts of ops whose issue was lost
   kTimeouts,           // ops failed by deadline / retry exhaustion
   kFaultsInjected,     // ACX_FAULT hits (drop + delay + fail)
+  kFaultsWire,         // ACX_FAULT wire hits (frame drop/corrupt/stall/close)
   kHbSent,             // heartbeats sent
   kHbRecv,             // heartbeats received
   kHbMisses,           // in-flight ops failed by dead-peer teardown

@@ -1,0 +1,432 @@
+/* tpu-acx integration test: chaos soak under a seeded multi-fault schedule
+ * (DESIGN.md §16 — the chaos-conductor capstone).
+ *
+ * Serving-shaped traffic — a byte-verified neighbor ring leg plus a
+ * partitioned (Psend/Precv) leg per round, fenced all-to-all — runs for
+ * ACX_CC_ROUNDS rounds while a fault schedule (ACX_FAULT / ACX_CHAOS,
+ * armed by the harness) drops, delays, corrupts and stalls underneath it.
+ * Recoverable wire faults must be absorbed invisibly by the CRC/NAK/replay
+ * machinery: every payload integer is checked against a closed-form
+ * formula, so a single duplicated or lost delivery fails the run.
+ *
+ * The `kill` action is the one fault the transport cannot hide: the victim
+ * rank dies by SIGKILL mid-round (no dump, no goodbye — SIGKILL is
+ * uncatchable) and `acxrun -chaos` respawns it with ACX_JOIN=1. This
+ * workload supplies the application half of that story, the heal protocol:
+ *   - any op error sends a survivor into heal: dump flight state once
+ *     (evidence for tools/acx_doctor.py), MPIX_Drain parked ops, identify
+ *     the victim by probing for the joiner's hello (only a respawned
+ *     incarnation ever sends tag 900 — a DEAD slot in the fleet view
+ *     cannot be trusted here, the victim may have already rejoined by the
+ *     time a survivor unwedges from an abandoned round), and report the
+ *     round it died in to the coordinator (the lowest-ranked survivor);
+ *   - the coordinator takes the MINIMUM failing round across survivors
+ *     (ranks can be one round apart when the kill lands inside a fence)
+ *     and, once the joiner's hello lands, tells the joiner and every
+ *     survivor where to resume;
+ *   - the respawned incarnation (ACX_JOIN=1 in env) joins the fleet,
+ *     hellos every survivor, receives the resume round, and the FULL
+ *     fleet re-runs from there. Payloads are closed-form in (rank, round,
+ *     i), so replayed rounds reproduce byte-identical traffic and
+ *     duplicate deliveries of a redone round are detected, not absorbed.
+ *
+ * Run under `acxrun -np N -transport socket -chaos`. Fault-free it is a
+ * plain soak and passes on any plane; the heal path needs the socket
+ * plane's rendezvous listeners (ACX_JOB_ID) to readmit the joiner.
+ *
+ * Knobs: ACX_CC_ROUNDS (default 10), ACX_CC_INTS (ring payload ints,
+ * default 1024), ACX_CC_JOIN_WAIT_MS (heal wait for the joiner's hello,
+ * default 30000).
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <mpi.h>
+#include <mpi-acx.h>
+
+#define MAX_RANKS 16
+#define MAX_INTS 65536
+#define PARTS 8
+#define PART_INTS 32
+
+static int g_rank, g_size, g_rounds, g_ints;
+static uint64_t g_join_wait_ms;
+static int g_dumped; /* MPIX_Dump_state once per process */
+
+static int expect(int rank, int round, int i) {
+    return rank * 1000003 + round * 8191 + i * 7 + 1;
+}
+
+static uint64_t now_ms(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1000u + (uint64_t)(ts.tv_nsec / 1000000);
+}
+
+static int env_int(const char *name, int dflt) {
+    const char *s = getenv(name);
+    return s != NULL && atoi(s) > 0 ? atoi(s) : dflt;
+}
+
+/* ---- traffic legs -------------------------------------------------- */
+
+/* Neighbor ring, ACX_CC_INTS ints, byte-verified. Returns 0 ok, -1 on an
+ * op error (heal), >0 on a verify miss (hard failure: the transport
+ * delivered wrong bytes — nothing to heal). */
+static int ring_leg(int round) {
+    static int sbuf[MAX_INTS], rbuf[MAX_INTS];
+    const int right = (g_rank + 1) % g_size;
+    const int left = (g_rank + g_size - 1) % g_size;
+    for (int i = 0; i < g_ints; i++) {
+        sbuf[i] = expect(g_rank, round, i);
+        rbuf[i] = -1;
+    }
+    cudaStream_t stream = 0;
+    MPIX_Request req[2];
+    MPI_Status st[2];
+    MPIX_Isend_enqueue(sbuf, g_ints, MPI_INT, right, 100 + round,
+                       MPI_COMM_WORLD, &req[0], MPIX_QUEUE_XLA_STREAM,
+                       &stream);
+    MPIX_Irecv_enqueue(rbuf, g_ints, MPI_INT, left, 100 + round,
+                       MPI_COMM_WORLD, &req[1], MPIX_QUEUE_XLA_STREAM,
+                       &stream);
+    MPIX_Wait(&req[0], &st[0]);
+    MPIX_Wait(&req[1], &st[1]);
+    if (st[0].MPI_ERROR != MPI_SUCCESS || st[1].MPI_ERROR != MPI_SUCCESS)
+        return -1;
+    for (int i = 0; i < g_ints; i++) {
+        if (rbuf[i] != expect(left, round, i)) {
+            printf("[%d] round %d: ring rbuf[%d] = %d, want %d\n", g_rank,
+                   round, i, rbuf[i], expect(left, round, i));
+            return 1;
+        }
+    }
+    return 0;
+}
+
+/* Partitioned leg: PARTS x PART_INTS ints to the right neighbor, Pready
+ * out of order, arrival polled with a bound (a dead peer never flips the
+ * arrived flag — the bounded poll falls through to Waitall, which reports
+ * the teardown error and routes us into heal). Same return contract as
+ * ring_leg. */
+static int partitioned_leg(int round) {
+    static int sbuf[PARTS * PART_INTS], rbuf[PARTS * PART_INTS];
+    const int right = (g_rank + 1) % g_size;
+    const int left = (g_rank + g_size - 1) % g_size;
+    for (int i = 0; i < PARTS * PART_INTS; i++) {
+        sbuf[i] = expect(g_rank, round, 500000 + i);
+        rbuf[i] = -1;
+    }
+    MPIX_Request req[2];
+    MPI_Status st[2];
+    MPIX_Prequest psend, precv;
+    if (MPIX_Psend_init(sbuf, PARTS, PART_INTS, MPI_INT, right, 500 + round,
+                        MPI_COMM_WORLD, MPI_INFO_NULL, &req[0]) ||
+        MPIX_Precv_init(rbuf, PARTS, PART_INTS, MPI_INT, left, 500 + round,
+                        MPI_COMM_WORLD, MPI_INFO_NULL, &req[1]))
+        return 1;
+    MPIX_Prequest_create(req[0], &psend);
+    MPIX_Prequest_create(req[1], &precv);
+    MPIX_Startall(2, req);
+    for (int p = PARTS - 1; p >= 0; p--) MPIX_Pready(p, psend);
+    const uint64_t poll_deadline = now_ms() + 8000;
+    for (int p = 0; p < PARTS; p++) {
+        int flag = 0;
+        while (!flag && now_ms() < poll_deadline) {
+            MPIX_Parrived(precv, p, &flag);
+            if (!flag) usleep(200);
+        }
+        if (!flag) break; /* peer likely dead: let Waitall name the error */
+    }
+    MPIX_Waitall(2, req, st);
+    MPIX_Prequest_free(&psend);
+    MPIX_Prequest_free(&precv);
+    MPIX_Request_free(&req[0]);
+    MPIX_Request_free(&req[1]);
+    if (st[0].MPI_ERROR != MPI_SUCCESS || st[1].MPI_ERROR != MPI_SUCCESS)
+        return -1;
+    for (int i = 0; i < PARTS * PART_INTS; i++) {
+        if (rbuf[i] != expect(left, round, 500000 + i)) {
+            printf("[%d] round %d: part rbuf[%d] = %d, want %d\n", g_rank,
+                   round, i, rbuf[i], expect(left, round, 500000 + i));
+            return 1;
+        }
+    }
+    return 0;
+}
+
+/* All-to-all token fence closing each round: bounds cross-rank round skew
+ * to one and guarantees every survivor of a mid-round kill observes the
+ * death within that round (the victim's missing token fails the fence
+ * even on ranks that are not the victim's ring neighbors). Returns 0 ok,
+ * -1 on op error, >0 on token mismatch. */
+static int fence_leg(int round) {
+    cudaStream_t stream = 0;
+    static int token;
+    token = round;
+    MPIX_Request req[2 * MAX_RANKS];
+    int rbuf[MAX_RANKS];
+    int n = 0;
+    for (int r = 0; r < g_size; r++) {
+        if (r == g_rank) continue;
+        MPIX_Isend_enqueue(&token, 1, MPI_INT, r, 700 + round,
+                           MPI_COMM_WORLD, &req[n++], MPIX_QUEUE_XLA_STREAM,
+                           &stream);
+        rbuf[r] = -1;
+        MPIX_Irecv_enqueue(&rbuf[r], 1, MPI_INT, r, 700 + round,
+                           MPI_COMM_WORLD, &req[n++], MPIX_QUEUE_XLA_STREAM,
+                           &stream);
+    }
+    int bad = 0;
+    for (int i = 0; i < n; i++) {
+        MPI_Status st;
+        MPIX_Wait(&req[i], &st);
+        if (st.MPI_ERROR != MPI_SUCCESS) bad = 1;
+    }
+    if (bad) return -1;
+    for (int r = 0; r < g_size; r++) {
+        if (r != g_rank && rbuf[r] != round) {
+            printf("[%d] round %d: fence token from %d = %d\n", g_rank,
+                   round, r, rbuf[r]);
+            return 1;
+        }
+    }
+    return 0;
+}
+
+/* ---- heal protocol -------------------------------------------------- */
+
+/* Retrying one-int send: the heal window overlaps the victim's LEFT/DEAD
+ * latch, so a post can complete immediately with PEER_DEAD until the
+ * joiner is adopted. Bounded by `deadline` (absolute ms). */
+static int send_retry(int *val, int peer, int tag, uint64_t deadline) {
+    cudaStream_t stream = 0;
+    for (;;) {
+        MPIX_Request req;
+        MPI_Status st;
+        MPIX_Isend_enqueue(val, 1, MPI_INT, peer, tag, MPI_COMM_WORLD, &req,
+                           MPIX_QUEUE_XLA_STREAM, &stream);
+        MPIX_Wait(&req, &st);
+        if (st.MPI_ERROR == MPI_SUCCESS) return 0;
+        if (now_ms() >= deadline) return -1;
+        usleep(5000);
+    }
+}
+
+static int recv_retry(int *val, int peer, int tag, uint64_t deadline) {
+    cudaStream_t stream = 0;
+    for (;;) {
+        const uint64_t left_ms =
+            deadline > now_ms() ? deadline - now_ms() : 1;
+        MPIX_Set_deadline((double)left_ms);
+        MPIX_Request req;
+        MPI_Status st;
+        MPIX_Irecv_enqueue(val, 1, MPI_INT, peer, tag, MPI_COMM_WORLD, &req,
+                           MPIX_QUEUE_XLA_STREAM, &stream);
+        MPIX_Wait(&req, &st);
+        MPIX_Set_deadline(8000); /* restore the failsafe */
+        if (st.MPI_ERROR == MPI_SUCCESS) return 0;
+        if (now_ms() >= deadline) return -1;
+        usleep(5000);
+    }
+}
+
+/* One short-deadline recv, no retry: the victim-discovery probe. A probe
+ * against a live peer times out in `ms`; one against the joiner's slot
+ * consumes the buffered hello and succeeds. */
+static int probe_recv(int *val, int peer, int tag, uint64_t ms) {
+    cudaStream_t stream = 0;
+    MPIX_Set_deadline((double)ms);
+    MPIX_Request req;
+    MPI_Status st;
+    MPIX_Irecv_enqueue(val, 1, MPI_INT, peer, tag, MPI_COMM_WORLD, &req,
+                       MPIX_QUEUE_XLA_STREAM, &stream);
+    MPIX_Wait(&req, &st);
+    MPIX_Set_deadline(8000);
+    return st.MPI_ERROR == MPI_SUCCESS ? 0 : -1;
+}
+
+/* Survivor heal: returns the resume round (>= 0) or -1 on failure. */
+static int heal(int failed_round) {
+    if (!g_dumped) {
+        g_dumped = 1;
+        MPIX_Dump_state(); /* evidence for acx_doctor before the wait */
+    }
+    MPIX_Drain(500); /* cancel parked ops so the retry lanes are clean */
+    /* Victim discovery doubles as the adoption wait: the respawned
+     * incarnation hellos every survivor (tag 900) right after its JOIN
+     * lands, and nothing else ever sends that tag — so probing each peer
+     * in turn both names the victim and proves our transport adopted the
+     * joiner (its frame can only arrive over the link installed when the
+     * JOIN dial was accepted). The fleet view is NOT consulted: a
+     * survivor that unwedges late can enter heal after the DEAD->ACTIVE
+     * rejoin transition already erased the verdict. */
+    const uint64_t deadline = now_ms() + g_join_wait_ms;
+    int victim = -1;
+    while (victim < 0) {
+        for (int r = 0; r < g_size && victim < 0; r++) {
+            if (r == g_rank) continue;
+            int token = -1;
+            if (probe_recv(&token, r, 900, 400) == 0) victim = r;
+        }
+        if (victim < 0 && now_ms() >= deadline) {
+            printf("[%d] heal: no joiner hello within %llums\n", g_rank,
+                   (unsigned long long)g_join_wait_ms);
+            fflush(stdout);
+            MPIX_Dump_state();
+            return -1;
+        }
+    }
+    int coord = -1;
+    for (int r = 0; r < g_size; r++)
+        if (r != victim) { coord = r; break; }
+    printf("[%d] heal: victim=%d coord=%d failed_round=%d\n", g_rank,
+           victim, coord, failed_round);
+    fflush(stdout);
+    int resume = failed_round;
+    if (g_rank == coord) {
+        /* Min failing round across survivors: a rank that passed the
+         * fence the victim's tokens squeaked through can be one round
+         * ahead of its peers. */
+        for (int r = 0; r < g_size; r++) {
+            if (r == victim || r == coord) continue;
+            int fr = -1;
+            if (recv_retry(&fr, r, 930, deadline) != 0) return -1;
+            if (fr >= 0 && fr < resume) resume = fr;
+        }
+    } else {
+        if (send_retry(&failed_round, coord, 930, deadline) != 0) return -1;
+    }
+    if (g_rank == coord) {
+        if (send_retry(&resume, victim, 901, deadline) != 0) return -1;
+        for (int r = 0; r < g_size; r++) {
+            if (r == victim || r == coord) continue;
+            if (send_retry(&resume, r, 902, deadline) != 0) return -1;
+        }
+    } else {
+        if (recv_retry(&resume, coord, 902, deadline) != 0) return -1;
+    }
+    printf("[%d] heal: resuming at round %d (epoch %llu)\n", g_rank, resume,
+           (unsigned long long)MPIX_Fleet_epoch());
+    fflush(stdout);
+    return resume;
+}
+
+/* Joiner-side heal entry: hello every survivor, learn where to resume. */
+static int join_resume(void) {
+    const uint64_t deadline = now_ms() + g_join_wait_ms;
+    int coord = -1;
+    for (int r = 0; r < g_size; r++)
+        if (r != g_rank) { coord = r; break; }
+    static int token;
+    token = g_rank;
+    for (int r = 0; r < g_size; r++) {
+        if (r == g_rank) continue;
+        if (send_retry(&token, r, 900, deadline) != 0) return -1;
+    }
+    int resume = -1;
+    if (recv_retry(&resume, coord, 901, deadline) != 0) return -1;
+    printf("[%d] join: resuming at round %d (epoch %llu)\n", g_rank, resume,
+           (unsigned long long)MPIX_Fleet_epoch());
+    fflush(stdout);
+    return resume;
+}
+
+int main(int argc, char **argv) {
+    /* Snappy failure detection: the kill leg budgets ~2s for the death
+     * latch, not the 30s defaults. overwrite=0 so a harness can repin. */
+    setenv("ACX_HEARTBEAT_MS", "25", 0);
+    setenv("ACX_PEER_TIMEOUT_MS", "2000", 0);
+    setenv("ACX_PEER_GRACE_MS", "2000", 0);
+
+    const int joiner = getenv("ACX_JOIN") != NULL &&
+                       atoi(getenv("ACX_JOIN")) != 0;
+    g_rounds = env_int("ACX_CC_ROUNDS", 10);
+    g_ints = env_int("ACX_CC_INTS", 1024);
+    if (g_ints > MAX_INTS) g_ints = MAX_INTS;
+    g_join_wait_ms = (uint64_t)env_int("ACX_CC_JOIN_WAIT_MS", 30000);
+
+    int provided;
+    MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided);
+    if (provided < MPI_THREAD_MULTIPLE) MPI_Abort(MPI_COMM_WORLD, 1);
+    MPI_Comm_rank(MPI_COMM_WORLD, &g_rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &g_size);
+    if (g_size < 2 || g_size > MAX_RANKS) {
+        printf("chaos-conductor: needs 2..%d ranks\n", MAX_RANKS);
+        MPI_Abort(MPI_COMM_WORLD, 1);
+    }
+    if (MPIX_Init()) MPI_Abort(MPI_COMM_WORLD, 2);
+    /* Leg failsafe: generous against recoverable faults (a reconnect
+     * ladder runs ~2s) but short enough that a survivor whose live peer
+     * abandoned the round unwedges while the joiner is still waiting. */
+    MPIX_Set_deadline(8000);
+
+    const uint64_t epoch0 = MPIX_Fleet_epoch();
+    int round = 0;
+    if (joiner) {
+        round = join_resume();
+        if (round < 0) {
+            fflush(stdout);
+            _exit(7);
+        }
+    }
+
+    int errs = 0;
+    while (round < g_rounds) {
+        int rc = ring_leg(round);
+        if (rc == 0) rc = partitioned_leg(round);
+        if (rc == 0) rc = fence_leg(round);
+        if (rc > 0) { /* wrong bytes delivered: nothing to heal */
+            errs = 1;
+            break;
+        }
+        if (rc < 0) {
+            const int resume = heal(round);
+            if (resume < 0) {
+                fflush(stdout);
+                _exit(7);
+            }
+            round = resume;
+            continue;
+        }
+        round++;
+    }
+
+    /* Completion barrier, best-effort: a clean rank must NOT exit while a
+     * peer still needs its last round's frames. Exit closes the links, and
+     * a straggler whose final fence recv loses the race sees EOF -> phantom
+     * death -> a full joiner wait for a joiner that never comes. Tokens to
+     * dead/absent peers are abandoned at the deadline (that side already
+     * chose its own exit). */
+    if (errs == 0) {
+        const uint64_t dl = now_ms() + 5000;
+        static int done_tok;
+        done_tok = g_rounds;
+        for (int r = 0; r < g_size; r++)
+            if (r != g_rank) send_retry(&done_tok, r, 799, dl);
+        for (int r = 0; r < g_size; r++) {
+            int v = 0;
+            if (r != g_rank) recv_retry(&v, r, 799, dl);
+        }
+    }
+
+    /* A healed run must show the membership churn: one death + one join
+     * is two epoch bumps minimum over the incarnation's starting point. */
+    if (errs == 0 && g_dumped && MPIX_Fleet_epoch() < epoch0 + 2) {
+        printf("[%d] epoch %llu did not climb past %llu after heal\n",
+               g_rank, (unsigned long long)MPIX_Fleet_epoch(),
+               (unsigned long long)epoch0);
+        errs = 1;
+    }
+
+    MPIX_Finalize(); /* local teardown; no barrier — the fleet is a mix of
+                        original and respawned incarnations */
+    if (g_rank == 0 && errs == 0) printf("chaos-conductor: OK\n");
+    fflush(stdout);
+    fflush(stderr);
+    _exit(errs != 0);
+}

@@ -40,6 +40,8 @@ void RefreshRuntimeMetrics() {
   }
   const fault::Stats f = fault::stats();
   metrics::Set(metrics::kFaultsInjected, f.drops + f.delays + f.fails);
+  metrics::Set(metrics::kFaultsWire, f.frame_drops + f.frame_corrupts +
+                                         f.link_stalls + f.link_closes);
   if (g.transport != nullptr) {
     const NetStats n = g.transport->net_stats();
     metrics::Set(metrics::kHbSent, n.hb_sent);

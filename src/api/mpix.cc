@@ -25,6 +25,7 @@
 #include <cstring>
 
 #include "acx/api_internal.h"
+#include "acx/fault.h"
 #include "acx/span.h"
 #include "acx/debug.h"
 #include "acx/flightrec.h"
@@ -310,12 +311,20 @@ int HostWaitPartitioned(MpixRequest* req, MPI_Status* status) {
     CopyStatus(Status{}, status);
     return MPI_SUCCESS;
   }
+  Status part_err{};
   for (int p = 0; p < req->partitions; p++) {
     SpinUntil(g.table, g.proxy, req->part_idx[p], kCompleted);
+    // A partition the proxy failed (arrival deadline, drain) carries its
+    // error in the slot status; the transport round below may still close
+    // cleanly, so the slot error must win or the caller sees silent
+    // short/stale bytes.
+    const Status& ps = g.table->op(req->part_idx[p]).status;
+    if (ps.error != 0 && part_err.error == 0) part_err = ps;
     g.table->Store(req->part_idx[p], kReserved);
   }
   Status st;
   req->chan->FinishRound(&st);
+  if (st.error == 0 && part_err.error != 0) st = part_err;
   CopyStatus(st, status);
   req->started = false;
   return MPI_SUCCESS;
@@ -395,6 +404,10 @@ extern "C" {
 int MPIX_Init(void) {
   ApiState& g = GS();
   if (g.mpix_inited) return kErr;
+  // Arm (and validate) any env fault schedule BEFORE the transport dials:
+  // a typo'd ACX_FAULT/ACX_CHAOS must abort the rank at init, not be
+  // discovered (or worse, silently skipped) mid-run.
+  (void)fault::Enabled();
   EnsureTransport();
   // Table size from env; both the tpu-acx and the reference spelling work
   // (reference MPIACX_NFLAGS, init.cpp:205-216; default 4096,
@@ -461,6 +474,9 @@ int MPIX_Finalize(void) {
     RefreshRuntimeMetrics();
     metrics::FlushAtFinalize(g.transport->rank());
   }
+  // Per-spec fault ledger (ACX_FAULT_REPORT): the chaos oracle's proof
+  // that every scheduled fault actually fired (DESIGN.md §16).
+  fault::WriteReport(g.transport->rank());
   // Final tseries sample: guarantees the series tail (and, with the init
   // baseline, >= 2 samples) even for runs shorter than one interval. The
   // transport outlives finalize, so the link section stays valid.
@@ -566,6 +582,13 @@ int MPIX_Start(MPIX_Request* request) {
       Op& op = g.table->op(req->part_idx[p]);
       op.watch_since_ns = 0;
       op.watch_stage = 0;
+      // Arm a FRESH arrival deadline per round. Partition slots are reused
+      // across rounds without Reset, so a stale deadline from round k would
+      // instantly fail round k+1 — and with no deadline at all an abandoned
+      // round (sender died, or healed past it) pins the waiter forever.
+      const uint64_t t = Policy().timeout_ns.load(std::memory_order_relaxed);
+      op.deadline_ns = t != 0 ? NowNs() + t : 0;
+      op.status = Status{};
       g.table->Store(req->part_idx[p], kIssued);
     }
     g.proxy->Kick();

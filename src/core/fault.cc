@@ -2,13 +2,51 @@
 
 #include "acx/fault.h"
 
+#include <unistd.h>
+
+#include <cerrno>
+#include <csignal>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <string>
 
 #include "acx/state.h"
 
 namespace acx {
+
+namespace {
+
+// Strict unsigned parse: whole string, base 10, no sign. The lenient
+// atof/strtoull parses these knobs used before PR 11 turned "ACX_MAX_
+// RETRIES=8x" into 8 and "abc" into 0 — a typo'd chaos leg would then run
+// with a policy nobody asked for. Same convention as tseries.cc.
+bool StrictU64(const char* s, uint64_t* out) {
+  if (s == nullptr || *s == '\0' || strchr(s, '-') != nullptr) return false;
+  char* end = nullptr;
+  errno = 0;
+  const unsigned long long v = strtoull(s, &end, 10);
+  if (errno != 0 || end == s || *end != '\0') return false;
+  *out = static_cast<uint64_t>(v);
+  return true;
+}
+
+// Strict non-negative decimal (ACX_OP_TIMEOUT_MS accepts fractions).
+bool StrictMs(const char* s, double* out) {
+  if (s == nullptr || *s == '\0' || strchr(s, '-') != nullptr) return false;
+  char* end = nullptr;
+  const double v = strtod(s, &end);
+  if (end == s || *end != '\0' || !(v >= 0)) return false;
+  *out = v;
+  return true;
+}
+
+void RefuseEnv(const char* name, const char* val, const char* why) {
+  std::fprintf(stderr, "tpu-acx: %s=\"%s\" invalid (%s); keeping default\n",
+               name, val, why);
+}
+
+}  // namespace
 
 RetryPolicy& Policy() {
   // Leaked on purpose (process-lifetime; atexit-ordering-proof, same
@@ -16,22 +54,39 @@ RetryPolicy& Policy() {
   static RetryPolicy* p = [] {
     auto* pp = new RetryPolicy();
     if (const char* e = getenv("ACX_OP_TIMEOUT_MS")) {
-      const double ms = atof(e);
-      if (ms > 0) pp->timeout_ns.store(static_cast<uint64_t>(ms * 1e6));
+      double ms = 0;
+      if (!StrictMs(e, &ms))
+        RefuseEnv("ACX_OP_TIMEOUT_MS", e, "want a non-negative number");
+      else if (ms > 0)
+        pp->timeout_ns.store(static_cast<uint64_t>(ms * 1e6));
     }
     if (const char* e = getenv("ACX_RETRY_BACKOFF_US")) {
-      const unsigned long long us = strtoull(e, nullptr, 10);
-      if (us > 0) pp->backoff_us.store(us);
+      uint64_t us = 0;
+      if (!StrictU64(e, &us) || us == 0)
+        RefuseEnv("ACX_RETRY_BACKOFF_US", e, "want an integer >= 1");
+      else
+        pp->backoff_us.store(us);
     }
-    if (const char* e = getenv("ACX_MAX_RETRIES"))
-      pp->max_retries.store(static_cast<uint32_t>(atoi(e)));
+    if (const char* e = getenv("ACX_MAX_RETRIES")) {
+      uint64_t v = 0;
+      if (!StrictU64(e, &v) || v > 1000000000ull)
+        RefuseEnv("ACX_MAX_RETRIES", e, "want an integer 0..1e9");
+      else
+        pp->max_retries.store(static_cast<uint32_t>(v));
+    }
     if (const char* e = getenv("ACX_RECONNECT_MAX")) {
-      const int v = atoi(e);
-      if (v >= 0) pp->reconnect_max.store(static_cast<uint32_t>(v));
+      uint64_t v = 0;
+      if (!StrictU64(e, &v) || v > 1000000000ull)
+        RefuseEnv("ACX_RECONNECT_MAX", e, "want an integer 0..1e9");
+      else
+        pp->reconnect_max.store(static_cast<uint32_t>(v));
     }
     if (const char* e = getenv("ACX_RECONNECT_BACKOFF_MS")) {
-      const unsigned long long ms = strtoull(e, nullptr, 10);
-      if (ms > 0) pp->reconnect_backoff_ms.store(ms);
+      uint64_t ms = 0;
+      if (!StrictU64(e, &ms) || ms == 0)
+        RefuseEnv("ACX_RECONNECT_BACKOFF_MS", e, "want an integer >= 1");
+      else
+        pp->reconnect_backoff_ms.store(ms);
     }
     return pp;
   }();
@@ -41,44 +96,131 @@ RetryPolicy& Policy() {
 namespace fault {
 namespace {
 
-struct State {
+// One schedule entry: the parsed spec plus ITS OWN trigger state. Per-spec
+// counters keep `nth=` a stable coordinate in a multi-spec schedule — spec
+// B's window cannot be burned by attempts only spec A matched.
+struct SpecState {
   Config cfg;
-  std::atomic<bool> enabled{false};
   std::atomic<uint64_t> matched{0};
+  std::atomic<uint64_t> fired{0};
+};
+
+struct State {
+  SpecState specs[kMaxSpecs];
+  std::atomic<int> nspecs{0};
+  std::atomic<bool> enabled{false};
   std::atomic<uint64_t> drops{0};
   std::atomic<uint64_t> delays{0};
   std::atomic<uint64_t> fails{0};
+  std::atomic<uint64_t> kills{0};
   std::atomic<uint64_t> frame_drops{0};
   std::atomic<uint64_t> frame_corrupts{0};
   std::atomic<uint64_t> link_stalls{0};
   std::atomic<uint64_t> link_closes{0};
 };
 
+void Install(State* st, const Config* cfgs, int n) {
+  if (n < 0) n = 0;
+  if (n > kMaxSpecs) n = kMaxSpecs;
+  bool any = false;
+  for (int i = 0; i < n; i++) {
+    st->specs[i].cfg = cfgs[i];
+    st->specs[i].matched.store(0, std::memory_order_relaxed);
+    st->specs[i].fired.store(0, std::memory_order_relaxed);
+    if (cfgs[i].action != Action::kNone) any = true;
+  }
+  st->nspecs.store(n, std::memory_order_relaxed);
+  st->enabled.store(any, std::memory_order_release);
+}
+
 State& S() {
   static State* s = [] {
     auto* st = new State();
+    Config cfgs[kMaxSpecs];
+    int n = 0;
     if (const char* e = getenv("ACX_FAULT")) {
-      Config c;
-      if (ParseSpec(e, &c)) {
-        st->cfg = c;
-        st->enabled.store(c.action != Action::kNone,
-                          std::memory_order_release);
-      } else {
-        std::fprintf(stderr, "tpu-acx: bad ACX_FAULT spec '%s' (ignored)\n",
+      // A typo'd spec must never let a CI chaos leg silently run
+      // fault-free: fail the rank the way `acxrun -fault` fails the
+      // launch (satellite of DESIGN.md §16).
+      if (!ParseSchedule(e, cfgs, kMaxSpecs, &n)) {
+        std::fprintf(stderr,
+                     "tpu-acx: bad ACX_FAULT spec '%s' (fatal: refusing to "
+                     "run fault-free)\n",
                      e);
+        std::fflush(stderr);
+        abort();
       }
     }
+    if (const char* e = getenv("ACX_CHAOS")) {
+      const char* np_s = getenv("ACX_SIZE");
+      const int np = np_s != nullptr && atoi(np_s) > 0 ? atoi(np_s) : 2;
+      char expanded[2048];
+      int m = 0;
+      if (!ExpandChaos(e, np, expanded, sizeof expanded) ||
+          !ParseSchedule(expanded, cfgs + n, kMaxSpecs - n, &m)) {
+        std::fprintf(stderr,
+                     "tpu-acx: bad ACX_CHAOS spec '%s' (fatal: refusing to "
+                     "run fault-free)\n",
+                     e);
+        std::fflush(stderr);
+        abort();
+      }
+      n += m;
+    }
+    Install(st, cfgs, n);
     return st;
   }();
   return *s;
+}
+
+bool PassesIssueFilters(const Config& c, int rank, bool is_send, int peer) {
+  if (c.action == Action::kNone || IsFrameAction(c.action)) return false;
+  if (c.rank >= 0 && rank != c.rank) return false;
+  if (c.kind == 1 && !is_send) return false;
+  if (c.kind == 2 && is_send) return false;
+  if (c.peer >= 0 && peer != c.peer) return false;
+  return true;
+}
+
+bool PassesFrameFilters(const Config& c, int rank, int peer, int subflow) {
+  if (!IsFrameAction(c.action)) return false;
+  if (c.rank >= 0 && rank != c.rank) return false;
+  if (c.peer >= 0 && peer != c.peer) return false;
+  // Subflow filter sits with rank/peer, BEFORE the matched counter: a
+  // `subflow=` spec counts only that lane's frames, so nth= stays a stable
+  // coordinate regardless of how the other lanes interleave.
+  if (c.subflow >= 0 && subflow != c.subflow) return false;
+  return true;
+}
+
+bool InWindow(const Config& c, uint64_t m) {
+  return m >= static_cast<uint64_t>(c.nth) &&
+         m < static_cast<uint64_t>(c.nth) + static_cast<uint64_t>(c.count);
 }
 
 }  // namespace
 
 bool Enabled() { return S().enabled.load(std::memory_order_acquire); }
 
+const char* ActionName(Action a) {
+  switch (a) {
+    case Action::kDrop: return "drop";
+    case Action::kDelay: return "delay";
+    case Action::kFail: return "fail";
+    case Action::kDropFrame: return "drop_frame";
+    case Action::kCorruptFrame: return "corrupt_frame";
+    case Action::kStallLink: return "stall_link_ms";
+    case Action::kCloseLink: return "close_link_once";
+    case Action::kKill: return "kill";
+    default: return "none";
+  }
+}
+
 bool ParseSpec(const char* spec, Config* out) {
   if (spec == nullptr || *spec == '\0') return false;
+  // ';' belongs to the schedule grammar (ParseSchedule); inside a single
+  // spec it can only be a typo half-swallowed by atoi.
+  if (strchr(spec, ';') != nullptr) return false;
   Config c;
   const char* p = spec;
   char tok[64];
@@ -101,6 +243,7 @@ bool ParseSpec(const char* spec, Config* out) {
   else if (strcmp(tok, "corrupt_frame") == 0) c.action = Action::kCorruptFrame;
   else if (strcmp(tok, "stall_link_ms") == 0) c.action = Action::kStallLink;
   else if (strcmp(tok, "close_link_once") == 0) c.action = Action::kCloseLink;
+  else if (strcmp(tok, "kill") == 0) c.action = Action::kKill;
   else if (strcmp(tok, "none") == 0) c.action = Action::kNone;
   else return false;
   while (*p != '\0') {
@@ -133,30 +276,241 @@ bool ParseSpec(const char* spec, Config* out) {
   return true;
 }
 
-void Configure(const Config& cfg) {
-  State& s = S();
-  s.cfg = cfg;
-  s.matched.store(0, std::memory_order_relaxed);
-  s.enabled.store(cfg.action != Action::kNone, std::memory_order_release);
+bool ParseSchedule(const char* spec, Config* out, int cap, int* n) {
+  if (spec == nullptr || *spec == '\0' || out == nullptr || n == nullptr)
+    return false;
+  Config parsed[kMaxSpecs];
+  int k = 0;
+  const char* p = spec;
+  while (*p != '\0') {
+    const char* semi = strchr(p, ';');
+    const size_t len = semi != nullptr ? static_cast<size_t>(semi - p)
+                                       : strlen(p);
+    char seg[256];
+    if (len == 0 || len >= sizeof seg) return false;
+    memcpy(seg, p, len);
+    seg[len] = '\0';
+    if (k >= cap || k >= kMaxSpecs) return false;
+    if (!ParseSpec(seg, &parsed[k])) return false;
+    k++;
+    p = semi != nullptr ? semi + 1 : p + len;
+    // A trailing ';' means a segment is MISSING (half a schedule survived
+    // shell quoting) — refuse rather than arm a truncated experiment.
+    if (semi != nullptr && *p == '\0') return false;
+  }
+  if (k == 0) return false;
+  for (int i = 0; i < k; i++) out[i] = parsed[i];
+  *n = k;
+  return true;
 }
+
+int FormatSpec(const Config& c, char* buf, size_t cap) {
+  size_t off = 0;
+  const auto puts_ = [&](const char* s) -> bool {
+    const size_t len = strlen(s);
+    if (off + len + 1 > cap) return false;
+    memcpy(buf + off, s, len + 1);
+    off += len;
+    return true;
+  };
+  const auto put = [&](const char* key, long long v) -> bool {
+    char kv[48];
+    snprintf(kv, sizeof kv, ":%s=%lld", key, v);
+    return puts_(kv);
+  };
+  if (!puts_(ActionName(c.action))) return -1;
+  if (c.rank >= 0 && !put("rank", c.rank)) return -1;
+  if (c.kind == 1 && !puts_(":kind=send")) return -1;
+  if (c.kind == 2 && !puts_(":kind=recv")) return -1;
+  if (c.peer >= 0 && !put("peer", c.peer)) return -1;
+  if (c.subflow >= 0 && !put("subflow", c.subflow)) return -1;
+  if (c.nth != 1 && !put("nth", c.nth)) return -1;
+  if (c.count != 1 && !put("count", c.count)) return -1;
+  if (c.action == Action::kDelay && c.delay_us != 1000 &&
+      !put("us", static_cast<long long>(c.delay_us)))
+    return -1;
+  if (c.action == Action::kStallLink && c.stall_ms != 10 &&
+      !put("ms", static_cast<long long>(c.stall_ms)))
+    return -1;
+  if (c.err != 0 && !put("err", c.err)) return -1;
+  return static_cast<int>(off);
+}
+
+bool ExpandChaos(const char* spec, int np, char* out, size_t cap) {
+  if (spec == nullptr || *spec == '\0' || out == nullptr || np < 1)
+    return false;
+  uint64_t seed = 0;
+  bool have_seed = false;
+  int faults = 3;
+  bool mix_issue = false, mix_wire = false, mix_kill = false, have_mix = false;
+  const char* p = spec;
+  char tok[96];
+  while (*p != '\0') {
+    size_t i = 0;
+    while (*p != '\0' && *p != ':') {
+      if (i + 1 >= sizeof tok) return false;
+      tok[i++] = *p++;
+    }
+    tok[i] = '\0';
+    if (*p == ':') p++;
+    if (i == 0) return false;
+    char* eq = strchr(tok, '=');
+    if (eq == nullptr) return false;
+    *eq = '\0';
+    const char* val = eq + 1;
+    if (strcmp(tok, "seed") == 0) {
+      if (!StrictU64(val, &seed)) return false;
+      have_seed = true;
+    } else if (strcmp(tok, "faults") == 0) {
+      uint64_t f = 0;
+      if (!StrictU64(val, &f) || f < 1 ||
+          f > static_cast<uint64_t>(kMaxSpecs))
+        return false;
+      faults = static_cast<int>(f);
+    } else if (strcmp(tok, "mix") == 0) {
+      have_mix = true;
+      const char* q = val;
+      while (*q != '\0') {
+        const char* comma = strchr(q, ',');
+        const size_t len =
+            comma != nullptr ? static_cast<size_t>(comma - q) : strlen(q);
+        if (len == 5 && strncmp(q, "issue", 5) == 0) mix_issue = true;
+        else if (len == 4 && strncmp(q, "wire", 4) == 0) mix_wire = true;
+        else if (len == 4 && strncmp(q, "kill", 4) == 0) mix_kill = true;
+        else return false;
+        q = comma != nullptr ? comma + 1 : q + len;
+      }
+    } else {
+      return false;
+    }
+  }
+  if (!have_seed) return false;
+  if (!have_mix) mix_issue = mix_wire = true;
+  if (!mix_issue && !mix_wire && !mix_kill) return false;
+
+  // splitmix64: fixed-width, overflow-defined, identical on every
+  // platform — the whole point is `acxrun -print-chaos` and every rank
+  // agreeing on the schedule forever.
+  uint64_t x = seed ^ 0x9e3779b97f4a7c15ull;
+  const auto rnd = [&x]() {
+    uint64_t z = (x += 0x9e3779b97f4a7c15ull);
+    z ^= z >> 30;
+    z *= 0xbf58476d1ce4e5b9ull;
+    z ^= z >> 27;
+    z *= 0x94d049bb133111ebull;
+    z ^= z >> 31;
+    return z;
+  };
+
+  int classes[3];
+  int ncls = 0;
+  if (mix_issue) classes[ncls++] = 0;
+  if (mix_wire) classes[ncls++] = 1;
+  if (mix_kill) classes[ncls++] = 2;
+  bool kill_used = false;
+  size_t off = 0;
+  // Trigger windows already handed out, per (rank, match domain). Two
+  // same-rank specs of the same domain (issue-level vs wire-level — each
+  // has its own matched counter) with overlapping [nth, nth+count) windows
+  // would SHADOW each other: the first in-window spec in schedule order
+  // wins every attempt, the later spec can never fire, and the oracle
+  // rightly calls a scheduled-but-impossible fault a failed experiment.
+  struct Win {
+    int rank, domain, lo, hi;
+  };
+  Win wins[kMaxSpecs];
+  int nwins = 0;
+  const auto overlaps = [&](int rank, int domain, int lo, int hi) {
+    for (int w = 0; w < nwins; w++)
+      if (wins[w].rank == rank && wins[w].domain == domain &&
+          lo < wins[w].hi && wins[w].lo < hi)
+        return true;
+    return false;
+  };
+  for (int i = 0; i < faults; i++) {
+    int cls = classes[i % ncls];
+    // At most ONE abrupt death per schedule: a second kill would race the
+    // first victim's respawn and make the run order-dependent.
+    if (cls == 2 && kill_used) cls = mix_wire ? 1 : (mix_issue ? 0 : 1);
+    Config c;
+    c.rank = static_cast<int>(rnd() % static_cast<uint64_t>(np));
+    c.nth = 2 + static_cast<int>(rnd() % 10);
+    c.count = 1 + static_cast<int>(rnd() % 2);
+    if (cls == 0) {
+      // Recoverable by construction: drop (retried) or delay (waited out)
+      // — never `fail`, which would make the seeded workload fail by
+      // design instead of surviving.
+      const uint64_t pick = rnd() % 3;
+      c.action = pick < 2 ? Action::kDrop : Action::kDelay;
+      if (c.action == Action::kDelay) c.delay_us = 500 + rnd() % 4500;
+    } else if (cls == 1) {
+      static const Action kWire[4] = {Action::kDropFrame,
+                                      Action::kCorruptFrame,
+                                      Action::kStallLink, Action::kCloseLink};
+      c.action = kWire[rnd() % 4];
+      if (c.action == Action::kStallLink) c.stall_ms = 10 + rnd() % 40;
+      if (c.action == Action::kCloseLink) c.count = 1;
+    } else {
+      c.action = Action::kKill;
+      c.count = 1;
+      c.nth = 4 + static_cast<int>(rnd() % 8);
+      kill_used = true;
+    }
+    // De-shadow: re-roll the window until it is disjoint from every prior
+    // same-(rank, domain) window; as a deterministic last resort place it
+    // right after the occupied region. All rolls come from the seeded
+    // stream, so the schedule stays identical for a given (seed, np).
+    {
+      const int domain = IsFrameAction(c.action) ? 1 : 0;
+      const int base = c.action == Action::kKill ? 4 : 2;
+      const int range = c.action == Action::kKill ? 8 : 10;
+      for (int t = 0; t < 16 && overlaps(c.rank, domain, c.nth,
+                                         c.nth + c.count); t++)
+        c.nth = base + static_cast<int>(rnd() % range);
+      if (overlaps(c.rank, domain, c.nth, c.nth + c.count)) {
+        int hi = base;
+        for (int w = 0; w < nwins; w++)
+          if (wins[w].rank == c.rank && wins[w].domain == domain &&
+              wins[w].hi > hi)
+            hi = wins[w].hi;
+        c.nth = hi;
+      }
+      if (nwins < kMaxSpecs)
+        wins[nwins++] = Win{c.rank, domain, c.nth, c.nth + c.count};
+    }
+    char sbuf[128];
+    if (FormatSpec(c, sbuf, sizeof sbuf) < 0) return false;
+    const size_t need = strlen(sbuf) + (i > 0 ? 1 : 0);
+    if (off + need + 1 > cap) return false;
+    if (i > 0) out[off++] = ';';
+    memcpy(out + off, sbuf, strlen(sbuf) + 1);
+    off += strlen(sbuf);
+  }
+  return true;
+}
+
+void Configure(const Config& cfg) { ConfigureSchedule(&cfg, 1); }
+
+void ConfigureSchedule(const Config* cfgs, int n) { Install(&S(), cfgs, n); }
 
 Action OnIssue(int rank, bool is_send, int peer, uint64_t* delay_us,
                int* err) {
   State& s = S();
-  const Config& c = s.cfg;
-  // Frame actions never fire (or consume a match) at the issue level; the
-  // shared matched counter stays consistent because exactly one action is
-  // armed at a time and the other consult site early-returns symmetrically.
-  if (c.action == Action::kNone || c.action >= Action::kDropFrame)
-    return Action::kNone;
-  if (c.rank >= 0 && rank != c.rank) return Action::kNone;
-  if (c.kind == 1 && !is_send) return Action::kNone;
-  if (c.kind == 2 && is_send) return Action::kNone;
-  if (c.peer >= 0 && peer != c.peer) return Action::kNone;
-  const uint64_t m = s.matched.fetch_add(1, std::memory_order_relaxed) + 1;
-  if (m < static_cast<uint64_t>(c.nth) ||
-      m >= static_cast<uint64_t>(c.nth) + static_cast<uint64_t>(c.count))
-    return Action::kNone;
+  const int n = s.nspecs.load(std::memory_order_relaxed);
+  int winner = -1;
+  for (int i = 0; i < n; i++) {
+    SpecState& sp = s.specs[i];
+    if (!PassesIssueFilters(sp.cfg, rank, is_send, peer)) continue;
+    const uint64_t m = sp.matched.fetch_add(1, std::memory_order_relaxed) + 1;
+    // Every matching spec counts this attempt (its nth= coordinate must
+    // advance even while another spec fires); the FIRST in-window spec in
+    // schedule order supplies the action.
+    if (winner < 0 && InWindow(sp.cfg, m)) winner = i;
+  }
+  if (winner < 0) return Action::kNone;
+  SpecState& sp = s.specs[winner];
+  const Config& c = sp.cfg;
+  sp.fired.fetch_add(1, std::memory_order_relaxed);
   switch (c.action) {
     case Action::kDrop:
       s.drops.fetch_add(1, std::memory_order_relaxed);
@@ -169,6 +523,17 @@ Action OnIssue(int rank, bool is_send, int peer, uint64_t* delay_us,
       s.fails.fetch_add(1, std::memory_order_relaxed);
       if (err != nullptr) *err = c.err != 0 ? c.err : kErrInjected;
       break;
+    case Action::kKill:
+      // Abrupt death, by design indistinguishable from the OOM-killer:
+      // no dump, no finalize, no graceful LEFT. The note below is the
+      // only trace (SIGKILL cannot be caught) — acxrun -chaos and the
+      // oracle key on the supervisor's SIGKILL observation, not on this.
+      s.kills.fetch_add(1, std::memory_order_relaxed);
+      std::fprintf(stderr, "tpu-acx[%d]: fault kill: raising SIGKILL\n",
+                   rank);
+      std::fflush(stderr);
+      raise(SIGKILL);
+      for (;;) pause();  // unreachable; SIGKILL cannot be handled
     default:
       break;
   }
@@ -177,18 +542,18 @@ Action OnIssue(int rank, bool is_send, int peer, uint64_t* delay_us,
 
 Action OnFrame(int rank, int peer, int subflow, uint64_t* stall_us) {
   State& s = S();
-  const Config& c = s.cfg;
-  if (c.action < Action::kDropFrame) return Action::kNone;
-  if (c.rank >= 0 && rank != c.rank) return Action::kNone;
-  if (c.peer >= 0 && peer != c.peer) return Action::kNone;
-  // Subflow filter sits with rank/peer, BEFORE the matched counter: a
-  // `subflow=` spec counts only that lane's frames, so nth= stays a stable
-  // coordinate regardless of how the other lanes interleave.
-  if (c.subflow >= 0 && subflow != c.subflow) return Action::kNone;
-  const uint64_t m = s.matched.fetch_add(1, std::memory_order_relaxed) + 1;
-  if (m < static_cast<uint64_t>(c.nth) ||
-      m >= static_cast<uint64_t>(c.nth) + static_cast<uint64_t>(c.count))
-    return Action::kNone;
+  const int n = s.nspecs.load(std::memory_order_relaxed);
+  int winner = -1;
+  for (int i = 0; i < n; i++) {
+    SpecState& sp = s.specs[i];
+    if (!PassesFrameFilters(sp.cfg, rank, peer, subflow)) continue;
+    const uint64_t m = sp.matched.fetch_add(1, std::memory_order_relaxed) + 1;
+    if (winner < 0 && InWindow(sp.cfg, m)) winner = i;
+  }
+  if (winner < 0) return Action::kNone;
+  SpecState& sp = s.specs[winner];
+  const Config& c = sp.cfg;
+  sp.fired.fetch_add(1, std::memory_order_relaxed);
   switch (c.action) {
     case Action::kDropFrame:
       s.frame_drops.fetch_add(1, std::memory_order_relaxed);
@@ -215,11 +580,70 @@ Stats stats() {
   out.drops = s.drops.load(std::memory_order_relaxed);
   out.delays = s.delays.load(std::memory_order_relaxed);
   out.fails = s.fails.load(std::memory_order_relaxed);
+  out.kills = s.kills.load(std::memory_order_relaxed);
   out.frame_drops = s.frame_drops.load(std::memory_order_relaxed);
   out.frame_corrupts = s.frame_corrupts.load(std::memory_order_relaxed);
   out.link_stalls = s.link_stalls.load(std::memory_order_relaxed);
   out.link_closes = s.link_closes.load(std::memory_order_relaxed);
   return out;
+}
+
+int ScheduleSize() { return S().nspecs.load(std::memory_order_relaxed); }
+
+uint64_t SpecMatched(int i) {
+  State& s = S();
+  if (i < 0 || i >= s.nspecs.load(std::memory_order_relaxed)) return 0;
+  return s.specs[i].matched.load(std::memory_order_relaxed);
+}
+
+uint64_t SpecFired(int i) {
+  State& s = S();
+  if (i < 0 || i >= s.nspecs.load(std::memory_order_relaxed)) return 0;
+  return s.specs[i].fired.load(std::memory_order_relaxed);
+}
+
+int WriteReport(int rank) {
+  const char* prefix = getenv("ACX_FAULT_REPORT");
+  if (prefix == nullptr || prefix[0] == '\0') return 1;
+  State& s = S();
+  const std::string fn = std::string(prefix) + ".rank" +
+                         std::to_string(rank) + ".fault.json";
+  FILE* f = std::fopen(fn.c_str(), "w");
+  if (f == nullptr) {
+    std::fprintf(stderr, "tpu-acx: fault: cannot write %s\n", fn.c_str());
+    return -1;
+  }
+  std::fprintf(f, "{\"rank\":%d,\"specs\":[", rank);
+  const int n = s.nspecs.load(std::memory_order_relaxed);
+  for (int i = 0; i < n; i++) {
+    const Config& c = s.specs[i].cfg;
+    char sbuf[192];
+    if (FormatSpec(c, sbuf, sizeof sbuf) < 0) sbuf[0] = '\0';
+    std::fprintf(f,
+                 "%s\n {\"spec\":\"%s\",\"action\":\"%s\",\"rank\":%d,"
+                 "\"kind\":%d,\"peer\":%d,\"subflow\":%d,\"nth\":%d,"
+                 "\"count\":%d,\"matched\":%llu,\"fired\":%llu}",
+                 i > 0 ? "," : "", sbuf, ActionName(c.action), c.rank,
+                 c.kind, c.peer, c.subflow, c.nth, c.count,
+                 (unsigned long long)s.specs[i].matched.load(
+                     std::memory_order_relaxed),
+                 (unsigned long long)s.specs[i].fired.load(
+                     std::memory_order_relaxed));
+  }
+  const Stats st = stats();
+  std::fprintf(f,
+               "],\n\"stats\":{\"drops\":%llu,\"delays\":%llu,"
+               "\"fails\":%llu,\"kills\":%llu,\"frame_drops\":%llu,"
+               "\"frame_corrupts\":%llu,\"link_stalls\":%llu,"
+               "\"link_closes\":%llu}}\n",
+               (unsigned long long)st.drops, (unsigned long long)st.delays,
+               (unsigned long long)st.fails, (unsigned long long)st.kills,
+               (unsigned long long)st.frame_drops,
+               (unsigned long long)st.frame_corrupts,
+               (unsigned long long)st.link_stalls,
+               (unsigned long long)st.link_closes);
+  std::fclose(f);
+  return 0;
 }
 
 }  // namespace fault

@@ -19,8 +19,9 @@ namespace {
 const char* const kCounterName[] = {
     "triggers",        "waits",          "ops_isend",      "ops_irecv",
     "ops_pready",      "ops_parrived",   "bytes_sent",     "bytes_recv",
-    "retries",         "timeouts",       "faults_injected", "hb_sent",
-    "hb_recv",         "hb_misses",      "peers_dead",     "slot_hwm",
+    "retries",         "timeouts",       "faults_injected", "faults_wire",
+    "hb_sent",         "hb_recv",        "hb_misses",      "peers_dead",
+    "slot_hwm",
     "proxy_sweeps",    "ops_issued",     "ops_completed",  "slots_reclaimed",
     "proxy_busy_ns",   "proxy_idle_ns",  "reconnects",     "frames_replayed",
     "crc_rejects",     "naks_sent",      "drained_slots",  "fleet_epoch",

@@ -328,6 +328,20 @@ bool Proxy::Sweep() {
                 metrics::Add(metrics::kOpsParrived, 1);
               local.ops_completed++;
               progressed = true;
+            } else if (op.deadline_ns != 0 && NowNs() >= op.deadline_ns) {
+              // An abandoned partition never arrives (its sender died, or
+              // healed past this round and will not redo it); without a
+              // deadline here the waiter spins forever — these slots have
+              // no ticket, so CheckStalled never polices them.
+              op.status = Status{op.peer, op.tag, kErrTimeout, 0};
+              ACX_FLIGHT_SPAN(kOpTimeout, i, op.peer, op.tag, 0, kErrTimeout,
+                              op.span);
+              table_->Store(i, kCompleted);
+              ACX_TRACE_SPAN("op_timeout", i, op.span);
+              if (metrics::Enabled()) metrics::MarkComplete(i);
+              local.timeouts++;
+              local.ops_completed++;
+              progressed = true;
             }
             break;
           }

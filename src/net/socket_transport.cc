@@ -233,6 +233,7 @@ class SockTicket : public Ticket {
   SockTicket(StreamTransport* t, std::shared_ptr<RecvReq> r)
       : t_(t), recv_(std::move(r)) {}
   bool Test(Status* st) override;
+  const std::shared_ptr<RecvReq>& recv() const { return recv_; }
 
  private:
   StreamTransport* t_;
@@ -610,6 +611,29 @@ class StreamTransport : public Transport {
     }
     if (r->done && st) *st = r->st;
     return r->done;
+  }
+
+  // Called from SockPrecvChan::FinishRound for partitions the proxy gave up
+  // on (arrival deadline / drain): un-post the recv so a REDONE round's
+  // frame is matched by the redo's fresh request instead of this stale one.
+  // If the frame arrives anyway it lands on the unexpected queue for its
+  // (tag, ctx) — the round's tags are never reused, so it sits inert.
+  // Returns false when the req already matched (a frame is mid-stream into
+  // its buffer) or went rendezvous; those cases can't be un-posted.
+  bool CancelPostedRecv(const std::shared_ptr<RecvReq>& r) {
+    if (!r) return false;
+    std::lock_guard<std::mutex> lk(mu_);
+    if (r->done || r->src < 0 || r->src >= size_) return false;
+    auto& q = peers_[r->src].posted;
+    for (auto it = q.begin(); it != q.end(); ++it) {
+      if (it->get() == r.get()) {
+        q.erase(it);
+        r->st = Status{r->src, r->tag, kErrTimeout, 0};
+        r->done = true;
+        return true;
+      }
+    }
+    return false;
   }
 
  private:
@@ -1120,10 +1144,13 @@ class StreamTransport : public Transport {
   // clock delta — both timelines are per-rank trace origins, so it embeds
   // a constant offset; live consumers (tseries/acx_top) present it as raw,
   // and acx_trace_merge/acx_critpath subtract the barrier-anchored skew.
-  void NoteFrameRxLocked(int p, const WireHeader& h) {
+  void NoteFrameRxLocked(int p, int lane, const WireHeader& h) {
     if (h.span != 0) {
       ACX_TRACE_SPAN("wire_rx", -1, h.span);
-      ACX_FLIGHT_SPAN(kRxFrame, -1, p, h.tag, h.seq, 0, h.span);
+      // aux = lane: seq spaces are per-subflow (each lane has its own wire
+      // clock), so offline seq audits need the lane to scope the monotone
+      // check — without it striped traffic looks like seq regressions.
+      ACX_FLIGHT_SPAN(kRxFrame, -1, p, h.tag, h.seq, lane, h.span);
     }
     if (h.tx_ns != 0) {
       const uint64_t now = trace::NowSinceStartNs();
@@ -1702,7 +1729,7 @@ class StreamTransport : public Transport {
           continue;
         }
         if (recovery_armed_) BumpRxLocked(p, lane, in.hdr.seq);
-        NoteFrameRxLocked(p, in.hdr);
+        NoteFrameRxLocked(p, lane, in.hdr);
         if (!seen && srx->got.insert(in.chdr.idx).second) {
           if (srx->have_env && srx->got.size() == srx->nchunks)
             CompleteStripeLocked(p, in.chdr.msg_id);
@@ -1753,7 +1780,7 @@ class StreamTransport : public Transport {
           continue;
         }
         if (recovery_armed_) BumpRxLocked(p, lane, in.hdr.seq);
-        NoteFrameRxLocked(p, in.hdr);
+        NoteFrameRxLocked(p, lane, in.hdr);
         NoteMatchLocked(in.hdr.span, r->span);
         // Wire scope: goodput is what the app receives (delivered bytes,
         // truncation excluded), not what crossed the wire.
@@ -1790,7 +1817,7 @@ class StreamTransport : public Transport {
         continue;
       }
       if (recovery_armed_) BumpRxLocked(p, lane, in.hdr.seq);
-      NoteFrameRxLocked(p, in.hdr);
+      NoteFrameRxLocked(p, lane, in.hdr);
       if (in.hdr.magic == kMagicRts) {
         Msg m;
         m.tag = in.hdr.tag;
@@ -2857,12 +2884,19 @@ class SockPsendChan : public PartitionedChan {
   bool Parrived(int) override { return false; }  // send side has no arrivals
   void StartRound() override { inflight_.clear(); }
   void FinishRound(Status* st) override {
+    // Sends resolve in bounded time — a live peer's link drains the outq
+    // and a dead peer's teardown error-completes it — but the error must
+    // not be swallowed: a partition that never reached the peer is the
+    // receiver's missing round.
+    Status out{t_->rank(), tag_, 0,
+               part_bytes * static_cast<size_t>(partitions)};
     Status tmp;
     for (auto& tk : inflight_) {
       while (!tk->Test(&tmp)) sched_yield();
+      if (tmp.error != 0 && out.error == 0)
+        out = Status{dst_, tag_, tmp.error, 0};
     }
-    if (st) *st = Status{t_->rank(), tag_, 0,
-                         part_bytes * static_cast<size_t>(partitions)};
+    if (st) *st = out;
     inflight_.clear();
   }
 
@@ -2890,11 +2924,16 @@ class SockPrecvChan : public PartitionedChan {
     Status st;
     if (tickets_[p] && tickets_[p]->Test(&st)) {
       done_[p] = true;
+      // Completed WITH an error (peer died mid-round) means "resolved",
+      // not "arrived" — keep the status so FinishRound reports it instead
+      // of handing the caller silent stale bytes.
+      if (st.error != 0 && err_.error == 0) err_ = st;
       return true;
     }
     return false;
   }
   void StartRound() override {
+    err_ = Status{};
     for (int p = 0; p < partitions; p++) {
       done_[p] = false;
       tickets_[p].reset(
@@ -2903,12 +2942,24 @@ class SockPrecvChan : public PartitionedChan {
     }
   }
   void FinishRound(Status* st) override {
+    // By the wait contract every partition slot has already completed —
+    // either arrived, or failed by the proxy (arrival deadline / drain).
+    // NEVER spin here: a failed partition's frame may never come. Un-post
+    // abandoned recvs so a redone round can't be half-matched against
+    // this round's stale requests.
+    Status out{src_, tag_, 0, part_bytes * static_cast<size_t>(partitions)};
     for (int p = 0; p < partitions; p++) {
-      while (!Parrived(p)) sched_yield();
+      if (!Parrived(p)) {
+        if (tickets_[p])
+          t_->CancelPostedRecv(
+              static_cast<SockTicket*>(tickets_[p].get())->recv());
+        if (out.error == 0) out = Status{src_, tag_, kErrTimeout, 0};
+      }
       tickets_[p].reset();
     }
-    if (st) *st = Status{src_, tag_, 0,
-                         part_bytes * static_cast<size_t>(partitions)};
+    if (err_.error != 0 && out.error == 0) out = err_;
+    err_ = Status{};
+    if (st) *st = out;
   }
 
  private:
@@ -2917,6 +2968,7 @@ class SockPrecvChan : public PartitionedChan {
   int src_, tag_, ctx_;
   std::vector<std::unique_ptr<Ticket>> tickets_;
   std::vector<bool> done_;
+  Status err_{};
 };
 
 PartitionedChan* StreamTransport::PsendInit(const void* buf, int partitions,

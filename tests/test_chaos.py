@@ -1,0 +1,233 @@
+"""Chaos invariant oracle (tools/acx_chaos.py): schedule parsing, the
+cross-rank invariant audits, and the ddmin schedule shrinker.
+
+These tests feed the oracle *synthetic* artifacts — fault reports,
+tseries streams, and flight dumps of the shapes the runtime writes —
+so each invariant is exercised in isolation, and drive ddmin with a
+scripted failure predicate instead of real runs. The end-to-end path
+(real kills under `acxrun -chaos`, real artifact audits, real shrink
+runs) is covered by `make chaos-check`.
+"""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _chaos():
+    spec = importlib.util.spec_from_file_location(
+        "acx_chaos", os.path.join(REPO, "tools", "acx_chaos.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+chaos = _chaos()
+
+
+def _report(rank, fired, incarnation=0):
+    """A fault report: fired[i] = times spec i fired on this rank."""
+    return {"rank": rank, "incarnation": incarnation,
+            "specs": [{"spec": "s%d" % i, "fired": f, "matched": f}
+                      for i, f in enumerate(fired)]}
+
+
+# ---- schedule parsing -------------------------------------------------
+
+def test_parse_schedule_routes_audit_fields():
+    sched = chaos.parse_schedule(
+        "drop:rank=1:nth=3:count=2;kill:rank=2:nth=7;delay:us=100")
+    assert [s["action"] for s in sched] == ["drop", "kill", "delay"]
+    assert sched[0]["rank"] == 1 and sched[0]["nth"] == 3
+    assert sched[0]["count"] == 2
+    assert sched[1]["rank"] == 2
+    assert sched[2]["rank"] == -1  # unfiltered spec matches any rank
+    assert sched[2]["raw"] == "delay:us=100"
+
+
+# ---- fault accounting -------------------------------------------------
+
+def test_fault_accounting_all_fired():
+    sched = chaos.parse_schedule("drop:rank=0:nth=2;drop_frame:rank=1:nth=3")
+    reports = [_report(0, [1, 0]), _report(1, [0, 2])]
+    failures, notes = chaos.audit_fault_accounting(sched, reports, set())
+    assert failures == [] and notes == []
+
+
+def test_fault_accounting_never_fired_is_failure():
+    sched = chaos.parse_schedule("drop:rank=0:nth=2;drop_frame:rank=1:nth=999")
+    reports = [_report(0, [1, 0]), _report(1, [0, 0])]
+    failures, _ = chaos.audit_fault_accounting(sched, reports, set())
+    assert len(failures) == 1
+    assert "spec 1" in failures[0] and "never fired" in failures[0]
+
+
+def test_fault_accounting_unfiltered_spec_sums_ranks():
+    # rank=-1 specs may fire on any rank; firing on ONE rank suffices.
+    sched = chaos.parse_schedule("drop:nth=5")
+    reports = [_report(0, [0]), _report(1, [3])]
+    failures, _ = chaos.audit_fault_accounting(sched, reports, set())
+    assert failures == []
+
+
+def test_fault_accounting_kill_verified_from_respawn_ledger():
+    # The SIGKILLed incarnation writes no report: the supervisor's respawn
+    # ledger is the evidence that the kill fired.
+    sched = chaos.parse_schedule("kill:rank=1:nth=7")
+    failures, _ = chaos.audit_fault_accounting(sched, [], {1})
+    assert failures == []
+    failures, _ = chaos.audit_fault_accounting(sched, [], set())
+    assert len(failures) == 1 and "no respawn" in failures[0]
+
+
+def test_fault_accounting_spec_on_killed_rank_is_skipped():
+    # A non-kill spec targeting the killed rank died with its report;
+    # unverifiable is a note, not a failure.
+    sched = chaos.parse_schedule("drop:rank=1:nth=3;kill:rank=1:nth=7")
+    failures, notes = chaos.audit_fault_accounting(sched, [], {1})
+    assert failures == []
+    assert len(notes) == 1 and "unverifiable" in notes[0]
+
+
+# ---- epoch monotonicity ----------------------------------------------
+
+def test_epoch_monotone_pass():
+    streams = {"ts.rank0": [{"epoch": 1}, {"epoch": 1}, {"epoch": 3}],
+               "ts.rank1": [{"epoch": 1}, {"epoch": 5}]}
+    assert chaos.audit_epoch_monotone(streams, expect_kill=True) == []
+
+
+def test_epoch_regression_is_failure():
+    streams = {"ts.rank0": [{"epoch": 3}, {"epoch": 2}]}
+    failures = chaos.audit_epoch_monotone(streams, expect_kill=False)
+    assert len(failures) == 1 and "regressed" in failures[0]
+
+
+def test_epoch_must_climb_on_kill_run():
+    # Death + rejoin bumps the fleet epoch twice past the seed of 1; a
+    # kill run whose peak epoch stays at 1 healed nothing.
+    streams = {"ts.rank0": [{"epoch": 1}, {"epoch": 1}]}
+    failures = chaos.audit_epoch_monotone(streams, expect_kill=True)
+    assert len(failures) == 1 and "climbed" in failures[0]
+    assert chaos.audit_epoch_monotone(streams, expect_kill=False) == []
+
+
+# ---- per-lane sequence spaces ----------------------------------------
+
+def _dump_events(events):
+    return [("fl.rank0.flight.json", {"events": events})]
+
+
+def test_seq_spaces_monotone_pass():
+    evs = [{"kind": "rx_frame", "peer": 1, "aux": 0, "seq": 1},
+           {"kind": "rx_frame", "peer": 1, "aux": 0, "seq": 2},
+           {"kind": "rx_frame", "peer": 2, "aux": 0, "seq": 1}]
+    assert chaos.audit_seq_spaces(_dump_events(evs)) == []
+
+
+def test_seq_regression_without_boundary_is_failure():
+    evs = [{"kind": "rx_frame", "peer": 1, "aux": 0, "seq": 2},
+           {"kind": "rx_frame", "peer": 1, "aux": 0, "seq": 1}]
+    failures = chaos.audit_seq_spaces(_dump_events(evs))
+    assert len(failures) == 1 and "duplicate or regressed" in failures[0]
+
+
+def test_seq_restart_after_boundary_is_legal():
+    # A recovery boundary (reconnect, NAK, death) legally resets the
+    # peer's seq space — the joiner's new incarnation starts from 1.
+    evs = [{"kind": "rx_frame", "peer": 1, "aux": 0, "seq": 5},
+           {"kind": "peer_dead", "peer": 1},
+           {"kind": "rx_frame", "peer": 1, "aux": 0, "seq": 1}]
+    assert chaos.audit_seq_spaces(_dump_events(evs)) == []
+
+
+def test_seq_spaces_are_per_lane():
+    # Striped links interleave lanes with independent wire clocks: lane 1
+    # starting at 1 after lane 0 reached 2 is NOT a regression.
+    evs = [{"kind": "rx_frame", "peer": 1, "aux": 0, "seq": 1},
+           {"kind": "rx_frame", "peer": 1, "aux": 0, "seq": 2},
+           {"kind": "rx_frame", "peer": 1, "aux": 1, "seq": 1},
+           {"kind": "rx_frame", "peer": 1, "aux": 1, "seq": 2}]
+    assert chaos.audit_seq_spaces(_dump_events(evs)) == []
+
+
+def test_boundary_resets_only_that_peer():
+    evs = [{"kind": "rx_frame", "peer": 1, "aux": 0, "seq": 4},
+           {"kind": "rx_frame", "peer": 2, "aux": 0, "seq": 4},
+           {"kind": "link_recovering", "peer": 1},
+           {"kind": "rx_frame", "peer": 1, "aux": 0, "seq": 1},  # legal
+           {"kind": "rx_frame", "peer": 2, "aux": 0, "seq": 1}]  # not
+    failures = chaos.audit_seq_spaces(_dump_events(evs))
+    assert len(failures) == 1 and "peer 2" in failures[0]
+
+
+# ---- ddmin shrinker ---------------------------------------------------
+
+def test_ddmin_finds_single_culprit():
+    items = ["a", "b", "c", "d", "e", "f", "g", "h"]
+    assert chaos.ddmin(items, lambda s: "f" in s) == ["f"]
+
+
+def test_ddmin_finds_interacting_pair():
+    # The failure needs BOTH specs: ddmin must keep exactly the pair.
+    items = ["a", "b", "c", "d", "e", "f"]
+    out = chaos.ddmin(items, lambda s: "b" in s and "e" in s)
+    assert sorted(out) == ["b", "e"]
+
+
+def test_ddmin_preserves_schedule_order():
+    # Schedule order is semantic (first in-window spec wins): the minimal
+    # subset must come back in original order, not sorted or shuffled.
+    items = ["z", "m", "a"]
+    out = chaos.ddmin(items, lambda s: "z" in s and "a" in s)
+    assert out == ["z", "a"]
+
+
+def test_ddmin_counts_runs_frugally():
+    # 8 specs, single culprit: ddmin needs O(k log n) probes, not 2^n.
+    calls = [0]
+
+    def still_fails(s):
+        calls[0] += 1
+        return "d" in s
+
+    assert chaos.ddmin(list("abcdefgh"), still_fails) == ["d"]
+    assert calls[0] <= 20
+
+
+# ---- full-run audit plumbing -----------------------------------------
+
+def _ok_run(schedule_str, **over):
+    run = {
+        "exit": 0,
+        "schedule_str": schedule_str,
+        "schedule": chaos.parse_schedule(schedule_str),
+        "respawns": {},
+        "reports": [],
+        "dumps": [],
+        "tseries": {},
+        "flight_prefix": "/nonexistent/fl",
+        "stdout": "",
+        "stderr": "",
+    }
+    run.update(over)
+    return run
+
+
+def test_audit_run_clean():
+    run = _ok_run("drop:rank=0:nth=2", reports=[_report(0, [1])])
+    failures, notes = chaos.audit_run(run)
+    assert failures == [] and notes == []
+
+
+def test_audit_run_nonzero_exit_fails():
+    run = _ok_run("drop:rank=0:nth=2", exit=7, reports=[_report(0, [1])])
+    failures, _ = chaos.audit_run(run)
+    assert any("workload_exit" in f for f in failures)
+
+
+def test_audit_run_kill_without_respawn_fails():
+    run = _ok_run("kill:rank=1:nth=7")
+    failures, _ = chaos.audit_run(run)
+    assert any("no respawn" in f for f in failures)

@@ -44,7 +44,16 @@ def test_acxrun_rejects_bad_fault_spec():
     r = _run([_acxrun(), "-np", "1", "-fault", "bogus:nth=1",
               "/bin/true"])
     assert r.returncode == 2, r.stdout + r.stderr
-    assert "bad -fault spec" in r.stderr
+    assert "bad -fault schedule" in r.stderr
+
+
+def test_acxrun_rejects_truncated_schedule():
+    """A trailing ';' means a spec went missing (shell quoting): refuse
+    the half-schedule rather than run a different experiment."""
+    r = _run([_acxrun(), "-np", "1", "-fault", "drop:nth=1;",
+              "/bin/true"])
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "bad -fault schedule" in r.stderr
 
 
 # -- transient drop -> retry -> success ------------------------------------

@@ -119,6 +119,26 @@ def test_chaos_ring_counters_reach_metrics_json(tmp_path):
     assert totals["frames_replayed"] >= 1, totals
 
 
+# -- replay_broken: budget overrun latches, next loss is terminal -----------
+
+
+def test_replay_broken_latch_end_to_end():
+    """Overrunning ACX_REPLAY_BUF_BYTES latches the link replay_broken:
+    the gauge is live in Runtime.recovery_stats(), and when the peer then
+    dies the parked op resolves to a typed error in bounded time (the
+    broken link cannot heal, so it dead-latches instead of recovering)
+    and the gauge settles back to 0."""
+    r = _run([_acxrun(), "-np", "2", "-transport", "socket",
+              sys.executable, __file__, "--replay-broken-worker"],
+             env_extra={"ACX_REPLAY_BUF_BYTES": "64",
+                        "ACX_RECONNECT_MAX": "2",
+                        "ACX_RECONNECT_BACKOFF_MS": "50"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "REPLAY BROKEN OK" in r.stdout
+    # The runtime said so out loud, once, at latch time.
+    assert "overran ACX_REPLAY_BUF_BYTES" in r.stderr, r.stderr
+
+
 # -- serving: peer loss requeues without charging the retry budget ----------
 
 
@@ -305,6 +325,55 @@ def _drain_recovering_worker() -> int:
     os._exit(0)  # peer is gone; skip the finalize barrier entirely
 
 
+def _replay_broken_worker() -> int:
+    sys.path.insert(0, REPO)
+    from mpi_acx_tpu import runtime
+    rt = runtime.Runtime()
+    if rt.rank == 1:
+        # Receive the sends that overrun rank 0's replay budget, tell
+        # rank 0 we're done, then die without finalize — the broken
+        # link's next loss must be terminal, not a heal.
+        buf = np.zeros(256, dtype=np.int32)
+        for i in range(3):
+            rt.wait(rt.irecv_enqueue(buf, source=0, tag=31))
+            assert buf[0] == i, (i, buf[0])
+        tok = np.ones(1, dtype=np.int32)
+        rt.wait(rt.isend_enqueue(tok, dest=0, tag=32))
+        time.sleep(0.1)  # let the token frame drain off the socket
+        os._exit(0)
+    # Each 1 KiB eager frame dwarfs the 64-byte budget, so recording it
+    # evicts unacked bytes and latches replay_broken on first full write.
+    src = np.zeros(256, dtype=np.int32)
+    for i in range(3):
+        src[0] = i
+        rt.wait(rt.isend_enqueue(src, dest=1, tag=31))
+    deadline = time.monotonic() + 10
+    while rt.recovery_stats()["replay_broken_links"] < 1:
+        assert time.monotonic() < deadline, rt.recovery_stats()
+        time.sleep(0.01)
+    tok = np.zeros(1, dtype=np.int32)
+    rt.wait(rt.irecv_enqueue(tok, source=1, tag=32))
+    assert tok[0] == 1
+    # Park an op against the (about to be dead) peer. The short pinned
+    # ladder means the EOF dead-latches within ~1s; the posted recv must
+    # resolve to a typed error, never hang.
+    dst = np.zeros(8, dtype=np.int32)
+    rv = rt.irecv_enqueue(dst, source=1, tag=33)
+    t0 = time.monotonic()
+    try:
+        rt.wait(rv)
+        return 1  # completing clean against a dead peer is the bug
+    except (runtime.AcxPeerDeadError, runtime.AcxTimeoutError):
+        pass
+    assert time.monotonic() - t0 < 30
+    # Dead-latch settles the gauge: a gone link is no longer "moving but
+    # fragile".
+    assert rt.recovery_stats()["replay_broken_links"] == 0, \
+        rt.recovery_stats()
+    print("REPLAY BROKEN OK", flush=True)
+    os._exit(0)  # peer is gone; skip the finalize barrier entirely
+
+
 def _metrics_keys_worker() -> int:
     sys.path.insert(0, REPO)
     from mpi_acx_tpu import runtime
@@ -340,6 +409,8 @@ if __name__ == "__main__":
         raise SystemExit(_drain_socket_worker())
     if "--drain-recovering-worker" in sys.argv:
         raise SystemExit(_drain_recovering_worker())
+    if "--replay-broken-worker" in sys.argv:
+        raise SystemExit(_replay_broken_worker())
     if "--metrics-keys-worker" in sys.argv:
         raise SystemExit(_metrics_keys_worker())
     raise SystemExit("unknown worker mode")

@@ -1,0 +1,500 @@
+#!/usr/bin/env python3
+"""Chaos conductor: run fault schedules under ``acxrun -chaos`` and audit
+the artifact trail against cross-rank invariants (docs/DESIGN.md §16).
+
+A chaos run is only as good as its verdict. The workload (typically
+``itests/chaos-conductor``) already byte-checks every payload; this tool
+holds the run to the invariants the payload check alone cannot see:
+
+  workload_exit     the job exited 0 — byte-exactness is the workload's
+                    own closed-form check, so nonzero means data loss,
+                    duplication, corruption, or a wedged heal
+  fault_accounting  every scheduled fault spec FIRED at least once. A
+                    schedule that never triggers is a broken experiment,
+                    not a passing one — silence is failure. Verified from
+                    the per-rank fault reports (<ACX_FAULT_REPORT>.rank<r>
+                    .fault.json, per-spec matched/fired counters); `kill`
+                    specs are verified from the supervisor's respawn
+                    ledger instead (a SIGKILLed rank writes no report —
+                    the ledger line IS the evidence it died)
+  epoch_monotone    the fleet epoch never moves backwards in any rank's
+                    tseries stream, and a run that killed a rank shows
+                    the epoch climbing (death + rejoin = at least two
+                    bumps over the seed value of 1)
+  seq_spaces        per-(peer, lane) rx_frame sequence numbers in the
+                    flight dumps are strictly increasing between recovery
+                    boundaries — a duplicate or regressed seq outside a
+                    NAK/reconnect/rejoin window means duplicate delivery
+  doctor_verdict    tools/acx_doctor.py, fed the survivors' flight dumps,
+                    names the killed rank as the culprit (dead_link /
+                    missing_dump / peer_died)
+
+On failure the schedule is shrunk with ddmin — subsets are re-run until a
+minimal failing spec list remains — and the tool prints the exact replay
+command (``ACX_FAULT='...' acxrun ... -chaos ...``) and writes it to
+<out>/replay.txt, so "seed 1007 is broken" becomes a one-line repro.
+
+Usage:
+    python3 tools/acx_chaos.py run  --np 3 --fault 'kill:rank=1:nth=7' \
+        [--chaos seed=7:faults=3:mix=issue,wire,kill] [--expect-fail] \
+        [--no-shrink] [--out DIR] -- ./build/itests/chaos-conductor
+    python3 tools/acx_chaos.py soak --np 3 --seeds 3 [--seed-base 1000] \
+        [--faults 3] [--mix issue,wire] -- ./build/itests/chaos-conductor
+
+Seed rotation: --seed-base defaults to $ACX_CHAOS_SEED_BASE (then 1000),
+so a nightly job can sweep fresh schedules (e.g. base = day number) while
+CI pins a fixed base for reproducibility. Every schedule a seed expands
+to is printed, so any nightly failure is replayable by spec, not seed.
+
+The audit functions are importable and pure (tests/test_chaos.py drives
+them on synthetic artifacts without a build).
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOCTOR = os.path.join(REPO, "tools", "acx_doctor.py")
+
+# Event kinds that legitimately reset a peer's rx seq floor: NAK-driven
+# replay re-sends old seqs, and a reconnect / rejoin restarts the lane's
+# id space from scratch (src/net/socket_transport.cc).
+SEQ_BOUNDARIES = ("link_recovering", "link_up", "tx_nak", "rx_nak",
+                  "peer_dead")
+
+# Doctor anomalies that correctly attribute a killed rank.
+KILL_ANOMALIES = ("dead_link", "missing_dump", "peer_died")
+
+
+# ---- schedule parsing (mirror of fault.cc's grammar, audit subset) ----
+
+def parse_spec(spec):
+    """One spec string -> {action, rank, nth, count, raw}. Filters the
+    audit does not route on are kept in `raw` only."""
+    parts = spec.split(":")
+    if not parts or not parts[0]:
+        raise ValueError("empty spec in %r" % spec)
+    out = {"action": parts[0], "rank": -1, "nth": 1, "count": 1,
+           "raw": spec}
+    for kv in parts[1:]:
+        if "=" not in kv:
+            raise ValueError("bad key=value %r in %r" % (kv, spec))
+        k, v = kv.split("=", 1)
+        if k in ("rank", "nth", "count"):
+            out[k] = int(v)
+    return out
+
+
+def parse_schedule(sched):
+    """';'-separated schedule -> list of spec dicts (order preserved)."""
+    return [parse_spec(s) for s in sched.split(";") if s != ""]
+
+
+# ---- artifact loaders -------------------------------------------------
+
+def load_fault_reports(prefix):
+    """All <prefix>[.i<k>].rank<r>.fault.json -> [{rank, incarnation,
+    specs: [...]}, ...]."""
+    reports = []
+    for path in sorted(glob.glob(prefix + "*.fault.json")):
+        m = re.search(r"(?:\.i(\d+))?\.rank(\d+)\.fault\.json$", path)
+        if not m:
+            continue
+        with open(path) as f:
+            d = json.load(f)
+        d["incarnation"] = int(m.group(1)) if m.group(1) else 0
+        d["rank"] = int(m.group(2))
+        reports.append(d)
+    return reports
+
+
+def load_flight_dumps(prefix):
+    dumps = []
+    for path in sorted(glob.glob(prefix + "*.flight.json")):
+        with open(path) as f:
+            dumps.append((path, json.load(f)))
+    return dumps
+
+
+def load_tseries(prefix):
+    """All <prefix>[.i<k>].rank<r>.tseries.jsonl -> {stream_name:
+    [sample, ...]} (malformed trailing lines from a killed sampler are
+    skipped)."""
+    streams = {}
+    for path in sorted(glob.glob(prefix + "*.tseries.jsonl")):
+        samples = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    samples.append(json.loads(line))
+                except ValueError:
+                    continue  # torn final line of a SIGKILLed rank
+        streams[os.path.basename(path)] = samples
+    return streams
+
+
+# ---- invariant audits (pure: artifacts in, failure strings out) -------
+
+def audit_fault_accounting(schedule, reports, respawned_ranks):
+    """Every scheduled spec fired >= once. Returns (failures, notes)."""
+    failures, notes = [], []
+    fired_by_rank = {}  # (rank, spec_index) -> fired total across incs
+    for rep in reports:
+        for i, s in enumerate(rep.get("specs", [])):
+            key = (rep["rank"], i)
+            fired_by_rank[key] = fired_by_rank.get(key, 0) + \
+                int(s.get("fired", 0))
+    for i, spec in enumerate(schedule):
+        if spec["action"] == "kill":
+            victims = respawned_ranks if spec["rank"] < 0 \
+                else ([spec["rank"]] if spec["rank"] in respawned_ranks
+                      else [])
+            if not victims:
+                failures.append(
+                    "fault_accounting: spec %d %r scheduled a kill but "
+                    "no respawn was recorded — the kill never fired"
+                    % (i, spec["raw"]))
+            continue
+        if spec["rank"] >= 0 and spec["rank"] in respawned_ranks:
+            # The victim's pre-kill incarnation writes no report (SIGKILL)
+            # and its respawn runs fault-free; this spec is unverifiable.
+            notes.append(
+                "fault_accounting: spec %d %r targets killed rank %d; "
+                "its report died with it (unverifiable, skipped)"
+                % (i, spec["raw"], spec["rank"]))
+            continue
+        ranks = [spec["rank"]] if spec["rank"] >= 0 else \
+            sorted({r["rank"] for r in reports})
+        fired = sum(fired_by_rank.get((r, i), 0) for r in ranks)
+        if fired == 0:
+            failures.append(
+                "fault_accounting: spec %d %r never fired (matched "
+                "window never reached on rank %s) — a scheduled fault "
+                "that does not happen is a failed experiment"
+                % (i, spec["raw"],
+                   spec["rank"] if spec["rank"] >= 0 else "any"))
+    return failures, notes
+
+
+def audit_epoch_monotone(streams, expect_kill):
+    """Fleet epoch never regresses per stream; climbs past 2 on a kill
+    run (1 seed + death + join)."""
+    failures = []
+    peak = 0
+    for name, samples in streams.items():
+        last = 0
+        for s in samples:
+            e = int(s.get("epoch", 0))
+            if e < last:
+                failures.append(
+                    "epoch_monotone: %s: fleet epoch regressed %d -> %d"
+                    % (name, last, e))
+                break
+            last = e
+            peak = max(peak, e)
+    if expect_kill and streams and peak < 3:
+        failures.append(
+            "epoch_monotone: a rank was killed and respawned but no "
+            "stream's fleet epoch climbed past %d (want >= 3: death + "
+            "rejoin over the seed epoch of 1)" % peak)
+    return failures
+
+
+def audit_seq_spaces(dumps):
+    """rx_frame seqs strictly increase per (peer, lane) between recovery
+    boundaries: a repeat or regression elsewhere is duplicate delivery."""
+    failures = []
+    for path, d in dumps:
+        floor = {}  # (peer, lane) -> last seq seen since boundary
+        for e in d.get("events", []):
+            kind = e.get("kind")
+            peer = e.get("peer")
+            if kind in SEQ_BOUNDARIES:
+                for key in [k for k in floor if k[0] == peer]:
+                    del floor[key]
+                continue
+            if kind != "rx_frame":
+                continue
+            key = (peer, e.get("aux", 0))
+            seq = int(e.get("seq", 0))
+            if key in floor and seq <= floor[key]:
+                failures.append(
+                    "seq_spaces: %s: rx_frame from peer %s lane %s seq "
+                    "%d after %d with no recovery boundary — duplicate "
+                    "or regressed delivery"
+                    % (os.path.basename(path), key[0], key[1], seq,
+                       floor[key]))
+                break
+            floor[key] = seq
+    return failures
+
+
+def audit_doctor(flight_prefix, victims):
+    """acx_doctor must attribute the kill to the victim rank."""
+    paths = sorted(glob.glob(flight_prefix + "*.flight.json"))
+    if not paths:
+        return ["doctor_verdict: a rank was killed but no survivor "
+                "wrote a flight dump — no evidence trail to audit"]
+    proc = subprocess.run(
+        [sys.executable, DOCTOR, "--json"] + paths,
+        capture_output=True, text=True)
+    try:
+        diag = json.loads(proc.stdout)
+    except ValueError:
+        return ["doctor_verdict: acx_doctor produced no JSON "
+                "(rc=%d): %s" % (proc.returncode, proc.stderr.strip())]
+    if diag.get("anomaly") not in KILL_ANOMALIES:
+        return ["doctor_verdict: anomaly %r, want one of %s"
+                % (diag.get("anomaly"), list(KILL_ANOMALIES))]
+    if diag.get("culprit") not in victims:
+        return ["doctor_verdict: culprit %r, want the killed rank %s"
+                % (diag.get("culprit"), sorted(victims))]
+    return []
+
+
+def audit_run(run):
+    """All invariants over one run's result dict. Returns (failures,
+    notes)."""
+    failures, notes = [], []
+    if run["exit"] != 0:
+        failures.append("workload_exit: job exited %d (byte check or "
+                        "heal failed)" % run["exit"])
+    f, n = audit_fault_accounting(run["schedule"], run["reports"],
+                                  set(run["respawns"]))
+    failures += f
+    notes += n
+    expect_kill = any(s["action"] == "kill" for s in run["schedule"])
+    failures += audit_epoch_monotone(run["tseries"], expect_kill
+                                     and bool(run["respawns"]))
+    failures += audit_seq_spaces(run["dumps"])
+    if expect_kill and run["respawns"]:
+        failures += audit_doctor(run["flight_prefix"],
+                                 set(run["respawns"]))
+    return failures, notes
+
+
+# ---- runner -----------------------------------------------------------
+
+def run_schedule(acxrun, np, schedule_str, workload, outdir, timeout):
+    """One supervised chaos run; artifacts land under outdir."""
+    os.makedirs(outdir, exist_ok=True)
+    env = dict(os.environ)
+    env.pop("ACX_CHAOS", None)  # the concrete schedule is authoritative
+    env["ACX_FLIGHT"] = os.path.join(outdir, "fl")
+    env["ACX_METRICS"] = os.path.join(outdir, "m")
+    env["ACX_FAULT_REPORT"] = os.path.join(outdir, "fr")
+    env["ACX_TSERIES"] = os.path.join(outdir, "ts")
+    env.setdefault("ACX_TSERIES_INTERVAL_MS", "50")
+    cmd = [acxrun, "-np", str(np), "-timeout", str(timeout),
+           "-transport", "socket", "-chaos"]
+    if schedule_str:
+        cmd += ["-fault", schedule_str]
+    cmd += workload
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout + 60)
+    with open(os.path.join(outdir, "run.log"), "w") as f:
+        f.write(proc.stdout)
+        f.write(proc.stderr)
+    respawns = {}
+    for m in re.finditer(r"acxrun: chaos ledger rank=(\d+) respawns=(\d+)",
+                         proc.stderr):
+        respawns[int(m.group(1))] = int(m.group(2))
+    return {
+        "exit": proc.returncode,
+        "schedule_str": schedule_str,
+        "schedule": parse_schedule(schedule_str) if schedule_str else [],
+        "respawns": respawns,
+        "reports": load_fault_reports(os.path.join(outdir, "fr")),
+        "dumps": load_flight_dumps(os.path.join(outdir, "fl")),
+        "tseries": load_tseries(os.path.join(outdir, "ts")),
+        "flight_prefix": os.path.join(outdir, "fl"),
+        "stdout": proc.stdout,
+        "stderr": proc.stderr,
+    }
+
+
+def expand_chaos(acxrun, spec, np):
+    """Seed spec -> concrete schedule via `acxrun -print-chaos` (the same
+    splitmix64 expansion every rank performs)."""
+    proc = subprocess.run([acxrun, "-print-chaos", spec, "-np", str(np)],
+                         capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError("acxrun -print-chaos failed for %r: %s"
+                           % (spec, proc.stderr.strip()))
+    return proc.stdout.strip()
+
+
+# ---- ddmin shrinker ---------------------------------------------------
+
+def ddmin(items, still_fails):
+    """Classic ddmin: smallest sublist of `items` for which
+    still_fails(sublist) holds. still_fails(items) must be true."""
+    n = 2
+    while len(items) >= 2:
+        chunk = max(1, len(items) // n)
+        subsets = [items[i:i + chunk] for i in range(0, len(items), chunk)]
+        reduced = False
+        for i, sub in enumerate(subsets):
+            complement = [x for j, s in enumerate(subsets) if j != i
+                          for x in s]
+            if still_fails(sub):
+                items, n, reduced = sub, 2, True
+                break
+            if len(subsets) > 2 and complement and still_fails(complement):
+                items, n, reduced = complement, max(n - 1, 2), True
+                break
+        if not reduced:
+            if n >= len(items):
+                break
+            n = min(len(items), n * 2)
+    return items
+
+
+def shrink(run, acxrun, np, workload, outdir, timeout):
+    """Shrink a failing schedule to a minimal failing spec subset by
+    re-running candidates. Returns (minimal_specs, replay_cmd)."""
+    specs = [s["raw"] for s in run["schedule"]]
+    counter = [0]
+
+    def still_fails(subset):
+        if not subset:
+            return False
+        counter[0] += 1
+        sub_out = os.path.join(outdir, "shrink-%d" % counter[0])
+        r = run_schedule(acxrun, np, ";".join(subset), workload, sub_out,
+                         timeout)
+        failures, _ = audit_run(r)
+        return bool(failures)
+
+    minimal = ddmin(specs, still_fails) if len(specs) > 1 else specs
+    sched = ";".join(minimal)
+    replay = "ACX_FAULT='%s' %s -np %d -transport socket -chaos " \
+             "-timeout %d %s" % (sched, acxrun, np, timeout,
+                                 " ".join(workload))
+    return minimal, replay
+
+
+# ---- CLI --------------------------------------------------------------
+
+def report(run, failures, notes, label):
+    for n in notes:
+        print("acx_chaos: note: %s" % n)
+    for f in failures:
+        print("acx_chaos: FAIL %s: %s" % (label, f))
+    if not failures:
+        fired = sum(int(s.get("fired", 0)) for rep in run["reports"]
+                    for s in rep.get("specs", []))
+        print("acx_chaos: PASS %s (%d spec(s), %d fault(s) fired, "
+              "%d respawn(s))" % (label, len(run["schedule"]), fired,
+                                  sum(run["respawns"].values())))
+
+
+def do_run(args):
+    schedule = args.fault or ""
+    if args.chaos:
+        expanded = expand_chaos(args.acxrun, args.chaos, args.np)
+        schedule = (schedule + ";" + expanded) if schedule else expanded
+    if not schedule:
+        print("acx_chaos: nothing to run (need --fault and/or --chaos)",
+              file=sys.stderr)
+        return 2
+    print("acx_chaos: schedule %s" % schedule)
+    run = run_schedule(args.acxrun, args.np, schedule, args.workload,
+                       args.out, args.timeout)
+    failures, notes = audit_run(run)
+    report(run, failures, notes, "run")
+    if failures and not args.no_shrink:
+        minimal, replay = shrink(run, args.acxrun, args.np, args.workload,
+                                 args.out, args.timeout)
+        print("acx_chaos: minimal failing schedule: %s" % ";".join(minimal))
+        print("acx_chaos: replay: %s" % replay)
+        with open(os.path.join(args.out, "replay.txt"), "w") as f:
+            f.write(replay + "\n")
+    if args.expect_fail:
+        # Control leg: the oracle itself is under test — it must both
+        # flag the run AND hand back a replay line.
+        ok = bool(failures) and (args.no_shrink or
+                                 os.path.exists(os.path.join(args.out,
+                                                             "replay.txt")))
+        print("acx_chaos: expect-fail %s" % ("satisfied" if ok else
+                                             "NOT satisfied"))
+        return 0 if ok else 1
+    return 1 if failures else 0
+
+
+def do_soak(args):
+    base = args.seed_base
+    if base is None:
+        base = int(os.environ.get("ACX_CHAOS_SEED_BASE", "1000"))
+    bad = 0
+    for i in range(args.seeds):
+        seed = base + i
+        spec = "seed=%d:faults=%d:mix=%s" % (seed, args.faults, args.mix)
+        schedule = expand_chaos(args.acxrun, spec, args.np)
+        print("acx_chaos: seed %d -> %s" % (seed, schedule))
+        outdir = os.path.join(args.out, "seed-%d" % seed)
+        run = run_schedule(args.acxrun, args.np, schedule, args.workload,
+                           outdir, args.timeout)
+        failures, notes = audit_run(run)
+        report(run, failures, notes, "seed %d" % seed)
+        if failures:
+            bad += 1
+            minimal, replay = shrink(run, args.acxrun, args.np,
+                                     args.workload, outdir, args.timeout)
+            print("acx_chaos: minimal failing schedule: %s"
+                  % ";".join(minimal))
+            print("acx_chaos: replay: %s" % replay)
+            with open(os.path.join(outdir, "replay.txt"), "w") as f:
+                f.write(replay + "\n")
+    print("acx_chaos: soak %d/%d seed(s) passed"
+          % (args.seeds - bad, args.seeds))
+    return 1 if bad else 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Run and audit chaos schedules (DESIGN.md §16).")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--np", type=int, default=3)
+        p.add_argument("--timeout", type=int, default=90)
+        p.add_argument("--out", default="chaos-out")
+        p.add_argument("--acxrun",
+                       default=os.path.join(REPO, "build", "acxrun"))
+        p.add_argument("workload", nargs="+",
+                       help="workload command (prefix with -- )")
+
+    rp = sub.add_parser("run", help="one schedule, audited")
+    common(rp)
+    rp.add_argument("--fault", default=None,
+                    help="explicit ';'-separated schedule")
+    rp.add_argument("--chaos", default=None,
+                    help="seed spec (seed=N:faults=K:mix=...) to expand")
+    rp.add_argument("--expect-fail", action="store_true",
+                    help="exit 0 iff the audit fails and a replay line "
+                         "is produced (oracle self-test)")
+    rp.add_argument("--no-shrink", action="store_true")
+
+    sp = sub.add_parser("soak", help="sweep seeds seed_base..+N")
+    common(sp)
+    sp.add_argument("--seeds", type=int, default=3)
+    sp.add_argument("--seed-base", type=int, default=None,
+                    help="default $ACX_CHAOS_SEED_BASE, then 1000")
+    sp.add_argument("--faults", type=int, default=3)
+    sp.add_argument("--mix", default="issue,wire")
+
+    args = ap.parse_args(argv)
+    return do_run(args) if args.cmd == "run" else do_soak(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,10 @@ entry count — and prints a diagnosis naming one of:
                                never sent
     barrier_skew               some ranks entered a barrier another rank
                                never reached
+    peer_died                  nothing is stuck now, but a dump's event
+                               log recorded a peer's death — the fleet
+                               declared it dead and has since moved on
+                               (the chaos-conductor heal/rejoin shape)
     none                       no anomaly detected
 
 The culprit is the rank whose *missing* action would unblock the job: the
@@ -373,6 +377,29 @@ def diagnose(dumps):
                 "only entered %d barrier(s)"
                 % (sorted(in_barrier), max(entered.values()),
                    straggler, entered[straggler]))
+
+    # 9. historical death: nothing is stuck NOW, but one or more dumps
+    # recorded a peer_dead event. This is the chaos-conductor shape
+    # (DESIGN.md §16): survivors of a SIGKILLed rank dump at heal time,
+    # after which the victim's respawned incarnation rejoins and clears
+    # the dead latch — so no live anomaly remains, yet the event log
+    # still names who died. Lowest priority: any live wait/latch evidence
+    # above explains the dumps better than a death the fleet already
+    # survived.
+    died = {}
+    for rank in sorted(dumps):
+        for e in _events(dumps[rank], "peer_dead"):
+            p = e.get("peer")
+            if isinstance(p, int) and p >= 0:
+                died[p] = died.get(p, 0) + 1
+    if died:
+        victim = max(sorted(died), key=lambda p: died[p])
+        return _result(
+            "peer_died", int(victim),
+            "no live anomaly, but %d dump(s) recorded rank %d's death "
+            "(peer_dead event) — the fleet declared it dead and has "
+            "since moved on (healed or rejoined)"
+            % (died[victim], victim))
 
     return _result("none", None, "no anomaly detected")
 

@@ -15,6 +15,22 @@
 // timeout fires, the remaining ranks are killed (matching mpiexec behavior
 // on MPI_Abort).
 //
+// Chaos mode (-chaos, DESIGN.md §16): a rank that dies by SIGKILL — the
+// `kill` fault action, or an external chaos agent — is respawned with
+// ACX_JOIN=1 so it rejoins the fleet through the membership plane (§12)
+// instead of failing the job. Respawns are bounded (-max-respawns, default
+// 2 per rank); the respawned incarnation gets fault injection stripped
+// (one scheduled kill must not re-fire forever) and its artifact prefixes
+// (ACX_FLIGHT/ACX_METRICS/ACX_TSERIES/ACX_TRACE/ACX_FAULT_REPORT)
+// suffixed ".i<k>" so it cannot clobber its predecessor's dumps. The
+// supervisor prints a machine-readable ledger:
+//   acxrun: chaos schedule <full ;-joined spec list>   (launch, if armed)
+//   acxrun: chaos respawn rank=R incarnation=K         (per respawn)
+//   acxrun: chaos ledger rank=R respawns=K             (at exit)
+// `-print-chaos SPEC` expands an ACX_CHAOS seed spec (with -np if given,
+// else np=2) to its concrete schedule on stdout and exits — the same
+// expansion every rank performs, exposed for harnesses and replay.
+//
 // Failure detection (exceeds the reference, whose only story is
 // MPI_ERRORS_ARE_FATAL abort — SURVEY.md §5.3): the supervisor attributes
 // every failure to a rank. The FIRST failing rank is named with its exit
@@ -44,16 +60,25 @@
 static void usage() {
   fprintf(stderr,
           "usage: acxrun -np N [-timeout SEC] [-transport shm|socket] "
-          "[-fault SPEC] prog [args...]\n"
-          "  -fault SPEC  arm deterministic fault injection in every rank\n"
-          "               (sets ACX_FAULT; spec: action[:key=val]..., e.g.\n"
-          "               drop:rank=0:kind=send:nth=1 — see include/acx/"
-          "fault.h)\n"
-          "               op-level actions:   drop | delay | fail\n"
+          "[-fault SCHEDULE] [-chaos] [-max-respawns K] prog [args...]\n"
+          "       acxrun -print-chaos SPEC [-np N]\n"
+          "  -fault SCHEDULE  arm deterministic fault injection in every rank\n"
+          "               (sets ACX_FAULT; ';'-separated list of specs, each\n"
+          "               action[:key=val]..., e.g.\n"
+          "               drop:rank=0:kind=send:nth=1;kill:rank=1:nth=7 —\n"
+          "               see include/acx/fault.h)\n"
+          "               op-level actions:   drop | delay | fail | kill\n"
           "               wire-level actions: drop_frame | corrupt_frame |\n"
           "               stall_link_ms (ms=M) | close_link_once — exercise\n"
           "               the CRC/NAK/replay/reconnect machinery on the\n"
-          "               socket plane (-transport socket)\n");
+          "               socket plane (-transport socket)\n"
+          "  -chaos       respawn SIGKILLed ranks with ACX_JOIN=1 (requires\n"
+          "               -transport socket); print respawn ledger\n"
+          "  -max-respawns K  per-rank respawn budget in -chaos mode "
+          "(default 2)\n"
+          "  -print-chaos SPEC  expand an ACX_CHAOS seed spec (seed=N:\n"
+          "               faults=K:mix=...) to its concrete schedule and "
+          "exit\n");
   exit(2);
 }
 
@@ -62,6 +87,9 @@ int main(int argc, char** argv) {
   int timeout_s = 120;
   const char* transport = nullptr;  // nullptr = leave env as-is (default shm)
   const char* fault = nullptr;
+  const char* print_chaos = nullptr;
+  bool chaos = false;
+  int max_respawns = 2;
   int argi = 1;
   while (argi < argc && argv[argi][0] == '-') {
     if (!strcmp(argv[argi], "-np") && argi + 1 < argc) {
@@ -76,17 +104,42 @@ int main(int argc, char** argv) {
     } else if (!strcmp(argv[argi], "-fault") && argi + 1 < argc) {
       fault = argv[argi + 1];
       argi += 2;
+    } else if (!strcmp(argv[argi], "-chaos")) {
+      chaos = true;
+      argi += 1;
+    } else if (!strcmp(argv[argi], "-max-respawns") && argi + 1 < argc) {
+      max_respawns = atoi(argv[argi + 1]);
+      argi += 2;
+    } else if (!strcmp(argv[argi], "-print-chaos") && argi + 1 < argc) {
+      print_chaos = argv[argi + 1];
+      argi += 2;
     } else {
       usage();
     }
   }
+  if (print_chaos != nullptr) {
+    // Expansion oracle: same splitmix64 expansion every rank performs on
+    // ACX_CHAOS, exposed so harnesses can know the concrete schedule (and
+    // replay it verbatim via -fault) without running a rank.
+    char buf[2048];
+    if (!acx::fault::ExpandChaos(print_chaos, np > 0 ? np : 2, buf,
+                                 sizeof buf)) {
+      fprintf(stderr, "acxrun: bad -print-chaos spec '%s'\n", print_chaos);
+      return 2;
+    }
+    printf("%s\n", buf);
+    return 0;
+  }
   if (np < 1 || argi >= argc) usage();
+  if (max_respawns < 0) max_respawns = 0;
   if (fault != nullptr) {
-    // Validate up front with the same parser the ranks use: a typo'd spec
-    // must fail the launch, not silently run the job fault-free.
-    acx::fault::Config fc;
-    if (!acx::fault::ParseSpec(fault, &fc)) {
-      fprintf(stderr, "acxrun: bad -fault spec '%s'\n", fault);
+    // Validate up front with the same parser the ranks use: a typo'd
+    // schedule must fail the launch, not silently run the job fault-free.
+    acx::fault::Config fc[acx::fault::kMaxSpecs];
+    int nspec = 0;
+    if (!acx::fault::ParseSchedule(fault, fc, acx::fault::kMaxSpecs,
+                                   &nspec)) {
+      fprintf(stderr, "acxrun: bad -fault schedule '%s'\n", fault);
       return 2;
     }
   }
@@ -95,6 +148,41 @@ int main(int argc, char** argv) {
     fprintf(stderr, "acxrun: unknown -transport '%s' (want shm or socket)\n",
             transport);
     return 2;
+  }
+  const char* env_transport = getenv("ACX_TRANSPORT");
+  const bool socket_plane =
+      (transport != nullptr && strcmp(transport, "socket") == 0) ||
+      (transport == nullptr && env_transport != nullptr &&
+       strcmp(env_transport, "socket") == 0);
+  if (chaos && !socket_plane) {
+    // Rejoin runs over the reconnect listeners (§9) — a shm-plane rank has
+    // no path back into the fleet, so respawning it would just wedge.
+    fprintf(stderr, "acxrun: -chaos requires -transport socket\n");
+    return 2;
+  }
+
+  // Echo the full concrete schedule when any injection is armed: the one
+  // line a harness needs to audit "every scheduled fault fired" and to
+  // replay a seeded run without re-deriving the expansion.
+  {
+    const char* env_fault = getenv("ACX_FAULT");
+    const char* env_chaos = getenv("ACX_CHAOS");
+    std::string sched;
+    if (fault != nullptr)
+      sched = fault;
+    else if (env_fault != nullptr && env_fault[0] != '\0')
+      sched = env_fault;
+    if (env_chaos != nullptr && env_chaos[0] != '\0') {
+      char buf[2048];
+      if (!acx::fault::ExpandChaos(env_chaos, np, buf, sizeof buf)) {
+        fprintf(stderr, "acxrun: bad ACX_CHAOS spec '%s'\n", env_chaos);
+        return 2;
+      }
+      if (!sched.empty()) sched += ';';
+      sched += buf;
+    }
+    if (!sched.empty())
+      fprintf(stderr, "acxrun: chaos schedule %s\n", sched.c_str());
   }
 
   // Shared-memory plane: one memfd of np*(np-1) directed rings. The fd is
@@ -135,9 +223,9 @@ int main(int argc, char** argv) {
     }
   }
 
+  const std::string job_id = std::to_string(getpid());  // captured pre-fork
   std::vector<pid_t> pids(np);
   for (int r = 0; r < np; r++) {
-    const std::string job_id = std::to_string(getpid());  // captured pre-fork
     pid_t pid = fork();
     if (pid < 0) {
       perror("acxrun: fork");
@@ -201,6 +289,54 @@ int main(int argc, char** argv) {
   // induced, not failures, and are tagged killed=1 so a harness counting
   // `status rank=R exit=`/`signal=` lines counts only genuine failures.
   std::vector<bool> killed_by_us(np, false);
+  // Chaos mode: per-rank respawn ledger.
+  std::vector<int> respawns(np, 0);
+  // Respawn a SIGKILLed rank as a late joiner. The original socketpair
+  // mesh is gone (every fd is closed on both sides by now); the new
+  // incarnation comes back through the reconnect listeners, which is
+  // exactly the ACX_JOIN=1 path the membership plane already speaks.
+  auto respawn_rank = [&](int r) -> bool {
+    pid_t pid = fork();
+    if (pid < 0) {
+      perror("acxrun: fork (respawn)");
+      return false;
+    }
+    if (pid == 0) {
+      // Stale launch plumbing from the supervisor's env must not leak in:
+      // the fds in ACX_FDS don't exist in this process.
+      unsetenv("ACX_FDS");
+      unsetenv("ACX_SHM_FD");
+      // Strip injection — a scheduled kill must not re-fire in every
+      // incarnation, turning one fault into an infinite crash loop.
+      unsetenv("ACX_FAULT");
+      unsetenv("ACX_CHAOS");
+      setenv("ACX_RANK", std::to_string(r).c_str(), 1);
+      setenv("ACX_SIZE", std::to_string(np).c_str(), 1);
+      setenv("ACX_JOB_ID", job_id.c_str(), 0);
+      setenv("ACX_JOIN", "1", 1);
+      if (transport != nullptr) setenv("ACX_TRANSPORT", transport, 1);
+      // Artifact prefixes get ".i<k>" so incarnation k's flight dump /
+      // metrics / tseries / traces land NEXT TO the dead incarnation's
+      // files instead of overwriting them (the oracle audits both).
+      static const char* const kPrefixEnvs[] = {
+          "ACX_FLIGHT", "ACX_METRICS", "ACX_TSERIES", "ACX_TRACE",
+          "ACX_FAULT_REPORT"};
+      for (const char* name : kPrefixEnvs) {
+        const char* v = getenv(name);
+        if (v == nullptr || v[0] == '\0' || !strcmp(v, "0") ||
+            !strcmp(v, "1"))
+          continue;  // boolean/off gating, not a path prefix
+        std::string nv = std::string(v) + ".i" + std::to_string(respawns[r]);
+        setenv(name, nv.c_str(), 1);
+      }
+      execvp(argv[argi], &argv[argi]);
+      fprintf(stderr, "acxrun: exec %s failed (respawn): %s\n", argv[argi],
+              strerror(errno));
+      _exit(127);
+    }
+    pids[r] = pid;
+    return true;
+  };
   auto rank_of = [&](pid_t pid) {
     for (int r = 0; r < np; r++)
       if (pids[r] == pid) return r;
@@ -267,6 +403,23 @@ int main(int argc, char** argv) {
       }
       break;
     }
+    if (chaos) {
+      // SIGKILL deaths we did not induce are chaos casualties: respawn
+      // within budget instead of failing the job. (Only SIGKILL — a rank
+      // that aborts or segfaults is a genuine bug, not injected chaos.)
+      const int rank = rank_of(pid);
+      if (rank >= 0 && WIFSIGNALED(st) && WTERMSIG(st) == SIGKILL &&
+          !killed_by_us[rank] && respawns[rank] < max_respawns) {
+        respawns[rank]++;
+        fprintf(stderr, "acxrun: chaos respawn rank=%d incarnation=%d\n",
+                rank, respawns[rank]);
+        if (respawn_rank(rank)) continue;  // live count unchanged
+        live--;  // fork failed: fall through to plain accounting
+        status_of[rank] = 128 + SIGKILL;
+        worst = worst ? worst : status_of[rank];
+        continue;
+      }
+    }
     if (reap_one(pid, st)) {
       // Genuine failure: before attributing teardown to the peers,
       // DRAIN ranks that already died on their own (kill() on an
@@ -283,6 +436,12 @@ int main(int argc, char** argv) {
           kill(pids[r], SIGTERM);
         }
     }
+  }
+  if (chaos) {
+    for (int r = 0; r < np; r++)
+      if (respawns[r] > 0)
+        fprintf(stderr, "acxrun: chaos ledger rank=%d respawns=%d\n", r,
+                respawns[r]);
   }
   return worst;
 }
