@@ -130,6 +130,7 @@ check: ctest itest tools
 	@$(MAKE) --no-print-directory causality-check || exit 1
 	@$(MAKE) --no-print-directory decode-check || exit 1
 	@$(MAKE) --no-print-directory stripe-check || exit 1
+	@$(MAKE) --no-print-directory disagg-check || exit 1
 	@echo "ALL NATIVE TESTS PASSED"
 
 # --- survivable links end-to-end (DESIGN.md §9) ---
@@ -369,6 +370,33 @@ stripe-check: itest tools
 	  --expect-nonneg-transit \
 	  $(BUILD)/stripe-check/ping.rank*.trace.json || exit 1
 	@echo "STRIPE CHECK PASSED"
+
+# --- disaggregated prefill/decode serving (DESIGN.md §17) ---
+# Loopback parity suite (the full wire handoff bit-equal to the
+# monolithic server, mid-handoff failure requeue), a 3-rank role-split
+# fleet (1 prefill + 2 decode) on the socket plane with both decode
+# ranks byte-checking against a local monolithic serve, the same fleet
+# with the prefill rank SIGKILLed mid-handoff under the chaos oracle
+# (supervisor respawns it, the torn handoff requeues UNCHARGED, the
+# re-ship satisfies it, acx_doctor attributes the dead link), and the
+# bench disagg dryrun (TTFT-split + handoff-GB/s rows land).
+.PHONY: disagg-check
+disagg-check: tools
+	@echo "== disagg-check: loopback parity + handoff-failure suite"
+	@JAX_PLATFORMS=cpu python3 -m pytest tests/test_disagg.py -q \
+	  -p no:cacheprovider || exit 1
+	@echo "== disagg-check: 3-rank role-split fleet (1 prefill + 2 decode)"
+	@ACX_ROLE=prefill,decode,decode $(BUILD)/acxrun -np 3 -timeout 240 \
+	  -transport socket python3 tests/disagg_worker.py || exit 1
+	@echo "== disagg-check: kill prefill mid-handoff (chaos oracle + doctor)"
+	@rm -rf $(BUILD)/disagg-oracle
+	@ACX_ROLE=prefill,decode,decode python3 tools/acx_chaos.py run --np 3 \
+	  --timeout 240 --acxrun $(BUILD)/acxrun \
+	  --out $(BUILD)/disagg-oracle/kill --fault kill:rank=0:nth=8 \
+	  -- python3 tests/disagg_worker.py || exit 1
+	@echo "== disagg-check: bench.py --dryrun-disagg (TTFT split rows)"
+	@JAX_PLATFORMS=cpu python3 bench.py --dryrun-disagg || exit 1
+	@echo "DISAGG CHECK PASSED"
 
 # Header dependency tracking (-MMD): a header edit rebuilds its users.
 -include $(LIB_OBJS:.o=.d)

@@ -121,6 +121,75 @@ def _record_wire_rows(rows, part_bw):
         pass
 
 
+def disagg_fleet_rows(n_reqs: int = 6, timeout: int = 300):
+    """TTFT A/B of the role-split disagg fleet (models/disagg.py): the
+    same 3-rank (1 prefill + 2 decode) workload with per-layer Pready
+    overlap ON vs OFF (ship only after the full prompt pass). Decode
+    ranks print DISAGG_ROW lines with their observed TTFT p50 and the
+    exposed-ship p50 (FIN-carried: publish time left after the head) —
+    per-layer Pready hides the ship under compute, so its exposed time
+    is ~0 while the baseline pays the full serialized pack+publish on
+    the TTFT path. ACX_DISAGG_BIG makes each handoff ~1 MiB so that
+    exposure is milliseconds, not clock noise."""
+    subprocess.run(["make", "-C", REPO, "lib", "tools"], check=True,
+                   capture_output=True)
+    rows = {}
+    for key, overlap in (("overlap", "1"), ("noverlap", "0")):
+        env = dict(os.environ)
+        env.pop("PYTHONPATH", None)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["ACX_ROLE"] = "prefill,decode,decode"
+        env["ACX_DISAGG_OVERLAP"] = overlap
+        env["ACX_DISAGG_REQS"] = str(n_reqs)
+        env["ACX_DISAGG_BIG"] = "1"
+        cmd = [os.path.join(REPO, "build", "acxrun"), "-np", "3",
+               "-timeout", str(timeout), "-transport", "socket",
+               sys.executable, os.path.join(REPO, "tests",
+                                            "disagg_worker.py")]
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout + 60, env=env)
+        decoded = [json.loads(ln.split("DISAGG_ROW ", 1)[1])
+                   for ln in r.stdout.splitlines()
+                   if ln.startswith("DISAGG_ROW ")]
+        decoded = [d for d in decoded if d.get("role") == "decode"]
+        if r.returncode != 0 or not decoded:
+            raise RuntimeError(
+                f"disagg fleet ({key}) rc={r.returncode}: "
+                f"{r.stdout[-300:]} {r.stderr[-300:]}")
+        ttfts = sorted(d["ttft_p50_s"] for d in decoded)
+        exposes = sorted(d["expose_p50_s"] for d in decoded)
+        rows[f"disagg_fleet_ttft_{key}_p50_s"] = round(
+            ttfts[len(ttfts) // 2], 4)
+        rows[f"disagg_fleet_ship_exposed_{key}_p50_ms"] = round(
+            exposes[len(exposes) // 2] * 1e3, 3)
+    rows["disagg_fleet_overlap_ttft_speedup"] = round(
+        rows["disagg_fleet_ttft_noverlap_p50_s"]
+        / max(rows["disagg_fleet_ttft_overlap_p50_s"], 1e-9), 3)
+    rows["disagg_fleet_ship_hidden_ms"] = round(
+        rows["disagg_fleet_ship_exposed_noverlap_p50_ms"]
+        - rows["disagg_fleet_ship_exposed_overlap_p50_ms"], 3)
+    return rows
+
+
+def _record_disagg_rows(rows):
+    """Fold the disagg rows into the newest MULTICHIP_r*.json (same
+    merge-never-fail contract as _record_wire_rows)."""
+    import glob
+    files = sorted(glob.glob(os.path.join(REPO, "MULTICHIP_r*.json")))
+    if not files:
+        return
+    try:
+        with open(files[-1]) as f:
+            d = json.load(f)
+        d["disagg"] = rows
+        with open(files[-1], "w") as f:
+            json.dump(d, f)
+            f.write("\n")
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def _code_rev():
     """Fingerprint of the MEASURED code: tree hashes of the source
     paths plus any uncommitted diff to them. Deliberately excludes the
@@ -905,6 +974,57 @@ def cpu_child_quant():
     }))
 
 
+def cpu_child_disagg():
+    """Child process (forced CPU): loopback disagg serve (models/
+    disagg.py) — the full wire handoff path in one process. Reports the
+    TTFT handoff split (prefill vs ship vs pickup p50) for per-layer
+    overlap and for the ship-after-full-prefill baseline, plus handoff
+    wire throughput for the two prefill-side cache variants (int8
+    quantize-at-compute vs bf16 quantize-at-wire — same wire bytes, the
+    EQuARX rule, different pack cost). Deterministic in shape; no chip."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from mpi_acx_tpu.models import transformer as tfm
+    from mpi_acx_tpu.models.disagg import serve_disagg_greedy
+    from mpi_acx_tpu.models.serving import make_server_fns
+
+    cfg = tfm.tiny_config()
+    params = tfm.init_params(jax.random.key(0), cfg)
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, cfg.vocab, size=n).astype(np.int32)
+               for n in (5, 11, 17, 8)]
+    n_new = [4, 3, 5, 4]
+    fns = make_server_fns(params, cfg, tfm, chunk=1, kv_int8=True)
+
+    def one(**kw):
+        b = serve_disagg_greedy(params, cfg, prompts, n_new, n_slots=2,
+                                max_len=64, server_fns=fns, **kw)
+        m = b.metrics
+        wire = sum(h.wire_bytes for h in m.handoffs)
+        wall = sum(h.ship_s + h.pickup_s for h in m.handoffs) or 1e-9
+        return m, wire / wall / 1e9
+
+    m_ov, gbps_bf16 = one()                      # warm compile caches
+    m_ov, gbps_bf16 = one()
+    m_no, _ = one(overlap=False)
+    m_i8, gbps_int8 = one(prefill_kv_int8=True)
+    print(json.dumps({
+        "disagg_requests": m_ov.requests,
+        "disagg_handoff_prefill_p50_ms": round(
+            m_ov.handoff_prefill_p50_s * 1e3, 3),
+        "disagg_handoff_ship_p50_ms": round(
+            m_ov.handoff_ship_p50_s * 1e3, 3),
+        "disagg_handoff_pickup_p50_ms": round(
+            m_ov.handoff_pickup_p50_s * 1e3, 3),
+        "disagg_noverlap_ship_p50_ms": round(
+            m_no.handoff_ship_p50_s * 1e3, 3),
+        "disagg_handoff_gbps_bf16": round(gbps_bf16, 4),
+        "disagg_handoff_gbps_int8": round(gbps_int8, 4),
+        "device": str(jax.devices()[0].platform),
+    }))
+
+
 def _run_cpu_child(mode: str, timeout: int = 300):
     """_run_tpu_child with a forced 8-virtual-device CPU backend (the
     pinned axon platform must never initialize here)."""
@@ -944,6 +1064,23 @@ def main(full: bool = False):
         _record_wire_rows(srows, bw)
     except Exception as e:  # noqa: BLE001 — report, don't crash
         out["stripe_sweep_error"] = str(e)
+
+    # Disagg serving rows: loopback TTFT handoff split + wire GB/s for
+    # the two prefill-side cache variants (CPU child), then the 3-rank
+    # role-split fleet's overlap-vs-ship-after-prefill TTFT A/B — the
+    # per-layer-Pready win only visible with the roles on separate
+    # processes. Folded into the MULTICHIP artifact like the wire rows.
+    db, derr = _run_cpu_child("disagg")
+    if db is not None:
+        out.update(db)
+    else:
+        out["disagg_error"] = derr
+    try:
+        drows = disagg_fleet_rows()
+        out.update(drows)
+        _record_disagg_rows({**(db or {}), **drows})
+    except Exception as e:  # noqa: BLE001 — report, don't crash
+        out["disagg_fleet_error"] = str(e)
 
     # Deterministic, chip-independent design metric (CPU-compiled HLO).
     qb, qerr = _run_cpu_child("quant")
@@ -1201,8 +1338,32 @@ def dryrun_decode():
                       "rows": {k: rows[k] for k in need}}))
 
 
+def dryrun_disagg():
+    """`make disagg-check` hook: run the disagg loopback child
+    in-process on the tiny CPU geometry and assert the TTFT-split and
+    wire-throughput rows actually land — catches wire-path breakage and
+    row-name drift before a bench window burns minutes on it. The fleet
+    A/B runs in the same make target as its own acxrun legs, so this
+    dryrun stays single-process."""
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        cpu_child_disagg()
+    rows = json.loads(buf.getvalue().strip().splitlines()[-1])
+    need = ["disagg_handoff_prefill_p50_ms", "disagg_handoff_ship_p50_ms",
+            "disagg_handoff_pickup_p50_ms", "disagg_noverlap_ship_p50_ms",
+            "disagg_handoff_gbps_bf16", "disagg_handoff_gbps_int8"]
+    missing = [k for k in need if k not in rows]
+    assert not missing, f"disagg dryrun: rows missing {missing}"
+    assert all(rows[k] > 0 for k in need), rows
+    print(json.dumps({"dryrun_disagg_ok": True,
+                      "rows": {k: rows[k] for k in need}}))
+
+
 if __name__ == "__main__":
-    if "--dryrun-decode" in sys.argv:
+    if "--dryrun-decode" in sys.argv or "--dryrun-disagg" in sys.argv:
         # The dryrun is a correctness smoke, never a measurement: force
         # the tiny CPU geometry no matter how it was invoked.
         os.environ["ACX_BENCH_TINY"] = "1"
@@ -1215,6 +1376,10 @@ if __name__ == "__main__":
         jax.config.update("jax_platforms", "cpu")
     if "--cpu-child-quant" in sys.argv:
         cpu_child_quant()
+    elif "--cpu-child-disagg" in sys.argv:
+        cpu_child_disagg()
+    elif "--dryrun-disagg" in sys.argv:
+        dryrun_disagg()
     elif "--tpu-child-probe" in sys.argv:
         tpu_child_probe()
     elif "--tpu-child-fwd" in sys.argv:

@@ -94,6 +94,25 @@ void test_parse_spec() {
   CHECK(c.action == fault::Action::kCloseLink);
   CHECK(c.rank == 1 && c.nth == 6);
 
+  // Partitioned-push domain selector (op=part): issue actions only.
+  CHECK(fault::ParseSpec("drop:op=part:rank=1:nth=3", &c));
+  CHECK(c.action == fault::Action::kDrop && c.op == 1);
+  CHECK(c.rank == 1 && c.nth == 3);
+  CHECK(fault::ParseSpec("delay:op=part:us=2500", &c));
+  CHECK(c.action == fault::Action::kDelay && c.op == 1 && c.delay_us == 2500);
+  CHECK(fault::ParseSpec("drop:op=plain", &c));
+  CHECK(c.op == 0);
+  // Round-trips through the canonical formatter.
+  CHECK(fault::ParseSpec("drop:op=part:nth=2:count=3", &c));
+  {
+    char buf[128];
+    CHECK(fault::FormatSpec(c, buf, sizeof buf) > 0);
+    CHECK(strstr(buf, ":op=part") != nullptr);
+    fault::Config c2;
+    CHECK(fault::ParseSpec(buf, &c2));
+    CHECK(c2.op == 1 && c2.nth == 2 && c2.count == 3);
+  }
+
   // Malformed specs must be rejected, not half-parsed.
   CHECK(!fault::ParseSpec("", &c));
   CHECK(!fault::ParseSpec(nullptr, &c));
@@ -104,6 +123,10 @@ void test_parse_spec() {
   CHECK(!fault::ParseSpec("drop:nth=0", &c));
   CHECK(!fault::ParseSpec("drop:count=0", &c));
   CHECK(!fault::ParseSpec("stall_link_ms:ms=0", &c));
+  CHECK(!fault::ParseSpec("drop:op=bogus", &c));
+  // op=part names an OnPartIssue domain; frame actions never consult it.
+  CHECK(!fault::ParseSpec("drop_frame:op=part", &c));
+  CHECK(!fault::ParseSpec("stall_link_ms:op=part", &c));
   std::printf("parse_spec: OK\n");
 }
 
@@ -377,6 +400,100 @@ void test_schedule_independent_windows() {
   std::printf("schedule_independent_windows: OK\n");
 }
 
+void test_part_domain() {
+  // op=part specs live in a SEPARATE match domain: OnIssue attempts never
+  // match (or count against) them, and OnPartIssue attempts never match
+  // plain specs — each domain keeps its own nth= coordinate.
+  fault::Config cs[2];
+  int n = 0;
+  CHECK(fault::ParseSchedule("drop:op=part:kind=send:nth=2;drop:kind=send:nth=1",
+                             cs, 2, &n) && n == 2);
+  fault::ConfigureSchedule(cs, n);
+  uint64_t us = 0;
+  int err = 0;
+  // Plain attempts: only the plain spec (schedule pos 1) matches; the part
+  // spec's window is untouched.
+  CHECK(fault::OnIssue(0, true, 1, &us, &err) == fault::Action::kDrop);
+  CHECK(fault::OnIssue(0, true, 1, &us, &err) == fault::Action::kNone);
+  CHECK(fault::SpecMatched(0) == 0);  // part spec saw no plain attempts
+  // Part attempts: the part spec fires at ITS nth=2, the plain spec's
+  // counter does not advance.
+  CHECK(fault::OnPartIssue(0, true, 1, &us, &err) == fault::Action::kNone);
+  CHECK(fault::OnPartIssue(0, true, 1, &us, &err) == fault::Action::kDrop);
+  CHECK(fault::OnPartIssue(0, true, 1, &us, &err) == fault::Action::kNone);
+  CHECK(fault::SpecMatched(0) == 3 && fault::SpecFired(0) == 1);
+  CHECK(fault::SpecMatched(1) == 2 && fault::SpecFired(1) == 1);
+
+  // Delay fills delay_us from the part spec, same as OnIssue.
+  fault::Config c;
+  CHECK(fault::ParseSpec("delay:op=part:us=7000:nth=1", &c));
+  fault::Configure(c);
+  us = 0;
+  CHECK(fault::OnPartIssue(0, true, 1, &us, &err) == fault::Action::kDelay);
+  CHECK(us == 7000);
+  CHECK(fault::OnIssue(0, true, 1, &us, &err) == fault::Action::kNone);
+  RestorePolicy();
+  std::printf("part_domain: OK\n");
+}
+
+void test_expand_chaos_part() {
+  // mix=part draws only recoverable op=part actions (drop/delay), and the
+  // three match domains (issue / wire / part) de-shadow independently.
+  for (uint64_t seed = 1; seed <= 20; seed++) {
+    char spec[64], out[2048];
+    snprintf(spec, sizeof spec, "seed=%llu:faults=6:mix=part",
+             (unsigned long long)seed);
+    CHECK(fault::ExpandChaos(spec, 3, out, sizeof out));
+    fault::Config cs[fault::kMaxSpecs];
+    int n = 0;
+    CHECK(fault::ParseSchedule(out, cs, fault::kMaxSpecs, &n));
+    CHECK(n == 6);
+    for (int i = 0; i < n; i++) {
+      CHECK(cs[i].op == 1);
+      CHECK(cs[i].action == fault::Action::kDrop ||
+            cs[i].action == fault::Action::kDelay);
+      // Same-rank part windows are disjoint (first in-window spec wins —
+      // an overlapped later spec could never fire).
+      for (int j = 0; j < i; j++) {
+        if (cs[i].rank != cs[j].rank) continue;
+        const bool overlap = cs[i].nth < cs[j].nth + cs[j].count &&
+                             cs[j].nth < cs[i].nth + cs[i].count;
+        CHECK(!overlap);
+      }
+    }
+  }
+  // Deterministic, like every other mix.
+  char a[2048], b[2048];
+  CHECK(fault::ExpandChaos("seed=9:faults=5:mix=issue,part", 2, a, sizeof a));
+  CHECK(fault::ExpandChaos("seed=9:faults=5:mix=issue,part", 2, b, sizeof b));
+  CHECK(strcmp(a, b) == 0);
+  // A combined mix keeps per-domain windows disjoint but may overlap
+  // ACROSS domains (each has its own attempt stream).
+  for (uint64_t seed = 1; seed <= 20; seed++) {
+    char spec[64], out[2048];
+    snprintf(spec, sizeof spec, "seed=%llu:faults=8:mix=issue,part,kill",
+             (unsigned long long)seed);
+    CHECK(fault::ExpandChaos(spec, 3, out, sizeof out));
+    fault::Config cs[fault::kMaxSpecs];
+    int n = 0;
+    CHECK(fault::ParseSchedule(out, cs, fault::kMaxSpecs, &n));
+    CHECK(n == 8);
+    int kills = 0;
+    for (int i = 0; i < n; i++) {
+      if (cs[i].action == fault::Action::kKill) kills++;
+      for (int j = 0; j < i; j++) {
+        if (cs[i].rank != cs[j].rank || cs[i].op != cs[j].op) continue;
+        const bool overlap = cs[i].nth < cs[j].nth + cs[j].count &&
+                             cs[j].nth < cs[i].nth + cs[i].count;
+        CHECK(!overlap);
+      }
+    }
+    CHECK(kills <= 1);
+  }
+  RestorePolicy();
+  std::printf("expand_chaos_part: OK\n");
+}
+
 void test_expand_chaos() {
   char a[1024], b[1024];
   // Deterministic: same (seed, np) -> byte-identical schedule, forever.
@@ -570,7 +687,9 @@ int main(int argc, char** argv) {
   test_on_frame_window();
   test_parse_schedule();
   test_schedule_independent_windows();
+  test_part_domain();
   test_expand_chaos();
+  test_expand_chaos_part();
   test_kill_action();
   test_bad_env_aborts(argv[0]);
   test_policy_env_refused(argv[0]);

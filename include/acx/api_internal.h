@@ -31,6 +31,11 @@ struct MpixRequest {
   PartitionedChan* chan = nullptr;
   int partitions = 0;
   int* part_idx = nullptr;  // malloc'd array[partitions] of slot indices
+  // Recv side: per-round "first observed arrived" latches, so the
+  // parriveds_observed counter ticks once per (partition, round) no matter
+  // how often the app polls MPIX_Parrived. Reset by MPIX_Start; nullptr on
+  // the send side.
+  uint8_t* part_seen = nullptr;  // malloc'd array[partitions]
   bool started = false;
   // Graph-owned ops re-fire per launch and are reclaimed by the graph's
   // cleanup set, not by waits (reference SENDRECV vs SENDRECV_GRAPH kinds,
@@ -48,6 +53,7 @@ struct MpixPrequest {
   ReqKind kind = ReqKind::kPsend;
   int partitions = 0;
   int* part_idx = nullptr;  // borrowed from the owning MpixRequest
+  uint8_t* part_seen = nullptr;  // borrowed (recv side; see MpixRequest)
   PartitionedChan* chan = nullptr;
 };
 

@@ -31,6 +31,14 @@
 // Spec grammar: action[:key=value]...
 //   rank=R   inject only on rank R               (default: every rank)
 //   kind=K   send | recv | any (issue actions)   (default: any)
+//   op=part  issue actions only: target partitioned-op pushes (the proxy's
+//            Pready wire pushes, consulted via OnPartIssue) instead of
+//            plain send/recv issues. Partitioned pushes are a SEPARATE
+//            match domain with their own attempt stream: a plain spec
+//            never matches a partition push and vice versa, so arming
+//            `drop` in an existing soak cannot silently start eating
+//            Pready publishes (which have no retry ladder of their own —
+//            the proxy re-pushes them after the policy backoff instead).
 //   peer=P   only ops/frames to/from peer P      (default: any)
 //   subflow=S  only frames on striped subflow S (frame actions; subflow 0
 //              is the primary link — DESIGN.md §15)  (default: any)
@@ -49,14 +57,18 @@
 // first armed spec in schedule order fires and the rest only count.
 //   ACX_FAULT='drop:rank=0:nth=2;stall_link_ms:rank=1:nth=5:ms=40;kill:rank=2:nth=9'
 //
-// Seeded schedules: ACX_CHAOS=seed=N[:faults=K][:mix=issue,wire,kill]
+// Seeded schedules: ACX_CHAOS=seed=N[:faults=K][:mix=issue,wire,kill,part]
 // expands deterministically (splitmix64; same seed + same ACX_SIZE ==
 // same schedule, forever) into a K-spec schedule drawn from the named
 // classes — `issue` draws drop/delay (never fail: a seeded run must be
 // recoverable by construction), `wire` draws the four frame actions,
-// `kill` contributes at most ONE abrupt death per schedule. ACX_FAULT and
-// ACX_CHAOS compose additively. `acxrun -print-chaos SPEC` shows the
-// expansion; tools/acx_chaos.py replays and audits it.
+// `kill` contributes at most ONE abrupt death per schedule, and `part`
+// draws drop/delay with op=part (recoverable by the same construction:
+// a dropped Pready push is re-pushed after the policy backoff, a delayed
+// one is merely late — both exercise the receiver's arrival-deadline
+// machinery). ACX_FAULT and ACX_CHAOS compose additively.
+// `acxrun -print-chaos SPEC` shows the expansion; tools/acx_chaos.py
+// replays and audits it.
 #pragma once
 
 #include <atomic>
@@ -102,6 +114,7 @@ struct Config {
   Action action = Action::kNone;
   int rank = -1;   // -1 = any rank
   int kind = 0;    // 0 = any, 1 = send, 2 = recv
+  int op = 0;      // 0 = plain issue ops, 1 = partitioned pushes (op=part)
   int peer = -1;   // -1 = any peer
   int subflow = -1;  // -1 = any subflow (frame actions only)
   int nth = 1;     // 1-based index of the first matching attempt hit
@@ -157,6 +170,14 @@ void ConfigureSchedule(const Config* cfgs, int n);
 // kFail fills *err; kKill raises SIGKILL and does not return.
 Action OnIssue(int rank, bool is_send, int peer, uint64_t* delay_us,
                int* err);
+
+// Consult the plane for one partitioned-op push attempt (the proxy's
+// kPready sweep work; is_send is true there today — arrival polls are not
+// consulted, they are where the injected loss is OBSERVED). Only op=part
+// specs match here — a separate attempt stream from OnIssue, so `nth=`
+// stays a stable per-domain coordinate. Same action semantics as OnIssue.
+Action OnPartIssue(int rank, bool is_send, int peer, uint64_t* delay_us,
+                   int* err);
 
 // Consult the plane for one sequenced frame about to be written on subflow
 // `subflow` of peer's link. Only frame actions (kDropFrame..kCloseLink)

@@ -72,6 +72,12 @@ enum Counter : int {
   kFleetJoins,         // ranks that (re)joined after init
   kFleetLeaves,        // graceful departures observed
   kFleetDeaths,        // crash verdicts observed
+  kPreadysPublished,   // MPIX_Pready calls (app-level partition publishes;
+                       // ops_pready counts the proxy's wire pushes, which
+                       // lag under injected drop/delay)
+  kParrivedsObserved,  // partitions first observed arrived by MPIX_Parrived
+                       // (per round; repeated polls of an arrived partition
+                       // do not re-count)
   kNumCounters
 };
 

@@ -118,6 +118,14 @@ struct LinkScope {
   uint64_t tx_queue_frames = 0;   //   frames contributing to the sum
   uint64_t rx_transit_ns_sum = 0; // sender tx_ns -> local delivery, clamped
   uint64_t rx_transit_frames = 0; //   stamped data frames delivered
+
+  // Partitioned rounds (DESIGN.md §17): partitions currently in flight on
+  // this link — send partitions pushed but not yet drained by FinishRound,
+  // plus recv partitions posted but not yet arrived. A GAUGE, not a
+  // cumulative counter: it rises as a handoff round opens and must fall
+  // back to zero when the round closes, so a stalled handoff shows up as a
+  // pinned nonzero value in acx_top's pif column.
+  uint64_t part_inflight = 0;
 };
 
 class Transport {

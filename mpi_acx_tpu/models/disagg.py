@@ -1,0 +1,872 @@
+"""Disaggregated prefill/decode serving: per-layer KV handoff.
+
+Monolithic continuous batching (models/serving.py) runs prefill and
+decode on the same rank, so every prompt pass stalls the decode batch
+behind it — the interference disaggregation exists to remove. Here the
+fleet splits by role (``ACX_ROLE``): prefill ranks run the prompt pass
+and ship each layer's KV block THE MOMENT that layer finishes — one
+partitioned send per request, one partition per layer, MPIX_Pready
+fired from inside the layer loop while later layers still run — and
+decode ranks poll MPIX_Parrived, splice arriving pages into their slot
+caches through the same ``scatter_fn`` the monolithic server uses, and
+own token generation. The wire mechanics (packing, persistent
+channels, tags) live in parallel/kv_ship.py.
+
+Wire form (the EQuARX rule): int8 codes + f32 scales are the ONLY form
+KV takes on the wire, so decode slot caches are always the int8
+variant and a disagg serve is bit-equal to the monolithic
+``_serve(kv_int8=True)`` — for BOTH prefill-side cache variants
+(``prefill_kv_int8``): quantize-at-compute and quantize-at-wire
+produce identical bytes because prefill attention runs on the exact
+bf16 K/V either way and ops/kvquant.py is deterministic. Pinned by
+tests/test_disagg.py.
+
+Handoff protocol, per request (descriptor + one partitioned round):
+
+  prefill                           decode
+  -------                           ------
+  HDR isend {rid, prompt_len,
+             bucket}         ---->  irecv HDR; pick channel(peer,
+                                    bucket); MPIX_Start recv round
+  MPIX_Start send round
+  layer 0 compute; quant;
+  pack; Pready(0)            ---->  Parrived(0) -> splice layer 0
+  layer 1 ...                ---->  ... (arrival overlaps prefill
+  ...                               compute of later layers)
+  head -> first token
+  FIN isend {rid, first,
+             prefill_us}     ---->  irecv FIN; all layers arrived;
+  wait round                        wait round; scatter_fn -> slot
+                                    armed; decode takes over
+
+Failure semantics: a handoff that dies mid-round (peer loss, injected
+fault) is REQUEUED — the decode side discards the partial splice and
+re-arms for the re-shipped handoff; peer loss does not charge the
+request's retry budget (serving.py's ``_peer_dead`` rule). A respawned
+prefill rank re-ships every handoff it owns from scratch; the decode
+side discards duplicates of already-completed requests by rid, which
+makes the re-ship idempotent. The send side completes an aborted round
+by publishing its remaining partitions with stale staging bytes
+(``abort_fill``) so the persistent channel stays restartable.
+
+Telemetry: every handoff records the TTFT split — prefill-compute vs
+ship (publish -> last arrival) vs decode-pickup (unpack + scatter) —
+as ``HandoffTelemetry`` rows on ``DisaggMetrics.handoffs``;
+``overlap=False`` (ship only after the full prompt pass) is the
+baseline the bench compares against (bench.py disagg rows).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from mpi_acx_tpu.models.serving import (
+    RollingSLO, RequestTelemetry, ServedBatch, ServingMetrics, _bucket,
+    _flight_dump_best_effort, _pct, _peer_dead,
+    _span_app_begin_best_effort, _span_app_end_best_effort,
+    _tseries_annotate_best_effort, make_server_fns)
+from mpi_acx_tpu.parallel.kv_ship import (
+    DESC_FIN_TAG, DESC_HDR_TAG, KvReceiver, KvShipper)
+
+# Descriptor magics ("ACXH"/"ACXF"): a handoff stream that desyncs
+# (protocol bug, stale message from a dead incarnation) fails loudly at
+# the magic check instead of splicing garbage into a slot cache.
+_HDR_MAGIC = 0x41435848
+_FIN_MAGIC = 0x41435846
+
+
+def _hdr_wire(rid: int, prompt_len: int, bucket: int) -> np.ndarray:
+    return np.array([_HDR_MAGIC, rid, prompt_len, bucket], np.int64)
+
+
+def _fin_wire(rid: int, first_token: int, prefill_us: int,
+              expose_us: int) -> np.ndarray:
+    return np.array([_FIN_MAGIC, rid, first_token, prefill_us,
+                     expose_us], np.int64)
+
+
+def fleet_roles(size: int) -> List[str]:
+    """Role of every rank, from $ACX_ROLE.
+
+    Accepted forms (README knob table): a comma list mapping every rank
+    (``prefill,decode,decode`` — the form acxrun propagates, since all
+    ranks share one environment), a single role token (this rank's
+    role; the fleet map defaults to rank 0 = prefill, rest = decode and
+    the token must agree with it), or unset (loopback single-process
+    serving — no fleet)."""
+    spec = os.environ.get("ACX_ROLE", "").strip()
+    default = ["prefill"] + ["decode"] * max(size - 1, 0)
+    if not spec:
+        return default
+    if "," in spec:
+        roles = [t.strip() for t in spec.split(",") if t.strip()]
+        if len(roles) != size or any(r not in ("prefill", "decode")
+                                     for r in roles):
+            raise ValueError(
+                f"ACX_ROLE={spec!r}: need one prefill|decode per rank "
+                f"({size})")
+        if "prefill" not in roles or "decode" not in roles:
+            raise ValueError(
+                f"ACX_ROLE={spec!r}: need at least one prefill and one "
+                "decode rank")
+        return roles
+    if spec not in ("prefill", "decode"):
+        raise ValueError(f"ACX_ROLE={spec!r}: prefill|decode|comma-list")
+    return default
+
+
+@dataclass
+class HandoffTelemetry:
+    """One handoff's TTFT split (DisaggMetrics.handoffs row)."""
+
+    rid: int
+    layers: int
+    wire_bytes: int      # partitioned payload (codes + scales)
+    prefill_s: float     # embed -> first token (incl. per-layer publish)
+    ship_s: float        # FIN observed -> last partition arrived + round
+    pickup_s: float      # unpack/assemble -> scatter -> slot armed
+    overlap: bool        # per-layer Pready (True) vs ship-after-prefill
+    expose_s: float = 0.0  # publish time EXPOSED after the head — the
+    #                        wire cost overlap hides under compute (~0
+    #                        with per-layer Pready; the full serialized
+    #                        pack+publish without it)
+
+
+@dataclass
+class DisaggMetrics(ServingMetrics):
+    """ServingMetrics grown by the handoff rows of a disagg serve."""
+
+    handoffs: List[HandoffTelemetry] = field(default_factory=list)
+    handoff_prefill_p50_s: float = 0.0
+    handoff_ship_p50_s: float = 0.0
+    handoff_pickup_p50_s: float = 0.0
+
+
+def _finish_handoff_metrics(m: DisaggMetrics) -> DisaggMetrics:
+    m.handoff_prefill_p50_s = _pct([h.prefill_s for h in m.handoffs], 0.5)
+    m.handoff_ship_p50_s = _pct([h.ship_s for h in m.handoffs], 0.5)
+    m.handoff_pickup_p50_s = _pct([h.pickup_s for h in m.handoffs], 0.5)
+    return m
+
+
+def make_layerwise_prefill_fns(params, cfg, family=None):
+    """Per-layer prefill closures: (embed_fn, layer_fn, head_fn,
+    quant_fn). The layer loop is hoisted to the host so the caller can
+    publish layer l's KV the moment ``layer_fn`` returns — the
+    per-layer Pready the monolithic scan prefill structurally cannot
+    express. Each closure reuses the dense family's exact block pieces
+    (_qkv/_attend/_mlp, same primitive sequence as the scan body), so
+    the hoisted loop is bit-identical to ``family.prefill`` — logits,
+    codes, and scales (pinned by tests/test_disagg.py).
+
+    Only the dense transformer scaffold is supported (the layer
+    internals are family-specific; llama/MoE would need their own
+    block closures)."""
+    from mpi_acx_tpu.models import transformer as tfm
+    from mpi_acx_tpu.ops.kvquant import kv_quant
+    from mpi_acx_tpu.ops.wquant import wread
+    if family is not None and family is not tfm:
+        raise NotImplementedError(
+            "layerwise prefill: dense transformer family only")
+
+    @jax.jit
+    def embed_fn(tokens):
+        S = tokens.shape[1]
+        return (params["embed"][tokens]
+                + params["pos"][:S]).astype(cfg.dtype)
+
+    @jax.jit
+    def layer_fn(x, layer):
+        lp = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, layer, 0,
+                                               keepdims=False),
+            params["layers"])
+        q, k, v = tfm._qkv(cfg, lp, x)
+        x = x + tfm._attend(cfg, q, k, v) @ wread(lp, "wo", x.dtype)
+        return tfm._mlp(cfg, lp, x), k, v
+
+    @jax.jit
+    def head_fn(x, last_index):
+        x = tfm.layernorm(x, params["lnf_g"], params["lnf_b"])
+        x = lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)
+        return jnp.einsum("bsd,vd->bsv", x,
+                          params["embed"].astype(x.dtype),
+                          preferred_element_type=jnp.float32)
+
+    @jax.jit
+    def quant_fn(k, v):
+        kq, ks = kv_quant(k)
+        vq, vs = kv_quant(v)
+        return kq, ks, vq, vs
+
+    return embed_fn, layer_fn, head_fn, quant_fn
+
+
+def _prefill_ship(ch, pfns, cfg, padded, last_index, overlap,
+                  prefill_kv_int8, ship_fault=None, rid=0):
+    """Run the layerwise prompt pass, publishing layer l's partition as
+    it completes (``overlap``) or all partitions after the head
+    (the ship-after-full-prefill baseline). Returns (first_token,
+    prefill_s). The caller has already begun the channel round.
+
+    ``prefill_kv_int8`` picks the prefill-side cache variant:
+    quantize-at-compute (the prefill holds int8 codes, as a
+    kv_int8-serving prefill rank would) vs quantize-at-wire (bf16
+    staging, codes produced at pack time). Same wire bytes either way
+    — prefill attention uses the exact bf16 K/V in both, and the
+    quantizer is the single ops/kvquant.py definition.
+
+    ``ship_fault(rid, layer)`` is a test hook called before layer
+    ``layer``'s publish — raising from it models a prefill rank dying
+    mid-handoff (tests/test_disagg.py).
+
+    Returns (first_token, prefill_s, expose_s) — ``expose_s`` is the
+    publish time left EXPOSED after the head finished: ~0 with per-layer
+    overlap (everything already shipped under compute), the full
+    serialized pack+publish cost without it. The bench's overlap A/B
+    reads this off the FIN descriptor."""
+    embed_fn, layer_fn, head_fn, quant_fn = pfns
+    t0 = time.perf_counter()
+    x = embed_fn(padded)
+    staged = []
+    for layer in range(cfg.n_layers):
+        x, k, v = layer_fn(x, layer)
+        if prefill_kv_int8:
+            # quantize-at-compute: codes are the prefill's cache form.
+            kq, ks, vq, vs = (np.asarray(a) for a in quant_fn(k, v))
+        else:
+            # quantize-at-wire: bf16 staging until the pack.
+            kq = ks = vq = vs = None
+        if ship_fault is not None:
+            ship_fault(rid, layer)
+        if overlap:
+            if kq is None:
+                kq, ks, vq, vs = (np.asarray(a) for a in quant_fn(k, v))
+            ch.publish(layer, kq[0], ks[0], vq[0], vs[0])
+        else:
+            staged.append((kq, ks, vq, vs) if kq is not None else (k, v))
+    logits = head_fn(x, last_index)
+    first = int(jnp.argmax(logits[0, 0]))
+    t_head = time.perf_counter()
+    if not overlap:
+        for layer, st in enumerate(staged):
+            if len(st) == 2:
+                kq, ks, vq, vs = (np.asarray(a) for a in quant_fn(*st))
+            else:
+                kq, ks, vq, vs = st
+            ch.publish(layer, kq[0], ks[0], vq[0], vs[0])
+    t1 = time.perf_counter()
+    return first, t1 - t0, t1 - t_head
+
+
+def _splice_poll(ch, bucket, heads, head_dim, timeout_s=30.0):
+    """Poll every layer partition, splicing arrivals into the assembled
+    [L, 1, bucket, ...] host cache as they land (arrival order, not
+    layer order). Raises AcxTimeoutError past ``timeout_s`` — the
+    bound that keeps a decode rank from spinning forever on a prefill
+    rank that died before heartbeat detection."""
+    from mpi_acx_tpu.runtime import ERR_TIMEOUT, AcxTimeoutError
+    L = ch.geom.n_layers
+    kq = np.zeros((L, 1, bucket, heads, head_dim), np.int8)
+    vq = np.zeros_like(kq)
+    ks = np.zeros((L, 1, bucket, heads, 1), np.float32)
+    vs = np.zeros_like(ks)
+    pending = set(range(L))
+    deadline = time.monotonic() + timeout_s
+    while pending:
+        for layer in sorted(pending):
+            if ch.poll(layer):
+                lkq, lks, lvq, lvs = ch.take(layer)
+                kq[layer, 0] = lkq
+                ks[layer, 0] = lks
+                vq[layer, 0] = lvq
+                vs[layer, 0] = lvs
+                pending.discard(layer)
+        if pending and time.monotonic() > deadline:
+            raise AcxTimeoutError(
+                f"handoff: {len(pending)} layer partition(s) never "
+                f"arrived within {timeout_s}s", ERR_TIMEOUT, ch.geom.peer,
+                -1)
+    return {"k": kq, "ks": ks, "v": vq, "vs": vs}
+
+
+def _abort_rounds(send_ch, recv_ch, drain_s: float = 5.0) -> None:
+    """Close both ends of a failed handoff round so the persistent
+    channels stay restartable: the send side publishes its remaining
+    partitions with stale staging bytes, the recv side drains arrivals
+    (error-completed partitions read arrived too) and closes. Never
+    raises — this runs on the requeue path, where the original
+    exception is the one that matters."""
+    try:
+        if send_ch is not None and send_ch.open_round:
+            send_ch.abort_fill()
+            send_ch.finish()
+    except Exception:  # noqa: BLE001 — cleanup must not mask the cause
+        send_ch.open_round = False
+    try:
+        if recv_ch is not None and recv_ch.open_round:
+            deadline = time.monotonic() + drain_s
+            while (not all(recv_ch.poll(p)
+                           for p in range(recv_ch.geom.n_layers))
+                   and time.monotonic() < deadline):
+                pass
+            recv_ch.finish()
+    except Exception:  # noqa: BLE001 — cleanup must not mask the cause
+        recv_ch.open_round = False
+
+
+_loopback_runtime = None
+
+
+def _loopback_rt():
+    """Process-singleton loopback Runtime (rank 0 of 1) for the
+    single-process disagg mode; finalized at interpreter exit. A
+    caller running under acxrun passes its own Runtime instead."""
+    global _loopback_runtime
+    if _loopback_runtime is None:
+        import atexit
+
+        from mpi_acx_tpu.runtime import Runtime
+        _loopback_runtime = Runtime()
+        atexit.register(_loopback_runtime.finalize)
+    return _loopback_runtime
+
+
+def serve_disagg_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
+                        n_slots: int, max_len: int, family=None,
+                        eos: Optional[int] = None, chunk: int = 1,
+                        server_fns=None, prefill_kv_int8: bool = False,
+                        max_request_retries: int = 2, rt=None,
+                        overlap: bool = True,
+                        ship_fault: Optional[Callable] = None,
+                        poll_timeout_s: float = 30.0) -> ServedBatch:
+    """Disaggregated greedy serve. With $ACX_ROLE unset: loopback mode
+    — this process plays both roles against a self-channel, so the
+    full wire path (descriptors, partitioned round, per-layer Pready /
+    Parrived, splice) runs single-process; outputs are bit-equal to
+    the monolithic ``serve_greedy(..., kv_int8=True)``. With $ACX_ROLE
+    set (under acxrun): dispatches to this rank's role worker —
+    prefill ranks return an empty batch, decode ranks return their
+    requests' outputs (None rows elsewhere).
+
+    ``server_fns`` must be a ``make_server_fns(..., kv_int8=True)``
+    tuple — decode slots are always int8, the wire form.
+    ``prefill_kv_int8`` picks the prefill-side variant (see
+    ``_prefill_ship``); ``overlap=False`` ships only after the full
+    prompt pass (the bench baseline). ``ship_fault(rid, layer)`` is a
+    failure-injection hook (see ``_prefill_ship``)."""
+    roles = None
+    if os.environ.get("ACX_ROLE", "").strip():
+        if rt is None:
+            raise ValueError("fleet mode needs an explicit Runtime")
+        roles = fleet_roles(rt.size)
+        if roles[rt.rank] == "prefill":
+            run_prefill_worker(rt, params, cfg, prompts, max_len,
+                               family=family, overlap=overlap,
+                               prefill_kv_int8=prefill_kv_int8)
+            return ServedBatch([None] * len(prompts),
+                               _finish_handoff_metrics(DisaggMetrics()))
+        return run_decode_worker(
+            rt, params, cfg, prompts, n_new, n_slots, max_len,
+            family=family, eos=eos, chunk=chunk, server_fns=server_fns,
+            max_request_retries=max_request_retries,
+            poll_timeout_s=poll_timeout_s)
+    return _serve_disagg_loopback(
+        params, cfg, prompts, n_new, n_slots, max_len, family, eos,
+        chunk, server_fns, prefill_kv_int8, max_request_retries,
+        rt if rt is not None else _loopback_rt(), overlap, ship_fault,
+        poll_timeout_s)
+
+
+def _serve_disagg_loopback(params, cfg, prompts, n_new, n_slots, max_len,
+                           family, eos, chunk, server_fns,
+                           prefill_kv_int8, max_request_retries, rt,
+                           overlap, ship_fault, poll_timeout_s):
+    """Single-process disagg scheduler: models/serving.py's ``_serve``
+    with the refill path replaced by a real wire handoff (descriptor
+    exchange + partitioned round against the loopback transport). The
+    decode loop is byte-for-byte the monolithic one — that, plus the
+    wire carrying the exact int8 codes the monolithic fill would have
+    produced, is the bit-equality argument."""
+    if family is None:
+        from mpi_acx_tpu.models import transformer as family  # noqa: N813
+    assert prompts, "no requests"
+    assert all(len(p) > 0 for p in prompts), "zero-length prompt"
+    n_new = ([int(n_new)] * len(prompts) if np.ndim(n_new) == 0
+             else [int(n) for n in n_new])
+    assert len(n_new) == len(prompts), (len(n_new), len(prompts))
+    assert all(n >= 1 for n in n_new), "n_new >= 1 per request"
+    assert all(len(p) + n + chunk <= max_len
+               for p, n in zip(prompts, n_new)), "request exceeds max_len"
+    assert all(len(p) + n + chunk <= cfg.max_seq
+               for p, n in zip(prompts, n_new)), "request exceeds max_seq"
+
+    if server_fns is None:
+        server_fns = make_server_fns(params, cfg, family, chunk=chunk,
+                                     kv_int8=True)
+    (_, step_fn, scatter_fn, fns_chunk, fns_int8, fns_sample) = server_fns
+    assert fns_chunk == chunk, (fns_chunk, chunk)
+    assert fns_int8, "disagg decode slots are int8 (the wire form)"
+    assert fns_sample is None, "disagg serving is greedy-only for now"
+
+    pfns = make_layerwise_prefill_fns(params, cfg, family)
+    shipper = KvShipper(rt, cfg.n_layers, cfg.n_heads, cfg.head_dim)
+    receiver = KvReceiver(rt, cfg.n_layers, cfg.n_heads, cfg.head_dim)
+
+    slots = family.init_kv_cache(cfg, n_slots, max_len, kv_int8=True)
+    slots["pos"] = jnp.zeros((n_slots,), jnp.int32)
+    queue = deque(enumerate(np.asarray(p, np.int32) for p in prompts))
+    owner = [-1] * n_slots
+    emitted: List[List[int]] = [[] for _ in prompts]
+    done: List[Optional[np.ndarray]] = [None] * len(prompts)
+    last_tok = np.zeros((n_slots,), np.int32)
+    keys = jax.random.split(jax.random.key(0), n_slots)  # greedy dummies
+    attempts = [0] * len(prompts)
+
+    t0 = time.perf_counter()
+    ttft = [None] * len(prompts)
+    finish = [None] * len(prompts)
+    slo = RollingSLO()
+    itl_samples: List[float] = []
+    qd_samples: List[int] = []
+    occ_samples: List[float] = []
+    handoffs: List[HandoffTelemetry] = []
+    n_steps = n_prefills = n_requeues = n_peer_requeues = 0
+    n_hang_dumps = 0
+
+    def _requeue(rid, prompt, exc, charge=True):
+        nonlocal n_requeues, n_peer_requeues
+        if charge:
+            attempts[rid] += 1
+            if attempts[rid] > max_request_retries:
+                raise RuntimeError(
+                    f"request {rid} failed {attempts[rid]} time(s), past "
+                    f"max_request_retries={max_request_retries}") from exc
+        else:
+            n_peer_requeues += 1
+        emitted[rid] = []
+        ttft[rid] = None
+        n_requeues += 1
+        queue.append((rid, prompt))
+
+    def refill(b):
+        """Handoff-refill: prefill-side layer loop publishes into the
+        loopback self-channel, decode side splices and scatters —
+        the wire path the role-split fleet runs, serialized in one
+        process."""
+        nonlocal slots, n_prefills
+        rid, prompt = queue.popleft()
+        S = len(prompt)
+        bucket = min(_bucket(S), max_len, cfg.max_seq)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :S] = prompt
+        send_ch = shipper.channel(rt.rank, bucket)
+        recv_ch = receiver.channel(rt.rank, bucket)
+        spanned = _span_app_begin_best_effort(rid)
+        try:
+            # Descriptor header: recv posted first, both waited — the
+            # exchange is atomic, so a later handoff failure can never
+            # leave a dangling descriptor in the loopback stream.
+            hdr = np.zeros(4, np.int64)
+            hr = rt.irecv_enqueue(hdr, source=rt.rank, tag=DESC_HDR_TAG)
+            rt.wait(rt.isend_enqueue(_hdr_wire(rid, S, bucket),
+                                     dest=rt.rank, tag=DESC_HDR_TAG))
+            rt.wait(hr)
+            assert int(hdr[0]) == _HDR_MAGIC and int(hdr[1]) == rid, hdr
+            recv_ch.begin()
+            send_ch.begin()
+            first, prefill_s, expose_s = _prefill_ship(
+                send_ch, pfns, cfg, jnp.asarray(padded), S - 1, overlap,
+                prefill_kv_int8, ship_fault=ship_fault, rid=rid)
+            fin = np.zeros(5, np.int64)
+            fr = rt.irecv_enqueue(fin, source=rt.rank, tag=DESC_FIN_TAG)
+            rt.wait(rt.isend_enqueue(
+                _fin_wire(rid, first, int(prefill_s * 1e6),
+                          int(expose_s * 1e6)),
+                dest=rt.rank, tag=DESC_FIN_TAG))
+            rt.wait(fr)
+            assert int(fin[0]) == _FIN_MAGIC and int(fin[1]) == rid, fin
+            t_ship = time.perf_counter()
+            one = _splice_poll(recv_ch, bucket, cfg.n_heads,
+                               cfg.head_dim, timeout_s=poll_timeout_s)
+            send_ch.finish()
+            recv_ch.finish()
+            ship_s = time.perf_counter() - t_ship
+            t_pick = time.perf_counter()
+            one = {k: jnp.asarray(v) for k, v in one.items()}
+            slots = scatter_fn(slots, one, b, S)
+            pickup_s = time.perf_counter() - t_pick
+        except Exception as exc:  # noqa: BLE001 — any handoff failure
+            _abort_rounds(send_ch, recv_ch)
+            _requeue(rid, prompt, exc, charge=not _peer_dead(exc))
+            return False
+        finally:
+            if spanned:
+                _span_app_end_best_effort()
+        owner[b] = rid
+        emitted[rid].append(int(fin[2]))
+        last_tok[b] = int(fin[2])
+        n_prefills += 1
+        ttft[rid] = time.perf_counter() - t0
+        slo.note_ttft(ttft[rid])
+        handoffs.append(HandoffTelemetry(
+            rid=rid, layers=cfg.n_layers,
+            wire_bytes=cfg.n_layers * send_ch.geom.part_bytes,
+            prefill_s=prefill_s, ship_s=ship_s, pickup_s=pickup_s,
+            overlap=overlap, expose_s=expose_s))
+        return True
+
+    def retire(b):
+        nonlocal slots
+        rid = owner[b]
+        done[rid] = np.concatenate(
+            [np.asarray(prompts[rid], np.int32),
+             np.asarray(emitted[rid], np.int32)])
+        finish[rid] = time.perf_counter() - t0
+        owner[b] = -1
+        slots["pos"] = slots["pos"].at[b].set(0)
+
+    def slot_finished(b):
+        rid = owner[b]
+        return (len(emitted[rid]) >= n_new[rid]
+                or (eos is not None and emitted[rid]
+                    and emitted[rid][-1] == eos))
+
+    qd_samples.append(len(queue))
+    while queue and any(o == -1 for o in owner):
+        b = owner.index(-1)
+        if refill(b) and slot_finished(b):
+            retire(b)
+
+    while any(o >= 0 for o in owner) or queue:
+        qd_samples.append(len(queue))
+        occ_samples.append(sum(o >= 0 for o in owner) / n_slots)
+        slo.note_gauges(qd_samples[-1], occ_samples[-1])
+        _tseries_annotate_best_effort(slo.live_slos())
+        if not any(o >= 0 for o in owner):
+            while queue and any(o == -1 for o in owner):
+                b = owner.index(-1)
+                if refill(b) and slot_finished(b):
+                    retire(b)
+            continue
+        step_t0 = time.perf_counter()
+        try:
+            slots, toks, keys = step_fn(slots, jnp.asarray(last_tok), keys)
+        except Exception as exc:  # noqa: BLE001 — any device failure
+            lost_peer = _peer_dead(exc)
+            if _flight_dump_best_effort():
+                n_hang_dumps += 1
+            for b in range(n_slots):
+                if owner[b] >= 0:
+                    rid = owner[b]
+                    owner[b] = -1
+                    _requeue(rid, np.asarray(prompts[rid], np.int32),
+                             exc, charge=not lost_peer)
+            slots = family.init_kv_cache(cfg, n_slots, max_len,
+                                         kv_int8=True)
+            slots["pos"] = jnp.zeros((n_slots,), jnp.int32)
+            keys = jax.random.split(jax.random.key(0), n_slots)
+            last_tok = np.zeros((n_slots,), np.int32)
+            continue
+        block = np.asarray(toks, np.int32)
+        step_dt = time.perf_counter() - step_t0
+        n_steps += 1
+        for b in range(n_slots):
+            last_tok[b] = block[-1, b]
+            if owner[b] < 0:
+                continue
+            for c in range(block.shape[0]):
+                if slot_finished(b):
+                    break
+                emitted[owner[b]].append(int(block[c, b]))
+                itl_samples.append(step_dt / chunk)
+                slo.note_itl(step_dt / chunk)
+        for b in range(n_slots):
+            while owner[b] >= 0 and slot_finished(b):
+                retire(b)
+                if queue:
+                    refill(b)
+
+    assert all(d is not None for d in done)
+    shipper.close()
+    receiver.close()
+    wall = time.perf_counter() - t0
+    per_request = []
+    total_new = 0
+    for rid in range(len(prompts)):
+        nt = len(emitted[rid])
+        total_new += nt
+        lat = finish[rid] if finish[rid] is not None else wall
+        per_request.append(RequestTelemetry(
+            rid=rid,
+            ttft_s=ttft[rid] if ttft[rid] is not None else lat,
+            latency_s=lat, new_tokens=nt,
+            tokens_per_s=nt / lat if lat > 0 else 0.0,
+            retries=attempts[rid]))
+    metrics = DisaggMetrics(
+        requests=len(prompts), wall_s=wall, new_tokens=total_new,
+        tokens_per_s=total_new / wall if wall > 0 else 0.0,
+        steps=n_steps, prefills=n_prefills, requeues=n_requeues,
+        peer_requeues=n_peer_requeues, hang_dumps=n_hang_dumps,
+        ttft_p50_s=_pct([r.ttft_s for r in per_request], 0.50),
+        ttft_p99_s=_pct([r.ttft_s for r in per_request], 0.99),
+        itl_p50_s=_pct(itl_samples, 0.50),
+        itl_p99_s=_pct(itl_samples, 0.99),
+        queue_depth_max=max(qd_samples) if qd_samples else 0,
+        queue_depth_mean=(sum(qd_samples) / len(qd_samples)
+                          if qd_samples else 0.0),
+        slot_occupancy_mean=(sum(occ_samples) / len(occ_samples)
+                             if occ_samples else 1.0),
+        per_request=per_request, handoffs=handoffs)
+    return ServedBatch(done, _finish_handoff_metrics(metrics))
+
+
+# -- fleet-mode role workers (under acxrun, $ACX_ROLE set) -----------------
+
+
+def run_prefill_worker(rt, params, cfg, prompts, max_len, family=None,
+                       overlap: bool = True,
+                       prefill_kv_int8: bool = False) -> int:
+    """Prefill rank's loop: for every owned request (static map: rid ->
+    prefill rank ``rid % n_prefill``, decode rank ``rid % n_decode``),
+    run the layerwise prompt pass and ship it. A respawned incarnation
+    of this rank simply reruns the loop from rid 0 — re-shipping is
+    idempotent because the decode side discards duplicates by rid.
+    Returns the number of handoffs shipped."""
+    roles = fleet_roles(rt.size)
+    prefill_ranks = [r for r, ro in enumerate(roles) if ro == "prefill"]
+    decode_ranks = [r for r, ro in enumerate(roles) if ro == "decode"]
+    me = prefill_ranks.index(rt.rank)
+    pfns = make_layerwise_prefill_fns(params, cfg, family)
+    shipper = KvShipper(rt, cfg.n_layers, cfg.n_heads, cfg.head_dim)
+    shipped = 0
+    for rid, prompt in enumerate(prompts):
+        if rid % len(prefill_ranks) != me:
+            continue
+        dst = decode_ranks[rid % len(decode_ranks)]
+        prompt = np.asarray(prompt, np.int32)
+        S = len(prompt)
+        bucket = min(_bucket(S), max_len, cfg.max_seq)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :S] = prompt
+        ch = shipper.channel(dst, bucket)
+        spanned = _span_app_begin_best_effort(rid)
+        try:
+            rt.wait(rt.isend_enqueue(_hdr_wire(rid, S, bucket), dest=dst,
+                                     tag=DESC_HDR_TAG))
+            ch.begin()
+            first, prefill_s, expose_s = _prefill_ship(
+                ch, pfns, cfg, jnp.asarray(padded), S - 1, overlap,
+                prefill_kv_int8, rid=rid)
+            rt.wait(rt.isend_enqueue(
+                _fin_wire(rid, first, int(prefill_s * 1e6),
+                          int(expose_s * 1e6)), dest=dst,
+                tag=DESC_FIN_TAG))
+            ch.finish()
+            shipped += 1
+        finally:
+            if spanned:
+                _span_app_end_best_effort()
+    shipper.close()
+    return shipped
+
+
+def run_decode_worker(rt, params, cfg, prompts, n_new, n_slots, max_len,
+                      family=None, eos=None, chunk: int = 1,
+                      server_fns=None, max_request_retries: int = 2,
+                      poll_timeout_s: float = 30.0) -> ServedBatch:
+    """Decode rank's loop: consume handoffs from the prefill rank,
+    splice them into slot caches, and generate. Returns a ServedBatch
+    with this rank's requests filled in (None rows elsewhere).
+
+    Failure semantics: a handoff that dies mid-flight (prefill rank
+    killed) raises out of the intake; the request is requeued —
+    UNCHARGED when the failure is peer-loss shaped — and satisfied by
+    the respawned prefill rank's re-ship. Handoffs for already-retired
+    rids (the re-ship's duplicates) are drained and discarded."""
+    if family is None:
+        from mpi_acx_tpu.models import transformer as family  # noqa: N813
+    roles = fleet_roles(rt.size)
+    prefill_ranks = [r for r, ro in enumerate(roles) if ro == "prefill"]
+    decode_ranks = [r for r, ro in enumerate(roles) if ro == "decode"]
+    assert len(prefill_ranks) == 1, \
+        "decode worker handles a single prefill rank for now"
+    src = prefill_ranks[0]
+    n_new = ([int(n_new)] * len(prompts) if np.ndim(n_new) == 0
+             else [int(n) for n in n_new])
+    my_rids = [rid for rid in range(len(prompts))
+               if decode_ranks[rid % len(decode_ranks)] == rt.rank]
+
+    if server_fns is None:
+        server_fns = make_server_fns(params, cfg, family, chunk=chunk,
+                                     kv_int8=True)
+    (_, step_fn, scatter_fn, fns_chunk, fns_int8, fns_sample) = server_fns
+    assert fns_chunk == chunk and fns_int8 and fns_sample is None
+
+    receiver = KvReceiver(rt, cfg.n_layers, cfg.n_heads, cfg.head_dim)
+    slots = family.init_kv_cache(cfg, n_slots, max_len, kv_int8=True)
+    slots["pos"] = jnp.zeros((n_slots,), jnp.int32)
+    owner = [-1] * n_slots
+    emitted = {rid: [] for rid in my_rids}
+    done: List[Optional[np.ndarray]] = [None] * len(prompts)
+    last_tok = np.zeros((n_slots,), np.int32)
+    keys = jax.random.split(jax.random.key(0), n_slots)
+    attempts = {rid: 0 for rid in my_rids}
+    pending = set(my_rids)       # not yet retired
+    seated = set()               # currently owning a slot
+
+    t0 = time.perf_counter()
+    ttft = {rid: None for rid in my_rids}
+    finish = {rid: None for rid in my_rids}
+    handoffs: List[HandoffTelemetry] = []
+    itl_samples: List[float] = []
+    n_steps = n_prefills = n_requeues = n_peer_requeues = 0
+    n_hang_dumps = 0
+
+    def _note_failure(rid, exc):
+        nonlocal n_requeues, n_peer_requeues
+        charge = not _peer_dead(exc)
+        if charge:
+            attempts[rid] += 1
+            if attempts[rid] > max_request_retries:
+                raise RuntimeError(
+                    f"request {rid} failed {attempts[rid]} time(s), past "
+                    f"max_request_retries={max_request_retries}") from exc
+        else:
+            n_peer_requeues += 1
+        emitted[rid] = []
+        ttft[rid] = None
+        n_requeues += 1
+
+    def intake(b) -> bool:
+        """Consume the next inbound handoff. Seats it in slot ``b`` and
+        returns True; returns False for a discarded duplicate or a
+        failed handoff (requeued — the re-ship will satisfy it)."""
+        nonlocal slots, n_prefills
+        hdr = np.zeros(4, np.int64)
+        recv_ch = None
+        rid = -1
+        try:
+            rt.wait(rt.irecv_enqueue(hdr, source=src, tag=DESC_HDR_TAG))
+            assert int(hdr[0]) == _HDR_MAGIC, hdr
+            rid, S, bucket = int(hdr[1]), int(hdr[2]), int(hdr[3])
+            recv_ch = receiver.channel(src, bucket)
+            recv_ch.begin()
+            one = _splice_poll(recv_ch, bucket, cfg.n_heads,
+                               cfg.head_dim, timeout_s=poll_timeout_s)
+            fin = np.zeros(5, np.int64)
+            rt.wait(rt.irecv_enqueue(fin, source=src, tag=DESC_FIN_TAG))
+            assert (int(fin[0]) == _FIN_MAGIC
+                    and int(fin[1]) == rid), (fin, rid)
+            recv_ch.finish()
+            if rid not in pending or rid in seated:
+                return False      # re-ship duplicate: drained, dropped
+            t_pick = time.perf_counter()
+            one = {k: jnp.asarray(v) for k, v in one.items()}
+            slots = scatter_fn(slots, one, b, S)
+            pickup_s = time.perf_counter() - t_pick
+        except Exception as exc:  # noqa: BLE001 — any handoff failure
+            nonlocal n_hang_dumps
+            # Snapshot the comm plane before healing: the flight dump
+            # is the evidence trail acx_doctor (and the chaos oracle's
+            # doctor_verdict audit) attributes the dead link from.
+            if n_hang_dumps == 0 and _flight_dump_best_effort():
+                n_hang_dumps += 1
+            _abort_rounds(None, recv_ch)
+            if rid in pending and rid not in seated:
+                _note_failure(rid, exc)
+            elif rid < 0 and not _peer_dead(exc):
+                raise
+            return False
+        owner[b] = rid
+        seated.add(rid)
+        first = int(fin[2])
+        emitted[rid].append(first)
+        last_tok[b] = first
+        n_prefills += 1
+        ttft[rid] = time.perf_counter() - t0
+        handoffs.append(HandoffTelemetry(
+            rid=rid, layers=cfg.n_layers,
+            wire_bytes=cfg.n_layers * recv_ch.geom.part_bytes,
+            prefill_s=int(fin[3]) / 1e6, ship_s=0.0, pickup_s=pickup_s,
+            overlap=True, expose_s=int(fin[4]) / 1e6))
+        return True
+
+    def retire(b):
+        nonlocal slots
+        rid = owner[b]
+        done[rid] = np.concatenate(
+            [np.asarray(prompts[rid], np.int32),
+             np.asarray(emitted[rid], np.int32)])
+        finish[rid] = time.perf_counter() - t0
+        pending.discard(rid)
+        seated.discard(rid)
+        owner[b] = -1
+        slots["pos"] = slots["pos"].at[b].set(0)
+
+    def slot_finished(b):
+        rid = owner[b]
+        return (len(emitted[rid]) >= n_new[rid]
+                or (eos is not None and emitted[rid]
+                    and emitted[rid][-1] == eos))
+
+    while pending:
+        # Seat inbound handoffs on every free slot before stepping.
+        while (len(seated) < len(pending)
+               and any(o == -1 for o in owner)):
+            b = owner.index(-1)
+            if intake(b) and slot_finished(b):
+                retire(b)
+        if not any(o >= 0 for o in owner):
+            continue
+        step_t0 = time.perf_counter()
+        slots, toks, keys = step_fn(slots, jnp.asarray(last_tok), keys)
+        block = np.asarray(toks, np.int32)
+        step_dt = time.perf_counter() - step_t0
+        n_steps += 1
+        for b in range(n_slots):
+            last_tok[b] = block[-1, b]
+            if owner[b] < 0:
+                continue
+            for c in range(block.shape[0]):
+                if slot_finished(b):
+                    break
+                emitted[owner[b]].append(int(block[c, b]))
+                itl_samples.append(step_dt / chunk)
+        for b in range(n_slots):
+            if owner[b] >= 0 and slot_finished(b):
+                retire(b)
+
+    receiver.close()
+    wall = time.perf_counter() - t0
+    per_request = []
+    total_new = 0
+    for rid in my_rids:
+        nt = len(emitted[rid])
+        total_new += nt
+        lat = finish[rid] if finish[rid] is not None else wall
+        per_request.append(RequestTelemetry(
+            rid=rid, ttft_s=ttft[rid] if ttft[rid] is not None else lat,
+            latency_s=lat, new_tokens=nt,
+            tokens_per_s=nt / lat if lat > 0 else 0.0,
+            retries=attempts[rid]))
+    metrics = DisaggMetrics(
+        requests=len(my_rids), wall_s=wall, new_tokens=total_new,
+        tokens_per_s=total_new / wall if wall > 0 else 0.0,
+        steps=n_steps, prefills=n_prefills, requeues=n_requeues,
+        peer_requeues=n_peer_requeues, hang_dumps=n_hang_dumps,
+        ttft_p50_s=_pct([r.ttft_s for r in per_request], 0.50),
+        ttft_p99_s=_pct([r.ttft_s for r in per_request], 0.99),
+        itl_p50_s=_pct(itl_samples, 0.50),
+        itl_p99_s=_pct(itl_samples, 0.99),
+        per_request=per_request, handoffs=handoffs)
+    return ServedBatch(done, _finish_handoff_metrics(metrics))

@@ -352,12 +352,16 @@ int PartitionedInit(bool is_send, void* buf, int partitions, MPI_Count count,
   req->partitions = partitions;
   req->part_idx =
       static_cast<int*>(std::malloc(sizeof(int) * partitions));
+  if (!is_send)
+    req->part_seen =
+        static_cast<uint8_t*>(std::calloc(partitions, sizeof(uint8_t)));
   // One flag slot per partition (reference partitioned.cu:61-68,105-112).
   for (int p = 0; p < partitions; p++) {
     const int idx = g.table->Allocate();
     if (idx < 0) {
       for (int q = 0; q < p; q++) g.table->Free(req->part_idx[q]);
       std::free(req->part_idx);
+      std::free(req->part_seen);
       std::free(req);
       delete chan;
       return kErr;
@@ -554,6 +558,7 @@ int MPIX_Prequest_create(MPIX_Request request, MPIX_Prequest* prequest) {
   preq->kind = req->kind;
   preq->partitions = req->partitions;
   preq->part_idx = req->part_idx;  // borrowed
+  preq->part_seen = req->part_seen;  // borrowed
   preq->chan = req->chan;
   *prequest = preq;
   return MPI_SUCCESS;
@@ -589,6 +594,7 @@ int MPIX_Start(MPIX_Request* request) {
       const uint64_t t = Policy().timeout_ns.load(std::memory_order_relaxed);
       op.deadline_ns = t != 0 ? NowNs() + t : 0;
       op.status = Status{};
+      if (req->part_seen != nullptr) req->part_seen[p] = 0;
       g.table->Store(req->part_idx[p], kIssued);
     }
     g.proxy->Kick();
@@ -638,6 +644,7 @@ int MPIX_Request_free(MPIX_Request* request) {
   for (int p = 0; p < req->partitions; p++) g.table->Free(req->part_idx[p]);
   delete req->chan;
   std::free(req->part_idx);
+  std::free(req->part_seen);
   std::free(req);
   *request = MPIX_REQUEST_NULL;
   return MPI_SUCCESS;
@@ -666,6 +673,7 @@ int MPIX_Pready(int partition, void* request) {
     op.watch_stage = 0;
   }
   g.table->Store(part_idx[partition], kPending);
+  if (metrics::Enabled()) metrics::Add(metrics::kPreadysPublished, 1);
   ACX_TRACE_EVENT("pready_marked", part_idx[partition]);
   {
     const Op& op = g.table->op(part_idx[partition]);
@@ -680,18 +688,25 @@ int MPIX_Parrived(void* request, int partition, int* flag) {
   ApiState& g = GS();
   Resolved h = ResolveHandle(request);
   int* part_idx = nullptr;
+  uint8_t* seen = nullptr;
   int partitions = 0;
   if (h.req != nullptr && h.req->kind == ReqKind::kPrecv) {
     part_idx = h.req->part_idx;
+    seen = h.req->part_seen;
     partitions = h.req->partitions;
   } else if (h.preq != nullptr && h.preq->kind == ReqKind::kPrecv) {
     part_idx = h.preq->part_idx;
+    seen = h.preq->part_seen;
     partitions = h.preq->partitions;
   } else {
     return kErr;
   }
   if (partition < 0 || partition >= partitions || flag == nullptr) return kErr;
   *flag = g.table->Load(part_idx[partition]) == kCompleted ? 1 : 0;
+  if (*flag != 0 && seen != nullptr && seen[partition] == 0) {
+    seen[partition] = 1;
+    if (metrics::Enabled()) metrics::Add(metrics::kParrivedsObserved, 1);
+  }
   return MPI_SUCCESS;
 }
 

@@ -173,8 +173,13 @@ State& S() {
   return *s;
 }
 
-bool PassesIssueFilters(const Config& c, int rank, bool is_send, int peer) {
+// `want_part` selects the match domain: plain issue attempts (OnIssue,
+// op=0 specs) vs partitioned pushes (OnPartIssue, op=part specs). The two
+// domains never cross-match — see the op=part note in acx/fault.h.
+bool PassesIssueFilters(const Config& c, int rank, bool is_send, int peer,
+                        bool want_part) {
   if (c.action == Action::kNone || IsFrameAction(c.action)) return false;
+  if ((c.op != 0) != want_part) return false;
   if (c.rank >= 0 && rank != c.rank) return false;
   if (c.kind == 1 && !is_send) return false;
   if (c.kind == 2 && is_send) return false;
@@ -265,6 +270,10 @@ bool ParseSpec(const char* spec, Config* out) {
       else if (strcmp(val, "recv") == 0) c.kind = 2;
       else if (strcmp(val, "any") == 0) c.kind = 0;
       else return false;
+    } else if (strcmp(tok, "op") == 0) {
+      if (strcmp(val, "part") == 0) c.op = 1;
+      else if (strcmp(val, "plain") == 0) c.op = 0;
+      else return false;
     } else {
       return false;
     }
@@ -272,6 +281,10 @@ bool ParseSpec(const char* spec, Config* out) {
   if (c.nth < 1 || c.count < 1) return false;
   // A zero-length stall is a typo, not a fault: reject like nth=0.
   if (c.action == Action::kStallLink && c.stall_ms < 1) return false;
+  // op=part names the partitioned-push domain, which only issue-level
+  // actions (OnPartIssue) ever consult — on a frame action it is a typo.
+  if (c.op != 0 && (IsFrameAction(c.action) || c.action == Action::kNone))
+    return false;
   *out = c;
   return true;
 }
@@ -322,6 +335,7 @@ int FormatSpec(const Config& c, char* buf, size_t cap) {
   if (c.rank >= 0 && !put("rank", c.rank)) return -1;
   if (c.kind == 1 && !puts_(":kind=send")) return -1;
   if (c.kind == 2 && !puts_(":kind=recv")) return -1;
+  if (c.op == 1 && !puts_(":op=part")) return -1;
   if (c.peer >= 0 && !put("peer", c.peer)) return -1;
   if (c.subflow >= 0 && !put("subflow", c.subflow)) return -1;
   if (c.nth != 1 && !put("nth", c.nth)) return -1;
@@ -342,7 +356,8 @@ bool ExpandChaos(const char* spec, int np, char* out, size_t cap) {
   uint64_t seed = 0;
   bool have_seed = false;
   int faults = 3;
-  bool mix_issue = false, mix_wire = false, mix_kill = false, have_mix = false;
+  bool mix_issue = false, mix_wire = false, mix_kill = false,
+       mix_part = false, have_mix = false;
   const char* p = spec;
   char tok[96];
   while (*p != '\0') {
@@ -377,6 +392,7 @@ bool ExpandChaos(const char* spec, int np, char* out, size_t cap) {
         if (len == 5 && strncmp(q, "issue", 5) == 0) mix_issue = true;
         else if (len == 4 && strncmp(q, "wire", 4) == 0) mix_wire = true;
         else if (len == 4 && strncmp(q, "kill", 4) == 0) mix_kill = true;
+        else if (len == 4 && strncmp(q, "part", 4) == 0) mix_part = true;
         else return false;
         q = comma != nullptr ? comma + 1 : q + len;
       }
@@ -386,7 +402,7 @@ bool ExpandChaos(const char* spec, int np, char* out, size_t cap) {
   }
   if (!have_seed) return false;
   if (!have_mix) mix_issue = mix_wire = true;
-  if (!mix_issue && !mix_wire && !mix_kill) return false;
+  if (!mix_issue && !mix_wire && !mix_kill && !mix_part) return false;
 
   // splitmix64: fixed-width, overflow-defined, identical on every
   // platform — the whole point is `acxrun -print-chaos` and every rank
@@ -402,11 +418,12 @@ bool ExpandChaos(const char* spec, int np, char* out, size_t cap) {
     return z;
   };
 
-  int classes[3];
+  int classes[4];
   int ncls = 0;
   if (mix_issue) classes[ncls++] = 0;
   if (mix_wire) classes[ncls++] = 1;
   if (mix_kill) classes[ncls++] = 2;
+  if (mix_part) classes[ncls++] = 3;
   bool kill_used = false;
   size_t off = 0;
   // Trigger windows already handed out, per (rank, match domain). Two
@@ -431,7 +448,8 @@ bool ExpandChaos(const char* spec, int np, char* out, size_t cap) {
     int cls = classes[i % ncls];
     // At most ONE abrupt death per schedule: a second kill would race the
     // first victim's respawn and make the run order-dependent.
-    if (cls == 2 && kill_used) cls = mix_wire ? 1 : (mix_issue ? 0 : 1);
+    if (cls == 2 && kill_used)
+      cls = mix_wire ? 1 : (mix_issue ? 0 : (mix_part ? 3 : 1));
     Config c;
     c.rank = static_cast<int>(rnd() % static_cast<uint64_t>(np));
     c.nth = 2 + static_cast<int>(rnd() % 10);
@@ -450,6 +468,14 @@ bool ExpandChaos(const char* spec, int np, char* out, size_t cap) {
       c.action = kWire[rnd() % 4];
       if (c.action == Action::kStallLink) c.stall_ms = 10 + rnd() % 40;
       if (c.action == Action::kCloseLink) c.count = 1;
+    } else if (cls == 3) {
+      // Partitioned-push domain (op=part): recoverable by the same
+      // construction as `issue` — a dropped Pready push is re-pushed
+      // after the policy backoff, a delayed one is merely late.
+      const uint64_t pick = rnd() % 3;
+      c.action = pick < 2 ? Action::kDrop : Action::kDelay;
+      if (c.action == Action::kDelay) c.delay_us = 500 + rnd() % 4500;
+      c.op = 1;
     } else {
       c.action = Action::kKill;
       c.count = 1;
@@ -461,7 +487,10 @@ bool ExpandChaos(const char* spec, int np, char* out, size_t cap) {
     // right after the occupied region. All rolls come from the seeded
     // stream, so the schedule stays identical for a given (seed, np).
     {
-      const int domain = IsFrameAction(c.action) ? 1 : 0;
+      // Three disjoint match domains, three independent window spaces:
+      // issue-level (OnIssue), wire-level (OnFrame), partitioned
+      // (OnPartIssue, op=part).
+      const int domain = IsFrameAction(c.action) ? 1 : (c.op != 0 ? 2 : 0);
       const int base = c.action == Action::kKill ? 4 : 2;
       const int range = c.action == Action::kKill ? 8 : 10;
       for (int t = 0; t < 16 && overlaps(c.rank, domain, c.nth,
@@ -493,21 +522,12 @@ void Configure(const Config& cfg) { ConfigureSchedule(&cfg, 1); }
 
 void ConfigureSchedule(const Config* cfgs, int n) { Install(&S(), cfgs, n); }
 
-Action OnIssue(int rank, bool is_send, int peer, uint64_t* delay_us,
-               int* err) {
-  State& s = S();
-  const int n = s.nspecs.load(std::memory_order_relaxed);
-  int winner = -1;
-  for (int i = 0; i < n; i++) {
-    SpecState& sp = s.specs[i];
-    if (!PassesIssueFilters(sp.cfg, rank, is_send, peer)) continue;
-    const uint64_t m = sp.matched.fetch_add(1, std::memory_order_relaxed) + 1;
-    // Every matching spec counts this attempt (its nth= coordinate must
-    // advance even while another spec fires); the FIRST in-window spec in
-    // schedule order supplies the action.
-    if (winner < 0 && InWindow(sp.cfg, m)) winner = i;
-  }
-  if (winner < 0) return Action::kNone;
+namespace {
+
+// Shared firing tail of OnIssue/OnPartIssue: the two entry points differ
+// only in which match domain their filter admits.
+Action FireIssueWinner(State& s, int winner, int rank, uint64_t* delay_us,
+                       int* err) {
   SpecState& sp = s.specs[winner];
   const Config& c = sp.cfg;
   sp.fired.fetch_add(1, std::memory_order_relaxed);
@@ -538,6 +558,41 @@ Action OnIssue(int rank, bool is_send, int peer, uint64_t* delay_us,
       break;
   }
   return c.action;
+}
+
+}  // namespace
+
+Action OnIssue(int rank, bool is_send, int peer, uint64_t* delay_us,
+               int* err) {
+  State& s = S();
+  const int n = s.nspecs.load(std::memory_order_relaxed);
+  int winner = -1;
+  for (int i = 0; i < n; i++) {
+    SpecState& sp = s.specs[i];
+    if (!PassesIssueFilters(sp.cfg, rank, is_send, peer, false)) continue;
+    const uint64_t m = sp.matched.fetch_add(1, std::memory_order_relaxed) + 1;
+    // Every matching spec counts this attempt (its nth= coordinate must
+    // advance even while another spec fires); the FIRST in-window spec in
+    // schedule order supplies the action.
+    if (winner < 0 && InWindow(sp.cfg, m)) winner = i;
+  }
+  if (winner < 0) return Action::kNone;
+  return FireIssueWinner(s, winner, rank, delay_us, err);
+}
+
+Action OnPartIssue(int rank, bool is_send, int peer, uint64_t* delay_us,
+                   int* err) {
+  State& s = S();
+  const int n = s.nspecs.load(std::memory_order_relaxed);
+  int winner = -1;
+  for (int i = 0; i < n; i++) {
+    SpecState& sp = s.specs[i];
+    if (!PassesIssueFilters(sp.cfg, rank, is_send, peer, true)) continue;
+    const uint64_t m = sp.matched.fetch_add(1, std::memory_order_relaxed) + 1;
+    if (winner < 0 && InWindow(sp.cfg, m)) winner = i;
+  }
+  if (winner < 0) return Action::kNone;
+  return FireIssueWinner(s, winner, rank, delay_us, err);
 }
 
 Action OnFrame(int rank, int peer, int subflow, uint64_t* stall_us) {
@@ -621,10 +676,10 @@ int WriteReport(int rank) {
     if (FormatSpec(c, sbuf, sizeof sbuf) < 0) sbuf[0] = '\0';
     std::fprintf(f,
                  "%s\n {\"spec\":\"%s\",\"action\":\"%s\",\"rank\":%d,"
-                 "\"kind\":%d,\"peer\":%d,\"subflow\":%d,\"nth\":%d,"
+                 "\"kind\":%d,\"op\":%d,\"peer\":%d,\"subflow\":%d,\"nth\":%d,"
                  "\"count\":%d,\"matched\":%llu,\"fired\":%llu}",
                  i > 0 ? "," : "", sbuf, ActionName(c.action), c.rank,
-                 c.kind, c.peer, c.subflow, c.nth, c.count,
+                 c.kind, c.op, c.peer, c.subflow, c.nth, c.count,
                  (unsigned long long)s.specs[i].matched.load(
                      std::memory_order_relaxed),
                  (unsigned long long)s.specs[i].fired.load(

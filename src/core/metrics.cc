@@ -26,6 +26,7 @@ const char* const kCounterName[] = {
     "proxy_busy_ns",   "proxy_idle_ns",  "reconnects",     "frames_replayed",
     "crc_rejects",     "naks_sent",      "drained_slots",  "fleet_epoch",
     "fleet_joins",     "fleet_leaves",   "fleet_deaths",
+    "preadys_published", "parriveds_observed",
 };
 
 const char* const kHistName[] = {

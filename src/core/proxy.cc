@@ -265,19 +265,76 @@ bool Proxy::Sweep() {
           case OpKind::kIrecv:
             progressed |= IssueOp(i, op, local, /*from_pending=*/true);
             break;
-          case OpKind::kPready:
+          case OpKind::kPready: {
             // Send-side partition became ready (host call or device-mirrored
             // flag write): push it to the wire and complete the slot.
-            op.chan->Pready(op.partition);
-            ACX_FLIGHT_SPAN(kPreadyWire, i, op.peer, op.tag, 0, op.partition,
-                            op.span);
-            table_->Store(i, kCompleted);
-            ACX_TRACE_SPAN("pready_wire", i, op.span);
-            if (metrics::Enabled())
-              metrics::Add(metrics::kOpsPready, 1);
-            local.ops_completed++;
-            progressed = true;
+            //
+            // Partition-push fault gate (op=part specs, acx/fault.h): a
+            // DELAYED push is held in PENDING until the gate opens; a
+            // DROPPED push is swallowed and held for the policy backoff
+            // (Pready has no ticket, so the plain retry ladder never
+            // polices it — the hold IS its re-push path, and the late
+            // partition exercises the receiver's arrival deadline). The
+            // reopened push goes out WITHOUT re-consulting: one fault, one
+            // hold. FAIL error-completes the partition slot; the waiter
+            // surfaces it from HostWaitPartitioned.
+            bool push = true;
+            if (op.not_before_ns != 0) {
+              if (NowNs() < op.not_before_ns) {
+                push = false;
+              } else {
+                op.not_before_ns = 0;
+              }
+            } else if (fault::Enabled()) {
+              uint64_t delay_us = 0;
+              int err = 0;
+              const fault::Action a = fault::OnPartIssue(
+                  transport_->rank(), /*is_send=*/true, op.peer, &delay_us,
+                  &err);
+              if (a == fault::Action::kDelay) {
+                op.not_before_ns = NowNs() + delay_us * 1000;
+                push = false;
+                progressed = true;
+                ACX_TRACE_EVENT("fault_delay", i);
+                ACX_FLIGHT(kOpFault, i, op.peer, op.tag, 0,
+                           (int16_t)fault::Action::kDelay);
+              } else if (a == fault::Action::kDrop) {
+                uint64_t b =
+                    Policy().backoff_us.load(std::memory_order_relaxed);
+                if (b < 1) b = 1;
+                op.not_before_ns = NowNs() + b * 1000;
+                push = false;
+                progressed = true;
+                ACX_TRACE_EVENT("fault_drop", i);
+                ACX_FLIGHT(kOpFault, i, op.peer, op.tag, 0,
+                           (int16_t)fault::Action::kDrop);
+              } else if (a == fault::Action::kFail) {
+                op.status = Status{op.peer, op.tag, err, 0};
+                ACX_FLIGHT(kOpFault, i, op.peer, op.tag, 0,
+                           (int16_t)fault::Action::kFail);
+                ACX_FLIGHT_SPAN(kOpCompleted, i, op.peer, op.tag, 0, err,
+                                op.span);
+                table_->Store(i, kCompleted);
+                ACX_TRACE_SPAN("fault_fail", i, op.span);
+                if (metrics::Enabled()) metrics::MarkComplete(i);
+                local.ops_completed++;
+                push = false;
+                progressed = true;
+              }
+            }
+            if (push) {
+              op.chan->Pready(op.partition);
+              ACX_FLIGHT_SPAN(kPreadyWire, i, op.peer, op.tag, 0,
+                              op.partition, op.span);
+              table_->Store(i, kCompleted);
+              ACX_TRACE_SPAN("pready_wire", i, op.span);
+              if (metrics::Enabled())
+                metrics::Add(metrics::kOpsPready, 1);
+              local.ops_completed++;
+              progressed = true;
+            }
             break;
+          }
           default:
             std::fprintf(stderr,
                          "tpu-acx proxy: invalid PENDING op kind %d slot %zu\n",

@@ -125,7 +125,7 @@ void AppendLinks(std::string* out, Transport* t) {
           "\"tx_wb\":%llu,\"rx_pb\":%llu,\"rx_wb\":%llu,\"tx_fr\":%llu,"
           "\"rx_fr\":%llu,\"naks\":%llu,\"crc\":%llu,\"replayed\":%llu,"
           "\"txq_ns\":%llu,\"txq_fr\":%llu,\"rxt_ns\":%llu,"
-          "\"rxt_fr\":%llu}",
+          "\"rxt_fr\":%llu,\"pif\":%llu}",
           p, sc.state, sc.epoch, sc.subflows, sc.subflows_up,
           (unsigned long long)sc.tx_payload_bytes,
           (unsigned long long)sc.tx_wire_bytes,
@@ -138,7 +138,9 @@ void AppendLinks(std::string* out, Transport* t) {
           (unsigned long long)sc.tx_queue_ns_sum,
           (unsigned long long)sc.tx_queue_frames,
           (unsigned long long)sc.rx_transit_ns_sum,
-          (unsigned long long)sc.rx_transit_frames);
+          (unsigned long long)sc.rx_transit_frames,
+          // Gauge, like "state": absolute per sample, never delta-decoded.
+          (unsigned long long)sc.part_inflight);
       *out += buf;
     }
   }

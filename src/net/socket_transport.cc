@@ -531,7 +531,17 @@ class StreamTransport : public Transport {
     out->tx_queue_frames = p.sc_tx_queue_frames;
     out->rx_transit_ns_sum = p.sc_rx_transit_ns;
     out->rx_transit_frames = p.sc_rx_transit_frames;
+    out->part_inflight = p.sc_part_inflight > 0
+                             ? static_cast<uint64_t>(p.sc_part_inflight)
+                             : 0;
     return true;
+  }
+
+  // Partitioned-round gauge bookkeeping (the channels below are friends).
+  void PartInflightAdd(int r, int delta) {
+    if (r < 0 || r >= size_) return;
+    std::lock_guard<std::mutex> lk(mu_);
+    peers_[r].sc_part_inflight += delta;
   }
 
   // Voluntary departure (MPIX_Fleet_leave, DESIGN.md §12). The caller has
@@ -708,6 +718,12 @@ class StreamTransport : public Transport {
     uint64_t sc_tx_queue_frames = 0;
     uint64_t sc_rx_transit_ns = 0;    // sender tx_ns -> delivery, clamped
     uint64_t sc_rx_transit_frames = 0;
+
+    // Partitions in flight on this link (gauge, DESIGN.md §17): maintained
+    // by the partitioned channels (SockPsendChan/SockPrecvChan) under mu_,
+    // exported via link_scope(). Signed so a transient over-decrement can
+    // never wrap the exported value to 2^64-ish.
+    int64_t sc_part_inflight = 0;
   };
 
   // Count of lanes currently usable for fresh traffic.
@@ -2880,6 +2896,7 @@ class SockPsendChan : public PartitionedChan {
     inflight_.emplace_back(t_->Isend(buf_ + static_cast<size_t>(p) * part_bytes,
                                      part_bytes, dst_, PartTag(tag_, p),
                                      PartCtx(ctx_)));
+    t_->PartInflightAdd(dst_, 1);
   }
   bool Parrived(int) override { return false; }  // send side has no arrivals
   void StartRound() override { inflight_.clear(); }
@@ -2895,6 +2912,7 @@ class SockPsendChan : public PartitionedChan {
       while (!tk->Test(&tmp)) sched_yield();
       if (tmp.error != 0 && out.error == 0)
         out = Status{dst_, tag_, tmp.error, 0};
+      t_->PartInflightAdd(dst_, -1);
     }
     if (st) *st = out;
     inflight_.clear();
@@ -2924,6 +2942,7 @@ class SockPrecvChan : public PartitionedChan {
     Status st;
     if (tickets_[p] && tickets_[p]->Test(&st)) {
       done_[p] = true;
+      t_->PartInflightAdd(src_, -1);
       // Completed WITH an error (peer died mid-round) means "resolved",
       // not "arrived" — keep the status so FinishRound reports it instead
       // of handing the caller silent stale bytes.
@@ -2940,6 +2959,7 @@ class SockPrecvChan : public PartitionedChan {
           t_->Irecv(buf_ + static_cast<size_t>(p) * part_bytes, part_bytes,
                     src_, PartTag(tag_, p), PartCtx(ctx_)));
     }
+    t_->PartInflightAdd(src_, partitions);
   }
   void FinishRound(Status* st) override {
     // By the wait contract every partition slot has already completed —
@@ -2954,6 +2974,7 @@ class SockPrecvChan : public PartitionedChan {
           t_->CancelPostedRecv(
               static_cast<SockTicket*>(tickets_[p].get())->recv());
         if (out.error == 0) out = Status{src_, tag_, kErrTimeout, 0};
+        t_->PartInflightAdd(src_, -1);  // abandoned, never arrived
       }
       tickets_[p].reset();
     }

@@ -127,6 +127,7 @@ def summarize(series):
         "itl_p99_s": None,
         "link_health": "-",
         "subflows": "-",
+        "part_inflight": None,
     }
     if len(samples) >= 2:
         a, b = samples[-2], samples[-1]
@@ -179,6 +180,11 @@ def summarize(series):
         ratios = [(ln.get("sf_up", 1), ln.get("sf", 1)) for ln in links]
         up, total = min(ratios, key=lambda r: (r[0] / max(r[1], 1), r[0]))
         row["subflows"] = f"{up}/{total}"
+        # Partitions in flight across this rank's links — a GAUGE (absolute
+        # per sample, from the newest links section), so a handoff that
+        # stalls mid-round shows as a pinned nonzero value here while the
+        # cumulative preadys/parriveds counters stop moving.
+        row["part_inflight"] = sum(ln.get("pif", 0) for ln in links)
     elif _latest(series, "links") == []:
         row["link_health"] = "none"
     return row
@@ -246,7 +252,7 @@ def render_table(all_series):
     hdr = (f"{'rank':>4} {'epoch':>5} {'smpls':>5} {'ops/s':>9} "
            f"{'good MB/s':>9} {'wire MB/s':>9} {'proxy%':>6} "
            f"{'txq µs':>7} {'rxt µs':>7} "
-           f"{'qdepth':>6} {'p99 TTFT':>9} {'link':>5} {'sf':>5}")
+           f"{'qdepth':>6} {'p99 TTFT':>9} {'pif':>4} {'link':>5} {'sf':>5}")
     lines = [hdr, "-" * len(hdr)]
     for r in rows:
         ttft = (_fmt(r["ttft_p99_s"], ".3f") + "s"
@@ -257,6 +263,7 @@ def render_table(all_series):
             f"{r['wire_mbps']:>9.2f} {r['proxy_util_pct']:>6.1f} "
             f"{_fmt(r['txq_us'], '.1f'):>7} {_fmt(r['rxt_us'], '.1f'):>7} "
             f"{_fmt(r['queue_depth'], 'd'):>6} {ttft:>9} "
+            f"{_fmt(r['part_inflight'], 'd'):>4} "
             f"{r['link_health']:>5} {r['subflows']:>5}")
     if not rows:
         lines.append("  (no .tseries.jsonl files yet)")
