@@ -19,8 +19,9 @@
 
 #include <atomic>
 #include <cstdint>
-#include <mutex>
 #include <vector>
+
+#include "acx/thread_annotations.h"
 
 namespace acx {
 
@@ -69,18 +70,24 @@ class Membership {
   void AdoptEpoch(uint64_t remote_epoch);
   uint64_t AdoptView(int rank, MemberState st, uint64_t remote_epoch);
 
+  // Lock-free snapshot: the tseries crash flusher reaches this through the
+  // metrics refresh hook (capi.cc RefreshRuntimeMetrics), and the
+  // signal-path contract (DESIGN.md §18, rule 5) forbids a blocking lock
+  // there — so the tallies are atomic mirrors maintained under mu_.
   FleetStats stats() const;
   // Copy up to `cap` per-rank states into out; returns the fleet size.
   int View(int32_t* out, int cap) const;
 
  private:
-  uint64_t BumpLocked();  // callers hold mu_
+  uint64_t BumpLocked() ACX_REQUIRES(mu_);
 
-  mutable std::mutex mu_;
+  mutable acx::Mutex mu_;
   std::atomic<uint64_t> epoch_{0};
-  std::vector<MemberState> state_;
-  int self_ = -1;
-  uint64_t joins_ = 0, leaves_ = 0, deaths_ = 0;
+  std::vector<MemberState> state_ ACX_GUARDED_BY(mu_);
+  int self_ ACX_GUARDED_BY(mu_) = -1;
+  // Written only under mu_; read lock-free by stats()/size() (crash path).
+  std::atomic<int> nslots_{0};
+  std::atomic<uint64_t> joins_{0}, leaves_{0}, deaths_{0}, active_{0};
 };
 
 // Process-wide membership table (one fleet per process, like GS()).

@@ -21,6 +21,7 @@
 #include <thread>
 
 #include "acx/state.h"
+#include "acx/thread_annotations.h"
 #include "acx/transport.h"
 
 namespace acx {
@@ -63,27 +64,34 @@ class Proxy {
  private:
   void Run();
   // One sweep over the table; returns true if any transition was made.
-  // Callers must hold sweep_mu_ (one sweeper at a time: the PENDING->ISSUED
-  // and CLEANUP->AVAILABLE transitions are plain stores).
-  bool Sweep();
+  // One sweeper at a time: the PENDING->ISSUED and CLEANUP->AVAILABLE
+  // transitions are plain stores.
+  bool Sweep() ACX_REQUIRES(sweep_mu_);
   // Post (or fault-gate) one op attempt. from_pending distinguishes a fresh
   // PENDING trigger from a retry of an ISSUED op whose post was lost.
-  bool IssueOp(size_t i, Op& op, Stats& local, bool from_pending);
+  bool IssueOp(size_t i, Op& op, Stats& local, bool from_pending)
+      ACX_REQUIRES(sweep_mu_);
   // Deadline/retry policing for an ISSUED-but-incomplete op.
-  bool CheckStalled(size_t i, Op& op, Stats& local);
+  bool CheckStalled(size_t i, Op& op, Stats& local) ACX_REQUIRES(sweep_mu_);
   // Stall watchdog (acx/flightrec.h): stamp in-flight slots, escalate
   // warn -> dump per ACX_STALL_WARN_MS / ACX_HANG_DUMP_MS. Returns true
   // when a hang dump should fire (caller dumps AFTER releasing sweep_mu_).
-  // Callers must hold sweep_mu_ (reads/writes Op watch fields).
-  bool WatchdogScan(uint64_t now);
+  // Reads/writes Op watch fields.
+  bool WatchdogScan(uint64_t now) ACX_REQUIRES(sweep_mu_);
 
   FlagTable* table_;
   Transport* transport_;
-  std::mutex sweep_mu_;
+  // The sweep capability: annotated (acx/thread_annotations.h) because it
+  // guards the flag-table transition protocol rather than member data —
+  // ACX_REQUIRES on the private methods above is the checkable contract.
+  Mutex sweep_mu_;
   std::thread thread_;
   std::atomic<bool> exit_{false};
   std::atomic<bool> running_{false};
 
+  // Deliberately std::mutex + std::condition_variable, not acx::Mutex: the
+  // wait_until form below is itself a GCC-10 libtsan workaround (see
+  // proxy.cc Run) and must keep the exact std wait path TSAN intercepts.
   std::mutex idle_mu_;
   std::condition_variable idle_cv_;
   std::atomic<uint64_t> kicks_{0};

@@ -1,0 +1,116 @@
+// tpu-acx: clang thread-safety annotations (DESIGN.md §18).
+//
+// The concurrency core (proxy sweep, socket transport, membership table,
+// tseries sampler) documents its locking contracts in comments; this header
+// turns the documentable subset into compiler-checked ones. Under clang the
+// macros expand to the [[clang::...]] capability attributes and `make lint`
+// compiles the tree with -Wthread-safety -Werror; under gcc (which has no
+// capability analysis) every macro expands to nothing and the wrappers are
+// zero-cost shims over the std primitives.
+//
+// Two deliberate scope limits, both documented in DESIGN.md §18:
+//   * std::mutex itself carries no capability attribute in libstdc++, so
+//     annotated state must be guarded by acx::Mutex below. Code that must
+//     keep std types (the proxy's idle condvar pair, whose wait_until form
+//     is itself a GCC-10 libtsan workaround — see proxy.cc) stays
+//     unannotated rather than half-annotated.
+//   * clang cannot express a *conditionally* scoped acquire, so
+//     TryMutexLock declares ACX_ACQUIRE unconditionally and callers must
+//     check owns() before touching guarded state — the same pragmatic cheat
+//     Abseil's try-lock guards use.
+#pragma once
+
+#include <sched.h>
+
+#include <mutex>
+
+#if defined(__clang__) && defined(__has_attribute)
+#if __has_attribute(capability)
+#define ACX_THREAD_ANNOTATION(x) __attribute__((x))
+#endif
+#endif
+#ifndef ACX_THREAD_ANNOTATION
+#define ACX_THREAD_ANNOTATION(x)  // no-op: gcc, or pre-capability clang
+#endif
+
+#define ACX_CAPABILITY(x) ACX_THREAD_ANNOTATION(capability(x))
+#define ACX_SCOPED_CAPABILITY ACX_THREAD_ANNOTATION(scoped_lockable)
+#define ACX_GUARDED_BY(x) ACX_THREAD_ANNOTATION(guarded_by(x))
+#define ACX_PT_GUARDED_BY(x) ACX_THREAD_ANNOTATION(pt_guarded_by(x))
+#define ACX_REQUIRES(...) \
+  ACX_THREAD_ANNOTATION(requires_capability(__VA_ARGS__))
+#define ACX_EXCLUDES(...) ACX_THREAD_ANNOTATION(locks_excluded(__VA_ARGS__))
+#define ACX_ACQUIRE(...) \
+  ACX_THREAD_ANNOTATION(acquire_capability(__VA_ARGS__))
+#define ACX_TRY_ACQUIRE(...) \
+  ACX_THREAD_ANNOTATION(try_acquire_capability(__VA_ARGS__))
+#define ACX_RELEASE(...) \
+  ACX_THREAD_ANNOTATION(release_capability(__VA_ARGS__))
+#define ACX_NO_THREAD_SAFETY_ANALYSIS \
+  ACX_THREAD_ANNOTATION(no_thread_safety_analysis)
+
+namespace acx {
+
+// std::mutex with a capability attribute, so ACX_GUARDED_BY(mu_) members
+// are actually checkable. API-compatible with std::unique_lock /
+// std::condition_variable_any (BasicLockable + Lockable).
+class ACX_CAPABILITY("mutex") Mutex {
+ public:
+  Mutex() = default;
+  Mutex(const Mutex&) = delete;
+  Mutex& operator=(const Mutex&) = delete;
+
+  void lock() ACX_ACQUIRE() { mu_.lock(); }
+  void unlock() ACX_RELEASE() { mu_.unlock(); }
+  bool try_lock() ACX_TRY_ACQUIRE(true) { return mu_.try_lock(); }
+
+ private:
+  std::mutex mu_;
+};
+
+// Scoped blocking lock (std::lock_guard, but over an annotated Mutex — the
+// analysis sees the acquire/release through the annotated ctor/dtor, which
+// it cannot do through std::lock_guard's unannotated ones).
+class ACX_SCOPED_CAPABILITY MutexLock {
+ public:
+  explicit MutexLock(Mutex& mu) ACX_ACQUIRE(mu) : mu_(mu) { mu_.lock(); }
+  ~MutexLock() ACX_RELEASE() { mu_.unlock(); }
+  MutexLock(const MutexLock&) = delete;
+  MutexLock& operator=(const MutexLock&) = delete;
+
+ private:
+  Mutex& mu_;
+};
+
+// Scoped bounded try-lock: the best-effort contract (DESIGN.md §13/§14) for
+// paths that must never block — crash flushers, the tseries sampler's link
+// scope reads. Spins `spins` times with sched_yield between attempts, then
+// gives up; callers MUST check owns() (see the header comment for why the
+// annotation claims the acquire unconditionally).
+class ACX_SCOPED_CAPABILITY TryMutexLock {
+ public:
+  explicit TryMutexLock(Mutex& mu, int spins = 0) ACX_ACQUIRE(mu)
+      : mu_(mu), held_(TryAcquire(mu, spins)) {}
+  ~TryMutexLock() ACX_RELEASE() {
+    if (held_) mu_.unlock();
+  }
+  TryMutexLock(const TryMutexLock&) = delete;
+  TryMutexLock& operator=(const TryMutexLock&) = delete;
+
+  bool owns() const { return held_; }
+
+ private:
+  static bool TryAcquire(Mutex& mu, int spins) ACX_NO_THREAD_SAFETY_ANALYSIS {
+    if (mu.try_lock()) return true;
+    for (int i = 0; i < spins; i++) {
+      sched_yield();
+      if (mu.try_lock()) return true;
+    }
+    return false;
+  }
+
+  Mutex& mu_;
+  bool held_;
+};
+
+}  // namespace acx

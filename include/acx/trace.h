@@ -73,6 +73,12 @@ uint64_t NowSinceStartNs();
 // so a crash inside a flusher cannot recurse.
 void RegisterCrashFlusher(void (*fn)(), bool on_exit);
 
+// Async-signal-safe two-part error note on stderr (raw write(2), no stdio):
+// the form crash-flush tails use instead of fprintf on a shared stream,
+// which the signal-path contract (DESIGN.md §18, rule 5) forbids — the
+// interrupted thread could hold the stdio lock.
+void WriteErrNote(const char* what, const char* name);
+
 }  // namespace trace
 }  // namespace acx
 

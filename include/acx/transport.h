@@ -171,6 +171,16 @@ class Transport {
   // consults it for every op that has not completed yet.
   virtual PeerHealth peer_health(int /*rank*/) { return PeerHealth::kHealthy; }
 
+  // Non-blocking peer_health for the dump/signal path: same answer when a
+  // bounded try-lock wins, a conservative kRecovering when the transport
+  // cannot look without blocking. peer_health itself may block for an
+  // exact verdict — the proxy's correctness (retry typing, park/resume)
+  // depends on it — so crash flushers must use this form instead
+  // (DESIGN.md §18, rule 5).
+  virtual PeerHealth peer_health_relaxed(int /*rank*/) {
+    return PeerHealth::kHealthy;
+  }
+
   // Best-effort snapshot of the wire clocks for peer `rank`'s link. False
   // when the transport has no sequenced wire (self/shm) or cannot take the
   // snapshot without blocking — callers on the dump/signal path must

@@ -116,6 +116,12 @@ def lib() -> ctypes.CDLL:
         _lib.acx_span_app_begin.argtypes = [ctypes.c_uint64]
         _lib.acx_span_app_end.restype = None
         _lib.acx_span_app_end.argtypes = []
+        _lib.acx_rank.restype = ctypes.c_int
+        _lib.acx_rank.argtypes = []
+        _lib.acx_size.restype = ctypes.c_int
+        _lib.acx_size.argtypes = []
+        _lib.acx_nflags.restype = ctypes.c_uint64
+        _lib.acx_nflags.argtypes = []
     return _lib
 
 

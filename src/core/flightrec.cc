@@ -193,11 +193,14 @@ int Dump(const char* prefix, const char* reason) {
   if (prefix == nullptr) prefix = std::getenv("ACX_FLIGHT");
   if (prefix == nullptr || prefix[0] == '\0') prefix = "acx";
   const int rank = RankForDump();
-  std::string fn = std::string(prefix) + ".rank" + std::to_string(rank) +
-                   ".flight.json";
-  FILE* f = std::fopen(fn.c_str(), "w");
+  // Stack filename + raw-write warning: this body runs from the
+  // fatal-signal flusher (DumpOnCrash), where std::string construction and
+  // fprintf on a shared stream are off-contract (DESIGN.md §18, rule 5).
+  char fn[512];
+  std::snprintf(fn, sizeof fn, "%s.rank%d.flight.json", prefix, rank);
+  FILE* f = std::fopen(fn, "w");
   if (f == nullptr) {
-    std::fprintf(stderr, "tpu-acx: flight: cannot write %s\n", fn.c_str());
+    trace::WriteErrNote("tpu-acx: flight: cannot write ", fn);
     return -1;
   }
   const uint64_t now = NowNs();
@@ -261,7 +264,9 @@ int Dump(const char* prefix, const char* reason) {
     const int self = g.transport->rank();
     for (int r = 0; r < size; r++) {
       if (r == self) continue;
-      const PeerHealth h = g.transport->peer_health(r);
+      // Relaxed form: the dump must never block on the transport mutex
+      // (this body can run from a fatal-signal handler).
+      const PeerHealth h = g.transport->peer_health_relaxed(r);
       LinkClock lc;
       const bool have = g.transport->link_clock(r, &lc);
       std::fprintf(f,

@@ -1,7 +1,10 @@
 // tpu-acx: fleet membership table (DESIGN.md §12). See acx/membership.h for
 // the model; this file is deliberately boring — a mutex-guarded state vector
 // plus an atomic epoch, so the transport can feed it from under its own lock
-// and the C API can snapshot it from any thread.
+// and the C API can snapshot it from any thread. The join/leave/death
+// tallies and the active count are atomic mirrors of the vector, written
+// only under mu_, so stats()/size() stay lock-free for the crash-flush path
+// (DESIGN.md §18, signal-path contract).
 
 #include "acx/membership.h"
 
@@ -13,21 +16,24 @@ Membership& Fleet() {
 }
 
 void Membership::Reset(int size, int self_rank) {
-  std::lock_guard<std::mutex> lk(mu_);
-  state_.assign(size < 0 ? 0 : static_cast<size_t>(size),
-                MemberState::kMemberActive);
+  MutexLock lk(mu_);
+  const size_t n = size < 0 ? 0 : static_cast<size_t>(size);
+  state_.assign(n, MemberState::kMemberActive);
   self_ = self_rank;
-  joins_ = leaves_ = deaths_ = 0;
+  nslots_.store(static_cast<int>(n), std::memory_order_relaxed);
+  joins_.store(0, std::memory_order_relaxed);
+  leaves_.store(0, std::memory_order_relaxed);
+  deaths_.store(0, std::memory_order_relaxed);
+  active_.store(n, std::memory_order_relaxed);
   epoch_.store(1, std::memory_order_release);
 }
 
 int Membership::size() const {
-  std::lock_guard<std::mutex> lk(mu_);
-  return static_cast<int>(state_.size());
+  return nslots_.load(std::memory_order_relaxed);
 }
 
 MemberState Membership::state(int rank) const {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   if (rank < 0 || rank >= static_cast<int>(state_.size()))
     return MemberState::kMemberUnknown;
   return state_[rank];
@@ -40,30 +46,33 @@ uint64_t Membership::BumpLocked() {
 }
 
 uint64_t Membership::OnJoin(int rank) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   if (rank < 0 || rank >= static_cast<int>(state_.size()))
     return epoch_.load(std::memory_order_relaxed);
   if (state_[rank] == MemberState::kMemberActive)
     return epoch_.load(std::memory_order_relaxed);
   state_[rank] = MemberState::kMemberActive;
-  joins_++;
+  joins_.fetch_add(1, std::memory_order_relaxed);
+  active_.fetch_add(1, std::memory_order_relaxed);
   return BumpLocked();
 }
 
 uint64_t Membership::OnLeave(int rank) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   if (rank < 0 || rank >= static_cast<int>(state_.size()))
     return epoch_.load(std::memory_order_relaxed);
   if (state_[rank] == MemberState::kMemberLeft ||
       state_[rank] == MemberState::kMemberDead)
     return epoch_.load(std::memory_order_relaxed);
+  if (state_[rank] == MemberState::kMemberActive)
+    active_.fetch_sub(1, std::memory_order_relaxed);
   state_[rank] = MemberState::kMemberLeft;
-  leaves_++;
+  leaves_.fetch_add(1, std::memory_order_relaxed);
   return BumpLocked();
 }
 
 uint64_t Membership::OnDeath(int rank) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   if (rank < 0 || rank >= static_cast<int>(state_.size()))
     return epoch_.load(std::memory_order_relaxed);
   // A graceful LEFT verdict is final: the EOF that trails a clean leave
@@ -71,16 +80,20 @@ uint64_t Membership::OnDeath(int rank) {
   if (state_[rank] == MemberState::kMemberLeft ||
       state_[rank] == MemberState::kMemberDead)
     return epoch_.load(std::memory_order_relaxed);
+  if (state_[rank] == MemberState::kMemberActive)
+    active_.fetch_sub(1, std::memory_order_relaxed);
   state_[rank] = MemberState::kMemberDead;
-  deaths_++;
+  deaths_.fetch_add(1, std::memory_order_relaxed);
   return BumpLocked();
 }
 
 void Membership::OnDraining(int rank) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   if (rank < 0 || rank >= static_cast<int>(state_.size())) return;
-  if (state_[rank] == MemberState::kMemberActive)
+  if (state_[rank] == MemberState::kMemberActive) {
     state_[rank] = MemberState::kMemberDraining;
+    active_.fetch_sub(1, std::memory_order_relaxed);
+  }
 }
 
 void Membership::AdoptEpoch(uint64_t remote_epoch) {
@@ -111,19 +124,21 @@ uint64_t Membership::AdoptView(int rank, MemberState st,
 }
 
 FleetStats Membership::stats() const {
-  std::lock_guard<std::mutex> lk(mu_);
+  // Lock-free by contract: reachable from the crash-flush tail via the
+  // tseries refresh hook. The mirrors are each individually consistent;
+  // a snapshot racing a transition may be one transition stale, which is
+  // fine for an observability read.
   FleetStats s;
   s.epoch = epoch_.load(std::memory_order_relaxed);
-  s.joins = joins_;
-  s.leaves = leaves_;
-  s.deaths = deaths_;
-  for (MemberState st : state_)
-    if (st == MemberState::kMemberActive) s.active++;
+  s.joins = joins_.load(std::memory_order_relaxed);
+  s.leaves = leaves_.load(std::memory_order_relaxed);
+  s.deaths = deaths_.load(std::memory_order_relaxed);
+  s.active = active_.load(std::memory_order_relaxed);
   return s;
 }
 
 int Membership::View(int32_t* out, int cap) const {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   const int n = static_cast<int>(state_.size());
   for (int i = 0; i < n && i < cap; i++)
     out[i] = static_cast<int32_t>(state_[i]);

@@ -1,3 +1,11 @@
+// Thread-safety: the registry is mutex-free by design — every mutation is
+// a relaxed atomic (acx/metrics.h) — so the clang thread-safety pass
+// (acx/thread_annotations.h, DESIGN.md §18) has nothing to annotate here;
+// this note is the annotation. Keep it that way: the crash-flush tail
+// (tseries FlushBestEffort) reads every counter and histogram, and the
+// signal-path audit (tools/acx_audit.py, rule 5) will flag any lock or
+// allocation a future change introduces on that path.
+
 #include "acx/metrics.h"
 
 #include <atomic>

@@ -39,8 +39,8 @@ void Proxy::Kick() {
 }
 
 bool Proxy::TryProgress() {
-  std::unique_lock<std::mutex> lk(sweep_mu_, std::try_to_lock);
-  if (!lk.owns_lock()) return false;  // another thread is already sweeping
+  TryMutexLock lk(sweep_mu_);
+  if (!lk.owns()) return false;  // another thread is already sweeping
   const bool progressed = Sweep();
   if (progressed) sweeps_.fetch_add(1, std::memory_order_relaxed);
   return progressed;
@@ -48,7 +48,7 @@ bool Proxy::TryProgress() {
 
 int Proxy::CancelInflight() {
   // Exclusive sweep: no concurrent Sweep may race the flag stores below.
-  std::lock_guard<std::mutex> lk(sweep_mu_);
+  MutexLock lk(sweep_mu_);
   int count = 0;
   const size_t n = table_->watermark();
   for (size_t i = 0; i < n; i++) {
@@ -554,7 +554,7 @@ void Proxy::Run() {
     bool progressed;
     const uint64_t t_sweep = mx ? NowNs() : 0;
     {
-      std::lock_guard<std::mutex> lk(sweep_mu_);
+      MutexLock lk(sweep_mu_);
       progressed = Sweep();
     }
     if (mx) {
@@ -574,7 +574,7 @@ void Proxy::Run() {
         wd_next = now + wd_interval;
         bool do_dump;
         {
-          std::lock_guard<std::mutex> lk(sweep_mu_);
+          MutexLock lk(sweep_mu_);
           do_dump = WatchdogScan(now);
         }
         if (do_dump) {

@@ -1,6 +1,7 @@
 #include "acx/trace.h"
 
 #include <signal.h>
+#include <unistd.h>
 
 #include <algorithm>
 #include <climits>
@@ -14,6 +15,8 @@
 #include <string>
 #include <unordered_map>
 #include <vector>
+
+#include "acx/thread_annotations.h"
 
 namespace acx {
 namespace trace {
@@ -30,10 +33,10 @@ struct Event {
 };
 
 struct Ring {
-  std::mutex mu;
-  std::vector<Event> events;
+  Mutex mu;
+  std::vector<Event> events ACX_GUARDED_BY(mu);
   size_t cap = 65536;
-  uint64_t dropped = 0;
+  uint64_t dropped ACX_GUARDED_BY(mu) = 0;
   Clock::time_point t0 = Clock::now();
 };
 
@@ -45,6 +48,7 @@ Ring& ring() {
       const unsigned long long v = strtoull(c, nullptr, 10);
       if (v > 0) r->cap = static_cast<size_t>(v);
     }
+    MutexLock lk(r->mu);  // satisfies the guard; uncontended at init
     r->events.reserve(r->cap < 4096 ? r->cap : 4096);
     return r;
   }();
@@ -67,21 +71,26 @@ int RankForFlush() {
 
 // Snapshot the ring without draining it (a later flush rewrites a
 // superset; an abnormal-exit flush after a normal finalize flush never
-// truncates the finalize file down to a tail). best_effort (signal/atexit
-// context) refuses to block on the ring mutex and skips empty rings.
-bool Snapshot(std::vector<Event>* events, uint64_t* dropped,
-              bool best_effort) {
+// truncates the finalize file down to a tail). Two entry points instead of
+// a best_effort flag: the signal-path contract (DESIGN.md §18, rule 5) is
+// per-function, and the crash flusher must reach a body that contains no
+// blocking acquire at all. The best-effort form refuses to block on the
+// ring mutex and skips empty rings.
+bool SnapshotBestEffort(std::vector<Event>* events, uint64_t* dropped) {
   Ring& r = ring();
-  std::unique_lock<std::mutex> lk(r.mu, std::defer_lock);
-  if (best_effort) {
-    if (!lk.try_lock()) return false;
-    if (r.events.empty()) return false;
-  } else {
-    lk.lock();
-  }
+  TryMutexLock lk(r.mu);
+  if (!lk.owns()) return false;
+  if (r.events.empty()) return false;
   *events = r.events;
   *dropped = r.dropped;
   return true;
+}
+
+void SnapshotBlocking(std::vector<Event>* events, uint64_t* dropped) {
+  Ring& r = ring();
+  MutexLock lk(r.mu);
+  *events = r.events;
+  *dropped = r.dropped;
 }
 
 void WriteFile(const std::vector<Event>& events, uint64_t dropped, int rank);
@@ -90,7 +99,7 @@ void FlushBestEffort() {
   if (!Enabled()) return;
   std::vector<Event> events;
   uint64_t dropped = 0;
-  if (!Snapshot(&events, &dropped, /*best_effort=*/true)) return;
+  if (!SnapshotBestEffort(&events, &dropped)) return;
   WriteFile(events, dropped, RankForFlush());
 }
 
@@ -224,11 +233,12 @@ size_t SynthesizeSpans(const std::vector<Event>& events, int rank,
 }
 
 void WriteFile(const std::vector<Event>& events, uint64_t dropped, int rank) {
-  std::string fn = std::string(path()) + ".rank" + std::to_string(rank) +
-                   ".trace.json";
-  FILE* f = std::fopen(fn.c_str(), "w");
+  // Filename on the stack — the crash path must not construct std::string.
+  char fn[512];
+  std::snprintf(fn, sizeof fn, "%s.rank%d.trace.json", path(), rank);
+  FILE* f = std::fopen(fn, "w");
   if (f == nullptr) {
-    std::fprintf(stderr, "tpu-acx: ACX_TRACE: cannot write %s\n", fn.c_str());
+    WriteErrNote("tpu-acx: ACX_TRACE: cannot write ", fn);
     return;
   }
   // Chrome trace-event JSON: instant events (one tid per slot, so each op
@@ -285,6 +295,22 @@ bool Enabled() {
   return on;
 }
 
+// See trace.h: raw write(2) on stderr, usable from signal context. Kept
+// deliberately free of stdio, allocation, and locks — the signal-path
+// audit (tools/acx_audit.py, rule 5) walks every function reachable from
+// the crash flushers and would flag any of those here.
+void WriteErrNote(const char* what, const char* name) {
+  char buf[512];
+  size_t n = 0;
+  for (const char* p = what; *p != '\0' && n < sizeof buf - 1; p++)
+    buf[n++] = *p;
+  for (const char* p = name; *p != '\0' && n < sizeof buf - 1; p++)
+    buf[n++] = *p;
+  buf[n++] = '\n';
+  const ssize_t rc = write(STDERR_FILENO, buf, n);
+  (void)rc;
+}
+
 void RegisterCrashFlusher(void (*fn)(), bool on_exit) {
   static std::once_flag once;
   std::call_once(once, InstallCrashHooks);
@@ -305,7 +331,7 @@ void Emit(const char* name, int64_t slot, uint64_t span) {
   Ring& r = ring();
   // Timestamp under the lock: emitters race (app, trigger, proxy, and
   // waiter threads), and the file must be time-ordered.
-  std::lock_guard<std::mutex> lk(r.mu);
+  MutexLock lk(r.mu);
   if (r.events.size() >= r.cap) {
     r.dropped++;
     return;
@@ -345,7 +371,7 @@ void Flush(int rank) {
   if (!Enabled()) return;
   std::vector<Event> events;
   uint64_t dropped = 0;
-  Snapshot(&events, &dropped, /*best_effort=*/false);
+  SnapshotBlocking(&events, &dropped);
   WriteFile(events, dropped, rank);
 }
 

@@ -11,6 +11,7 @@
 #include "acx/fault.h"  // NowNs
 #include "acx/membership.h"
 #include "acx/metrics.h"
+#include "acx/thread_annotations.h"
 #include "acx/trace.h"
 #include "acx/transport.h"
 
@@ -57,18 +58,23 @@ const Config& cfg() {
 }
 
 struct State {
-  std::mutex mu;  // serializes sampling + file writes
-  FILE* f = nullptr;
-  bool open_failed = false;  // latch: don't retry/ re-warn every interval
-  uint64_t seq = 0;          // delta samples written (init line is seq "0")
-  uint64_t prev_counters[metrics::kNumCounters] = {};
-  uint64_t prev_hcount[metrics::kNumHists] = {};
-  uint64_t prev_hsum[metrics::kNumHists] = {};
-  uint64_t prev_hbuckets[metrics::kNumHists][metrics::kNumBuckets] = {};
-  std::string live;  // most recent full sample line, for LiveJson
+  Mutex mu;  // serializes sampling + file writes
+  FILE* f ACX_GUARDED_BY(mu) = nullptr;
+  // Latch: don't retry / re-warn every interval.
+  bool open_failed ACX_GUARDED_BY(mu) = false;
+  // Delta samples written (init line is seq "0").
+  uint64_t seq ACX_GUARDED_BY(mu) = 0;
+  uint64_t prev_counters[metrics::kNumCounters] ACX_GUARDED_BY(mu) = {};
+  uint64_t prev_hcount[metrics::kNumHists] ACX_GUARDED_BY(mu) = {};
+  uint64_t prev_hsum[metrics::kNumHists] ACX_GUARDED_BY(mu) = {};
+  uint64_t prev_hbuckets[metrics::kNumHists][metrics::kNumBuckets]
+      ACX_GUARDED_BY(mu) = {};
+  // Most recent full sample line, for LiveJson.
+  std::string live ACX_GUARDED_BY(mu);
 
-  std::mutex ann_mu;
-  std::string annotation;  // last Annotate fragment, "" = none
+  Mutex ann_mu;
+  // Last Annotate fragment, "" = none.
+  std::string annotation ACX_GUARDED_BY(ann_mu);
 };
 
 State& S() {
@@ -147,17 +153,20 @@ void AppendLinks(std::string* out, Transport* t) {
   *out += "]";
 }
 
-// Caller holds s.mu.
-void SampleLocked(State& s, Transport* t) {
+void SampleLocked(State& s, Transport* t) ACX_REQUIRES(s.mu) {
   if (s.open_failed) return;
   if (s.f == nullptr) {
-    const std::string fn = std::string(cfg().prefix) + ".rank" +
-                           std::to_string(RankForFile()) + ".tseries.jsonl";
-    s.f = std::fopen(fn.c_str(), "w");
+    // Filename on the stack and the warning over raw write(2): this body
+    // also runs on the crash-flush tail (FlushBestEffort), where
+    // std::string construction and fprintf on stderr are off-contract
+    // (DESIGN.md §18, rule 5).
+    char fn[512];
+    std::snprintf(fn, sizeof fn, "%s.rank%d.tseries.jsonl", cfg().prefix,
+                  RankForFile());
+    s.f = std::fopen(fn, "w");
     if (s.f == nullptr) {
       s.open_failed = true;
-      std::fprintf(stderr, "tpu-acx: ACX_TSERIES: cannot write %s\n",
-                   fn.c_str());
+      trace::WriteErrNote("tpu-acx: ACX_TSERIES: cannot write ", fn);
       return;
     }
   }
@@ -199,8 +208,11 @@ void SampleLocked(State& s, Transport* t) {
     // program with no proxy forcing one via sample_now) must not be
     // dropped: the init line carries it like any other sample.
     {
-      std::lock_guard<std::mutex> alk(s.ann_mu);
-      if (!s.annotation.empty()) {
+      // Try-lock, not lock: this body runs on the crash-flush tail, and an
+      // Annotate call interrupted mid-assign must not deadlock the dying
+      // rank. A contended regular sample just drops the app fragment once.
+      TryMutexLock alk(s.ann_mu);
+      if (alk.owns() && !s.annotation.empty()) {
         line += ",\"app\":";
         line += s.annotation;
       }
@@ -282,8 +294,11 @@ void SampleLocked(State& s, Transport* t) {
     line += "},";
     AppendLinks(&line, t);
     {
-      std::lock_guard<std::mutex> alk(s.ann_mu);
-      if (!s.annotation.empty()) {
+      // Try-lock, not lock: this body runs on the crash-flush tail, and an
+      // Annotate call interrupted mid-assign must not deadlock the dying
+      // rank. A contended regular sample just drops the app fragment once.
+      TryMutexLock alk(s.ann_mu);
+      if (alk.owns() && !s.annotation.empty()) {
         line += ",\"app\":";
         line += s.annotation;
       }
@@ -310,8 +325,8 @@ void Refresh() {
 void FlushBestEffort() {
   if (!Enabled()) return;
   State& s = S();
-  std::unique_lock<std::mutex> lk(s.mu, std::try_to_lock);
-  if (!lk.owns_lock()) return;
+  TryMutexLock lk(s.mu);
+  if (!lk.owns()) return;
   Refresh();
   SampleLocked(s, g_transport.load(std::memory_order_acquire));
 }
@@ -353,7 +368,7 @@ void SampleNow(Transport* t) {
   if (t != nullptr) g_transport.store(t, std::memory_order_release);
   Refresh();
   State& s = S();
-  std::lock_guard<std::mutex> lk(s.mu);
+  MutexLock lk(s.mu);
   SampleLocked(s, t != nullptr
                       ? t
                       : g_transport.load(std::memory_order_acquire));
@@ -368,13 +383,13 @@ void Annotate(const char* json) {
   const size_t n = std::strlen(json);
   if (n == 0 || n > 8192 || json[0] != '{') return;
   State& s = S();
-  std::lock_guard<std::mutex> lk(s.ann_mu);
+  MutexLock lk(s.ann_mu);
   s.annotation.assign(json, n);
 }
 
 int LiveJson(char* buf, int cap) {
   State& s = S();
-  std::lock_guard<std::mutex> lk(s.mu);
+  MutexLock lk(s.mu);
   const std::string& l = s.live;
   if (buf != nullptr && cap > 0) {
     const size_t n =

@@ -8,6 +8,12 @@
 //
 // Everything here is lock-free plain data + arithmetic (except IoFullTimed,
 // which blocks on ONE fd with a deadline) — unit-testable in isolation.
+//
+// Thread-safety: these structs carry no mutex of their own. Instances live
+// inside socket_transport's Peer/Subflow state, which is ACX_GUARDED_BY the
+// transport mutex (acx/thread_annotations.h) — the *Locked methods that
+// mutate wire clocks run with that capability held, and the analysis checks
+// it there, at the owner, not here.
 #pragma once
 
 #include <stdint.h>

@@ -69,6 +69,7 @@
 #include "acx/flightrec.h"
 #include "acx/membership.h"
 #include "acx/metrics.h"
+#include "acx/thread_annotations.h"
 #include "acx/trace.h"
 #include "src/net/framing.h"
 #include "src/net/link.h"
@@ -381,13 +382,13 @@ class StreamTransport : public Transport {
 
   Ticket* Isend(const void* buf, size_t bytes, int dst, int tag, int ctx,
                 uint64_t span = 0) override {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     return IsendLocked(buf, bytes, dst, tag, ctx, span);
   }
 
   Ticket* Irecv(void* buf, size_t bytes, int src, int tag, int ctx,
                 uint64_t span = 0) override {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     return IrecvLocked(buf, bytes, src, tag, ctx, span);
   }
 
@@ -442,7 +443,7 @@ class StreamTransport : public Transport {
   // Ticket::Test is pumping progress; called from the proxy's idle branches.
   void Tick() override {
     if (size_ <= 1) return;
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     ProgressLocked();
   }
 
@@ -470,7 +471,23 @@ class StreamTransport : public Transport {
     if (recovering_count_.load(std::memory_order_relaxed) == 0 &&
         peers_dead_n_.load(std::memory_order_relaxed) == 0)
       return PeerHealth::kHealthy;
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
+    if (peer_dead_[r]) return PeerHealth::kDead;
+    return peers_[r].health != 0 ? PeerHealth::kRecovering
+                                 : PeerHealth::kHealthy;
+  }
+
+  // Crash-path form (flight dumps): identical fast path, but a bounded
+  // try-lock instead of blocking on mu_. On a miss the honest answer is
+  // kRecovering — the fast path already said something is in flux, and a
+  // dump annotation must not wedge a dying rank for an exact verdict.
+  PeerHealth peer_health_relaxed(int r) override {
+    if (r < 0 || r >= size_ || r == rank_) return PeerHealth::kHealthy;
+    if (recovering_count_.load(std::memory_order_relaxed) == 0 &&
+        peers_dead_n_.load(std::memory_order_relaxed) == 0)
+      return PeerHealth::kHealthy;
+    TryMutexLock lk(mu_, /*spins=*/4);
+    if (!lk.owns()) return PeerHealth::kRecovering;
     if (peer_dead_[r]) return PeerHealth::kDead;
     return peers_[r].health != 0 ? PeerHealth::kRecovering
                                  : PeerHealth::kHealthy;
@@ -481,12 +498,8 @@ class StreamTransport : public Transport {
     // Best-effort contract (acx/transport.h): callers include the stall
     // watchdog and the flight-recorder dump path, which may run from a
     // fatal-signal handler — never block on mu_, just try a few times.
-    std::unique_lock<std::mutex> lk(mu_, std::try_to_lock);
-    for (int i = 0; i < 4 && !lk.owns_lock(); i++) {
-      sched_yield();
-      (void)lk.try_lock();
-    }
-    if (!lk.owns_lock()) return false;
+    TryMutexLock lk(mu_, /*spins=*/4);
+    if (!lk.owns()) return false;
     const Peer& p = peers_[r];
     // Lane 0 is the link's identity clock; replay backlog is the SUM over
     // lanes (the number a stall report cares about is total unacked bytes).
@@ -504,12 +517,8 @@ class StreamTransport : public Transport {
     if (r < 0 || r >= size_ || r == rank_) return false;
     // Same best-effort contract as link_clock: the tseries sampler and the
     // crash flusher must never block on mu_.
-    std::unique_lock<std::mutex> lk(mu_, std::try_to_lock);
-    for (int i = 0; i < 4 && !lk.owns_lock(); i++) {
-      sched_yield();
-      (void)lk.try_lock();
-    }
-    if (!lk.owns_lock()) return false;
+    TryMutexLock lk(mu_, /*spins=*/4);
+    if (!lk.owns()) return false;
     const Peer& p = peers_[r];
     out->state = peer_dead_[r] ? 2 : (p.health != 0 ? 1 : 0);
     out->epoch = p.sf[0].clk.epoch;
@@ -540,7 +549,7 @@ class StreamTransport : public Transport {
   // Partitioned-round gauge bookkeeping (the channels below are friends).
   void PartInflightAdd(int r, int delta) {
     if (r < 0 || r >= size_) return;
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     peers_[r].sc_part_inflight += delta;
   }
 
@@ -553,7 +562,7 @@ class StreamTransport : public Transport {
   // replacement it forked).
   void FleetLeave() override {
     if (size_ <= 1) return;
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     const uint64_t fepoch = Fleet().OnLeave(rank_);
     for (int q = 0; q < size_; q++) {
       if (q == rank_ || !peers_[q].sf[0].link || peer_dead_[q]) continue;
@@ -574,7 +583,10 @@ class StreamTransport : public Transport {
   // itself be mid-replacement, so "unreachable right now" is not a verdict
   // until the deadline. Returns the number of live links established.
   int JoinFleet(int budget_ms) {
-    std::unique_lock<std::mutex> lk(mu_);
+    // Explicit lock()/unlock() (not a scoped guard): the dial loop drops
+    // the lock across its jittered naps, and the annotated acquire/release
+    // pair is the form the thread-safety analysis can follow.
+    mu_.lock();
     const uint64_t deadline =
         NowNs() + static_cast<uint64_t>(budget_ms) * 1000000ull;
     uint64_t pause_ms = 20;
@@ -593,15 +605,16 @@ class StreamTransport : public Transport {
         break;
       }
       const uint64_t wait_ns = JitteredWaitNs(pause_ms);
-      lk.unlock();
+      mu_.unlock();
       poll(nullptr, 0, static_cast<int>(wait_ns / 1000000ull) + 1);
-      lk.lock();
+      mu_.lock();
       if (pause_ms < 200) pause_ms *= 2;
     }
     Fleet().OnJoin(rank_);  // no-op bump-wise if Reset left us ACTIVE
     int linked = 0;
     for (int p = 0; p < size_; p++)
       if (p != rank_ && peers_[p].sf[0].link) linked++;
+    mu_.unlock();
     std::fprintf(stderr,
                  "tpu-acx[%d]: joined fleet (%d/%d peer link(s), fleet "
                  "epoch %llu)\n",
@@ -613,7 +626,7 @@ class StreamTransport : public Transport {
   // Called from SockTicket::Test.
   bool TestReq(const std::shared_ptr<SendReq>& s,
                const std::shared_ptr<RecvReq>& r, Status* st) {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     ProgressLocked();
     if (s) {
       if (s->done && st) *st = s->st;
@@ -632,7 +645,7 @@ class StreamTransport : public Transport {
   // its buffer) or went rendezvous; those cases can't be un-posted.
   bool CancelPostedRecv(const std::shared_ptr<RecvReq>& r) {
     if (!r) return false;
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     if (r->done || r->src < 0 || r->src >= size_) return false;
     auto& q = peers_[r->src].posted;
     for (auto it = q.begin(); it != q.end(); ++it) {
@@ -727,7 +740,7 @@ class StreamTransport : public Transport {
   };
 
   // Count of lanes currently usable for fresh traffic.
-  int LiveLanesLocked(const Peer& peer) const {
+  int LiveLanesLocked(const Peer& peer) const ACX_REQUIRES(mu_) {
     int n = 0;
     for (const Subflow& sf : peer.sf)
       if (sf.link && !sf.down) n++;
@@ -737,7 +750,7 @@ class StreamTransport : public Transport {
   // Next live lane at or after peer.rr_cursor, advancing the cursor. Lane 0
   // is always live when this is called (the link would be recovering/dead
   // otherwise), so the loop terminates.
-  int NextLiveLaneLocked(Peer& peer) {
+  int NextLiveLaneLocked(Peer& peer) ACX_REQUIRES(mu_) {
     const int n = static_cast<int>(peer.sf.size());
     for (int i = 0; i < n; i++) {
       const int k = peer.rr_cursor;
@@ -748,7 +761,7 @@ class StreamTransport : public Transport {
   }
 
   Ticket* IsendLocked(const void* buf, size_t bytes, int dst, int tag,
-                      int ctx, uint64_t span = 0) {
+                      int ctx, uint64_t span = 0) ACX_REQUIRES(mu_) {
     if (dst != rank_ && (dst < 0 || dst >= size_)) {
       std::fprintf(stderr, "tpu-acx[%d]: no wire to peer %d\n", rank_, dst);
       _exit(14);
@@ -815,7 +828,7 @@ class StreamTransport : public Transport {
   // slices round-robin over every live lane. The caller owns s->payload/
   // s->bytes and has reset off/rv/fault state.
   void EnqueueEagerLocked(int p, const std::shared_ptr<SendReq>& s, int tag,
-                          int ctx, uint64_t span) {
+                          int ctx, uint64_t span) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     const int nlive = LiveLanesLocked(peer);
     if (stripe::ShouldStripe(s->bytes, nlive, stripe_cfg_)) {
@@ -890,18 +903,18 @@ class StreamTransport : public Transport {
 
   // Epoch + header CRC for an unsequenced frame whose seq field the caller
   // already filled (heartbeat high-water, SeqAck/NAK cumulative rx).
-  void SealHdrLocked(int dst, size_t lane, WireHeader* h) {
+  void SealHdrLocked(int dst, size_t lane, WireHeader* h) ACX_REQUIRES(mu_) {
     h->epoch = peers_[dst].sf[lane].clk.epoch;
     h->hcrc = wire::HeaderCrc(*h);
   }
 
-  void StampSeqLocked(int dst, size_t lane, WireHeader* h) {
+  void StampSeqLocked(int dst, size_t lane, WireHeader* h) ACX_REQUIRES(mu_) {
     h->seq = ++peers_[dst].sf[lane].clk.tx_seq;
     SealHdrLocked(dst, lane, h);
   }
 
   Ticket* IrecvLocked(void* buf, size_t bytes, int src, int tag, int ctx,
-                      uint64_t span = 0) {
+                      uint64_t span = 0) ACX_REQUIRES(mu_) {
     // Same loud failure as IsendLocked: a recv from a wireless peer would
     // otherwise sit in `posted` forever (ProgressLocked skips null links).
     if (src != rank_ && (src < 0 || src >= size_)) {
@@ -968,9 +981,10 @@ class StreamTransport : public Transport {
   // A stripe envelope arrived on lane 0: create/complete the reassembly
   // entry and give the message its slot in FIFO matching order — matching a
   // posted recv directly, or queueing a placeholder Msg.
-  void HandleStripeEnvLocked(int p, const WireHeader& h, const StripeDesc& d) {
+  void HandleStripeEnvLocked(int p, const WireHeader& h, const StripeDesc& d) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
-    StripeRx& srx = peer.stripes[d.msg_id];  // chunks may have preceded us
+    const uint32_t msg_id = d.msg_id;  // packed member: copy before binding
+    StripeRx& srx = peer.stripes[msg_id];  // chunks may have preceded us
     srx.have_env = true;
     srx.tag = h.tag;
     srx.ctx = h.ctx;
@@ -1007,7 +1021,7 @@ class StreamTransport : public Transport {
 
   // A posted/late recv matched a stripe placeholder from the arrived queue.
   void AttachStripeLocked(int p, uint32_t msg_id,
-                          const std::shared_ptr<RecvReq>& r) {
+                          const std::shared_ptr<RecvReq>& r) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     auto it = peer.stripes.find(msg_id);
     if (it == peer.stripes.end()) {
@@ -1032,7 +1046,7 @@ class StreamTransport : public Transport {
 
   // Every chunk landed AND the envelope matched a recv: complete it and
   // retire the reassembly entry into the done-set.
-  void CompleteStripeLocked(int p, uint32_t msg_id) {
+  void CompleteStripeLocked(int p, uint32_t msg_id) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     auto it = peer.stripes.find(msg_id);
     if (it == peer.stripes.end() || !it->second.direct) return;
@@ -1058,7 +1072,7 @@ class StreamTransport : public Transport {
   // back so the sender's completion stays causally attributable.
   void CompleteRvLocked(int src, const std::shared_ptr<RecvReq>& r, int tag,
                         uint64_t full_bytes, const RvDesc& d,
-                        uint64_t span = 0) {
+                        uint64_t span = 0) ACX_REQUIRES(mu_) {
     const size_t deliver = r->bytes < full_bytes ? r->bytes : full_bytes;
     size_t got = 0;
     if (!rv_force_fallback_) {
@@ -1087,7 +1101,7 @@ class StreamTransport : public Transport {
     SendAckLocked(src, d.seq, ok, span);
   }
 
-  void SendAckLocked(int dst, uint32_t seq, bool ok, uint64_t span = 0) {
+  void SendAckLocked(int dst, uint32_t seq, bool ok, uint64_t span = 0) ACX_REQUIRES(mu_) {
     auto s = std::make_shared<SendReq>();
     s->hdr = MakeHdr(kMagicAck, 0, 0, 0);
     RvAck a{seq, ok ? 1 : 0};
@@ -1103,7 +1117,7 @@ class StreamTransport : public Transport {
     FlushOutLocked(dst, 0);
   }
 
-  void HandleAckLocked(int src, const RvAck& a) {
+  void HandleAckLocked(int src, const RvAck& a) ACX_REQUIRES(mu_) {
     auto it = rv_pending_.find(a.seq);
     if (it == rv_pending_.end()) return;  // duplicate/stale ack
     std::shared_ptr<SendReq> s = it->second;
@@ -1124,7 +1138,7 @@ class StreamTransport : public Transport {
                        kRvDataCtx, span);
   }
 
-  void DeliverLocked(int src, Msg&& m) {
+  void DeliverLocked(int src, Msg&& m) ACX_REQUIRES(mu_) {
     auto& posted = peers_[src].posted;
     for (auto it = posted.begin(); it != posted.end(); ++it) {
       if ((*it)->tag == m.tag && (*it)->ctx == m.ctx) {
@@ -1149,7 +1163,7 @@ class StreamTransport : public Transport {
   // wire), rx_match the LOCAL recv op's span — so offline tools can bridge
   // the sender's causal chain into the receiver's without heuristics: an
   // rx_match always follows its rx_from immediately in this rank's ring.
-  void NoteMatchLocked(uint64_t wire_span, uint64_t recv_span) {
+  void NoteMatchLocked(uint64_t wire_span, uint64_t recv_span) ACX_REQUIRES(mu_) {
     if (wire_span != 0) ACX_TRACE_SPAN("rx_from", -1, wire_span);
     if (recv_span != 0) ACX_TRACE_SPAN("rx_match", -1, recv_span);
   }
@@ -1160,7 +1174,7 @@ class StreamTransport : public Transport {
   // clock delta — both timelines are per-rank trace origins, so it embeds
   // a constant offset; live consumers (tseries/acx_top) present it as raw,
   // and acx_trace_merge/acx_critpath subtract the barrier-anchored skew.
-  void NoteFrameRxLocked(int p, int lane, const WireHeader& h) {
+  void NoteFrameRxLocked(int p, int lane, const WireHeader& h) ACX_REQUIRES(mu_) {
     if (h.span != 0) {
       ACX_TRACE_SPAN("wire_rx", -1, h.span);
       // aux = lane: seq spaces are per-subflow (each lane has its own wire
@@ -1201,7 +1215,7 @@ class StreamTransport : public Transport {
   // Called at full-write time (the payload is still borrowed, so the copy
   // is legal); a corrupt_frame-poisoned header is recorded with its
   // pristine CRCs so a replay heals rather than re-injects.
-  void RecordFrameLocked(int p, size_t lane, SendReq* s) {
+  void RecordFrameLocked(int p, size_t lane, SendReq* s) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     WireHeader h = s->hdr;
     if (s->corrupted) {
@@ -1226,19 +1240,19 @@ class StreamTransport : public Transport {
   }
 
   // A raw (replay) frame finished writing: release its record's blob.
-  void ClearQueuedLocked(int p, size_t lane, uint64_t seq) {
+  void ClearQueuedLocked(int p, size_t lane, uint64_t seq) ACX_REQUIRES(mu_) {
     peers_[p].sf[lane].replay.ClearQueued(seq);
   }
 
   // Peer acknowledged delivery of everything up to `acked` on this lane.
-  void HandleSeqAckLocked(int p, size_t lane, uint64_t acked) {
+  void HandleSeqAckLocked(int p, size_t lane, uint64_t acked) ACX_REQUIRES(mu_) {
     peers_[p].sf[lane].replay.AckThrough(acked);
   }
 
   // Header-only cumulative ack of our delivered-in-order high water on one
   // lane (acks travel on the lane they acknowledge — each lane is its own
   // seq space).
-  void SendSeqAckLocked(int p, size_t lane) {
+  void SendSeqAckLocked(int p, size_t lane) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     Subflow& sf = peer.sf[lane];
     auto s = std::make_shared<SendReq>();
@@ -1257,7 +1271,7 @@ class StreamTransport : public Transport {
   // Rate-limited re-pull: "I have everything through rx_seq; resend from
   // rx_seq+1" — per lane. Fired on a sequence gap, a CRC reject, or a
   // heartbeat whose tx high-water is ahead of us (tail loss).
-  void MaybeNakLocked(int p, size_t lane) {
+  void MaybeNakLocked(int p, size_t lane) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     Subflow& sf = peer.sf[lane];
     const uint64_t now = NowNs();
@@ -1281,7 +1295,7 @@ class StreamTransport : public Transport {
   // the lane's outq (replayed seqs are lower than anything not yet written,
   // so wire order stays sequence order). Duplicates are skip-consumed by
   // the receiver.
-  void HandleNakLocked(int p, size_t lane, uint64_t r) {
+  void HandleNakLocked(int p, size_t lane, uint64_t r) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     Subflow& sf = peer.sf[lane];
     HandleSeqAckLocked(p, lane, r);  // everything <= r is implicitly acked
@@ -1316,7 +1330,7 @@ class StreamTransport : public Transport {
     FlushOutLocked(p, lane);
   }
 
-  void FlushOutLocked(int p, size_t lane) {
+  void FlushOutLocked(int p, size_t lane) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     if (peer.health != 0) return;  // reconnecting: no wire to write to
     Subflow& sf = peer.sf[lane];
@@ -1499,7 +1513,7 @@ class StreamTransport : public Transport {
   // restores exactly-once delivery. Disarmed, this stays PR-1 fail-stop.
   // Desync on a SUBFLOW lane heals through the same lane-0 recovery: the
   // whole link tears down and the dialer re-establishes every lane.
-  void StreamDesyncLocked(int p) {
+  void StreamDesyncLocked(int p) ACX_REQUIRES(mu_) {
     std::fprintf(stderr, "tpu-acx[%d]: wire desync from %d (bad header)\n",
                  rank_, p);
     if (!recovery_armed_) _exit(14);
@@ -1510,14 +1524,14 @@ class StreamTransport : public Transport {
   // A sequenced frame was delivered in order on this lane: advance its rx
   // clock and ack every 16 frames (the idle flush in ProgressLocked covers
   // quiet tails).
-  void BumpRxLocked(int p, size_t lane, uint64_t seq) {
+  void BumpRxLocked(int p, size_t lane, uint64_t seq) ACX_REQUIRES(mu_) {
     Subflow& sf = peers_[p].sf[lane];
     sf.clk.rx_seq = seq;
     ACX_FLIGHT(kRxData, -1, p, -1, seq, 0);
     if (++sf.clk.rx_since_ack >= 16) SendSeqAckLocked(p, lane);
   }
 
-  void DrainInLocked(int p, size_t lane) {
+  void DrainInLocked(int p, size_t lane) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     Subflow& sf = peer.sf[lane];
     InState& in = sf.in;
@@ -1689,11 +1703,15 @@ class StreamTransport : public Transport {
         // buffer. Three cases: message already delivered (a degraded
         // lane's migrated duplicate) -> drain; recv attached -> write in
         // place at the chunk's offset; else -> assembly buffer.
-        const bool seen = peer.done_stripes.count(in.chdr.msg_id) != 0;
+        // ChunkHdr is packed (alignment 1): copy the key fields into
+        // aligned locals before any container call binds a reference.
+        const uint32_t ck_msg_id = in.chdr.msg_id;
+        const uint32_t ck_idx = in.chdr.idx;
+        const bool seen = peer.done_stripes.count(ck_msg_id) != 0;
         StripeRx* srx = nullptr;
         RecvReq* r = nullptr;
         if (!seen) {
-          srx = &peer.stripes[in.chdr.msg_id];  // chunks may precede the env
+          srx = &peer.stripes[ck_msg_id];  // chunks may precede the env
           r = srx->direct ? srx->direct.get() : nullptr;
           if (r == nullptr) {
             const size_t need =
@@ -1746,9 +1764,9 @@ class StreamTransport : public Transport {
         }
         if (recovery_armed_) BumpRxLocked(p, lane, in.hdr.seq);
         NoteFrameRxLocked(p, lane, in.hdr);
-        if (!seen && srx->got.insert(in.chdr.idx).second) {
+        if (!seen && srx->got.insert(ck_idx).second) {
           if (srx->have_env && srx->got.size() == srx->nchunks)
-            CompleteStripeLocked(p, in.chdr.msg_id);
+            CompleteStripeLocked(p, ck_msg_id);
         }
         in.hdr_got = 0;
         continue;
@@ -1858,7 +1876,7 @@ class StreamTransport : public Transport {
         in.hdr_got = 0;
         // A migrated duplicate envelope for a delivered message (lane
         // degradation window) must not resurrect a reassembly entry.
-        if (peer.done_stripes.count(d.msg_id) == 0)
+        if (peer.done_stripes.count(uint32_t{d.msg_id}) == 0)
           HandleStripeEnvLocked(p, in.hdr, d);
       } else {
         Msg m;
@@ -1875,7 +1893,7 @@ class StreamTransport : public Transport {
     }
   }
 
-  void ProgressLocked() {
+  void ProgressLocked() ACX_REQUIRES(mu_) {
     if (hb_interval_ns_ != 0) HeartbeatLocked();
     if (recovery_armed_) {
       PollRecoveryLocked();
@@ -1943,7 +1961,7 @@ class StreamTransport : public Transport {
     peers_[p].sc_rx_wire += n;
   }
 
-  void HeartbeatLocked() {
+  void HeartbeatLocked() ACX_REQUIRES(mu_) {
     const uint64_t now = NowNs();
     if (now - last_hb_send_ns_ >= hb_interval_ns_) {
       last_hb_send_ns_ = now;
@@ -1990,7 +2008,7 @@ class StreamTransport : public Transport {
   // kErrPeerDead, so every waiter (tickets, barriers, blocking helpers)
   // unblocks in bounded time instead of wedging — the reference's failure
   // mode (SURVEY.md §5.3).
-  void MarkPeerDeadLocked(int p, const char* why, bool hb_detected) {
+  void MarkPeerDeadLocked(int p, const char* why, bool hb_detected) ACX_REQUIRES(mu_) {
     if (peer_dead_[p]) return;
     peer_dead_[p] = true;
     peers_dead_n_.fetch_add(1, std::memory_order_relaxed);
@@ -2123,7 +2141,7 @@ class StreamTransport : public Transport {
   // clean teardown then take the quiet dead-latch fast path instead of a
   // pointless reconnect storm. Replay contents deliberately do NOT count:
   // fully-delivered-but-unacked frames are not in-flight work.
-  bool NothingInFlightLocked(int p) {
+  bool NothingInFlightLocked(int p) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     if (!peer.posted.empty()) return false;
     for (const Subflow& sf : peer.sf) {
@@ -2161,7 +2179,7 @@ class StreamTransport : public Transport {
   // in RECOVERING and start the reconnect ladder, or — when recovery can't
   // help (disarmed, replay gapped) or isn't needed (nothing in flight) —
   // fall through to the PR-1 dead-latch.
-  void StartRecoveryLocked(int p, const char* why) {
+  void StartRecoveryLocked(int p, const char* why) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     if (peer_dead_[p] || peer.health != 0) return;
     if (NothingInFlightLocked(p)) {
@@ -2194,7 +2212,7 @@ class StreamTransport : public Transport {
   // fully healthy fleet it is still polled at a coarse 10ms cadence so a
   // late JOINER (DESIGN.md §12) is never stuck waiting on a failure we
   // haven't noticed — at ~100 cheap EAGAIN accepts/sec, not per-sweep.
-  void PollRecoveryLocked() {
+  void PollRecoveryLocked() ACX_REQUIRES(mu_) {
     const bool urgent =
         recovering_count_.load(std::memory_order_relaxed) != 0 ||
         peers_dead_n_.load(std::memory_order_relaxed) != 0;
@@ -2220,7 +2238,7 @@ class StreamTransport : public Transport {
 
   // One connect() against peer p's abstract-namespace rendezvous listener.
   // Returns the connected fd, or -1 (not listening / no socket).
-  int ConnectListenerLocked(int p) {
+  int ConnectListenerLocked(int p) ACX_REQUIRES(mu_) {
     int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
     if (fd < 0) return -1;
     struct sockaddr_un sa;
@@ -2237,7 +2255,7 @@ class StreamTransport : public Transport {
     return fd;
   }
 
-  void DialPeerLocked(int p) {
+  void DialPeerLocked(int p) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     const uint32_t maxa =
         Policy().reconnect_max.load(std::memory_order_relaxed);
@@ -2274,7 +2292,7 @@ class StreamTransport : public Transport {
   // DialPeerLocked this proposes a FRESH incarnation: seq 0, kHelloJoin
   // set, our fleet epoch riding in bytes; the reply carries the acceptor's
   // post-join fleet epoch the same way.
-  bool DialJoinLocked(int p) {
+  bool DialJoinLocked(int p) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     const int fd = ConnectListenerLocked(p);
     if (fd < 0) return false;  // peer not listening (yet) — sweeps again
@@ -2306,7 +2324,7 @@ class StreamTransport : public Transport {
     return true;
   }
 
-  void HandleDialLocked() {
+  void HandleDialLocked() ACX_REQUIRES(mu_) {
     if (listen_fd_ < 0) return;
     for (;;) {
       const int fd = accept4(listen_fd_, nullptr, nullptr, SOCK_CLOEXEC);
@@ -2402,7 +2420,7 @@ class StreamTransport : public Transport {
   // dead-latch (its in-flight work can never complete), then install the
   // new socket with zeroed wire clocks, clear the dead latch, bump the
   // fleet epoch, and fan the new view over the existing links.
-  void AdoptJoinLocked(int p, int fd, uint32_t agreed) {
+  void AdoptJoinLocked(int p, int fd, uint32_t agreed) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     if (!peer_dead_[p])
       MarkPeerDeadLocked(p, "superseded by joining incarnation",
@@ -2462,7 +2480,7 @@ class StreamTransport : public Transport {
   // Header-only unsequenced membership frame: tag = subject rank, ctx =
   // its new state, bytes = our fleet epoch (see DrainInLocked's receive
   // side). Rides outside the sequence space like heartbeats; always lane 0.
-  void SendViewLocked(int q, int subject, MemberState st, uint64_t fepoch) {
+  void SendViewLocked(int q, int subject, MemberState st, uint64_t fepoch) ACX_REQUIRES(mu_) {
     auto s = std::make_shared<SendReq>();
     s->hdr = MakeHdr(wire::kMagicView, subject, static_cast<int>(st), 0);
     s->hdr.bytes = fepoch;
@@ -2479,7 +2497,7 @@ class StreamTransport : public Transport {
   // the peer hasn't delivered (epoch re-stamped in place), reset inbound
   // assembly. Subflow lanes are untouched — each heals through its own
   // AdoptSubflowLocked.
-  void AdoptLinkLocked(int p, int fd, uint64_t peer_rx, uint32_t agreed) {
+  void AdoptLinkLocked(int p, int fd, uint64_t peer_rx, uint32_t agreed) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     Subflow& sf = peer.sf[0];
     const int fl = fcntl(fd, F_GETFL, 0);
@@ -2565,7 +2583,7 @@ class StreamTransport : public Transport {
   // -- striping subflow lifecycle (DESIGN.md §15) ----------------------------
 
   // Dialer side: fire any due subflow dials for an otherwise healthy link.
-  void EnsureSubflowsLocked(int p) {
+  void EnsureSubflowsLocked(int p) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     if (peer.sf.size() <= 1) return;
     const uint64_t now = NowNs();
@@ -2583,7 +2601,7 @@ class StreamTransport : public Transport {
   // meanwhile. A REDIAL (lane died after being up) walks the same bounded
   // ladder as lane-0 recovery and then DEGRADES the lane instead of
   // killing the link.
-  void DialSubflowLocked(int p, int k) {
+  void DialSubflowLocked(int p, int k) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     Subflow& sf = peer.sf[k];
     const bool redial = sf.clk.epoch > 1;
@@ -2627,7 +2645,7 @@ class StreamTransport : public Transport {
   // frames — the per-lane mirror of AdoptLinkLocked, touching only this
   // lane's clock/replay/assembly.
   void AdoptSubflowLocked(int p, size_t k, int fd, uint64_t peer_rx,
-                          uint32_t agreed) {
+                          uint32_t agreed) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     Subflow& sf = peer.sf[k];
     const bool redial = sf.clk.epoch > 1;
@@ -2708,7 +2726,7 @@ class StreamTransport : public Transport {
   // lane's unacked frames sit in its replay buffer until the redial
   // resolves — replayed on success, migrated by DegradeSubflowLocked on
   // failure.
-  void SubflowLostLocked(int p, size_t k) {
+  void SubflowLostLocked(int p, size_t k) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     if (peer_dead_[p] || peer.health != 0) return;
     Subflow& sf = peer.sf[k];
@@ -2729,7 +2747,7 @@ class StreamTransport : public Transport {
   // frames migrate into lane 0's sequence space with FRESH seq numbers —
   // the receiver's per-stripe got-set and done_stripes dedup absorb any
   // frames that had actually been delivered but not yet acked.
-  void DegradeSubflowLocked(int p, size_t k) {
+  void DegradeSubflowLocked(int p, size_t k) ACX_REQUIRES(mu_) {
     Peer& peer = peers_[p];
     Subflow& sf = peer.sf[k];
     Subflow& sf0 = peer.sf[0];
@@ -2822,22 +2840,23 @@ class StreamTransport : public Transport {
   }
 
   int rank_, size_;
-  std::vector<Peer> peers_;
-  std::mutex mu_;
+  Mutex mu_;
+  std::vector<Peer> peers_ ACX_GUARDED_BY(mu_);
   void* shm_base_;
   size_t shm_len_;
   size_t rv_threshold_ = kRvDefaultThreshold;
   bool rv_force_fallback_ = false;
-  uint32_t rv_next_seq_ = 1;
-  std::unordered_map<uint32_t, std::shared_ptr<SendReq>> rv_pending_;
+  uint32_t rv_next_seq_ ACX_GUARDED_BY(mu_) = 1;
+  std::unordered_map<uint32_t, std::shared_ptr<SendReq>> rv_pending_
+      ACX_GUARDED_BY(mu_);
 
   // -- resilience state (all guarded by mu_ except the atomic counters) --
   uint64_t hb_interval_ns_ = 0;  // 0 = heartbeats off (EOF detection stays on)
   uint64_t peer_timeout_ns_ = 0;
-  uint64_t grace_deadline_ns_ = 0;
-  uint64_t last_hb_send_ns_ = 0;
-  std::vector<uint64_t> last_rx_ns_;
-  std::vector<bool> peer_dead_;
+  uint64_t grace_deadline_ns_ ACX_GUARDED_BY(mu_) = 0;
+  uint64_t last_hb_send_ns_ ACX_GUARDED_BY(mu_) = 0;
+  std::vector<uint64_t> last_rx_ns_ ACX_GUARDED_BY(mu_);
+  std::vector<bool> peer_dead_ ACX_GUARDED_BY(mu_);
   std::atomic<uint64_t> hb_sent_{0};
   std::atomic<uint64_t> hb_recv_{0};
   std::atomic<uint64_t> peers_dead_n_{0};

@@ -73,6 +73,46 @@ import sys
 # Slot states that mean "still waiting on the wire / the peer".
 STUCK_STATES = ("PENDING", "ISSUED", "RECOVERING")
 
+# Every event kind a flight dump can carry (src/core/flightrec.cc
+# kKindNames — dumps carry the NAME, never the raw enum value). The
+# contract is bidirectional and enforced by tools/acx_audit.py
+# (flight_kinds rule, docs/DESIGN.md §18): a kind added to the recorder
+# without a row here fails `make lint`, as does a stale row the
+# recorder no longer emits. An unknown kind in a dump is reported as
+# evidence, not crashed on — it usually means the dump and this tool
+# come from different builds.
+KNOWN_KINDS = {
+    "none",
+    # op lifecycle
+    "isend_enqueue", "irecv_enqueue", "trigger_fired", "isend_issued",
+    "irecv_issued", "op_completed", "wait_observed", "op_timeout",
+    "op_retry", "op_parked", "op_resumed", "op_drained", "slot_reclaimed",
+    "op_fault",
+    # partitioned
+    "psend_slot", "precv_slot", "pready_mark", "pready_wire", "parrived",
+    # wire
+    "tx_data", "tx_rts", "tx_ack", "tx_seqack", "tx_nak",
+    "rx_data", "rx_frame", "rx_seqack", "rx_nak",
+    "link_recovering", "link_up", "peer_dead",
+    # process scope
+    "barrier_enter", "barrier_exit", "stall_warn", "hang_dump",
+    "init", "finalize",
+}
+
+
+def unknown_kinds(dumps):
+    """Event kinds present in the merged dumps that this tool cannot
+    decode: {kind: [ranks]}. Nonempty means a recorder/doctor version
+    skew — the diagnosis still runs, but these events carried no
+    weight in it."""
+    out = {}
+    for rank, d in sorted(dumps.items()):
+        for e in d.get("events", []):
+            k = e.get("kind")
+            if k and k not in KNOWN_KINDS:
+                out.setdefault(k, []).append(rank)
+    return {k: sorted(set(rs)) for k, rs in out.items()}
+
 
 def load_dumps(paths, skipped=None):
     """Parse flight dumps into {rank: dump} (later files win on dup).
@@ -230,7 +270,8 @@ def diagnose(dumps):
             detail += ("; rank %d also produced no flight dump, which "
                        "corroborates it died" % culprit)
         return {"anomaly": anomaly, "culprit": culprit, "detail": detail,
-                "waits": waits, "missing_ranks": gaps}
+                "waits": waits, "missing_ranks": gaps,
+                "unknown_kinds": unknown_kinds(dumps)}
 
     # 1. dead link: a declared-dead peer explains every stuck op on it.
     for rank in sorted(dumps):
@@ -415,6 +456,9 @@ def format_report(dumps, diag, skipped=()):
         lines.append("  skipped unreadable dump %s (%s)" % (path, reason))
     for w in diag["waits"]:
         lines.append("  " + w)
+    for kind, ranks in sorted(diag.get("unknown_kinds", {}).items()):
+        lines.append("  warning: undecodable event kind %r from rank(s) %s "
+                     "(recorder/doctor build skew?)" % (kind, ranks))
     lines.append("diagnosis: %s" % diag["detail"])
     lines.append("anomaly: %s" % diag["anomaly"])
     if diag["culprit"] is not None:
