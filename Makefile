@@ -131,6 +131,7 @@ check: ctest itest tools
 	@$(MAKE) --no-print-directory decode-check || exit 1
 	@$(MAKE) --no-print-directory stripe-check || exit 1
 	@$(MAKE) --no-print-directory disagg-check || exit 1
+	@$(MAKE) --no-print-directory paged-check || exit 1
 	@$(MAKE) --no-print-directory lint || exit 1
 	@$(MAKE) --no-print-directory asan-ctest || exit 1
 	@echo "ALL NATIVE TESTS PASSED"
@@ -399,6 +400,37 @@ disagg-check: tools
 	@echo "== disagg-check: bench.py --dryrun-disagg (TTFT split rows)"
 	@JAX_PLATFORMS=cpu python3 bench.py --dryrun-disagg || exit 1
 	@echo "DISAGG CHECK PASSED"
+
+# --- paged KV cache + radix prefix sharing + page-pressure scheduling
+# (DESIGN.md §19). Four legs: the pytest suite (kernel bit-parity grid,
+# allocator/trie/COW units, serve_paged_greedy vs serve_greedy
+# bit-equality incl. preempt-then-resume, prefix reuse), a CPU interpret
+# smoke of the paged Pallas kernel proper, the 3-rank fleet with decode
+# ranks seating SHIPPED pages (byte-checked against a local monolithic
+# serve) plus the same fleet with the prefill rank SIGKILLed under the
+# chaos oracle, and the bench paged dryrun (HBM-scaling + prefix-TTFT +
+# fixed-budget-concurrency rows land in the newest BENCH_r*.json).
+.PHONY: paged-check
+paged-check: tools
+	@echo "== paged-check: paged KV parity + scheduler suite"
+	@JAX_PLATFORMS=cpu python3 -m pytest tests/test_paged.py -q \
+	  -p no:cacheprovider || exit 1
+	@echo "== paged-check: paged Pallas kernel interpret smoke"
+	@JAX_PLATFORMS=cpu python3 -m pytest \
+	  "tests/test_paged.py::test_paged_flash_bit_equals_fixed_flash" -q \
+	  -p no:cacheprovider || exit 1
+	@echo "== paged-check: 3-rank fleet, decode ranks on paged pools"
+	@ACX_ROLE=prefill,decode,decode $(BUILD)/acxrun -np 3 -timeout 240 \
+	  -transport socket python3 tests/paged_worker.py || exit 1
+	@echo "== paged-check: kill prefill mid-handoff (paged intake rollback)"
+	@rm -rf $(BUILD)/paged-oracle
+	@ACX_ROLE=prefill,decode,decode python3 tools/acx_chaos.py run --np 3 \
+	  --timeout 240 --acxrun $(BUILD)/acxrun \
+	  --out $(BUILD)/paged-oracle/kill --fault kill:rank=0:nth=8 \
+	  -- python3 tests/paged_worker.py || exit 1
+	@echo "== paged-check: bench.py --dryrun-paged (§19 rows land)"
+	@JAX_PLATFORMS=cpu python3 bench.py --dryrun-paged || exit 1
+	@echo "PAGED CHECK PASSED"
 
 # Header dependency tracking (-MMD): a header edit rebuilds its users.
 -include $(LIB_OBJS:.o=.d)

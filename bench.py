@@ -1025,6 +1025,152 @@ def cpu_child_disagg():
     }))
 
 
+def cpu_child_paged():
+    """Child process (forced CPU): the serving_sweep rows for the paged
+    KV plane (models/kvpage.py, DESIGN.md §19). Three claims, each a
+    row family:
+
+    1. HBM KV bytes scale with LIVE tokens, not n_slots*max_len — the
+       same workload served at max_len 64 and 128 holds its paged
+       high-water bytes while the fixed-slot reservation doubles.
+    2. A prefix-cache hit skips the shared prefix's prefill: hit-path
+       TTFT (seat -> first token, timed through on_token on a 1-slot
+       strictly-sequential server) beats the cold path's.
+    3. Max concurrent requests under a FIXED HBM budget: pages buy
+       admission for every request whose live need fits, not only
+       budget/max_len slots — verified by actually serving that
+       concurrency with zero preemptions.
+
+    Shape-deterministic; wall-clock rows are informational (CPU)."""
+    import time as _t
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from mpi_acx_tpu.models import kvpage, serving
+    from mpi_acx_tpu.models import transformer as tfm
+
+    cfg = tfm.tiny_config()
+    params = tfm.init_params(jax.random.key(0), cfg)
+    rng = np.random.default_rng(5)
+    pt, n_slots, chunk = 8, 2, 1
+
+    # -- claim 1: bytes per live token vs max_len ------------------------
+    prompts = [rng.integers(0, cfg.vocab, size=n).astype(np.int32)
+               for n in (5, 11, 17, 8)]
+    n_new = [4, 3, 5, 4]
+    rows = {}
+    for ml in (64, 128):
+        out = serving.serve_paged_greedy(
+            params, cfg, prompts, n_new, n_slots=n_slots, max_len=ml,
+            family=tfm, chunk=chunk, page_tokens=pt,
+            return_paged_state=True)
+        pkv = out.paged_state
+        # Bytes of ONE page across every pool array ([L, P, pt, H, Dh]:
+        # per-page = L * pt * H * Dh * itemsize, summed over k/v).
+        page_bytes = sum(
+            pkv.pool[k].shape[0] * pt
+            * int(np.prod(pkv.pool[k].shape[3:]))
+            * pkv.pool[k].dtype.itemsize for k in pkv.pool)
+        # The fixed-slot server's bf16 k+v reservation at this max_len.
+        fixed = (cfg.n_layers * 2 * n_slots * ml * cfg.n_heads
+                 * cfg.head_dim * 2)
+        rows[f"paged_kv_hwm_bytes_maxlen{ml}"] = \
+            out.metrics.pages_hwm * page_bytes
+        rows[f"fixed_kv_bytes_maxlen{ml}"] = fixed
+    live = sum(len(p) + n for p, n in zip(prompts, n_new))
+    rows["paged_kv_bytes_per_live_token"] = round(
+        rows["paged_kv_hwm_bytes_maxlen64"] / live, 1)
+    rows["fixed_kv_bytes_per_live_token_maxlen64"] = round(
+        rows["fixed_kv_bytes_maxlen64"] / live, 1)
+    # The scaling claim itself: fixed doubles with max_len, paged holds.
+    rows["paged_hbm_maxlen_growth"] = round(
+        rows["paged_kv_hwm_bytes_maxlen128"]
+        / max(rows["paged_kv_hwm_bytes_maxlen64"], 1), 2)
+    rows["fixed_hbm_maxlen_growth"] = round(
+        rows["fixed_kv_bytes_maxlen128"]
+        / rows["fixed_kv_bytes_maxlen64"], 2)
+
+    # -- claim 2: prefix-hit vs cold TTFT (1 slot = sequential seats) ----
+    system = rng.integers(0, cfg.vocab, 24).astype(np.int32)  # 3 pages
+    shared = [np.concatenate([system,
+                              rng.integers(0, cfg.vocab, 4 + i)
+                              .astype(np.int32)]) for i in range(4)]
+
+    def ttfts(prefix_cache):
+        stamps = {}
+        t0 = _t.perf_counter()
+
+        def on_token(rid, tok):
+            stamps.setdefault(rid, []).append(_t.perf_counter())
+
+        out = serving.serve_paged_greedy(
+            params, cfg, shared, 4, n_slots=1, max_len=40, family=tfm,
+            page_tokens=pt, prefix_cache=prefix_cache, on_token=on_token)
+        # Seat time for rid i on the 1-slot server is rid i-1's last
+        # token (or serve start); TTFT = first token - seat.
+        tt = []
+        for rid in range(len(shared)):
+            seat = t0 if rid == 0 else stamps[rid - 1][-1]
+            tt.append(stamps[rid][0] - seat)
+        return out, tt
+
+    ttfts(False)                                  # warm compile caches
+    ttfts(True)
+    out_cold, tt_cold = ttfts(False)
+    out_hit, tt_hit = ttfts(True)
+    assert out_hit.metrics.prefix_hits >= 3, out_hit.metrics
+    # p50 over the requests that CAN hit (rid >= 1).
+    rows["paged_prefix_cold_ttft_p50_ms"] = round(
+        sorted(tt_cold[1:])[len(tt_cold[1:]) // 2] * 1e3, 3)
+    rows["paged_prefix_hit_ttft_p50_ms"] = round(
+        sorted(tt_hit[1:])[len(tt_hit[1:]) // 2] * 1e3, 3)
+    rows["paged_prefix_pages_reused"] = out_hit.metrics.prefix_pages_reused
+
+    # -- claim 3: max concurrency at a fixed HBM budget ------------------
+    # Budget: the fixed-slot server's 4-slot, max_len=64 reservation =
+    # 32 pages of 8. Fixed admits 4 concurrent requests, period; paged
+    # admits every request whose LIVE need fits the pool.
+    budget_pages = 4 * (64 // pt)
+    S, n = 8, 8
+    need = kvpage.pages_needed(S + n + chunk, pt)
+    max_conc = budget_pages // need
+    many = [rng.integers(0, cfg.vocab, S).astype(np.int32)
+            for _ in range(max_conc)]
+    out = serving.serve_paged_greedy(
+        params, cfg, many, n, n_slots=max_conc, max_len=64, family=tfm,
+        chunk=chunk, page_tokens=pt, n_pages=budget_pages,
+        return_paged_state=True)
+    assert out.metrics.preemptions == 0, out.metrics
+    assert all(not isinstance(o, serving.RequestRejected) for o in out)
+    rows.update({
+        "fixed_max_concurrent_at_budget": 4,
+        "paged_max_concurrent_at_budget": max_conc,
+        "paged_concurrency_gain": round(max_conc / 4, 2),
+        "paged_budget_pages_hwm": out.metrics.pages_hwm,
+        "device": str(jax.devices()[0].platform),
+    })
+    print(json.dumps(rows))
+
+
+def _record_paged_rows(rows):
+    """Fold the paged serving-sweep rows into the newest BENCH_r*.json
+    (same merge-never-fail contract as _record_disagg_rows)."""
+    import glob
+    files = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    if not files:
+        return
+    try:
+        with open(files[-1]) as f:
+            d = json.load(f)
+        d["paged"] = rows
+        with open(files[-1], "w") as f:
+            json.dump(d, f)
+            f.write("\n")
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def _run_cpu_child(mode: str, timeout: int = 300):
     """_run_tpu_child with a forced 8-virtual-device CPU backend (the
     pinned axon platform must never initialize here)."""
@@ -1081,6 +1227,15 @@ def main(full: bool = False):
         _record_disagg_rows({**(db or {}), **drows})
     except Exception as e:  # noqa: BLE001 — report, don't crash
         out["disagg_fleet_error"] = str(e)
+
+    # Paged-KV serving sweep (CPU child): HBM-per-live-token scaling,
+    # prefix-hit TTFT split, fixed-budget concurrency (DESIGN.md §19).
+    pb, perr2 = _run_cpu_child("paged")
+    if pb is not None:
+        out.update(pb)
+        _record_paged_rows(pb)
+    else:
+        out["paged_error"] = perr2
 
     # Deterministic, chip-independent design metric (CPU-compiled HLO).
     qb, qerr = _run_cpu_child("quant")
@@ -1362,8 +1517,44 @@ def dryrun_disagg():
                       "rows": {k: rows[k] for k in need}}))
 
 
+def dryrun_paged():
+    """`make paged-check` hook: run the paged serving child in-process
+    on the tiny CPU geometry and assert the three §19 row families
+    actually land — the HBM-scaling rows, the prefix-hit TTFT split,
+    and the fixed-budget concurrency rows — catching scheduler
+    breakage and row-name drift before a bench window burns minutes on
+    it. The 3-rank paged fleet runs in the same make target as its own
+    acxrun legs, so this dryrun stays single-process."""
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        cpu_child_paged()
+    rows = json.loads(buf.getvalue().strip().splitlines()[-1])
+    need = ["paged_kv_hwm_bytes_maxlen64", "paged_kv_hwm_bytes_maxlen128",
+            "fixed_kv_bytes_maxlen64", "fixed_kv_bytes_maxlen128",
+            "paged_kv_bytes_per_live_token", "paged_hbm_maxlen_growth",
+            "fixed_hbm_maxlen_growth", "paged_prefix_cold_ttft_p50_ms",
+            "paged_prefix_hit_ttft_p50_ms", "paged_prefix_pages_reused",
+            "paged_max_concurrent_at_budget", "paged_concurrency_gain"]
+    missing = [k for k in need if k not in rows]
+    assert missing == [], f"paged dryrun: rows missing {missing}"
+    # The acceptance shape: the fixed reservation doubles with max_len,
+    # the paged high-water does not move (live tokens are unchanged);
+    # pages buy strictly more concurrency than slots at equal HBM.
+    assert rows["fixed_hbm_maxlen_growth"] == 2.0, rows
+    assert rows["paged_hbm_maxlen_growth"] == 1.0, rows
+    assert rows["paged_concurrency_gain"] > 1, rows
+    assert rows["paged_prefix_pages_reused"] >= 9, rows  # 3 hits * 3 pages
+    _record_paged_rows(rows)
+    print(json.dumps({"dryrun_paged_ok": True,
+                      "rows": {k: rows[k] for k in need}}))
+
+
 if __name__ == "__main__":
-    if "--dryrun-decode" in sys.argv or "--dryrun-disagg" in sys.argv:
+    if ("--dryrun-decode" in sys.argv or "--dryrun-disagg" in sys.argv
+            or "--dryrun-paged" in sys.argv):
         # The dryrun is a correctness smoke, never a measurement: force
         # the tiny CPU geometry no matter how it was invoked.
         os.environ["ACX_BENCH_TINY"] = "1"
@@ -1378,8 +1569,12 @@ if __name__ == "__main__":
         cpu_child_quant()
     elif "--cpu-child-disagg" in sys.argv:
         cpu_child_disagg()
+    elif "--cpu-child-paged" in sys.argv:
+        cpu_child_paged()
     elif "--dryrun-disagg" in sys.argv:
         dryrun_disagg()
+    elif "--dryrun-paged" in sys.argv:
+        dryrun_paged()
     elif "--tpu-child-probe" in sys.argv:
         tpu_child_probe()
     elif "--tpu-child-fwd" in sys.argv:

@@ -64,11 +64,13 @@ void test_hist_names() {
 }
 
 void test_gauge_set() {
-  // Exactly the two documented gauges (metrics.h counters-vs-gauges note);
-  // everything else is a cumulative counter the fleet tools may sum.
+  // Exactly the four documented gauges (metrics.h counters-vs-gauges
+  // note); everything else is a cumulative counter the fleet tools may
+  // sum.
   for (int i = 0; i < kNumCounters; i++) {
     Counter c = static_cast<Counter>(i);
-    bool want = (c == kFleetEpoch || c == kSlotHighWater);
+    bool want = (c == kFleetEpoch || c == kSlotHighWater ||
+                 c == kPagesFree || c == kPagesShared);
     CHECK(IsGauge(c) == want);
   }
 }
@@ -99,6 +101,8 @@ void test_snapshot_carries_every_name() {
   CHECK(js.find("\"gauges\":[") != std::string::npos);
   CHECK(js.find("\"fleet_epoch\"") != std::string::npos);
   CHECK(js.find("\"slot_hwm\"") != std::string::npos);
+  CHECK(js.find("\"pages_free\"") != std::string::npos);
+  CHECK(js.find("\"pages_shared\"") != std::string::npos);
   CHECK(js.find("\"proxy_util_pct\":") != std::string::npos);
 
   // Point reads agree with what was recorded above.

@@ -21,12 +21,15 @@
 //
 // Counters vs gauges: most entries are monotonic cumulative counters
 // (difference two snapshots for a rate; fleet aggregation sums them).
-// Two are gauges and must not be summed or differenced as counters:
+// Four are gauges and must not be summed or differenced as counters:
 // kFleetEpoch is the current epoch value (an absolute reading that can
-// only be compared for ordering on one rank), and kSlotHighWater is a
-// monotonic max watermark (aggregates across ranks as a max). The JSON
-// snapshot lists them under "gauges"; the tseries sampler reports them
-// absolute per sample instead of delta-encoded.
+// only be compared for ordering on one rank), kSlotHighWater is a
+// monotonic max watermark (aggregates across ranks as a max), and
+// kPagesFree / kPagesShared are the serving layer's paged-KV pool
+// occupancy readings (models/kvpage.py mirrors them through
+// acx_serving_page_stats). The JSON snapshot lists them under
+// "gauges"; the tseries sampler reports them absolute per sample
+// instead of delta-encoded.
 
 #pragma once
 
@@ -78,6 +81,11 @@ enum Counter : int {
   kParrivedsObserved,  // partitions first observed arrived by MPIX_Parrived
                        // (per round; repeated polls of an arrived partition
                        // do not re-count)
+  kPagesFree,          // paged-KV pool: free pages right now (gauge)
+  kPagesShared,        // paged-KV pool: pages with refcount > 1 (gauge)
+  kPrefixHits,         // radix prefix-cache prompt matches (serving layer)
+  kPrefixEvictions,    // prefix-cache pages evicted under pool pressure
+  kPreemptions,        // requests preempted by page pressure (requeued)
   kNumCounters
 };
 
@@ -111,8 +119,9 @@ uint64_t Value(Counter c);
 // counts too when `buckets` is non-null.
 void HistRead(Hist h, uint64_t* count, uint64_t* sum, uint64_t* buckets);
 
-// True for the gauge entries (kFleetEpoch, kSlotHighWater — see the
-// counters-vs-gauges note above); false for cumulative counters.
+// True for the gauge entries (kFleetEpoch, kSlotHighWater, kPagesFree,
+// kPagesShared — see the counters-vs-gauges note above); false for
+// cumulative counters.
 bool IsGauge(Counter c);
 
 // Raw mutation (relaxed atomics; callers gate on Enabled()).
@@ -133,7 +142,7 @@ void MarkWait(int64_t slot);
 // bytes including the NUL) and returns the byte length needed excluding
 // the NUL (call with cap=0 to size). The snapshot schema is
 //   {"enabled":..., "counters":{...}, "histograms":{...},
-//    "gauges":["fleet_epoch","slot_hwm"],
+//    "gauges":["fleet_epoch","slot_hwm","pages_free","pages_shared"],
 //    "derived":{"proxy_util_pct":...}}
 // where "gauges" names the counter entries that are absolute readings
 // (never sum or difference them) and "derived" carries rates computed

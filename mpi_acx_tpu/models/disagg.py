@@ -683,10 +683,21 @@ def run_prefill_worker(rt, params, cfg, prompts, max_len, family=None,
 def run_decode_worker(rt, params, cfg, prompts, n_new, n_slots, max_len,
                       family=None, eos=None, chunk: int = 1,
                       server_fns=None, max_request_retries: int = 2,
-                      poll_timeout_s: float = 30.0) -> ServedBatch:
+                      poll_timeout_s: float = 30.0,
+                      page_tokens: int = None,
+                      n_pages: int = None) -> ServedBatch:
     """Decode rank's loop: consume handoffs from the prefill rank,
     splice them into slot caches, and generate. Returns a ServedBatch
     with this rank's requests filled in (None rows elsewhere).
+
+    ``page_tokens`` switches the decode cache from fixed per-slot rows
+    to the paged pool (models/kvpage.py): an inbound handoff's bucket
+    rows land in freshly allocated pages (the wire already carries
+    int8 codes + f32 scales — exactly the page-resident form, so the
+    splice is a page scatter, no re-quantization) and the request's
+    FULL page budget (prompt + n_new + chunk) is reserved at seat
+    time — a seated request can never be starved mid-decode by a later
+    arrival. Outputs stay bit-equal to the fixed-slot worker's.
 
     Failure semantics: a handoff that dies mid-flight (prefill rank
     killed) raises out of the intake; the request is requeued —
@@ -706,15 +717,31 @@ def run_decode_worker(rt, params, cfg, prompts, n_new, n_slots, max_len,
     my_rids = [rid for rid in range(len(prompts))
                if decode_ranks[rid % len(decode_ranks)] == rt.rank]
 
-    if server_fns is None:
-        server_fns = make_server_fns(params, cfg, family, chunk=chunk,
-                                     kv_int8=True)
-    (_, step_fn, scatter_fn, fns_chunk, fns_int8, fns_sample) = server_fns
-    assert fns_chunk == chunk and fns_int8 and fns_sample is None
+    paged = page_tokens is not None
+    if paged:
+        from mpi_acx_tpu.models import kvpage
+        pt = int(page_tokens)
+        assert max_len % pt == 0, (max_len, pt)
+        if n_pages is None:
+            n_pages = n_slots * (max_len // pt)
+        pkv = kvpage.PagedKV(cfg, family, n_slots, max_len, pt, n_pages,
+                             kv_int8=True)
+        step_fn = kvpage.make_paged_step_fn(params, cfg, family, chunk,
+                                            pt)
+        scatter_fn = None
+    else:
+        if server_fns is None:
+            server_fns = make_server_fns(params, cfg, family, chunk=chunk,
+                                         kv_int8=True)
+        (_, step_fn, scatter_fn, fns_chunk, fns_int8,
+         fns_sample) = server_fns
+        assert fns_chunk == chunk and fns_int8 and fns_sample is None
 
     receiver = KvReceiver(rt, cfg.n_layers, cfg.n_heads, cfg.head_dim)
-    slots = family.init_kv_cache(cfg, n_slots, max_len, kv_int8=True)
-    slots["pos"] = jnp.zeros((n_slots,), jnp.int32)
+    slots = family.init_kv_cache(cfg, n_slots, max_len, kv_int8=True) \
+        if not paged else None
+    if not paged:
+        slots["pos"] = jnp.zeros((n_slots,), jnp.int32)
     owner = [-1] * n_slots
     emitted = {rid: [] for rid in my_rids}
     done: List[Optional[np.ndarray]] = [None] * len(prompts)
@@ -772,7 +799,30 @@ def run_decode_worker(rt, params, cfg, prompts, n_new, n_slots, max_len,
                 return False      # re-ship duplicate: drained, dropped
             t_pick = time.perf_counter()
             one = {k: jnp.asarray(v) for k, v in one.items()}
-            slots = scatter_fn(slots, one, b, S)
+            if paged:
+                # Reserve the request's FULL page budget up front (no
+                # growth path in this loop) and splice the wire's
+                # int8+scales bucket rows — already the page-resident
+                # form — straight into the prompt pages.
+                need = kvpage.pages_needed(S + n_new[rid] + chunk, pt)
+                pages = pkv.alloc_evicting(need)
+                if pages is None:
+                    raise RuntimeError(
+                        f"decode rank {rt.rank}: page pool dry seating "
+                        f"rid={rid} (need {need} pages, "
+                        f"{pkv.alloc.free_count} free) — size n_pages "
+                        "to n_slots*max_len/page_tokens")
+                try:
+                    pkv.scatter_prompt(
+                        {k: v for k, v in one.items() if k != "pos"},
+                        pages[:kvpage.pages_needed(S, pt)])
+                    pkv.seat(b, [], pages, S)
+                except Exception:
+                    for p in pages:
+                        pkv.alloc.decref(p)
+                    raise
+            else:
+                slots = scatter_fn(slots, one, b, S)
             pickup_s = time.perf_counter() - t_pick
         except Exception as exc:  # noqa: BLE001 — any handoff failure
             nonlocal n_hang_dumps
@@ -811,7 +861,10 @@ def run_decode_worker(rt, params, cfg, prompts, n_new, n_slots, max_len,
         pending.discard(rid)
         seated.discard(rid)
         owner[b] = -1
-        slots["pos"] = slots["pos"].at[b].set(0)
+        if paged:
+            pkv.release(b)        # pages back to the pool, slot parked
+        else:
+            slots["pos"] = slots["pos"].at[b].set(0)
 
     def slot_finished(b):
         rid = owner[b]
@@ -829,7 +882,16 @@ def run_decode_worker(rt, params, cfg, prompts, n_new, n_slots, max_len,
         if not any(o >= 0 for o in owner):
             continue
         step_t0 = time.perf_counter()
-        slots, toks, keys = step_fn(slots, jnp.asarray(last_tok), keys)
+        if paged:
+            state = pkv.device_state()
+            state, toks, keys = step_fn(state, jnp.asarray(last_tok),
+                                        keys)
+            pkv.absorb(state)
+            kvpage.publish_page_stats_best_effort(
+                pkv.alloc.free_count, pkv.alloc.shared_count(), 0, 0, 0)
+        else:
+            slots, toks, keys = step_fn(slots, jnp.asarray(last_tok),
+                                        keys)
         block = np.asarray(toks, np.int32)
         step_dt = time.perf_counter() - step_t0
         n_steps += 1

@@ -1,0 +1,693 @@
+"""Paged KV cache: block-table allocator + radix prefix sharing.
+
+The serving stack (models/serving.py) and the disagg decode workers
+(models/disagg.py) historically gave every slot a private
+``[max_len]`` cache row, so a request at position 40 of a 4096-token
+cache owned 4096 positions of HBM even though the flash-decode kernel
+no longer *reads* the dead tail — at scale the server is
+memory-capacity-bound, not compute-bound. This module replaces the
+per-slot rows with a shared pool of fixed-size PAGES (default 128
+tokens, matching flash-decode's block granularity) and a per-slot
+block table:
+
+* **Pool** — ``{'k','v': [L, P, page_tokens, H, Dh]}`` device buffers
+  (plus ``'ks','vs'`` f32 scale pages when the cache is int8 —
+  ops/kvquant.py codes + scales stay the only page-resident form, the
+  same EQuARX rule the wire plane enforces). The trailing ``n_slots``
+  pages of P are per-slot PARKING pages: an idle slot's table points
+  every entry at its own parking page, so the lockstep decode step's
+  writes for idle slots land somewhere harmless instead of corrupting
+  pages a live request owns.
+* **Block tables** — host-side ``[n_slots, max_pages]`` int32 rows
+  (mirrored to the device per step) mapping token position
+  ``t`` of slot ``b`` to pool page ``table[b, t // page_tokens]``.
+* **Allocator** — :class:`PageAllocator`: a free list plus per-page
+  refcounts; pages are shared by refcount and reclaimed at zero.
+* **Radix prefix cache** — :class:`RadixPrefixCache`: a trie over
+  full-page token chunks, so requests sharing a system prompt store
+  the shared pages ONCE; a prefix hit seats the cached pages and
+  prefill runs only on the suffix (:func:`prefill_with_history`).
+  Shared pages are never written (the matched depth is capped so the
+  suffix always starts at a page boundary with >= 1 fresh token);
+  copy-on-write (:meth:`PagedKV.ensure_writable`) guards the
+  invariant defensively.
+
+Bit-equality contract: the paged dense attend gathers the slot's
+pages into the SAME ``[B, max_len, H, Dh]`` shape the fixed-slot path
+attends (mpi_acx_tpu/ops/flash_decode.py:paged_gather_attend), so on
+a cold (no-prefix-hit) schedule paged greedy serving is bit-equal to
+fixed-slot ``serve_greedy`` — dead gathered positions contribute
+exactly 0.0 through the masked softmax (finite garbage, never NaN).
+Prefix-HIT prefills compute the suffix against the stored pages with
+different tensor shapes than the cold full-prompt pass, so hit-path
+outputs are deterministic per backend but not bitwise-pinned to the
+cold path (docs/DESIGN.md §19).
+
+The paged decode step is transformer-family-scoped (the
+``make_layerwise_prefill_fns`` precedent in models/disagg.py): it
+closes over the GPT-2 block internals. Other families raise loudly.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def default_page_tokens(max_len: int) -> int:
+    """Default page size: ``$ACX_KV_PAGE_TOKENS`` (128 unset — the
+    flash-decode block granularity), stepped down to the largest
+    divisor of ``max_len`` so the table tiles the cache exactly."""
+    want = int(os.environ.get("ACX_KV_PAGE_TOKENS", "128") or "128")
+    want = max(1, min(want, max_len))
+    while max_len % want:
+        want -= 1
+    return want
+
+
+def pages_needed(tokens: int, page_tokens: int) -> int:
+    return -(-int(tokens) // page_tokens)            # ceil div
+
+
+# --------------------------------------------------------------------------
+# Allocator
+
+
+class PageAllocator:
+    """Host-side page bookkeeping: a deterministic (lowest-id-first)
+    free list plus per-page refcounts. All-or-nothing allocation; a
+    page is reclaimed exactly when its refcount reaches zero."""
+
+    def __init__(self, n_pages: int):
+        assert n_pages >= 1, n_pages
+        self.n_pages = int(n_pages)
+        # pop() takes from the end; storing descending ids hands out
+        # page 0 first — deterministic layouts for reproducible tests.
+        self._free = list(range(self.n_pages - 1, -1, -1))
+        self._ref = [0] * self.n_pages
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.n_pages - len(self._free)
+
+    def shared_count(self) -> int:
+        """Pages referenced by more than one owner (slot or trie)."""
+        return sum(1 for r in self._ref if r > 1)
+
+    def refcount(self, page: int) -> int:
+        return self._ref[page]
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n fresh pages at refcount 1, or None (nothing allocated)
+        when fewer than n are free."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._ref[p] = 1
+        return pages
+
+    def incref(self, page: int) -> None:
+        assert self._ref[page] > 0, (page, "incref of a free page")
+        self._ref[page] += 1
+
+    def decref(self, page: int) -> bool:
+        """Drop one reference; True iff the page was reclaimed."""
+        assert self._ref[page] > 0, (page, "decref of a free page")
+        self._ref[page] -= 1
+        if self._ref[page] == 0:
+            self._free.append(page)
+            # Keep the free list sorted descending so reclaimed pages
+            # re-issue lowest-first too (determinism under churn).
+            self._free.sort(reverse=True)
+            return True
+        return False
+
+
+# --------------------------------------------------------------------------
+# Radix prefix cache
+
+
+class _TrieNode:
+    __slots__ = ("children", "page", "stamp")
+
+    def __init__(self, page: int = -1):
+        self.children: Dict[Tuple[int, ...], "_TrieNode"] = {}
+        self.page = page
+        self.stamp = 0
+
+
+class RadixPrefixCache:
+    """Trie over FULL-PAGE token chunks. ``match`` walks the prompt's
+    complete pages and increfs every page on the matched path (the
+    caller owns those references until it releases the slot);
+    ``insert`` adopts a served request's prompt pages into the trie
+    (incref — the trie is an owner like any slot). Eviction removes
+    least-recently-matched LEAVES only, so an interior page can never
+    outlive a cached extension of it.
+
+    Invariant (why shared pages are never written): ``match`` caps the
+    hit depth at ``(S - 1) // page_tokens`` — the suffix keeps >= 1
+    token and starts exactly at a page boundary, so every position a
+    prefill or decode write touches lands in a freshly allocated page.
+    """
+
+    def __init__(self, alloc: PageAllocator, page_tokens: int):
+        self.alloc = alloc
+        self.page_tokens = page_tokens
+        self.root = _TrieNode()
+        self._clock = 0
+        self.hits = 0            # matches with depth >= 1 page
+        self.evictions = 0       # pages evicted (LRU leaves)
+        self.pages_reused = 0    # cumulative pages handed out by match
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def match(self, prompt: np.ndarray) -> List[int]:
+        """Longest cached full-page prefix of ``prompt``; increfs and
+        returns its pages (possibly empty). Depth capped so at least
+        one suffix token remains (see class docstring)."""
+        max_depth = (len(prompt) - 1) // self.page_tokens
+        node, pages = self.root, []
+        stamp = self._tick()
+        for d in range(max_depth):
+            chunk = tuple(
+                int(t) for t in
+                prompt[d * self.page_tokens:(d + 1) * self.page_tokens])
+            nxt = node.children.get(chunk)
+            if nxt is None:
+                break
+            nxt.stamp = stamp
+            pages.append(nxt.page)
+            node = nxt
+        for p in pages:
+            self.alloc.incref(p)
+        if pages:
+            self.hits += 1
+            self.pages_reused += len(pages)
+        return pages
+
+    def insert(self, prompt: np.ndarray, pages: List[int]) -> int:
+        """Adopt the prompt's full pages (``pages[d]`` backs chunk d)
+        into the trie; returns how many pages were newly adopted."""
+        node, adopted = self.root, 0
+        stamp = self._tick()
+        n_full = len(prompt) // self.page_tokens
+        for d in range(min(n_full, len(pages))):
+            chunk = tuple(
+                int(t) for t in
+                prompt[d * self.page_tokens:(d + 1) * self.page_tokens])
+            nxt = node.children.get(chunk)
+            if nxt is None:
+                nxt = _TrieNode(pages[d])
+                node.children[chunk] = nxt
+                self.alloc.incref(pages[d])
+                adopted += 1
+            nxt.stamp = stamp
+            node = nxt
+        return adopted
+
+    def evict_one(self) -> bool:
+        """Drop the least-recently-matched leaf (decref its page).
+        Returns False when the trie is empty."""
+        best = None  # (stamp, parent, key, node)
+        stack = [(self.root, None, None)]
+        while stack:
+            node, parent, key = stack.pop()
+            if parent is not None and not node.children:
+                if best is None or node.stamp < best[0]:
+                    best = (node.stamp, parent, key, node)
+            for k, ch in node.children.items():
+                stack.append((ch, node, k))
+        if best is None:
+            return False
+        _, parent, key, node = best
+        del parent.children[key]
+        self.alloc.decref(node.page)
+        self.evictions += 1
+        return True
+
+
+# --------------------------------------------------------------------------
+# Device pool
+
+
+def init_page_pool(cfg, n_pages: int, page_tokens: int, n_slots: int,
+                   kv_int8: bool = False):
+    """Zeroed page pool: ``{'k','v': [L, P, page_tokens, H, Dh]}``
+    (+ ``'ks','vs'`` f32 scale pages when int8) with
+    ``P = n_pages + n_slots`` — the trailing ``n_slots`` pages are the
+    per-slot parking pages (module docstring), outside the allocator."""
+    P = n_pages + n_slots
+    shape = (cfg.n_layers, P, page_tokens, cfg.n_heads, cfg.head_dim)
+    pool = {
+        "k": jnp.zeros(shape, jnp.int8 if kv_int8 else cfg.dtype),
+        "v": jnp.zeros(shape, jnp.int8 if kv_int8 else cfg.dtype),
+    }
+    if kv_int8:
+        pool["ks"] = jnp.zeros(shape[:-1] + (1,), jnp.float32)
+        pool["vs"] = jnp.zeros(shape[:-1] + (1,), jnp.float32)
+    return pool
+
+
+_POOL_KEYS = ("k", "v", "ks", "vs")
+
+
+def _check_family(family) -> None:
+    name = getattr(family, "__name__", "").rsplit(".", 1)[-1]
+    if family is not None and name != "transformer":
+        raise NotImplementedError(
+            "paged KV serving closes over the GPT-2 block internals "
+            "(the make_layerwise_prefill_fns precedent); family "
+            f"{name!r} is not wired yet — use models.transformer")
+
+
+# --------------------------------------------------------------------------
+# Paged decode step (transformer family)
+
+
+def paged_decode_step(params, cfg, state, token, page_tokens: int,
+                      ffn=None):
+    """One autoregressive step against the page pool; mirrors
+    ``transformer.decode_step`` exactly (same _qkv/attend/ffn math, so
+    active slots are bit-equal to the fixed-slot step) with the cache
+    writes routed through the block table: layer i's fresh K/V for
+    slot b lands at ``pool[i, table[b, pos_b // pt], pos_b % pt]``.
+    ``state`` = pool keys + ``'table'`` [B, max_pages] + ``'pos'``
+    [B]. Idle slots write their parking page (their table rows point
+    nowhere else) and the page index is clipped so a long-idle slot's
+    walking pos can never index past its table row."""
+    from mpi_acx_tpu.models import transformer as tfm
+    from mpi_acx_tpu.ops.flash_decode import select_paged_decode_attend
+    from mpi_acx_tpu.ops.kvquant import kv_quant
+    from mpi_acx_tpu.ops.wquant import wread
+
+    ffn = ffn or tfm._mlp
+    table, pos = state["table"], state["pos"]
+    B, max_pages = table.shape
+    quant = "ks" in state
+    pe = params["pos"][pos][:, None, :]
+    x = (params["embed"][token][:, None, :] + pe).astype(cfg.dtype)
+
+    write_page = jnp.take_along_axis(
+        table, jnp.minimum(pos // page_tokens, max_pages - 1)[:, None],
+        axis=1)[:, 0]                                  # [B]
+    off = pos % page_tokens
+
+    def write(pool, fresh, i):
+        """pool [L, P, pt, H, *]; fresh [B, 1, H, *] -> slot b's row
+        (write_page[b], off[b]). Distinct pages per slot (each slot
+        owns its pages; idle slots own their parking page), so the
+        scatter never collides."""
+        layer = lax.dynamic_index_in_dim(pool, i, 0, keepdims=False)
+        layer = layer.at[write_page, off].set(
+            fresh[:, 0].astype(pool.dtype))
+        return lax.dynamic_update_index_in_dim(pool, layer, i, 0)
+
+    attend = select_paged_decode_attend(cfg.decode_flash)
+
+    def body(carry, i):
+        if quant:
+            x, kp, vp, ksp, vsp = carry
+        else:
+            x, kp, vp = carry
+        lp = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+            params["layers"])
+        q, k, v = tfm._qkv(cfg, lp, x)
+        if quant:
+            k, ks = kv_quant(k)
+            v, vs = kv_quant(v)
+            ksp = write(ksp, ks, i)
+            vsp = write(vsp, vs, i)
+        kp = write(kp, k, i)
+        vp = write(vp, v, i)
+        kl = lax.dynamic_index_in_dim(kp, i, 0, keepdims=False)
+        vl = lax.dynamic_index_in_dim(vp, i, 0, keepdims=False)
+        if quant:
+            kl = (kl, lax.dynamic_index_in_dim(ksp, i, 0, keepdims=False))
+            vl = (vl, lax.dynamic_index_in_dim(vsp, i, 0, keepdims=False))
+        o = attend(q, kl, vl, table, pos, page_tokens, 1)
+        x = ffn(cfg, lp, x + o @ wread(lp, "wo", x.dtype))
+        if quant:
+            return (x, kp, vp, ksp, vsp), None
+        return (x, kp, vp), None
+
+    n_layers = jax.tree.leaves(params["layers"])[0].shape[0]
+    if quant:
+        carry = (x, state["k"], state["v"], state["ks"], state["vs"])
+        (x, kp, vp, ksp, vsp), _ = lax.scan(body, carry,
+                                            jnp.arange(n_layers))
+        out = {"k": kp, "v": vp, "ks": ksp, "vs": vsp}
+    else:
+        (x, kp, vp), _ = lax.scan(body, (x, state["k"], state["v"]),
+                                  jnp.arange(n_layers))
+        out = {"k": kp, "v": vp}
+    out["table"] = table
+    out["pos"] = pos + 1
+    x = tfm.layernorm(x, params["lnf_g"], params["lnf_b"])
+    logits = jnp.einsum("bsd,vd->bsv", x,
+                        params["embed"].astype(x.dtype),
+                        preferred_element_type=jnp.float32)[:, 0]
+    return logits, out
+
+
+def make_paged_step_fn(params, cfg, family, chunk: int,
+                       page_tokens: int):
+    """Jitted chunked decode step over the paged state (the paged
+    sibling of make_server_fns' step_fn — greedy only; the state is
+    donated so XLA updates the pool in place)."""
+    _check_family(family)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step_fn(state, tok, keys):
+        def one(carry, _):
+            state, tok, keys = carry
+            logits, state = paged_decode_step(params, cfg, state, tok,
+                                              page_tokens)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (state, nxt, keys), nxt
+        (state, _, keys), toks = lax.scan(one, (state, tok, keys), None,
+                                          length=chunk)
+        return state, toks, keys
+
+    return step_fn
+
+
+# --------------------------------------------------------------------------
+# Prefix-hit suffix prefill
+
+
+def prefill_with_history(params, cfg, suffix, hk, hv, last_index,
+                         ffn=None):
+    """Prefill ONLY the suffix of a prompt whose first ``P`` tokens'
+    K/V are already paged in (a radix prefix hit): ``suffix``
+    [1, S_suf] tokens occupying absolute positions ``P..P+S_suf-1``,
+    ``hk``/``hv`` [L, P, H, Dh] the gathered (dequantized) history.
+    Per layer the suffix queries attend ``concat(history, suffix)``
+    through the shared :func:`dense_decode_attend` definition (pos=P
+    scalar — row w sees cols <= P + w, full history + causal suffix).
+    Returns (logits [1, 1, vocab] at ``last_index``, suffix K/V
+    [L, 1, S_suf, H, Dh] in compute dtype, ready for page scatter).
+
+    The compute skipped is the point: a hit at depth P runs S_suf
+    rows through the trunk instead of P + S_suf. The cost is bitwise
+    freedom — the concat shapes differ from the cold full-prompt
+    pass, so hit-path logits match cold only to numerics (docs/
+    DESIGN.md §19)."""
+    from mpi_acx_tpu.models import transformer as tfm
+    from mpi_acx_tpu.models.decoding import dense_decode_attend
+    from mpi_acx_tpu.ops.wquant import wread
+
+    ffn = ffn or tfm._mlp
+    B, Sb = suffix.shape
+    P = hk.shape[1]
+    x = (params["embed"][suffix]
+         + params["pos"][P:P + Sb]).astype(cfg.dtype)
+
+    def body(x, xs):
+        lp, hkl, hvl = xs
+        q, k, v = tfm._qkv(cfg, lp, x)
+        kcat = jnp.concatenate([hkl[None].astype(x.dtype), k], axis=1)
+        vcat = jnp.concatenate([hvl[None].astype(x.dtype), v], axis=1)
+        o = dense_decode_attend(q, kcat, vcat, P, P + Sb, 1)
+        x = x + o @ wread(lp, "wo", x.dtype)
+        return ffn(cfg, lp, x), (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, (params["layers"], hk, hv))
+    x = tfm.layernorm(x, params["lnf_g"], params["lnf_b"])
+    x = lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)
+    logits = jnp.einsum("bsd,vd->bsv", x,
+                        params["embed"].astype(x.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, ks, vs
+
+
+# --------------------------------------------------------------------------
+# Host-side paged state manager
+
+
+class PagedKV:
+    """The serving scheduler's view of the page plane: device pool +
+    host block tables + allocator + (optional) radix prefix cache.
+    The scheduler calls the seat/grow/release methods; the jitted step
+    consumes :meth:`device_state` and hands the donated result back
+    through :meth:`absorb`."""
+
+    def __init__(self, cfg, family, n_slots: int, max_len: int,
+                 page_tokens: int, n_pages: int, kv_int8: bool = False,
+                 prefix_cache: bool = False):
+        assert max_len % page_tokens == 0, \
+            (f"max_len={max_len} must be a multiple of "
+             f"page_tokens={page_tokens} (the block table tiles the "
+             "cache exactly)")
+        _check_family(family)
+        self.cfg = cfg
+        self.n_slots = int(n_slots)
+        self.max_len = int(max_len)
+        self.page_tokens = int(page_tokens)
+        self.n_pages = int(n_pages)
+        self.max_pages = max_len // page_tokens
+        self.kv_int8 = bool(kv_int8)
+        self.alloc = PageAllocator(n_pages)
+        self.prefix = (RadixPrefixCache(self.alloc, page_tokens)
+                       if prefix_cache else None)
+        self.pool = init_page_pool(cfg, n_pages, page_tokens, n_slots,
+                                   kv_int8=kv_int8)
+        # Slot b's parking page sits past the allocator's range.
+        self._park = [n_pages + b for b in range(n_slots)]
+        self.pages: List[List[int]] = [[] for _ in range(n_slots)]
+        self.pos = np.zeros((n_slots,), np.int32)
+        self.table = np.asarray(
+            [[self._park[b]] * self.max_pages
+             for b in range(n_slots)], np.int32)
+        self._dev_table = None
+        self.pages_hwm = 0
+        self.preemptions = 0
+        self._scatter_cache: Dict = {}
+        self._gather_cache: Dict = {}
+        self._copy_fn = None
+
+    # -- device state ------------------------------------------------------
+
+    def device_state(self):
+        if self._dev_table is None:
+            self._dev_table = jnp.asarray(self.table)
+        state = dict(self.pool)
+        state["table"] = self._dev_table
+        state["pos"] = jnp.asarray(self.pos)
+        return state
+
+    def absorb(self, state) -> None:
+        self.pool = {k: state[k] for k in _POOL_KEYS if k in state}
+        self._dev_table = state["table"]
+        # np.array (copy): np.asarray of a device array is a read-only
+        # view, and the host mirror gets written by seat/release.
+        self.pos = np.array(state["pos"], np.int32)
+
+    def reset_pool(self) -> None:
+        """Rebuild the device pool from zeros (after a failed donated
+        step the buffers can't be trusted) and drop every reference —
+        allocator, tables, and the prefix cache start over."""
+        self.pool = init_page_pool(self.cfg, self.n_pages,
+                                   self.page_tokens, self.n_slots,
+                                   kv_int8=self.kv_int8)
+        self.alloc = PageAllocator(self.n_pages)
+        if self.prefix is not None:
+            hits, ev, reused = (self.prefix.hits, self.prefix.evictions,
+                                self.prefix.pages_reused)
+            self.prefix = RadixPrefixCache(self.alloc, self.page_tokens)
+            self.prefix.hits, self.prefix.evictions = hits, ev
+            self.prefix.pages_reused = reused
+        self.pages = [[] for _ in range(self.n_slots)]
+        self.pos = np.zeros((self.n_slots,), np.int32)
+        self.table = np.asarray(
+            [[self._park[b]] * self.max_pages
+             for b in range(self.n_slots)], np.int32)
+        self._dev_table = None
+
+    # -- table bookkeeping -------------------------------------------------
+
+    def _sync_row(self, b: int) -> None:
+        row = self.pages[b] + [self._park[b]] * (self.max_pages
+                                                 - len(self.pages[b]))
+        self.table[b] = np.asarray(row, np.int32)
+        self._dev_table = None
+
+    def _note_hwm(self) -> None:
+        self.pages_hwm = max(self.pages_hwm, self.alloc.used_count)
+
+    def alloc_evicting(self, n: int) -> Optional[List[int]]:
+        """Allocate n pages, evicting prefix-cache LRU leaves to make
+        room; None when the pool can't cover n even fully drained."""
+        while self.alloc.free_count < n:
+            if self.prefix is None or not self.prefix.evict_one():
+                return None
+        got = self.alloc.alloc(n)
+        if got is not None:
+            self._note_hwm()
+        return got
+
+    def seat(self, b: int, prompt_pages: List[int],
+             fresh_pages: List[int], new_pos: int) -> None:
+        """Slot b takes ownership of ``prompt_pages + fresh_pages``
+        (references already held by the caller) at position
+        ``new_pos``."""
+        assert not self.pages[b], (b, "seat of an occupied slot")
+        self.pages[b] = list(prompt_pages) + list(fresh_pages)
+        assert len(self.pages[b]) <= self.max_pages, \
+            (b, len(self.pages[b]), self.max_pages)
+        self.pos[b] = new_pos
+        self._sync_row(b)
+
+    def release(self, b: int) -> None:
+        """Drop slot b's page references (shared prefix pages survive
+        through the trie's reference) and park the slot."""
+        for p in self.pages[b]:
+            self.alloc.decref(p)
+        self.pages[b] = []
+        self.pos[b] = 0
+        self._sync_row(b)
+
+    def grow(self, b: int, need_pages: int) -> bool:
+        """Extend slot b's page list to ``need_pages``; False when the
+        pool is dry even after prefix eviction (caller preempts)."""
+        need_pages = min(need_pages, self.max_pages)
+        short = need_pages - len(self.pages[b])
+        if short <= 0:
+            return True
+        got = self.alloc_evicting(short)
+        if got is None:
+            return False
+        self.pages[b].extend(got)
+        self._sync_row(b)
+        return True
+
+    def ensure_writable(self, b: int, j: int) -> bool:
+        """Copy-on-write: if slot b's page j is shared (refcount > 1),
+        give the slot a private copy. Unreachable under the default
+        policy (RadixPrefixCache docstring) — kept as the defensive
+        guard the scheduler runs before decode writes. Returns True
+        iff a copy was made."""
+        page = self.pages[b][j]
+        if self.alloc.refcount(page) <= 1:
+            return False
+        got = self.alloc_evicting(1)
+        if got is None:
+            raise RuntimeError(
+                "copy-on-write with a dry pool (admission should have "
+                "bounded the request)")
+        if self._copy_fn is None:
+            @partial(jax.jit, donate_argnums=(0,))
+            def _copy(pool, src, dst):
+                out = {}
+                for key in pool:
+                    page_data = lax.dynamic_index_in_dim(
+                        pool[key], src, 1, keepdims=True)
+                    out[key] = lax.dynamic_update_slice(
+                        pool[key], page_data, (0, dst, 0, 0, 0))
+                return out
+            self._copy_fn = _copy
+        self.pool = self._copy_fn(self.pool, jnp.int32(page),
+                                  jnp.int32(got[0]))
+        self.pages[b][j] = got[0]
+        self.alloc.decref(page)
+        self._sync_row(b)
+        return True
+
+    # -- prompt scatter / history gather -----------------------------------
+
+    def scatter_prompt(self, one, pages: List[int], start_page: int = 0
+                       ) -> None:
+        """Write a prefilled cache (``one`` = {'k','v'[,'ks','vs']:
+        [L, 1, S_bucket, H, *]}) into ``pages`` — page d takes bucket
+        rows [d*pt, (d+1)*pt) (zero-padded rows past the prompt are
+        never attended). ``start_page`` offsets the SOURCE rows only
+        (0 for a cold full-prompt scatter; unused pages cost
+        nothing — only ``len(pages)`` pages are written)."""
+        pt = self.page_tokens
+        bucket = one["k"].shape[2]
+        keys = tuple(k for k in _POOL_KEYS if k in one and k in self.pool)
+        ck = (bucket, len(pages), keys)
+        if ck not in self._scatter_cache:
+            @partial(jax.jit, donate_argnums=(0,))
+            def _scatter(pool, one, pages_arr, n_pg=len(pages),
+                         bucket=bucket, keys=keys):
+                for j in range(n_pg):
+                    n = min(pt, bucket - j * pt)
+                    if n <= 0:
+                        break
+                    for key in keys:
+                        src = one[key][:, 0, j * pt:j * pt + n]
+                        pool[key] = lax.dynamic_update_slice(
+                            pool[key], src[:, None].astype(
+                                pool[key].dtype),
+                            (0, pages_arr[j], 0, 0, 0))
+                return pool
+            self._scatter_cache[ck] = _scatter
+        if pages:
+            self.pool = self._scatter_cache[ck](
+                self.pool, one, jnp.asarray(pages, jnp.int32))
+
+    def gather_history(self, pages: List[int]):
+        """Gather ``pages`` into contiguous [L, n*pt, H, Dh] history
+        K/V in compute dtype (dequantizing int8 pages — the only
+        page-resident form — through their f32 scales)."""
+        ck = len(pages)
+        if ck not in self._gather_cache:
+            @jax.jit
+            def _gather(pool, pages_arr):
+                def grab(key):
+                    return jnp.take(pool[key], pages_arr, axis=1)
+                k, v = grab("k"), grab("v")
+                if "ks" in pool:
+                    k = k.astype(jnp.float32) * grab("ks")
+                    v = v.astype(jnp.float32) * grab("vs")
+                L = k.shape[0]
+                shp = (L, ck * self.page_tokens) + k.shape[3:]
+                return (k.reshape(shp).astype(self.cfg.dtype),
+                        v.reshape(shp).astype(self.cfg.dtype))
+            self._gather_cache[ck] = _gather
+        return self._gather_cache[ck](
+            self.pool, jnp.asarray(pages, jnp.int32))
+
+
+# --------------------------------------------------------------------------
+# Native-metrics publication (no-build/no-load discipline)
+
+
+def publish_page_stats_best_effort(pages_free: int, pages_shared: int,
+                                   prefix_hits: int,
+                                   prefix_evictions: int,
+                                   preemptions: int) -> bool:
+    """Mirror the page plane into the native registry gauges/counters
+    (src/core/metrics.cc: pages_free, pages_shared, prefix_hits,
+    prefix_evictions, preemptions) — but only when the native runtime
+    is already loaded; never build or load the library for telemetry
+    (the ``_flight_dump_best_effort`` discipline)."""
+    try:
+        import ctypes
+        import mpi_acx_tpu.runtime as _rt
+        if _rt._lib is None:
+            return False
+        _rt._lib.acx_serving_page_stats(
+            ctypes.c_uint64(pages_free), ctypes.c_uint64(pages_shared),
+            ctypes.c_uint64(prefix_hits),
+            ctypes.c_uint64(prefix_evictions),
+            ctypes.c_uint64(preemptions))
+        return True
+    except Exception:  # pragma: no cover — diagnostics must never raise
+        return False

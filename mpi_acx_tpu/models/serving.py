@@ -78,6 +78,14 @@ class ServingMetrics:
     slots_shed: int = 0           # slots retired to match lost capacity
     slots_revived: int = 0        # shed slots returned after a fleet join
     hang_dumps: int = 0           # flight dumps written on step failure
+    rejections: int = 0           # typed admission rejections
+    rejection_reasons: Dict[str, int] = field(default_factory=dict)
+    preemptions: int = 0          # paged: page-pressure evictions (uncharged)
+    prefix_hits: int = 0          # paged: radix-cache prompt matches
+    prefix_evictions: int = 0     # paged: trie pages evicted under pressure
+    prefix_pages_reused: int = 0  # paged: prompt pages seated from the trie
+    pages_hwm: int = 0            # paged: pool pages-in-use high-water mark
+    slo_deferrals: int = 0        # paged: refills deferred by the SLO gate
     ttft_p50_s: float = 0.0
     ttft_p99_s: float = 0.0
     itl_p50_s: float = 0.0        # inter-token latency (per decoded token)
@@ -86,6 +94,57 @@ class ServingMetrics:
     queue_depth_mean: float = 0.0
     slot_occupancy_mean: float = 0.0  # fraction of slots owned per step
     per_request: List[RequestTelemetry] = field(default_factory=list)
+
+
+@dataclass
+class RequestRejected:
+    """Typed admission rejection: an oversized (or otherwise
+    unservable) request degrades to this marker at its index in the
+    ServedBatch instead of an assert killing the whole server. Check
+    with ``isinstance(out[i], RequestRejected)``; ``reason`` is a
+    stable token (``exceeds_max_len``, ``exceeds_model_ceiling``,
+    ``exceeds_page_budget``), ``detail`` the human-readable arithmetic.
+    Counted in ``ServingMetrics.rejections`` / ``rejection_reasons``."""
+
+    rid: int
+    reason: str
+    detail: str = ""
+
+
+def _admission_check(rid, prompt, n, chunk, max_len, max_seq,
+                     page_budget=None, page_tokens=None
+                     ) -> Optional[RequestRejected]:
+    """The serving admission rule: a request needs ``len(prompt) + n +
+    chunk`` cache positions (the chunk overrun is real — a slot
+    finishing mid-chunk keeps writing until the boundary). Returns a
+    RequestRejected or None; the paged path adds the pool-budget bound
+    (``page_budget`` in pages of ``page_tokens``)."""
+    total = len(prompt) + n + chunk
+    if total > max_len:
+        return RequestRejected(
+            rid, "exceeds_max_len",
+            f"len(prompt)={len(prompt)} + n_new={n} + chunk={chunk} "
+            f"= {total} > max_len={max_len}")
+    if total > max_seq:
+        return RequestRejected(
+            rid, "exceeds_model_ceiling",
+            f"len(prompt)={len(prompt)} + n_new={n} + chunk={chunk} "
+            f"= {total} > cfg.max_seq={max_seq}")
+    if page_budget is not None:
+        need = -(-total // page_tokens)
+        if need > page_budget:
+            return RequestRejected(
+                rid, "exceeds_page_budget",
+                f"ceil({total} / {page_tokens}) = {need} pages > "
+                f"pool n_pages={page_budget}")
+    return None
+
+
+def _count_reasons(rejections) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for rej in rejections:
+        out[rej.reason] = out.get(rej.reason, 0) + 1
+    return out
 
 
 class ServedBatch(list):
@@ -396,12 +455,15 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
     assert len(n_new) == len(prompts), (len(n_new), len(prompts))
     assert all(n >= 1 for n in n_new), \
         "n_new >= 1 per request (the prefill itself emits the first token)"
-    assert all(len(p) + n + chunk <= max_len
-               for p, n in zip(prompts, n_new)), \
-        "request (+ chunk overrun) exceeds max_len"
-    assert all(len(p) + n + chunk <= cfg.max_seq
-               for p, n in zip(prompts, n_new)), \
-        "request (+ chunk overrun) exceeds the model's position ceiling"
+
+    # Typed admission: an oversized request degrades to a
+    # RequestRejected at its output index instead of an assert killing
+    # the server for everyone else in the batch.
+    rejected: Dict[int, RequestRejected] = {}
+    for rid, (p, n) in enumerate(zip(prompts, n_new)):
+        rej = _admission_check(rid, p, n, chunk, max_len, cfg.max_seq)
+        if rej is not None:
+            rejected[rid] = rej
 
     if server_fns is None:
         server_fns = make_server_fns(params, cfg, family, chunk=chunk,
@@ -421,12 +483,15 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
     slots = family.init_kv_cache(cfg, n_slots, max_len, kv_int8=kv_int8)
     slots["pos"] = jnp.zeros((n_slots,), jnp.int32)
 
-    queue = deque(enumerate(np.asarray(p, np.int32) for p in prompts))
+    queue = deque((rid, np.asarray(p, np.int32))
+                  for rid, p in enumerate(prompts) if rid not in rejected)
     # Request id per slot; -1 = idle, -2 = shed (capacity retired after a
     # peer loss — never refilled, skipped by every owner[b] >= 0 loop).
     owner = [-1] * n_slots
     emitted: List[List[int]] = [[] for _ in prompts]
-    done: List[Optional[np.ndarray]] = [None] * len(prompts)
+    done: List[Optional[object]] = [None] * len(prompts)
+    for rid, rej in rejected.items():
+        done[rid] = rej
     last_tok = np.zeros((n_slots,), np.int32)
     # Per-slot key streams (greedy: dummies the step passes through).
     keys = jax.random.split(key if key is not None else jax.random.key(0),
@@ -673,6 +738,8 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
     per_request = []
     total_new = 0
     for rid in range(len(prompts)):
+        if rid in rejected:
+            continue            # never ran — no telemetry to report
         nt = len(emitted[rid])
         total_new += nt
         lat = finish[rid] if finish[rid] is not None else wall
@@ -695,6 +762,8 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
         slots_shed=n_shed,
         slots_revived=n_revived,
         hang_dumps=n_hang_dumps,
+        rejections=len(rejected),
+        rejection_reasons=_count_reasons(rejected.values()),
         ttft_p50_s=_pct([r.ttft_s for r in per_request], 0.50),
         ttft_p99_s=_pct([r.ttft_s for r in per_request], 0.99),
         itl_p50_s=_pct(itl_samples, 0.50),
@@ -768,3 +837,500 @@ def serve_sample(params, cfg, prompts: Sequence[np.ndarray], n_new,
                   eos, chunk, server_fns, kv_int8,
                   (temperature, top_k, top_p), key,
                   max_request_retries=max_request_retries)
+
+
+def _slo_admit_targets(slo_admit) -> tuple:
+    """Resolve the SLO admission gate: ``slo_admit`` is (ttft_s, itl_s)
+    rolling-p50 targets (either may be None), or None to read the
+    ``ACX_SERVE_ADMIT_TTFT_MS`` / ``ACX_SERVE_ADMIT_ITL_MS`` knobs
+    (unset/0 = gate off — the default, which keeps paged schedules
+    identical to the fixed-slot path's)."""
+    if slo_admit is not None:
+        ttft_t, itl_t = slo_admit
+        return (float(ttft_t) if ttft_t else None,
+                float(itl_t) if itl_t else None)
+    ttft_ms = float(os.environ.get("ACX_SERVE_ADMIT_TTFT_MS", "0") or 0)
+    itl_ms = float(os.environ.get("ACX_SERVE_ADMIT_ITL_MS", "0") or 0)
+    return (ttft_ms / 1e3 if ttft_ms > 0 else None,
+            itl_ms / 1e3 if itl_ms > 0 else None)
+
+
+def serve_paged_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
+                       n_slots: int, max_len: int, family=None,
+                       eos: Optional[int] = None, chunk: int = 1,
+                       kv_int8: bool = False,
+                       page_tokens: Optional[int] = None,
+                       n_pages: Optional[int] = None,
+                       prefix_cache: bool = False,
+                       slo_admit=None,
+                       on_token=None,
+                       max_request_retries: int = 2,
+                       return_paged_state: bool = False) -> ServedBatch:
+    """Greedy continuous batching over a PAGED KV cache
+    (models/kvpage.py): slots share a pool of ``page_tokens``-sized
+    pages through per-slot block tables, so HBM-resident KV bytes
+    scale with LIVE tokens, not ``n_slots * max_len``. On identical
+    schedules (the defaults: no prefix cache, no SLO gate, enough
+    pages) outputs are BIT-EQUAL to the fixed-slot ``serve_greedy`` —
+    the paged attend gathers each slot's pages into the exact
+    ``[B, max_len]`` layout the dense reference attends, and the paged
+    decode step is the fixed step with table-routed writes (tested in
+    tests/test_paged.py for bf16 and int8 caches alike).
+
+    Beyond the fixed path it adds:
+
+    * **Typed admission** — a request that cannot fit ``max_len``,
+      ``cfg.max_seq``, or the page budget degrades to a
+      :class:`RequestRejected` at its output index (and a
+      ``rejections`` count in metrics) instead of an assert.
+    * **Lazy page growth + preemption** — a slot owns only the pages
+      its live tokens need; growth happens at chunk boundaries, and
+      when the pool runs dry the LOWEST-priority request (highest rid
+      = latest arrival) is preempted: its pages are freed and it
+      requeues UNCHARGED (the PR 3 peer-loss rule — pressure is the
+      server's fault, not the request's), replaying bit-equal when
+      reseated.
+    * **Radix prefix sharing** (``prefix_cache=True``) — full-page
+      prompt prefixes are cached in a refcounted radix trie; a hit
+      seats the shared pages (stored ONCE, never rewritten) and
+      prefills only the suffix. Hit-path prefills use different tensor
+      shapes than cold ones, so a hit's outputs are deterministic but
+      not bitwise-pinned to the cold path (docs/DESIGN.md §19) — which
+      is why the feature is opt-in.
+    * **SLO-aware batch formation** — ``slo_admit=(ttft_s, itl_s)``
+      (or the ``ACX_SERVE_ADMIT_*_MS`` knobs) defers REFILLS while the
+      RollingSLO window's p50 violates a target and at least one
+      request is in flight: trading queue wait (cheap, visible) for
+      inter-token latency (the SLO a streaming client feels).
+    * **Streaming output** — ``on_token(rid, token)`` fires for every
+      token as it is consumed, first (prefill) token included.
+      At-least-once semantics: a preempted or requeued request replays
+      its stream from the start when re-served.
+
+    ``page_tokens`` defaults to $ACX_KV_PAGE_TOKENS (128 — the
+    flash-decode block granularity) stepped down to divide ``max_len``;
+    ``n_pages`` defaults to ``n_slots * max_len / page_tokens``
+    (capacity parity with the fixed-slot cache). The returned
+    ServedBatch carries the paged counters (preemptions, prefix_hits,
+    prefix_evictions, prefix_pages_reused, pages_hwm) in ``.metrics``;
+    ``return_paged_state=True`` additionally exposes the live
+    :class:`~mpi_acx_tpu.models.kvpage.PagedKV` as ``.paged_state``
+    (tests and benches inspect allocator occupancy through it)."""
+    from mpi_acx_tpu.models import kvpage
+
+    if family is None:
+        from mpi_acx_tpu.models import transformer as family  # noqa: N813
+    assert prompts, "no requests"
+    assert all(len(p) > 0 for p in prompts), \
+        "zero-length prompt (prefill needs at least one token to attend)"
+    n_new = ([int(n_new)] * len(prompts) if np.ndim(n_new) == 0
+             else [int(n) for n in n_new])
+    assert len(n_new) == len(prompts), (len(n_new), len(prompts))
+    assert all(n >= 1 for n in n_new), \
+        "n_new >= 1 per request (the prefill itself emits the first token)"
+
+    pt = page_tokens or kvpage.default_page_tokens(max_len)
+    assert max_len % pt == 0, \
+        f"page_tokens={pt} must divide max_len={max_len}"
+    max_pages = max_len // pt
+    if n_pages is None:
+        n_pages = n_slots * max_pages
+    ttft_target, itl_target = _slo_admit_targets(slo_admit)
+
+    rejected: Dict[int, RequestRejected] = {}
+    for rid, (p, n) in enumerate(zip(prompts, n_new)):
+        rej = _admission_check(rid, p, n, chunk, max_len, cfg.max_seq,
+                               page_budget=n_pages, page_tokens=pt)
+        if rej is not None:
+            rejected[rid] = rej
+
+    pkv = kvpage.PagedKV(cfg, family, n_slots, max_len, pt, n_pages,
+                         kv_int8=kv_int8, prefix_cache=prefix_cache)
+
+    prefill_cache: Dict[int, object] = {}
+
+    def prefill_fn(tokens, last):
+        S = tokens.shape[1]
+        if S not in prefill_cache:
+            prefill_cache[S] = jax.jit(
+                lambda t, li, S=S: family.prefill(params, cfg, t, S,
+                                                  kv_int8=kv_int8,
+                                                  last_index=li))
+        return prefill_cache[S](tokens, last)
+
+    suffix_cache: Dict[tuple, object] = {}
+
+    def suffix_prefill_fn(suffix, hk, hv, last):
+        ck = (suffix.shape[1], hk.shape[1])
+        if ck not in suffix_cache:
+            suffix_cache[ck] = jax.jit(
+                lambda s, k, v, li: kvpage.prefill_with_history(
+                    params, cfg, s, k, v, li))
+        return suffix_cache[ck](suffix, hk, hv, last)
+
+    step_fn = kvpage.make_paged_step_fn(params, cfg, family, chunk, pt)
+
+    queue = deque((rid, np.asarray(p, np.int32))
+                  for rid, p in enumerate(prompts) if rid not in rejected)
+    owner = [-1] * n_slots          # -1 idle, -2 shed (as _serve)
+    emitted: List[List[int]] = [[] for _ in prompts]
+    done: List[Optional[object]] = [None] * len(prompts)
+    for rid, rej in rejected.items():
+        done[rid] = rej
+    last_tok = np.zeros((n_slots,), np.int32)
+    keys = jax.random.split(jax.random.key(0), n_slots)  # greedy dummies
+    attempts = [0] * len(prompts)
+
+    t0 = time.perf_counter()
+    ttft = [None] * len(prompts)      # type: List[Optional[float]]
+    finish = [None] * len(prompts)    # type: List[Optional[float]]
+    slo = RollingSLO()
+    itl_samples: List[float] = []
+    qd_samples: List[int] = []
+    occ_samples: List[float] = []
+    n_steps = n_prefills = n_requeues = n_peer_requeues = 0
+    n_shed = n_revived = n_hang_dumps = n_preempts = n_slo_defer = 0
+    fleet_active_seen = _fleet_active()
+
+    def _requeue(rid, prompt, exc, charge=True):
+        nonlocal n_requeues, n_peer_requeues
+        if charge:
+            attempts[rid] += 1
+            if attempts[rid] > max_request_retries:
+                raise RuntimeError(
+                    f"request {rid} failed {attempts[rid]} time(s), past "
+                    f"max_request_retries={max_request_retries}") from exc
+        else:
+            n_peer_requeues += 1
+        emitted[rid] = []
+        ttft[rid] = None
+        n_requeues += 1
+        queue.append((rid, prompt))
+
+    def _check_fleet_rejoin():
+        nonlocal fleet_active_seen, n_revived
+        if fleet_active_seen is None:
+            return []
+        act = _fleet_active()
+        if act is None:
+            return []
+        revived = []
+        if act > fleet_active_seen:
+            for b in range(n_slots):
+                if owner[b] == -2:
+                    owner[b] = -1
+                    revived.append(b)
+            n_revived += len(revived)
+        fleet_active_seen = act
+        return revived
+
+    def _shed_slot():
+        nonlocal n_shed
+        alive = [b for b in range(n_slots) if owner[b] != -2]
+        idle = [b for b in alive if owner[b] == -1]
+        if len(alive) <= 1 or not idle:
+            return
+        owner[max(idle)] = -2
+        n_shed += 1
+
+    def _slo_defers() -> bool:
+        """SLO-aware batch formation: with a target set, a violating
+        rolling window defers refills while work is in flight —
+        admitting another prompt would push the ITL every live stream
+        sees further past target for queue wait nobody measures."""
+        if ttft_target is None and itl_target is None:
+            return False
+        if not any(o >= 0 for o in owner):
+            return False            # an empty server always admits
+        live = slo.live_slos()
+        if (itl_target is not None and live["itl_n"]
+                and live["itl_p50_s"] > itl_target):
+            return True
+        return (ttft_target is not None and live["ttft_n"]
+                and live["ttft_p50_s"] > ttft_target)
+
+    def refill(b):
+        """Seat the queue head in slot b. Returns True iff the slot
+        now owns a request; False covers three distinct paths: the SLO
+        gate deferred (request left at the queue head), the pool could
+        not cover the prompt (ditto — a retire will free pages), or
+        the prefill failed (request re-queued via the retry rules)."""
+        nonlocal n_prefills, n_slo_defer
+        if _slo_defers():
+            n_slo_defer += 1
+            return False
+        rid, prompt = queue.popleft()
+        S = len(prompt)
+        hit_pages = (pkv.prefix.match(prompt)
+                     if pkv.prefix is not None else [])
+        n_fresh = kvpage.pages_needed(S, pt) - len(hit_pages)
+        fresh = pkv.alloc_evicting(n_fresh)
+        if fresh is None:
+            # Page pressure at admission: put the request BACK at the
+            # head (arrival order preserved) and release the trie refs
+            # the failed match took; a later retire frees pages.
+            for p in hit_pages:
+                pkv.alloc.decref(p)
+            queue.appendleft((rid, prompt))
+            return False
+        spanned = _span_app_begin_best_effort(rid)
+        try:
+            if hit_pages:
+                # Radix hit: prefill ONLY the suffix against the
+                # cached pages' gathered history.
+                P = len(hit_pages) * pt
+                suffix = prompt[P:]
+                Sb = min(_bucket(len(suffix)), max_len - P,
+                         cfg.max_seq - P)
+                padded = np.zeros((1, Sb), np.int32)
+                padded[0, :len(suffix)] = suffix
+                hk, hv = pkv.gather_history(hit_pages)
+                logits, sk, sv = suffix_prefill_fn(
+                    jnp.asarray(padded), hk, hv, len(suffix) - 1)
+                one = {"k": sk, "v": sv}
+                if kv_int8:
+                    from mpi_acx_tpu.ops.kvquant import kv_quant
+                    one["k"], one["ks"] = kv_quant(sk)
+                    one["v"], one["vs"] = kv_quant(sv)
+                first = int(jnp.argmax(logits[0, 0]))
+                pkv.scatter_prompt(one, fresh)
+            else:
+                padded = np.zeros(
+                    (1, min(_bucket(S), max_len, cfg.max_seq)), np.int32)
+                padded[0, :S] = prompt
+                logits, one = prefill_fn(jnp.asarray(padded), S - 1)
+                first = int(jnp.argmax(logits[0, 0]))
+                pkv.scatter_prompt(
+                    {k: v for k, v in one.items() if k != "pos"}, fresh)
+        except Exception as exc:  # noqa: BLE001 — any device failure
+            for p in hit_pages + fresh:
+                pkv.alloc.decref(p)
+            _requeue(rid, prompt, exc, charge=not _peer_dead(exc))
+            return False
+        finally:
+            if spanned:
+                _span_app_end_best_effort()
+        pkv.seat(b, hit_pages, fresh, S)
+        if pkv.prefix is not None:
+            pkv.prefix.insert(prompt, pkv.pages[b])
+        owner[b] = rid
+        emitted[rid].append(first)
+        if on_token is not None:
+            on_token(rid, first)
+        last_tok[b] = first
+        n_prefills += 1
+        ttft[rid] = time.perf_counter() - t0
+        slo.note_ttft(ttft[rid])
+        return True
+
+    def retire(b):
+        rid = owner[b]
+        done[rid] = np.concatenate(
+            [np.asarray(prompts[rid], np.int32),
+             np.asarray(emitted[rid], np.int32)])
+        finish[rid] = time.perf_counter() - t0
+        owner[b] = -1
+        pkv.release(b)              # pages back to the pool, slot parked
+
+    def preempt(b):
+        """Page-pressure eviction: requeue slot b's request UNCHARGED
+        (server pressure is not the request's fault — the peer-loss
+        rule) with its pages freed; the replay is bit-equal."""
+        nonlocal n_preempts
+        rid = owner[b]
+        owner[b] = -1
+        pkv.release(b)
+        emitted[rid] = []
+        ttft[rid] = None
+        queue.append((rid, np.asarray(prompts[rid], np.int32)))
+        n_preempts += 1
+        pkv.preemptions += 1
+
+    def grow_for_chunk():
+        """Before each step: every active slot's table must cover this
+        chunk's writes (positions pos..pos+chunk-1). Pool dry even
+        after trie eviction -> preempt the latest arrival and rescan;
+        admission guarantees a LONE request always fits, so the loop
+        terminates (each preemption strictly shrinks the active set)."""
+        while True:
+            for b in range(n_slots):
+                if owner[b] < 0:
+                    continue
+                need = (int(pkv.pos[b]) + chunk - 1) // pt + 1
+                if not pkv.grow(b, need):
+                    victims = [s for s in range(n_slots) if owner[s] >= 0]
+                    if len(victims) <= 1:
+                        raise RuntimeError(
+                            "page pool dry for a lone request — "
+                            "admission should have rejected it")
+                    preempt(max(victims, key=lambda s: owner[s]))
+                    break
+            else:
+                return
+
+    def slot_finished(b):
+        rid = owner[b]
+        return (len(emitted[rid]) >= n_new[rid]
+                or (eos is not None and emitted[rid]
+                    and emitted[rid][-1] == eos))
+
+    def _publish():
+        kvpage.publish_page_stats_best_effort(
+            pkv.alloc.free_count, pkv.alloc.shared_count(),
+            pkv.prefix.hits if pkv.prefix else 0,
+            pkv.prefix.evictions if pkv.prefix else 0,
+            pkv.preemptions)
+
+    qd_samples.append(len(queue))
+    while queue and any(o == -1 for o in owner):
+        b = owner.index(-1)
+        if refill(b):
+            if slot_finished(b):
+                retire(b)
+        else:
+            break                   # deferred/short on pages: stop seeding
+
+    stalls = 0
+    while any(o >= 0 for o in owner) or queue:
+        qd_samples.append(len(queue))
+        occ_samples.append(sum(o >= 0 for o in owner) / n_slots)
+        slo.note_gauges(qd_samples[-1], occ_samples[-1])
+        _tseries_annotate_best_effort(slo.live_slos())
+        _publish()
+        if queue:
+            for b in _check_fleet_rejoin():
+                if queue and refill(b) and slot_finished(b):
+                    retire(b)
+        if not any(o >= 0 for o in owner):
+            # All slots idle with requests queued (failure requeues, a
+            # deferred seed, or total preemption): reseed. The SLO gate
+            # never defers an empty server and admission bounds every
+            # queued request, so a stall here means a real bug — bound
+            # it instead of spinning.
+            progressed = False
+            while queue and any(o == -1 for o in owner):
+                b = owner.index(-1)
+                if refill(b):
+                    progressed = True
+                    if slot_finished(b):
+                        retire(b)
+                else:
+                    break
+            stalls = 0 if progressed else stalls + 1
+            if stalls > len(prompts) + n_slots + 2:
+                raise RuntimeError(
+                    "paged scheduler stalled: queue non-empty, no slot "
+                    "seatable (pool exhausted below a single request?)")
+            continue
+        stalls = 0
+        grow_for_chunk()
+        if not any(o >= 0 for o in owner):
+            continue                # grow_for_chunk preempted everyone
+        # COW guard (unreachable under the radix policy — defensive):
+        # the pages this chunk writes must be privately owned.
+        for b in range(n_slots):
+            if owner[b] < 0:
+                continue
+            for j in range(int(pkv.pos[b]) // pt,
+                           (int(pkv.pos[b]) + chunk - 1) // pt + 1):
+                if j < len(pkv.pages[b]):
+                    pkv.ensure_writable(b, j)
+        step_t0 = time.perf_counter()
+        state = pkv.device_state()
+        try:
+            state, toks, keys = step_fn(state, jnp.asarray(last_tok),
+                                        keys)
+            pkv.absorb(state)
+        except Exception as exc:  # noqa: BLE001 — any device failure
+            lost_peer = _peer_dead(exc)
+            if _flight_dump_best_effort():
+                n_hang_dumps += 1
+            for b in range(n_slots):
+                if owner[b] >= 0:
+                    rid = owner[b]
+                    owner[b] = -1
+                    _requeue(rid, np.asarray(prompts[rid], np.int32),
+                             exc, charge=not lost_peer)
+            if lost_peer:
+                _shed_slot()
+            # The step donated the pool buffers: rebuild from zeros and
+            # drop every reference (prefix cache included — its pages
+            # lived in the donated pool).
+            pkv.reset_pool()
+            last_tok = np.zeros((n_slots,), np.int32)
+            continue
+        block = np.asarray(toks, np.int32)           # [chunk, B]
+        step_dt = time.perf_counter() - step_t0
+        n_steps += 1
+        for b in range(n_slots):
+            last_tok[b] = block[-1, b]
+            if owner[b] < 0:
+                continue
+            for c in range(block.shape[0]):
+                if slot_finished(b):
+                    break
+                tok = int(block[c, b])
+                emitted[owner[b]].append(tok)
+                if on_token is not None:
+                    on_token(owner[b], tok)
+                itl_samples.append(step_dt / chunk)
+                slo.note_itl(step_dt / chunk)
+        for b in range(n_slots):
+            while owner[b] >= 0 and slot_finished(b):
+                retire(b)
+                if queue:
+                    refill(b)
+
+    _publish()
+    assert all(d is not None for d in done)
+    wall = time.perf_counter() - t0
+    per_request = []
+    total_new = 0
+    for rid in range(len(prompts)):
+        if rid in rejected:
+            continue
+        nt = len(emitted[rid])
+        total_new += nt
+        lat = finish[rid] if finish[rid] is not None else wall
+        per_request.append(RequestTelemetry(
+            rid=rid,
+            ttft_s=ttft[rid] if ttft[rid] is not None else lat,
+            latency_s=lat,
+            new_tokens=nt,
+            tokens_per_s=nt / lat if lat > 0 else 0.0,
+            retries=attempts[rid]))
+    metrics = ServingMetrics(
+        requests=len(prompts),
+        wall_s=wall,
+        new_tokens=total_new,
+        tokens_per_s=total_new / wall if wall > 0 else 0.0,
+        steps=n_steps,
+        prefills=n_prefills,
+        requeues=n_requeues,
+        peer_requeues=n_peer_requeues,
+        slots_shed=n_shed,
+        slots_revived=n_revived,
+        hang_dumps=n_hang_dumps,
+        rejections=len(rejected),
+        rejection_reasons=_count_reasons(rejected.values()),
+        preemptions=n_preempts,
+        prefix_hits=pkv.prefix.hits if pkv.prefix else 0,
+        prefix_evictions=pkv.prefix.evictions if pkv.prefix else 0,
+        prefix_pages_reused=(pkv.prefix.pages_reused if pkv.prefix
+                             else 0),
+        pages_hwm=pkv.pages_hwm,
+        slo_deferrals=n_slo_defer,
+        ttft_p50_s=_pct([r.ttft_s for r in per_request], 0.50),
+        ttft_p99_s=_pct([r.ttft_s for r in per_request], 0.99),
+        itl_p50_s=_pct(itl_samples, 0.50),
+        itl_p99_s=_pct(itl_samples, 0.99),
+        queue_depth_max=max(qd_samples) if qd_samples else 0,
+        queue_depth_mean=(sum(qd_samples) / len(qd_samples)
+                          if qd_samples else 0.0),
+        slot_occupancy_mean=(sum(occ_samples) / len(occ_samples)
+                             if occ_samples else 1.0),
+        per_request=per_request)
+    batch = ServedBatch(done, metrics)
+    if return_paged_state:
+        batch.paged_state = pkv
+    return batch

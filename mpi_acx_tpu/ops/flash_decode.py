@@ -232,6 +232,200 @@ def flash_decode_attend(q, kc, vc, pos, max_len, n_rep, block_k: int = 256):
         B, W, Hq * D)
 
 
+def _paged_decode_kernel(pos_ref, table_ref, q_ref, *refs, page_tokens,
+                         n_rep, n_k, quant, scale):
+    """The paged sibling of :func:`_decode_kernel`: one (batch slot, KV
+    group) program whose K/V blocks are POOL PAGES resolved through the
+    slot's block table instead of contiguous rows of a private cache.
+    ``table_ref`` rides SMEM next to ``pos`` — the per-slot ``pos``
+    plumbing generalized to a ``[B, max_pages]`` row — and block j's
+    DMA source is ``k_ref.at[table[j]]`` in the
+    ``[P, page_tokens, Hkv, D]`` pool. Everything else (q pre-scale,
+    GQA rows, n_full/n_live trip counts, the _online_softmax_step
+    order) is byte-for-byte the fixed kernel's math, which is the
+    bit-equality proof: at ``block_k == page_tokens`` the two kernels
+    run identical FLOPs over identical block values."""
+    if quant:
+        (k_ref, v_ref, ks_ref, vs_ref, o_ref,
+         k_scr, v_scr, ks_scr, vs_scr, sem) = refs
+    else:
+        k_ref, v_ref, o_ref, k_scr, v_scr, sem = refs
+    b = pl.program_id(0)
+    g = pl.program_id(1)
+    pos = pos_ref[0, 0]
+    Wn, D = q_ref.shape[2], q_ref.shape[3]
+    W = Wn // n_rep
+
+    qv = q_ref[0, 0].astype(jnp.float32) * scale         # [Wn, D]
+    if quant:
+        q, prec = qv, jax.lax.Precision.HIGHEST
+    else:
+        q = qv.astype(q_ref.dtype)
+        prec = (jax.lax.Precision.HIGHEST if q_ref.dtype == jnp.float32
+                else jax.lax.Precision.DEFAULT)
+
+    rows = pos + jax.lax.broadcasted_iota(jnp.int32, (Wn, 1), 0) // n_rep
+
+    def load(j):
+        page = table_ref[0, j]
+        cps = [pltpu.make_async_copy(k_ref.at[page, :, g], k_scr,
+                                     sem.at[0]),
+               pltpu.make_async_copy(v_ref.at[page, :, g], v_scr,
+                                     sem.at[1])]
+        if quant:
+            cps += [pltpu.make_async_copy(ks_ref.at[page, :, g], ks_scr,
+                                          sem.at[2]),
+                    pltpu.make_async_copy(vs_ref.at[page, :, g], vs_scr,
+                                          sem.at[3])]
+        for c in cps:
+            c.start()
+        for c in cps:
+            c.wait()
+        if quant:
+            return (k_scr[...].astype(jnp.float32) * ks_scr[...],
+                    v_scr[...].astype(jnp.float32) * vs_scr[...])
+        return k_scr[...], v_scr[...]
+
+    def step(j, carry, masked):
+        m, l, acc = carry
+        kb, vb = load(j)
+        return _online_softmax_step(q, kb, vb, m, l, acc, 0,
+                                    j * page_tokens, masked, prec,
+                                    rows=rows)
+
+    m0 = jnp.full((Wn, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Wn, 1), jnp.float32)
+    acc0 = jnp.zeros((Wn, D), jnp.float32)
+
+    n_live = jnp.minimum((pos + W + page_tokens - 1) // page_tokens, n_k)
+    n_full = jnp.minimum((pos + 1) // page_tokens, n_live)
+    carry = jax.lax.fori_loop(
+        0, n_full, lambda j, c: step(j, c, masked=False), (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(
+        n_full, n_live, lambda j, c: step(j, c, masked=True), carry)
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+def paged_gather_attend(q, kp, vp, table, pos, page_tokens, n_rep):
+    """Dense reference for paged attention: gather each slot's pages
+    into the contiguous ``[B, max_len, Hkv, D]`` layout the fixed-slot
+    path attends and call :func:`dense_decode_attend` — identical
+    shapes, identical XLA reduction, so a paged slot whose pages hold
+    the fixed cache's rows produces BIT-EQUAL output (gathered garbage
+    past the horizon contributes exactly 0.0 through the masked
+    softmax, same as the fixed cache's own dead tail)."""
+    from mpi_acx_tpu.models.decoding import dense_decode_attend
+
+    B, max_pages = table.shape
+    max_len = max_pages * page_tokens
+
+    def gather(pool):
+        t = jnp.take(pool, table, axis=0)     # [B, max_pages, pt, H, *]
+        return t.reshape((B, max_len) + pool.shape[2:])
+
+    kin = ((gather(kp[0]), gather(kp[1])) if isinstance(kp, tuple)
+           else gather(kp))
+    vin = ((gather(vp[0]), gather(vp[1])) if isinstance(vp, tuple)
+           else gather(vp))
+    return dense_decode_attend(q, kin, vin, pos, max_len, n_rep)
+
+
+def paged_flash_decode_attend(q, kp, vp, table, pos, page_tokens, n_rep):
+    """Pallas paged decode attention: K/V pools ``[P, page_tokens,
+    Hkv, D]`` (plus (codes, scales) tuples for int8 pools) addressed
+    through a ``[B, max_pages]`` block table. Block size IS the page
+    size; a page that Mosaic cannot tile (page_tokens % 128 on TPU)
+    falls back to :func:`paged_gather_attend` with a one-time
+    warning."""
+    ks = vs = None
+    if isinstance(kp, tuple):
+        kp, ks = kp
+    if isinstance(vp, tuple):
+        vp, vs = vp
+    quant = ks is not None
+    if jax.default_backend() == "tpu" and page_tokens % 128:
+        _warn_dense_fallback(page_tokens)
+        kin = kp if ks is None else (kp, ks)
+        vin = vp if vs is None else (vp, vs)
+        return paged_gather_attend(q, kin, vin, table, pos, page_tokens,
+                                   n_rep)
+
+    B, W, Hq, D = q.shape
+    Hkv = kp.shape[2]
+    assert Hq == Hkv * n_rep, (Hq, Hkv, n_rep)
+    Wn = W * n_rep
+    max_pages = table.shape[1]
+
+    pos = jnp.asarray(pos, jnp.int32)
+    if pos.ndim == 0:
+        pos = jnp.full((B,), pos, jnp.int32)
+    pos2 = pos.reshape(B, 1)
+    table = jnp.asarray(table, jnp.int32)
+
+    qg = q.reshape(B, W, Hkv, n_rep, D).transpose(0, 2, 1, 3, 4).reshape(
+        B, Hkv, Wn, D)
+
+    kernel = functools.partial(
+        _paged_decode_kernel, page_tokens=page_tokens, n_rep=n_rep,
+        n_k=max_pages, quant=quant, scale=1.0 / D ** 0.5)
+    in_specs = [
+        pl.BlockSpec((1, 1), lambda b, g: (b, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, max_pages), lambda b, g: (b, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1, Wn, D), lambda b, g: (b, g, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pltpu.ANY),     # K pool stays in HBM
+        pl.BlockSpec(memory_space=pltpu.ANY),     # V pool stays in HBM
+    ]
+    operands = [pos2, table, qg, kp, vp]
+    scratch = [pltpu.VMEM((page_tokens, D), kp.dtype),
+               pltpu.VMEM((page_tokens, D), vp.dtype)]
+    if quant:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        operands += [ks, vs]
+        scratch += [pltpu.VMEM((page_tokens, 1), jnp.float32)] * 2
+    scratch.append(pltpu.SemaphoreType.DMA((4,)))
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, Hkv),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, Wn, D), lambda b, g: (b, g, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=_out_struct((B, Hkv, Wn, D), q.dtype, q, kp, vp),
+        scratch_shapes=scratch,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=jax.default_backend() != "tpu",
+    )(*operands)
+    return out.reshape(B, Hkv, W, n_rep, D).transpose(0, 2, 1, 3, 4).reshape(
+        B, W, Hq * D)
+
+
+def auto_paged_decode_attend(q, kp, vp, table, pos, page_tokens, n_rep):
+    """Paged auto policy: the Pallas paged kernel on TPU when Mosaic
+    can tile the page (page_tokens % 128 == 0); the gather-dense
+    reference elsewhere — on CPU a dense einsum beats an interpreted
+    kernel, and gather-dense is also the bit-equality anchor."""
+    if jax.default_backend() == "tpu" and page_tokens % 128 == 0:
+        return paged_flash_decode_attend(q, kp, vp, table, pos,
+                                         page_tokens, n_rep)
+    return paged_gather_attend(q, kp, vp, table, pos, page_tokens, n_rep)
+
+
+def select_paged_decode_attend(decode_flash):
+    """The paged arm of the ``select_attention`` idiom, keyed on the
+    same ``decode_flash`` config field: ``None`` -> auto, ``True`` ->
+    paged Pallas kernel, ``False`` -> gather-dense reference. All
+    returned callables take
+    ``(q, kp, vp, table, pos, page_tokens, n_rep)``."""
+    if decode_flash is None:
+        return auto_paged_decode_attend
+    return (paged_flash_decode_attend if decode_flash
+            else paged_gather_attend)
+
+
 def auto_decode_attend(q, kc, vc, pos, max_len, n_rep):
     """THE decode flash/dense auto policy (mirrors ``auto_attention``):
     the Pallas kernel on TPU when the cache is long enough for

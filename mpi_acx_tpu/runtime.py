@@ -112,6 +112,10 @@ def lib() -> ctypes.CDLL:
         _lib.acx_tseries_live_json.argtypes = [ctypes.c_char_p, ctypes.c_int]
         _lib.acx_tseries_annotate.restype = None
         _lib.acx_tseries_annotate.argtypes = [ctypes.c_char_p]
+        _lib.acx_serving_page_stats.restype = None
+        _lib.acx_serving_page_stats.argtypes = [
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_uint64]
         _lib.acx_span_app_begin.restype = None
         _lib.acx_span_app_begin.argtypes = [ctypes.c_uint64]
         _lib.acx_span_app_end.restype = None

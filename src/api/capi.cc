@@ -115,6 +115,23 @@ int acx_tseries_live_json(char* buf, int cap) {
 // TTFT/ITL percentiles and queue depth this way. Invalid input is ignored.
 void acx_tseries_annotate(const char* json) { acx::tseries::Annotate(json); }
 
+// Fold the serving layer's paged-KV pool stats into the registry
+// (models/kvpage.py publishes once per scheduler iteration). pages_free
+// and pages_shared are gauges (absolute pool occupancy right now);
+// prefix_hits / prefix_evictions / preemptions arrive as host-side
+// CUMULATIVE values, so Set (not Add) mirrors them — the same fold
+// convention RefreshRuntimeMetrics uses for proxy stats.
+void acx_serving_page_stats(uint64_t pages_free, uint64_t pages_shared,
+                            uint64_t prefix_hits, uint64_t prefix_evictions,
+                            uint64_t preemptions) {
+  if (!acx::metrics::Enabled()) return;
+  acx::metrics::Set(acx::metrics::kPagesFree, pages_free);
+  acx::metrics::Set(acx::metrics::kPagesShared, pages_shared);
+  acx::metrics::Set(acx::metrics::kPrefixHits, prefix_hits);
+  acx::metrics::Set(acx::metrics::kPrefixEvictions, prefix_evictions);
+  acx::metrics::Set(acx::metrics::kPreemptions, preemptions);
+}
+
 // Fills out[4] = {sweeps, ops_issued, ops_completed, slots_reclaimed}.
 void acx_proxy_stats(uint64_t* out) {
   acx::ApiState& g = acx::GS();

@@ -35,6 +35,8 @@ const char* const kCounterName[] = {
     "crc_rejects",     "naks_sent",      "drained_slots",  "fleet_epoch",
     "fleet_joins",     "fleet_leaves",   "fleet_deaths",
     "preadys_published", "parriveds_observed",
+    "pages_free",      "pages_shared",   "prefix_hits",
+    "prefix_evictions", "preemptions",
 };
 
 const char* const kHistName[] = {
@@ -147,7 +149,8 @@ std::string SnapshotString() {
   }
   // Schema tail: which counter entries are gauges (absolute readings —
   // never summed or differenced), plus run-lifetime derived rates.
-  out += "},\"gauges\":[\"fleet_epoch\",\"slot_hwm\"],\"derived\":{";
+  out += "},\"gauges\":[\"fleet_epoch\",\"slot_hwm\",\"pages_free\","
+         "\"pages_shared\"],\"derived\":{";
   const uint64_t busy =
       s.counters[kProxyBusyNs].load(std::memory_order_relaxed);
   const uint64_t idle =
@@ -199,7 +202,10 @@ void HistRead(Hist h, uint64_t* count, uint64_t* sum, uint64_t* buckets) {
       buckets[b] = hd.buckets[b].load(std::memory_order_relaxed);
 }
 
-bool IsGauge(Counter c) { return c == kFleetEpoch || c == kSlotHighWater; }
+bool IsGauge(Counter c) {
+  return c == kFleetEpoch || c == kSlotHighWater || c == kPagesFree ||
+         c == kPagesShared;
+}
 
 void Add(Counter c, uint64_t v) {
   S().counters[c].fetch_add(v, std::memory_order_relaxed);

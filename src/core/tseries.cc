@@ -244,6 +244,10 @@ void SampleLocked(State& s, Transport* t) ACX_REQUIRES(s.mu) {
     AppendU64(&line, "fleet_epoch", cur[metrics::kFleetEpoch]);
     line += ",";
     AppendU64(&line, "slot_hwm", cur[metrics::kSlotHighWater]);
+    line += ",";
+    AppendU64(&line, "pages_free", cur[metrics::kPagesFree]);
+    line += ",";
+    AppendU64(&line, "pages_shared", cur[metrics::kPagesShared]);
     line += "},";
     // Interval-local proxy utilization, from the busy/idle ns deltas.
     const uint64_t db =

@@ -29,6 +29,14 @@ jax.config.update("jax_enable_x64", False)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 `-m 'not slow'` wall-clock budget; "
+        "still run by the packaged make targets (e.g. paged-check), which "
+        "invoke their test files unfiltered.")
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_per_module():
     yield

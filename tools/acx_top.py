@@ -128,7 +128,15 @@ def summarize(series):
         "link_health": "-",
         "subflows": "-",
         "part_inflight": None,
+        "pages_free": None,
+        "pages_shared": None,
     }
+    # Paged-KV pool occupancy (serving layer, DESIGN.md §19): gauges, so
+    # the newest reconstructed absolutes are the live reading. A pure
+    # transport rank reports 0/0 — its registry entries never move.
+    if counters:
+        row["pages_free"] = counters[-1].get("pages_free")
+        row["pages_shared"] = counters[-1].get("pages_shared")
     if len(samples) >= 2:
         a, b = samples[-2], samples[-1]
         dt = (b.get("t_mono_ns", 0) - a.get("t_mono_ns", 0)) / 1e9
@@ -252,18 +260,22 @@ def render_table(all_series):
     hdr = (f"{'rank':>4} {'epoch':>5} {'smpls':>5} {'ops/s':>9} "
            f"{'good MB/s':>9} {'wire MB/s':>9} {'proxy%':>6} "
            f"{'txq µs':>7} {'rxt µs':>7} "
-           f"{'qdepth':>6} {'p99 TTFT':>9} {'pif':>4} {'link':>5} {'sf':>5}")
+           f"{'qdepth':>6} {'p99 TTFT':>9} {'pif':>4} {'pages':>9} "
+           f"{'link':>5} {'sf':>5}")
     lines = [hdr, "-" * len(hdr)]
     for r in rows:
         ttft = (_fmt(r["ttft_p99_s"], ".3f") + "s"
                 if r["ttft_p99_s"] is not None else "-")
+        # free/shared page counts from the paged-KV pool gauges.
+        pages = ("-" if r["pages_free"] is None
+                 else f"{r['pages_free']}/{r['pages_shared'] or 0}")
         lines.append(
             f"{r['rank']:>4} {r['fleet_epoch']:>5} {r['samples']:>5} "
             f"{r['ops_per_s']:>9.1f} {r['goodput_mbps']:>9.2f} "
             f"{r['wire_mbps']:>9.2f} {r['proxy_util_pct']:>6.1f} "
             f"{_fmt(r['txq_us'], '.1f'):>7} {_fmt(r['rxt_us'], '.1f'):>7} "
             f"{_fmt(r['queue_depth'], 'd'):>6} {ttft:>9} "
-            f"{_fmt(r['part_inflight'], 'd'):>4} "
+            f"{_fmt(r['part_inflight'], 'd'):>4} {pages:>9} "
             f"{r['link_health']:>5} {r['subflows']:>5}")
     if not rows:
         lines.append("  (no .tseries.jsonl files yet)")
