@@ -132,6 +132,7 @@ check: ctest itest tools
 	@$(MAKE) --no-print-directory stripe-check || exit 1
 	@$(MAKE) --no-print-directory disagg-check || exit 1
 	@$(MAKE) --no-print-directory paged-check || exit 1
+	@$(MAKE) --no-print-directory request-check || exit 1
 	@$(MAKE) --no-print-directory lint || exit 1
 	@$(MAKE) --no-print-directory asan-ctest || exit 1
 	@echo "ALL NATIVE TESTS PASSED"
@@ -431,6 +432,42 @@ paged-check: tools
 	@echo "== paged-check: bench.py --dryrun-paged (§19 rows land)"
 	@JAX_PLATFORMS=cpu python3 bench.py --dryrun-paged || exit 1
 	@echo "PAGED CHECK PASSED"
+
+# --- request-journey tracing + SLO burn-rate plane (DESIGN.md §20) ---
+# A 3-rank disaggregated fleet with ACX_REQLOG armed: every rank logs
+# each request's lifecycle events, and tools/acx_request.py --check
+# must reconstruct >= 95% of the journeys admit->finish ACROSS ranks
+# (skew-corrected via the sibling traces) and emit the SLO burn-rate
+# section. The second leg stalls the prefill rank's wire repeatedly
+# (stall_link_ms on every frame from the 3rd) and the reconstructor
+# must name the shipping edge as the fleet-dominant service phase —
+# the whole point of the plane: "where did this request's time go"
+# answered with the faulty leg, not a shrug.
+.PHONY: request-check
+request-check: tools
+	@rm -rf $(BUILD)/request-check && mkdir -p $(BUILD)/request-check
+	@echo "== request-check: 3-rank fleet with ACX_REQLOG armed"
+	@ACX_ROLE=prefill,decode,decode ACX_REQLOG=$(BUILD)/request-check/run \
+	  ACX_TRACE=$(BUILD)/request-check/run ACX_TRACE_CAP=2000000 \
+	  $(BUILD)/acxrun -np 3 -timeout 240 \
+	  -transport socket python3 tests/request_worker.py || exit 1
+	@echo "== request-check: journeys reconstruct (>= 95% admit->finish)"
+	@ACX_SERVE_ADMIT_TTFT_MS=60000 ACX_SERVE_ADMIT_ITL_MS=60000 \
+	  python3 tools/acx_request.py --check --min-reconstructed 0.95 \
+	  --waterfall 3 --json $(BUILD)/request-check/journeys.json \
+	  $(BUILD)/request-check/run.rank*.reqlog.jsonl \
+	  $(BUILD)/request-check/run.rank*.trace.json || exit 1
+	@echo "== request-check: stalled wire -> dominant phase is the ship edge"
+	@ACX_ROLE=prefill,decode,decode ACX_REQLOG=$(BUILD)/request-check/stall \
+	  ACX_TRACE=$(BUILD)/request-check/stall ACX_TRACE_CAP=2000000 \
+	  $(BUILD)/acxrun -np 3 -timeout 240 -transport socket \
+	  -fault stall_link_ms:rank=0:nth=3:count=100000:ms=250 \
+	  python3 tests/request_worker.py || exit 1
+	@python3 tools/acx_request.py --check --expect-dominant ship \
+	  --json $(BUILD)/request-check/stall.journeys.json \
+	  $(BUILD)/request-check/stall.rank*.reqlog.jsonl \
+	  $(BUILD)/request-check/stall.rank*.trace.json || exit 1
+	@echo "REQUEST CHECK PASSED"
 
 # Header dependency tracking (-MMD): a header edit rebuilds its users.
 -include $(LIB_OBJS:.o=.d)

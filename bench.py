@@ -190,6 +190,80 @@ def _record_disagg_rows(rows):
         pass
 
 
+def journey_phase_rows(n_reqs: int = 6, timeout: int = 300):
+    """Per-phase serving-time budget from the request-journey plane
+    (docs/DESIGN.md §20): run the 3-rank journaled fleet
+    (tests/request_worker.py — mono warmup first, so the phases measure
+    serving rather than XLA compiles), reconstruct the journeys offline
+    with tools/acx_request.py, and bank the fleet queue/prefill/ship/
+    decode p50/p99 so future PRs can regress against phase budgets, not
+    just the aggregate TTFT the disagg rows already carry."""
+    import glob
+    import tempfile
+    subprocess.run(["make", "-C", REPO, "lib", "tools"], check=True,
+                   capture_output=True)
+    with tempfile.TemporaryDirectory() as td:
+        env = dict(os.environ)
+        env.pop("PYTHONPATH", None)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["ACX_ROLE"] = "prefill,decode,decode"
+        env["ACX_DISAGG_REQS"] = str(n_reqs)
+        env["ACX_REQLOG"] = os.path.join(td, "run")
+        env["ACX_TRACE"] = os.path.join(td, "run")
+        env["ACX_TRACE_CAP"] = "2000000"
+        cmd = [os.path.join(REPO, "build", "acxrun"), "-np", "3",
+               "-timeout", str(timeout), "-transport", "socket",
+               sys.executable, os.path.join(REPO, "tests",
+                                            "request_worker.py")]
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout + 60, env=env)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"journey fleet rc={r.returncode}: "
+                f"{r.stdout[-300:]} {r.stderr[-300:]}")
+        inputs = (sorted(glob.glob(os.path.join(
+                      td, "run.rank*.reqlog.jsonl")))
+                  + sorted(glob.glob(os.path.join(
+                      td, "run.rank*.trace.json"))))
+        rep_path = os.path.join(td, "journey.json")
+        rq = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "acx_request.py"),
+             "--json", rep_path] + inputs,
+            capture_output=True, text=True, timeout=120)
+        if rq.returncode != 0:
+            raise RuntimeError(
+                f"acx_request rc={rq.returncode}: {rq.stderr[-300:]}")
+        with open(rep_path) as f:
+            rep = json.load(f)
+    rows = {}
+    for ph in ("queue", "prefill", "ship", "decode"):
+        st = rep["phase_breakdown"][ph]
+        rows[f"journey_{ph}_p50_s"] = round(st["p50_s"] or 0.0, 4)
+        rows[f"journey_{ph}_p99_s"] = round(st["p99_s"] or 0.0, 4)
+    rows["journey_reconstructed_rate"] = rep["reconstructed_rate"]
+    rows["journey_dominant_phase"] = rep["dominant_phase"]
+    return rows
+
+
+def _record_journey_rows(rows):
+    """Fold the journey phase-budget rows into the newest BENCH_r*.json
+    (same merge-never-fail contract as _record_paged_rows)."""
+    import glob
+    files = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    if not files:
+        return
+    try:
+        with open(files[-1]) as f:
+            d = json.load(f)
+        d["journey"] = rows
+        with open(files[-1], "w") as f:
+            json.dump(d, f)
+            f.write("\n")
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def _code_rev():
     """Fingerprint of the MEASURED code: tree hashes of the source
     paths plus any uncommitted diff to them. Deliberately excludes the
@@ -1227,6 +1301,17 @@ def main(full: bool = False):
         _record_disagg_rows({**(db or {}), **drows})
     except Exception as e:  # noqa: BLE001 — report, don't crash
         out["disagg_fleet_error"] = str(e)
+
+    # Request-journey phase budget (DESIGN.md §20): where a request's
+    # wall time goes — queue/prefill/ship/decode p50/p99 from the
+    # journaled 3-rank fleet — so a regression in ONE leg is visible
+    # even when the aggregate TTFT still passes.
+    try:
+        jrows = journey_phase_rows()
+        out.update(jrows)
+        _record_journey_rows(jrows)
+    except Exception as e:  # noqa: BLE001 — report, don't crash
+        out["journey_error"] = str(e)
 
     # Paged-KV serving sweep (CPU child): HBM-per-live-token scaling,
     # prefix-hit TTFT split, fixed-budget concurrency (DESIGN.md §19).

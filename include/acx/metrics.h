@@ -152,6 +152,13 @@ void MarkWait(int64_t slot);
 // same JSON to a file, returning 0 on success. FlushAtFinalize writes
 // "<ACX_METRICS>.rank<rank>.metrics.json" iff ACX_METRICS is a path.
 int SnapshotJson(char* buf, int cap);
+// Prometheus text exposition (0.0.4) of the same registry: every
+// counter/gauge as "acx_<name>" with the correct TYPE line, histograms
+// as cumulative _bucket{le=...}/_sum/_count series whose le bounds are
+// the native power-of-two bucket edges (le="0", le="2^i - 1", le="+Inf").
+// Same sizing contract as SnapshotJson (returns length needed excluding
+// the NUL; call with cap=0 to size).
+int PromText(char* buf, int cap);
 int DumpJson(const char* path);
 void FlushAtFinalize(int rank);
 

@@ -69,6 +69,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from mpi_acx_tpu import reqlog
 from mpi_acx_tpu.models.serving import (
     RollingSLO, RequestTelemetry, ServedBatch, ServingMetrics, _bucket,
     _flight_dump_best_effort, _pct, _peer_dead,
@@ -240,6 +241,7 @@ def _prefill_ship(ch, pfns, cfg, padded, last_index, overlap,
     staged = []
     for layer in range(cfg.n_layers):
         x, k, v = layer_fn(x, layer)
+        reqlog.emit("prefill_layer", rid, layer=layer)
         if prefill_kv_int8:
             # quantize-at-compute: codes are the prefill's cache form.
             kq, ks, vq, vs = (np.asarray(a) for a in quant_fn(k, v))
@@ -252,6 +254,7 @@ def _prefill_ship(ch, pfns, cfg, padded, last_index, overlap,
             if kq is None:
                 kq, ks, vq, vs = (np.asarray(a) for a in quant_fn(k, v))
             ch.publish(layer, kq[0], ks[0], vq[0], vs[0])
+            reqlog.emit("ship_pready", rid, part=layer, overlap=True)
         else:
             staged.append((kq, ks, vq, vs) if kq is not None else (k, v))
     logits = head_fn(x, last_index)
@@ -264,6 +267,7 @@ def _prefill_ship(ch, pfns, cfg, padded, last_index, overlap,
             else:
                 kq, ks, vq, vs = st
             ch.publish(layer, kq[0], ks[0], vq[0], vs[0])
+            reqlog.emit("ship_pready", rid, part=layer, overlap=False)
     t1 = time.perf_counter()
     return first, t1 - t0, t1 - t_head
 
@@ -425,6 +429,9 @@ def _serve_disagg_loopback(params, cfg, prompts, n_new, n_slots, max_len,
     slots = family.init_kv_cache(cfg, n_slots, max_len, kv_int8=True)
     slots["pos"] = jnp.zeros((n_slots,), jnp.int32)
     queue = deque(enumerate(np.asarray(p, np.int32) for p in prompts))
+    for depth, (rid, p) in enumerate(queue):
+        reqlog.emit("admit", rid, prompt_len=len(p), n_new=n_new[rid])
+        reqlog.emit("queue", rid, depth=depth)
     owner = [-1] * n_slots
     emitted: List[List[int]] = [[] for _ in prompts]
     done: List[Optional[np.ndarray]] = [None] * len(prompts)
@@ -456,6 +463,7 @@ def _serve_disagg_loopback(params, cfg, prompts, n_new, n_slots, max_len,
         emitted[rid] = []
         ttft[rid] = None
         n_requeues += 1
+        reqlog.emit("requeue", rid, charged=bool(charge))
         queue.append((rid, prompt))
 
     def refill(b):
@@ -472,6 +480,7 @@ def _serve_disagg_loopback(params, cfg, prompts, n_new, n_slots, max_len,
         send_ch = shipper.channel(rt.rank, bucket)
         recv_ch = receiver.channel(rt.rank, bucket)
         spanned = _span_app_begin_best_effort(rid)
+        reqlog.emit("prefill_start", rid, prompt_len=S, bucket=bucket)
         try:
             # Descriptor header: recv posted first, both waited — the
             # exchange is atomic, so a later handoff failure can never
@@ -482,11 +491,14 @@ def _serve_disagg_loopback(params, cfg, prompts, n_new, n_slots, max_len,
                                      dest=rt.rank, tag=DESC_HDR_TAG))
             rt.wait(hr)
             assert int(hdr[0]) == _HDR_MAGIC and int(hdr[1]) == rid, hdr
+            reqlog.emit("ship_hdr", rid, side="loopback", bucket=bucket)
             recv_ch.begin()
             send_ch.begin()
             first, prefill_s, expose_s = _prefill_ship(
                 send_ch, pfns, cfg, jnp.asarray(padded), S - 1, overlap,
                 prefill_kv_int8, ship_fault=ship_fault, rid=rid)
+            reqlog.emit("prefill_end", rid, first_token=first,
+                        prefill_s=prefill_s)
             fin = np.zeros(5, np.int64)
             fr = rt.irecv_enqueue(fin, source=rt.rank, tag=DESC_FIN_TAG)
             rt.wait(rt.isend_enqueue(
@@ -495,6 +507,7 @@ def _serve_disagg_loopback(params, cfg, prompts, n_new, n_slots, max_len,
                 dest=rt.rank, tag=DESC_FIN_TAG))
             rt.wait(fr)
             assert int(fin[0]) == _FIN_MAGIC and int(fin[1]) == rid, fin
+            reqlog.emit("ship_fin", rid, side="loopback")
             t_ship = time.perf_counter()
             one = _splice_poll(recv_ch, bucket, cfg.n_heads,
                                cfg.head_dim, timeout_s=poll_timeout_s)
@@ -513,11 +526,13 @@ def _serve_disagg_loopback(params, cfg, prompts, n_new, n_slots, max_len,
             if spanned:
                 _span_app_end_best_effort()
         owner[b] = rid
+        reqlog.emit("seat", rid, slot=b, pos=S)
         emitted[rid].append(int(fin[2]))
         last_tok[b] = int(fin[2])
         n_prefills += 1
         ttft[rid] = time.perf_counter() - t0
         slo.note_ttft(ttft[rid])
+        reqlog.emit("stream", rid, n=1, ttft_s=ttft[rid])
         handoffs.append(HandoffTelemetry(
             rid=rid, layers=cfg.n_layers,
             wire_bytes=cfg.n_layers * send_ch.geom.part_bytes,
@@ -532,6 +547,8 @@ def _serve_disagg_loopback(params, cfg, prompts, n_new, n_slots, max_len,
             [np.asarray(prompts[rid], np.int32),
              np.asarray(emitted[rid], np.int32)])
         finish[rid] = time.perf_counter() - t0
+        reqlog.emit("finish", rid, new_tokens=len(emitted[rid]),
+                    latency_s=finish[rid])
         owner[b] = -1
         slots["pos"] = slots["pos"].at[b].set(0)
 
@@ -580,16 +597,22 @@ def _serve_disagg_loopback(params, cfg, prompts, n_new, n_slots, max_len,
         block = np.asarray(toks, np.int32)
         step_dt = time.perf_counter() - step_t0
         n_steps += 1
+        reqlog.emit("decode_step", step=n_steps, dt_s=step_dt,
+                    active=sum(o >= 0 for o in owner))
         for b in range(n_slots):
             last_tok[b] = block[-1, b]
             if owner[b] < 0:
                 continue
+            got = 0
             for c in range(block.shape[0]):
                 if slot_finished(b):
                     break
                 emitted[owner[b]].append(int(block[c, b]))
                 itl_samples.append(step_dt / chunk)
                 slo.note_itl(step_dt / chunk)
+                got += 1
+            if got:
+                reqlog.emit("stream", owner[b], n=got, itl_s=step_dt / chunk)
         for b in range(n_slots):
             while owner[b] >= 0 and slot_finished(b):
                 retire(b)
@@ -648,29 +671,41 @@ def run_prefill_worker(rt, params, cfg, prompts, max_len, family=None,
     me = prefill_ranks.index(rt.rank)
     pfns = make_layerwise_prefill_fns(params, cfg, family)
     shipper = KvShipper(rt, cfg.n_layers, cfg.n_heads, cfg.head_dim)
+    my_rids = [rid for rid in range(len(prompts))
+               if rid % len(prefill_ranks) == me]
+    for depth, rid in enumerate(my_rids):
+        # The prefill rank is the fleet's request entry point: its
+        # admit/queue events open every journey the decode rank's
+        # finish will close (tools/acx_request.py joins them by rid).
+        reqlog.emit("admit", rid, prompt_len=len(prompts[rid]))
+        reqlog.emit("queue", rid, depth=depth)
     shipped = 0
-    for rid, prompt in enumerate(prompts):
-        if rid % len(prefill_ranks) != me:
-            continue
+    for rid in my_rids:
         dst = decode_ranks[rid % len(decode_ranks)]
-        prompt = np.asarray(prompt, np.int32)
+        prompt = np.asarray(prompts[rid], np.int32)
         S = len(prompt)
         bucket = min(_bucket(S), max_len, cfg.max_seq)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :S] = prompt
         ch = shipper.channel(dst, bucket)
         spanned = _span_app_begin_best_effort(rid)
+        reqlog.emit("prefill_start", rid, prompt_len=S, bucket=bucket)
         try:
             rt.wait(rt.isend_enqueue(_hdr_wire(rid, S, bucket), dest=dst,
                                      tag=DESC_HDR_TAG))
+            reqlog.emit("ship_hdr", rid, side="send", bucket=bucket,
+                        dst=dst)
             ch.begin()
             first, prefill_s, expose_s = _prefill_ship(
                 ch, pfns, cfg, jnp.asarray(padded), S - 1, overlap,
                 prefill_kv_int8, rid=rid)
+            reqlog.emit("prefill_end", rid, first_token=first,
+                        prefill_s=prefill_s)
             rt.wait(rt.isend_enqueue(
                 _fin_wire(rid, first, int(prefill_s * 1e6),
                           int(expose_s * 1e6)), dest=dst,
                 tag=DESC_FIN_TAG))
+            reqlog.emit("ship_fin", rid, side="send", dst=dst)
             ch.finish()
             shipped += 1
         finally:
@@ -773,6 +808,7 @@ def run_decode_worker(rt, params, cfg, prompts, n_new, n_slots, max_len,
         emitted[rid] = []
         ttft[rid] = None
         n_requeues += 1
+        reqlog.emit("requeue", rid, charged=bool(charge))
 
     def intake(b) -> bool:
         """Consume the next inbound handoff. Seats it in slot ``b`` and
@@ -786,6 +822,8 @@ def run_decode_worker(rt, params, cfg, prompts, n_new, n_slots, max_len,
             rt.wait(rt.irecv_enqueue(hdr, source=src, tag=DESC_HDR_TAG))
             assert int(hdr[0]) == _HDR_MAGIC, hdr
             rid, S, bucket = int(hdr[1]), int(hdr[2]), int(hdr[3])
+            reqlog.emit("ship_hdr", rid, side="recv", bucket=bucket,
+                        src=src)
             recv_ch = receiver.channel(src, bucket)
             recv_ch.begin()
             one = _splice_poll(recv_ch, bucket, cfg.n_heads,
@@ -794,6 +832,7 @@ def run_decode_worker(rt, params, cfg, prompts, n_new, n_slots, max_len,
             rt.wait(rt.irecv_enqueue(fin, source=src, tag=DESC_FIN_TAG))
             assert (int(fin[0]) == _FIN_MAGIC
                     and int(fin[1]) == rid), (fin, rid)
+            reqlog.emit("ship_fin", rid, side="recv", src=src)
             recv_ch.finish()
             if rid not in pending or rid in seated:
                 return False      # re-ship duplicate: drained, dropped
@@ -816,13 +855,14 @@ def run_decode_worker(rt, params, cfg, prompts, n_new, n_slots, max_len,
                     pkv.scatter_prompt(
                         {k: v for k, v in one.items() if k != "pos"},
                         pages[:kvpage.pages_needed(S, pt)])
-                    pkv.seat(b, [], pages, S)
+                    pkv.seat(b, [], pages, S, rid=rid)
                 except Exception:
                     for p in pages:
                         pkv.alloc.decref(p)
                     raise
             else:
                 slots = scatter_fn(slots, one, b, S)
+                reqlog.emit("seat", rid, slot=b, pos=S)
             pickup_s = time.perf_counter() - t_pick
         except Exception as exc:  # noqa: BLE001 — any handoff failure
             nonlocal n_hang_dumps
@@ -844,6 +884,7 @@ def run_decode_worker(rt, params, cfg, prompts, n_new, n_slots, max_len,
         last_tok[b] = first
         n_prefills += 1
         ttft[rid] = time.perf_counter() - t0
+        reqlog.emit("stream", rid, n=1, ttft_s=ttft[rid])
         handoffs.append(HandoffTelemetry(
             rid=rid, layers=cfg.n_layers,
             wire_bytes=cfg.n_layers * recv_ch.geom.part_bytes,
@@ -858,6 +899,8 @@ def run_decode_worker(rt, params, cfg, prompts, n_new, n_slots, max_len,
             [np.asarray(prompts[rid], np.int32),
              np.asarray(emitted[rid], np.int32)])
         finish[rid] = time.perf_counter() - t0
+        reqlog.emit("finish", rid, new_tokens=len(emitted[rid]),
+                    latency_s=finish[rid])
         pending.discard(rid)
         seated.discard(rid)
         owner[b] = -1
@@ -895,15 +938,22 @@ def run_decode_worker(rt, params, cfg, prompts, n_new, n_slots, max_len,
         block = np.asarray(toks, np.int32)
         step_dt = time.perf_counter() - step_t0
         n_steps += 1
+        reqlog.emit("decode_step", step=n_steps, dt_s=step_dt,
+                    active=sum(o >= 0 for o in owner))
         for b in range(n_slots):
             last_tok[b] = block[-1, b]
             if owner[b] < 0:
                 continue
+            got = 0
             for c in range(block.shape[0]):
                 if slot_finished(b):
                     break
                 emitted[owner[b]].append(int(block[c, b]))
                 itl_samples.append(step_dt / chunk)
+                got += 1
+            if got:
+                reqlog.emit("stream", owner[b], n=got,
+                            itl_s=step_dt / chunk)
         for b in range(n_slots):
             if owner[b] >= 0 and slot_finished(b):
                 retire(b)

@@ -60,6 +60,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from mpi_acx_tpu import reqlog
+
 
 def default_page_tokens(max_len: int) -> int:
     """Default page size: ``$ACX_KV_PAGE_TOKENS`` (128 unset — the
@@ -542,15 +544,18 @@ class PagedKV:
         return got
 
     def seat(self, b: int, prompt_pages: List[int],
-             fresh_pages: List[int], new_pos: int) -> None:
+             fresh_pages: List[int], new_pos: int, rid: int = -1) -> None:
         """Slot b takes ownership of ``prompt_pages + fresh_pages``
         (references already held by the caller) at position
-        ``new_pos``."""
+        ``new_pos``. ``rid`` only labels the journey event (ACX_REQLOG,
+        docs/DESIGN.md §20) — the allocator itself is request-blind."""
         assert not self.pages[b], (b, "seat of an occupied slot")
         self.pages[b] = list(prompt_pages) + list(fresh_pages)
         assert len(self.pages[b]) <= self.max_pages, \
             (b, len(self.pages[b]), self.max_pages)
         self.pos[b] = new_pos
+        reqlog.emit("seat", rid, slot=b, pages=len(self.pages[b]),
+                    shared=len(prompt_pages), pos=new_pos)
         self._sync_row(b)
 
     def release(self, b: int) -> None:

@@ -39,6 +39,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from mpi_acx_tpu import reqlog
+
 
 def _pct(samples: List[float], p: float) -> float:
     """Nearest-rank percentile, StepTimer's convention (profiling.py):
@@ -229,6 +231,23 @@ class RollingSLO:
         self._itl: deque = deque()
         self.queue_depth = 0
         self.slot_occupancy = 0.0
+        # Lifecycle counters for the live "app" fragment: cumulative over
+        # the serve call (not windowed — a rejection burst 40 s ago still
+        # matters to an operator triaging "why is goodput down"). acx_top
+        # renders the per-reason breakdown from these, live, instead of
+        # waiting for the end-of-batch ServingMetrics totals.
+        self.rejects: Dict[str, int] = {}
+        self.preemptions = 0
+        self.resumes = 0
+
+    def note_reject(self, reason: str) -> None:
+        self.rejects[reason] = self.rejects.get(reason, 0) + 1
+
+    def note_preempt(self) -> None:
+        self.preemptions += 1
+
+    def note_resume(self) -> None:
+        self.resumes += 1
 
     def _trim(self, dq: deque, now: float) -> None:
         cutoff = now - self.window_s
@@ -266,6 +285,10 @@ class RollingSLO:
             "itl_n": len(itl),
             "queue_depth": self.queue_depth,
             "slot_occupancy": self.slot_occupancy,
+            "rejections": sum(self.rejects.values()),
+            "rejects": dict(self.rejects),
+            "preemptions": self.preemptions,
+            "resumes": self.resumes,
         }
 
 
@@ -464,6 +487,9 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
         rej = _admission_check(rid, p, n, chunk, max_len, cfg.max_seq)
         if rej is not None:
             rejected[rid] = rej
+            reqlog.emit("reject", rid, reason=rej.reason)
+        else:
+            reqlog.emit("admit", rid, prompt_len=len(p), n_new=n)
 
     if server_fns is None:
         server_fns = make_server_fns(params, cfg, family, chunk=chunk,
@@ -485,6 +511,8 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
 
     queue = deque((rid, np.asarray(p, np.int32))
                   for rid, p in enumerate(prompts) if rid not in rejected)
+    for depth, (rid, _p) in enumerate(queue):
+        reqlog.emit("queue", rid, depth=depth)
     # Request id per slot; -1 = idle, -2 = shed (capacity retired after a
     # peer loss — never refilled, skipped by every owner[b] >= 0 loop).
     owner = [-1] * n_slots
@@ -512,6 +540,8 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
     # whole-batch lists below, published to the ACX_TSERIES sampler once
     # per scheduler iteration (a no-op unless sampling is armed).
     slo = RollingSLO()
+    for rej in rejected.values():
+        slo.note_reject(rej.reason)
     itl_samples: List[float] = []
     qd_samples: List[int] = []
     occ_samples: List[float] = []
@@ -548,6 +578,7 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
         emitted[rid] = []
         ttft[rid] = None   # the replayed attempt re-earns its first token
         n_requeues += 1
+        reqlog.emit("requeue", rid, charged=bool(charge))
         queue.append((rid, prompt))
 
     def _check_fleet_rejoin():
@@ -603,6 +634,7 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
         # enqueues) is span-tagged with this request's id, so the
         # request's TTFT decomposes offline (acx_critpath.py).
         spanned = _span_app_begin_best_effort(rid)
+        reqlog.emit("prefill_start", rid, prompt_len=S, bucket=padded.shape[1])
         try:
             logits, one = prefill_fn(jnp.asarray(padded), S - 1)
             if sample_cfg is None:
@@ -625,8 +657,11 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
         emitted[rid].append(first)
         last_tok[b] = first
         n_prefills += 1
+        reqlog.emit("prefill_end", rid, first_token=first)
+        reqlog.emit("seat", rid, slot=b, pos=S)
         ttft[rid] = time.perf_counter() - t0  # prefill emitted token one
         slo.note_ttft(ttft[rid])
+        reqlog.emit("stream", rid, n=1, ttft_s=ttft[rid])
         return True
 
     def retire(b):
@@ -636,6 +671,8 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
             [np.asarray(prompts[rid], np.int32),
              np.asarray(emitted[rid], np.int32)])
         finish[rid] = time.perf_counter() - t0
+        reqlog.emit("finish", rid, new_tokens=len(emitted[rid]),
+                    latency_s=finish[rid])
         owner[b] = -1
         # Park the freed slot at pos 0: an idle slot keeps stepping in
         # the batch, and a stale pos walks toward max_len where the
@@ -713,10 +750,13 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
         # per-token cadence a streaming client would see.
         step_dt = time.perf_counter() - step_t0
         n_steps += 1
+        reqlog.emit("decode_step", step=n_steps, dt_s=step_dt,
+                    active=sum(o >= 0 for o in owner))
         for b in range(n_slots):
             last_tok[b] = block[-1, b]
             if owner[b] < 0:
                 continue
+            got = 0
             for c in range(block.shape[0]):
                 # A slot that finishes mid-chunk idles (its further
                 # tokens are valid continuations past the request's
@@ -727,6 +767,9 @@ def _serve(params, cfg, prompts, n_new, n_slots, max_len, family, eos,
                 emitted[owner[b]].append(int(block[c, b]))
                 itl_samples.append(step_dt / chunk)
                 slo.note_itl(step_dt / chunk)
+                got += 1
+            if got:
+                reqlog.emit("stream", owner[b], n=got, itl_s=step_dt / chunk)
         for b in range(n_slots):
             while owner[b] >= 0 and slot_finished(b):
                 retire(b)
@@ -943,6 +986,9 @@ def serve_paged_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
                                page_budget=n_pages, page_tokens=pt)
         if rej is not None:
             rejected[rid] = rej
+            reqlog.emit("reject", rid, reason=rej.reason)
+        else:
+            reqlog.emit("admit", rid, prompt_len=len(p), n_new=n)
 
     pkv = kvpage.PagedKV(cfg, family, n_slots, max_len, pt, n_pages,
                          kv_int8=kv_int8, prefix_cache=prefix_cache)
@@ -972,6 +1018,8 @@ def serve_paged_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
 
     queue = deque((rid, np.asarray(p, np.int32))
                   for rid, p in enumerate(prompts) if rid not in rejected)
+    for depth, (rid, _p) in enumerate(queue):
+        reqlog.emit("queue", rid, depth=depth)
     owner = [-1] * n_slots          # -1 idle, -2 shed (as _serve)
     emitted: List[List[int]] = [[] for _ in prompts]
     done: List[Optional[object]] = [None] * len(prompts)
@@ -985,11 +1033,16 @@ def serve_paged_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
     ttft = [None] * len(prompts)      # type: List[Optional[float]]
     finish = [None] * len(prompts)    # type: List[Optional[float]]
     slo = RollingSLO()
+    for rej in rejected.values():
+        slo.note_reject(rej.reason)
     itl_samples: List[float] = []
     qd_samples: List[int] = []
     occ_samples: List[float] = []
     n_steps = n_prefills = n_requeues = n_peer_requeues = 0
     n_shed = n_revived = n_hang_dumps = n_preempts = n_slo_defer = 0
+    # Requests currently evicted by page pressure: membership here turns
+    # the next successful seat into a journey "resume" event.
+    preempted_rids: set = set()
     fleet_active_seen = _fleet_active()
 
     def _requeue(rid, prompt, exc, charge=True):
@@ -1005,6 +1058,7 @@ def serve_paged_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
         emitted[rid] = []
         ttft[rid] = None
         n_requeues += 1
+        reqlog.emit("requeue", rid, charged=bool(charge))
         queue.append((rid, prompt))
 
     def _check_fleet_rejoin():
@@ -1063,6 +1117,8 @@ def serve_paged_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
         S = len(prompt)
         hit_pages = (pkv.prefix.match(prompt)
                      if pkv.prefix is not None else [])
+        if hit_pages:
+            reqlog.emit("prefix_hit", rid, pages=len(hit_pages))
         n_fresh = kvpage.pages_needed(S, pt) - len(hit_pages)
         fresh = pkv.alloc_evicting(n_fresh)
         if fresh is None:
@@ -1074,6 +1130,8 @@ def serve_paged_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
             queue.appendleft((rid, prompt))
             return False
         spanned = _span_app_begin_best_effort(rid)
+        reqlog.emit("prefill_start", rid, prompt_len=S,
+                    hit_pages=len(hit_pages), fresh_pages=len(fresh))
         try:
             if hit_pages:
                 # Radix hit: prefill ONLY the suffix against the
@@ -1110,10 +1168,15 @@ def serve_paged_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
         finally:
             if spanned:
                 _span_app_end_best_effort()
-        pkv.seat(b, hit_pages, fresh, S)
+        reqlog.emit("prefill_end", rid, first_token=first)
+        pkv.seat(b, hit_pages, fresh, S, rid=rid)
         if pkv.prefix is not None:
             pkv.prefix.insert(prompt, pkv.pages[b])
         owner[b] = rid
+        if rid in preempted_rids:
+            preempted_rids.discard(rid)
+            slo.note_resume()
+            reqlog.emit("resume", rid, slot=b)
         emitted[rid].append(first)
         if on_token is not None:
             on_token(rid, first)
@@ -1121,6 +1184,7 @@ def serve_paged_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
         n_prefills += 1
         ttft[rid] = time.perf_counter() - t0
         slo.note_ttft(ttft[rid])
+        reqlog.emit("stream", rid, n=1, ttft_s=ttft[rid])
         return True
 
     def retire(b):
@@ -1129,6 +1193,8 @@ def serve_paged_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
             [np.asarray(prompts[rid], np.int32),
              np.asarray(emitted[rid], np.int32)])
         finish[rid] = time.perf_counter() - t0
+        reqlog.emit("finish", rid, new_tokens=len(emitted[rid]),
+                    latency_s=finish[rid])
         owner[b] = -1
         pkv.release(b)              # pages back to the pool, slot parked
 
@@ -1145,6 +1211,9 @@ def serve_paged_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
         queue.append((rid, np.asarray(prompts[rid], np.int32)))
         n_preempts += 1
         pkv.preemptions += 1
+        preempted_rids.add(rid)
+        slo.note_preempt()
+        reqlog.emit("preempt", rid, slot=b)
 
     def grow_for_chunk():
         """Before each step: every active slot's table must cover this
@@ -1262,10 +1331,13 @@ def serve_paged_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
         block = np.asarray(toks, np.int32)           # [chunk, B]
         step_dt = time.perf_counter() - step_t0
         n_steps += 1
+        reqlog.emit("decode_step", step=n_steps, dt_s=step_dt,
+                    active=sum(o >= 0 for o in owner))
         for b in range(n_slots):
             last_tok[b] = block[-1, b]
             if owner[b] < 0:
                 continue
+            got = 0
             for c in range(block.shape[0]):
                 if slot_finished(b):
                     break
@@ -1275,6 +1347,9 @@ def serve_paged_greedy(params, cfg, prompts: Sequence[np.ndarray], n_new,
                     on_token(owner[b], tok)
                 itl_samples.append(step_dt / chunk)
                 slo.note_itl(step_dt / chunk)
+                got += 1
+            if got:
+                reqlog.emit("stream", owner[b], n=got, itl_s=step_dt / chunk)
         for b in range(n_slots):
             while owner[b] >= 0 and slot_finished(b):
                 retire(b)

@@ -1,0 +1,185 @@
+"""Request-journey event log (ACX_REQLOG, docs/DESIGN.md §20).
+
+The op-level planes (metrics registry, tseries, causal spans, flight
+recorder) explain any single native op; this plane explains a
+*request*: every serving loop (models/serving.py, models/disagg.py,
+models/kvpage.py) appends one JSON line per lifecycle event —
+admit/reject, queue, prefill, per-partition KV ship, page seat,
+decode steps, preempt/resume, prefix hit, stream, finish — to
+``<$ACX_REQLOG>.rank<r>.reqlog.jsonl``, keyed by request id and by
+the PR-8 app span id (``span = rid + 1``, the same offset the serving
+loops pass to ``acx_span_app_begin``), so tools/acx_request.py can
+join journeys against trace ``req_op`` events.
+
+Line schema (one JSON object per line, torn-tolerant like tseries):
+
+  init line   {"init":true,"rank":r,"pid":...,"role":"...",
+               "clock":"native"|"mono","schema":1,
+               "t_mono_ns":...,"t_wall_ms":...}
+  event line  {"k":<kind>,"t_mono_ns":...,"rid":...,"span":rid+1,
+               ...kind-specific fields}
+
+``t_mono_ns`` is trace::NowSinceStartNs (via acx_now_since_start_ns)
+when the native runtime is loaded — the SAME per-rank timeline traces
+and tseries stamp, so acx_trace_merge's barrier-anchored skew
+correction applies verbatim. Without the native library (pure-Python
+unit tests) it falls back to a process-local monotonic zero; the init
+line's paired (t_mono_ns, t_wall_ms) reading then gives the offline
+merge a wall-clock fallback anchor. The clock source is latched at
+the first emit and recorded in the init line — one file never mixes
+timelines.
+
+Crash-tail survival mirrors src/core/tseries.cc: every line is
+flushed as it is written, so the journey of a request in flight when
+a rank dies survives up to (at most) one torn final line, which
+readers skip and count.
+
+Discipline: emitting must NEVER raise and never build or load the
+native library (the ``_flight_dump_best_effort`` rule) — an
+observability plane that can take the server down is worse than no
+plane. With ACX_REQLOG unset, ``emit`` is one dict lookup and a
+falsy return.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+# The journey event-kind vocabulary. tools/acx_audit.py's
+# ``journey_kinds`` rule pins three tables together: the literal kinds
+# emitted by serving.py/disagg.py/kvpage.py, this set, and the decode
+# table in tools/acx_request.py (KINDS) must agree exactly — an event
+# kind the offline tool cannot decode is a schema bug, caught at lint
+# time, not in a 3 a.m. incident merge.
+KINDS = frozenset({
+    "admit",          # request accepted by typed admission
+    "reject",         # typed admission rejection (reason field)
+    "queue",          # request enqueued on the scheduler queue
+    "prefill_start",  # prompt pass begins (bucket field)
+    "prefill_layer",  # one layer of a layerwise (disagg) prefill done
+    "prefill_end",    # prompt pass done, first token known
+    "ship_hdr",       # KV handoff descriptor header sent/received
+    "ship_pready",    # one KV partition published to the wire
+    "ship_fin",       # KV handoff FIN descriptor sent/received
+    "seat",           # request seated in a cache slot (pages/scatter)
+    "prefix_hit",     # radix prefix-cache prompt match
+    "decode_step",    # one batched decode step (rid-less, batch-wide)
+    "stream",         # tokens streamed to the request this step
+    "preempt",        # request evicted by page pressure (requeued)
+    "resume",         # a previously preempted request re-seated
+    "requeue",        # failure-path restart (charged flag)
+    "finish",         # request retired; terminal journey event
+})
+
+_SCHEMA = 1
+
+_lock = threading.Lock()
+_state = None        # None = unprobed, False = disabled, file = armed
+_clock_native = False
+_mono_zero = 0
+
+
+def _now_ns() -> int:
+    if _clock_native:
+        try:
+            import mpi_acx_tpu.runtime as _rt
+            return int(_rt._lib.acx_now_since_start_ns())
+        except Exception:
+            pass
+    return time.monotonic_ns() - _mono_zero
+
+
+def _probe_clock() -> str:
+    """Latch the timeline source for this process's reqlog: the native
+    trace clock when the library is ALREADY loaded (never load it for
+    telemetry), else a process-local monotonic zero."""
+    global _clock_native, _mono_zero
+    try:
+        import mpi_acx_tpu.runtime as _rt
+        if _rt._lib is not None:
+            _clock_native = True
+            return "native"
+    except Exception:
+        pass
+    _mono_zero = time.monotonic_ns()
+    return "mono"
+
+
+def _rank() -> int:
+    try:
+        return int(os.environ.get("ACX_RANK", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def _armed():
+    """Open (once) the per-rank journey file, or latch disabled."""
+    global _state
+    if _state is not None:
+        return _state
+    with _lock:
+        if _state is not None:
+            return _state
+        prefix = os.environ.get("ACX_REQLOG", "").strip()
+        if not prefix:
+            _state = False
+            return _state
+        clock = _probe_clock()
+        try:
+            f = open(f"{prefix}.rank{_rank()}.reqlog.jsonl", "a")
+            f.write(json.dumps({
+                "init": True, "schema": _SCHEMA, "rank": _rank(),
+                "pid": os.getpid(),
+                "role": os.environ.get("ACX_ROLE", ""),
+                "clock": clock, "t_mono_ns": _now_ns(),
+                "t_wall_ms": int(time.time() * 1e3),
+            }, separators=(",", ":")) + "\n")
+            f.flush()
+            _state = f
+        except OSError:
+            _state = False
+    return _state
+
+
+def enabled() -> bool:
+    """True iff journey logging is armed for this process."""
+    return bool(_armed())
+
+
+def emit(kind: str, rid: int = -1, **fields) -> bool:
+    """Append one journey event; returns True iff a line was written.
+    Never raises (an unwritable line is dropped, not fatal) and
+    flushes per line so a crashed rank's tail survives."""
+    f = _armed()
+    if not f:
+        return False
+    try:
+        rec = {"k": kind, "t_mono_ns": _now_ns()}
+        if rid >= 0:
+            rec["rid"] = int(rid)
+            rec["span"] = int(rid) + 1   # the PR-8 app span id offset
+        rec.update(fields)
+        with _lock:
+            f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            f.flush()
+        return True
+    except Exception:  # pragma: no cover — diagnostics must never raise
+        return False
+
+
+def _reset_for_tests() -> None:
+    """Drop the armed/disabled latch so a test can re-point ACX_REQLOG.
+    Test-only; production code never re-arms."""
+    global _state, _clock_native, _mono_zero
+    with _lock:
+        if _state not in (None, False):
+            try:
+                _state.close()
+            except Exception:
+                pass
+        _state = None
+        _clock_native = False
+        _mono_zero = 0

@@ -93,6 +93,10 @@ def lib() -> ctypes.CDLL:
         _lib.acx_metrics_enabled.restype = ctypes.c_int
         _lib.acx_metrics_snapshot.restype = ctypes.c_int
         _lib.acx_metrics_snapshot.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        _lib.acx_metrics_prom.restype = ctypes.c_int
+        _lib.acx_metrics_prom.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        _lib.acx_now_since_start_ns.restype = ctypes.c_uint64
+        _lib.acx_now_since_start_ns.argtypes = []
         _lib.acx_metrics_dump_json.restype = ctypes.c_int
         _lib.acx_metrics_dump_json.argtypes = [ctypes.c_char_p]
         _lib.acx_flight_dump.restype = ctypes.c_int
@@ -509,6 +513,23 @@ class Runtime:
             n = self._lib.acx_metrics_snapshot(buf, cap)
             if n < cap:
                 return _json.loads(buf.value.decode())
+
+    def metrics_prom(self) -> str:
+        """The registry in Prometheus text exposition format (0.0.4):
+        every counter/gauge as ``acx_<name>`` with a ``# TYPE`` line,
+        histograms as cumulative ``_bucket{le=...}``/``_sum``/``_count``
+        series on the native power-of-two bucket edges. Runtime-derived
+        counters are refreshed at scrape time — this is the payload a
+        Prometheus scraper (or ``acx_top.py --prom``) serves verbatim."""
+        # Same retry-sizing discipline as metrics(): counters gain digits
+        # under the proxy thread between the size probe and the fill.
+        n = self._lib.acx_metrics_prom(None, 0)
+        while True:
+            cap = n + 256
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.acx_metrics_prom(buf, cap)
+            if n < cap:
+                return buf.value.decode()
 
     def metrics_dump(self, path: str) -> None:
         """Write the registry snapshot to ``path`` as JSON."""

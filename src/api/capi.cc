@@ -15,6 +15,7 @@
 #include "acx/flightrec.h"
 #include "acx/membership.h"
 #include "acx/metrics.h"
+#include "acx/trace.h"
 #include "acx/tseries.h"
 
 namespace acx {
@@ -79,6 +80,25 @@ int acx_metrics_enabled(void) { return acx::metrics::Enabled() ? 1 : 0; }
 int acx_metrics_snapshot(char* buf, int cap) {
   acx::RefreshRuntimeMetrics();
   return acx::metrics::SnapshotJson(buf, cap);
+}
+
+// Writes the registry in Prometheus text exposition format (0.0.4) into
+// buf: every counter/gauge as "acx_<name>" with a TYPE line, histograms
+// as cumulative _bucket{le=...}/_sum/_count series. Same sizing contract
+// as acx_metrics_snapshot. Refreshes runtime-derived counters first so a
+// scrape sees live proxy/fault/transport/fleet state (DESIGN.md §20).
+int acx_metrics_prom(char* buf, int cap) {
+  acx::RefreshRuntimeMetrics();
+  return acx::metrics::PromText(buf, cap);
+}
+
+// Nanoseconds on this rank's shared observability timeline
+// (trace::NowSinceStartNs) — the clock trace events and tseries samples
+// stamp, exported so the Python request-journey log (mpi_acx_tpu/
+// reqlog.py) lands on the same per-rank axis and the barrier-anchored
+// skew correction of tools/acx_trace_merge.py applies to journeys too.
+uint64_t acx_now_since_start_ns(void) {
+  return acx::trace::NowSinceStartNs();
 }
 
 // Dumps the registry snapshot to `path`. Returns 0 on success.

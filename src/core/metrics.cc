@@ -165,6 +165,71 @@ std::string SnapshotString() {
   return out;
 }
 
+// Prometheus text exposition (version 0.0.4) of the same registry the
+// JSON snapshot serializes, so a standard scraper can read the fleet
+// without bespoke tooling (docs/DESIGN.md §20). Every counter/gauge name
+// round-trips as "acx_<name>"; gauges (IsGauge) get TYPE gauge, the rest
+// TYPE counter. Histograms become the native cumulative-bucket series:
+// bucket 0 holds exactly 0 ns (le="0") and bucket i>0 holds
+// [2^(i-1), 2^i) ns — values are integer nanoseconds, so the inclusive
+// Prometheus upper bound is 2^i - 1 — with the saturating top bucket
+// mapped to le="+Inf".
+std::string PromString() {
+  State& s = S();
+  std::string out;
+  out.reserve(16384);
+  char buf[96];
+  for (int c = 0; c < kNumCounters; c++) {
+    const bool g = IsGauge(static_cast<Counter>(c));
+    std::snprintf(buf, sizeof buf, "# TYPE acx_%s %s\n", kCounterName[c],
+                  g ? "gauge" : "counter");
+    out += buf;
+    std::snprintf(buf, sizeof buf, "acx_%s %llu\n", kCounterName[c],
+                  (unsigned long long)s.counters[c].load(
+                      std::memory_order_relaxed));
+    out += buf;
+  }
+  for (int h = 0; h < kNumHists; h++) {
+    const HistData& hd = s.hists[h];
+    std::snprintf(buf, sizeof buf, "# TYPE acx_%s histogram\n", kHistName[h]);
+    out += buf;
+    uint64_t cum = 0;
+    for (int b = 0; b < kNumBuckets; b++) {
+      cum += hd.buckets[b].load(std::memory_order_relaxed);
+      if (b == kNumBuckets - 1) {
+        std::snprintf(buf, sizeof buf, "acx_%s_bucket{le=\"+Inf\"} %llu\n",
+                      kHistName[h], (unsigned long long)cum);
+      } else if (b == 0) {
+        std::snprintf(buf, sizeof buf, "acx_%s_bucket{le=\"0\"} %llu\n",
+                      kHistName[h], (unsigned long long)cum);
+      } else {
+        std::snprintf(buf, sizeof buf, "acx_%s_bucket{le=\"%llu\"} %llu\n",
+                      kHistName[h],
+                      (unsigned long long)((uint64_t{1} << b) - 1),
+                      (unsigned long long)cum);
+      }
+      out += buf;
+    }
+    std::snprintf(buf, sizeof buf, "acx_%s_sum %llu\nacx_%s_count %llu\n",
+                  kHistName[h],
+                  (unsigned long long)hd.sum.load(std::memory_order_relaxed),
+                  kHistName[h],
+                  (unsigned long long)hd.count.load(std::memory_order_relaxed));
+    out += buf;
+  }
+  const uint64_t busy =
+      s.counters[kProxyBusyNs].load(std::memory_order_relaxed);
+  const uint64_t idle =
+      s.counters[kProxyIdleNs].load(std::memory_order_relaxed);
+  std::snprintf(buf, sizeof buf,
+                "# TYPE acx_proxy_util_pct gauge\nacx_proxy_util_pct %.2f\n",
+                busy + idle > 0 ? 100.0 * static_cast<double>(busy) /
+                                      static_cast<double>(busy + idle)
+                                : 0.0);
+  out += buf;
+  return out;
+}
+
 }  // namespace
 
 bool Enabled() {
@@ -269,6 +334,17 @@ void MarkWait(int64_t slot) {
 
 int SnapshotJson(char* buf, int cap) {
   const std::string s = SnapshotString();
+  if (buf != nullptr && cap > 0) {
+    const size_t n =
+        s.size() < static_cast<size_t>(cap) - 1 ? s.size() : cap - 1;
+    std::memcpy(buf, s.data(), n);
+    buf[n] = '\0';
+  }
+  return static_cast<int>(s.size());
+}
+
+int PromText(char* buf, int cap) {
+  const std::string s = PromString();
   if (buf != nullptr && cap > 0) {
     const size_t n =
         s.size() < static_cast<size_t>(cap) - 1 ? s.size() : cap - 1;

@@ -1,0 +1,105 @@
+"""Worker for the request-check legs: a 3-rank disaggregated fleet
+with the request-journey log armed (docs/DESIGN.md §20).
+
+Launched by acxrun (``ACX_ROLE=prefill,decode,decode ACX_REQLOG=<p>
+acxrun -np 3 -transport socket python3 tests/request_worker.py``):
+every rank runs the same deterministic workload through
+``serve_disagg_greedy`` while mpi_acx_tpu/reqlog.py appends each
+request's lifecycle events to ``<p>.rank<r>.reqlog.jsonl`` — the
+prefill rank logs admit/queue/prefill/ship_hdr/ship_fin, the decode
+ranks log the receive side, seat, stream, finish. The Makefile's
+request-check then reconstructs the journeys offline with
+``tools/acx_request.py --check`` (>= 95% admit->finish coverage) and,
+on a second leg with a stalled wire (``-fault stall_link_ms``),
+asserts the dominant fleet phase is the shipping edge.
+
+The worker itself only asserts arming (a run that silently wrote no
+journey would make the offline --check vacuous) and bit-exactness of
+its outputs against the monolithic server — the journey plane must
+never change what is served.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from mpi_acx_tpu import reqlog, runtime  # noqa: E402
+from mpi_acx_tpu.models import transformer as tfm  # noqa: E402
+from mpi_acx_tpu.models.disagg import fleet_roles, serve_disagg_greedy  # noqa: E402
+from mpi_acx_tpu.models.serving import make_server_fns, serve_greedy  # noqa: E402
+
+
+def main():
+    assert os.environ.get("ACX_REQLOG"), \
+        "request_worker needs ACX_REQLOG armed"
+    n_reqs = int(os.environ.get("ACX_DISAGG_REQS", "6"))
+
+    cfg = tfm.tiny_config()
+    lens = [5, 11, 3, 17, 8, 13, 7, 21, 4, 9]
+    max_len, n_slots, chunk = 64, 2, 1
+    params = tfm.init_params(jax.random.key(0), cfg)
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, cfg.vocab, size=lens[i % len(lens)])
+               .astype(np.int32) for i in range(n_reqs)]
+    n_new = [3 + (i % 5) for i in range(n_reqs)]
+
+    rt = runtime.Runtime()
+    rt.set_deadline(120_000)
+    roles = fleet_roles(rt.size)
+    role = roles[rt.rank]
+
+    fns = None
+    mono = None
+    # The mono reference runs BEFORE the fleet, with the journey log
+    # disarmed, for two reasons: its events would smear the fleet
+    # attribution (same rids, re-served), and running it first warms
+    # every jitted decode path so the fleet's journey windows measure
+    # serving — queue/ship/decode — not one-time XLA compiles.
+    prefix = os.environ.pop("ACX_REQLOG")
+    if role == "decode":
+        fns = make_server_fns(params, cfg, tfm, chunk=chunk, kv_int8=True)
+        mono = serve_greedy(params, cfg, prompts, n_new, n_slots=n_slots,
+                            max_len=max_len, chunk=chunk, kv_int8=True,
+                            server_fns=fns)
+    os.environ["ACX_REQLOG"] = prefix
+    reqlog._reset_for_tests()
+    # Everyone waits out the decode ranks' warmup: without this the
+    # prefill rank ships into peers still busy compiling and every
+    # journey's ship leg silently absorbs the warmup skew. The barrier
+    # also gives the traces one more common skew anchor.
+    rt.barrier()
+
+    batch = serve_disagg_greedy(
+        params, cfg, prompts, n_new, n_slots=n_slots, max_len=max_len,
+        chunk=chunk, server_fns=fns, rt=rt)
+
+    # The lifecycle above must have armed the log on every rank; a
+    # misconfigured prefix would leave the offline --check with nothing
+    # to reconstruct and pass vacuously.
+    assert reqlog.enabled(), "reqlog did not arm despite ACX_REQLOG"
+
+    if role == "decode":
+        mine = [r.rid for r in batch.metrics.per_request]
+        assert mine, "decode rank owns no requests"
+        for rid in mine:
+            np.testing.assert_array_equal(
+                batch[rid], mono[rid],
+                err_msg=f"rank {rt.rank} request {rid} disagg != mono")
+        print(f"REQUEST_OK rank={rt.rank} rids={mine}", flush=True)
+    else:
+        print(f"REQUEST_OK rank={rt.rank} role=prefill", flush=True)
+    rt.barrier()
+    rt.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -107,6 +107,25 @@ DOCTOR_PY = '''KNOWN_KINDS = {
 }
 '''
 
+REQLOG_PY = """KINDS = frozenset({
+    "admit",
+    "finish",
+})
+"""
+
+SERVING_PY = """from mpi_acx_tpu import reqlog
+def serve():
+    reqlog.emit("admit", 0)
+    reqlog.emit("finish", 0)
+"""
+
+REQUEST_PY = '''"""fixture journey tool"""
+KINDS = {
+    "admit": "accepted",
+    "finish": "retired",
+}
+'''
+
 TRACE_CC = """#include <cstdio>
 namespace acx { namespace trace {
 void Safe() { }
@@ -132,6 +151,11 @@ def write_tree(tmp_path, **overrides):
         "tools/acx_top.py": TOP_PY,
         "src/core/flightrec.cc": FLIGHTREC_CC,
         "tools/acx_doctor.py": DOCTOR_PY,
+        "mpi_acx_tpu/reqlog.py": REQLOG_PY,
+        "mpi_acx_tpu/models/serving.py": SERVING_PY,
+        "mpi_acx_tpu/models/disagg.py": "# fixture: no journey emits\n",
+        "mpi_acx_tpu/models/kvpage.py": "# fixture: no journey emits\n",
+        "tools/acx_request.py": REQUEST_PY,
         "src/core/trace.cc": TRACE_CC,
         "tools/audit_allowlist.json": json.dumps(CLEAN_ALLOWLIST),
         "include/acx/.keep": "",
@@ -175,7 +199,8 @@ def test_json_report_shape(tmp_path, capsys):
     assert run_audit(write_tree(tmp_path), "--json") == 0
     report = json.loads(capsys.readouterr().out)
     assert report["ok"] is True
-    assert sorted(report["rules"]) == ["bindings", "flight_kinds", "knobs",
+    assert sorted(report["rules"]) == ["bindings", "flight_kinds",
+                                       "journey_kinds", "knobs",
                                        "registry", "signal_path"]
     assert report["violations"] == []
 
@@ -347,6 +372,43 @@ def test_stale_doctor_kind_fires(tmp_path):
     assert len(vs) == 1
     assert "never_emitted" in vs[0].msg
     assert vs[0].path == os.path.join("tools", "acx_doctor.py")
+
+
+# --------------------------------------------------------------------------
+# rule 4b: journey kinds
+
+def test_journey_emitted_but_undeclared_fires(tmp_path):
+    tree = write_tree(tmp_path, **{
+        "mpi_acx_tpu/models/disagg.py":
+            'from mpi_acx_tpu import reqlog\nreqlog.emit("warp", 0)\n'})
+    vs = violations(tree, "journey_kinds")
+    # Undeclared in reqlog.KINDS AND undecodable by acx_request.py.
+    assert len(vs) == 2
+    assert all("warp" in v.msg for v in vs)
+    assert vs[0].path == os.path.join("mpi_acx_tpu", "models", "disagg.py")
+
+
+def test_journey_stale_vocab_and_decode_row_fire(tmp_path):
+    tree = write_tree(tmp_path, **{
+        "mpi_acx_tpu/reqlog.py": REQLOG_PY.replace(
+            '"finish",', '"finish",\n    "never_emitted",')})
+    vs = violations(tree, "journey_kinds")
+    # Declared-never-emitted and declared-not-decodable both fire.
+    assert len(vs) == 2
+    assert all("never_emitted" in v.msg for v in vs)
+    assert all(v.path == os.path.join("mpi_acx_tpu", "reqlog.py")
+               for v in vs)
+
+
+def test_journey_stale_decode_table_row_fires(tmp_path):
+    tree = write_tree(tmp_path, **{
+        "tools/acx_request.py": REQUEST_PY.replace(
+            '"finish": "retired",',
+            '"finish": "retired",\n    "ghost": "stale row",')})
+    vs = violations(tree, "journey_kinds")
+    assert len(vs) == 1
+    assert "ghost" in vs[0].msg
+    assert vs[0].path == os.path.join("tools", "acx_request.py")
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,381 @@
+"""Request-journey plane (mpi_acx_tpu/reqlog.py, tools/acx_request.py,
+the Prometheus metrics export — docs/DESIGN.md §20).
+
+Three layers, bottom up: the per-rank JSONL writer (armed/disabled
+latch, init-line schema, span offset, never-raise discipline), the
+offline journey tool (wall-clock fallback merge, phase attribution,
+burn rate, the --check CI gate), and the Prometheus text exposition
+round-trip through the native registry.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REQUEST = os.path.join(REPO, "tools", "acx_request.py")
+
+
+# -- reqlog writer ----------------------------------------------------------
+
+
+@pytest.fixture
+def rl(monkeypatch):
+    """A clean reqlog latch before AND after: the armed/disabled state is
+    process-global, so tests must never leak it into the rest of the
+    suite (serving tests would otherwise start journaling)."""
+    monkeypatch.delenv("ACX_REQLOG", raising=False)
+    from mpi_acx_tpu import reqlog
+    reqlog._reset_for_tests()
+    yield reqlog
+    reqlog._reset_for_tests()
+
+
+def test_reqlog_disabled_without_env(rl, tmp_path):
+    """With ACX_REQLOG unset, emit is a cheap no-op: no file, falsy
+    return, and the disabled verdict is latched."""
+    assert not rl.enabled()
+    assert rl.emit("admit", 0, reason="x") is False
+    assert not list(tmp_path.glob("*.reqlog.jsonl"))
+
+
+def test_reqlog_init_line_and_span_offset(rl, tmp_path, monkeypatch):
+    """The armed writer opens <prefix>.rank<r>.reqlog.jsonl with a
+    schema-stamped init line (paired clock readings for the offline
+    wall fallback), then one line per event with span = rid + 1 — the
+    PR-8 app span offset — and no rid/span on rid-less events."""
+    monkeypatch.setenv("ACX_REQLOG", str(tmp_path / "run"))
+    monkeypatch.setenv("ACX_RANK", "3")
+    monkeypatch.setenv("ACX_ROLE", "decode")
+    assert rl.emit("admit", 7, queued=2) is True
+    assert rl.emit("decode_step", step=1, dt_s=0.5) is True
+
+    path = tmp_path / "run.rank3.reqlog.jsonl"
+    assert path.exists()
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    init, admit, step = lines
+
+    assert init["init"] is True and init["schema"] == 1
+    assert init["rank"] == 3 and init["role"] == "decode"
+    assert init["pid"] == os.getpid()
+    assert init["clock"] in ("native", "mono")
+    assert init["t_mono_ns"] >= 0 and init["t_wall_ms"] > 0
+
+    assert admit["k"] == "admit" and admit["rid"] == 7
+    assert admit["span"] == 8  # rid + 1
+    assert admit["queued"] == 2
+    assert step["k"] == "decode_step" and "rid" not in step
+    assert "span" not in step
+    assert step["t_mono_ns"] >= admit["t_mono_ns"]
+
+
+def test_reqlog_kinds_are_vocabulary(rl):
+    """Every kind the emitters may use is in the frozen vocabulary the
+    audit rule pins (a free-form kind would silently fail offline
+    decode)."""
+    assert "admit" in rl.KINDS and "finish" in rl.KINDS
+    assert len(rl.KINDS) == 17
+
+
+def test_reqlog_emit_never_raises(rl, tmp_path, monkeypatch):
+    """The never-raise discipline: a dead file handle (rank torn down
+    mid-serve) turns emit into a falsy drop, not an exception in the
+    serving loop."""
+    monkeypatch.setenv("ACX_REQLOG", str(tmp_path / "run"))
+    assert rl.emit("queue", 0) is True
+    rl._state.close()  # yank the file out from under the writer
+    assert rl.emit("finish", 0) is False  # dropped, no raise
+
+
+# -- acx_request.py: merge, attribution, burn, --check ----------------------
+
+
+def _tool(*argv):
+    return subprocess.run([sys.executable, REQUEST, *argv],
+                          capture_output=True, text=True, timeout=120)
+
+
+def _write_reqlog(path, rank, wall0_ms, events, clock="mono"):
+    lines = [json.dumps({"init": True, "schema": 1, "rank": rank,
+                         "pid": 1, "role": "", "clock": clock,
+                         "t_mono_ns": 0, "t_wall_ms": wall0_ms})]
+    lines += [json.dumps(e) for e in events]
+    path.write_text("\n".join(lines) + "\n")
+
+
+def _ev(k, t_ms, rid=None, **fields):
+    e = {"k": k, "t_mono_ns": int(t_ms * 1e6)}
+    if rid is not None:
+        e["rid"] = rid
+        e["span"] = rid + 1
+    e.update(fields)
+    return e
+
+
+def _two_rank_journey(tmp_path):
+    """One rid whose journey spans two mono-clock ranks. Rank 1's
+    process started 2 ms after rank 0 (wall readings 1000 vs 1002), so
+    the wall fallback must shift rank 1 by +2 ms; the legs below are
+    chosen so each phase is distinct: queue 1 ms, prefill 20 ms, ship
+    1 ms (cross-rank: prefill_end at rank-0 22 ms = fleet 22 ms, seat
+    at rank-1 local 21 ms = fleet 23 ms), decode 10 ms (2 stream
+    events x 1 token x 5 ms)."""
+    _write_reqlog(tmp_path / "run.rank0.reqlog.jsonl", 0, 1000, [
+        _ev("admit", 1, rid=0),
+        _ev("queue", 1, rid=0, depth=0),
+        _ev("prefill_start", 2, rid=0, bucket=8),
+        _ev("prefill_end", 22, rid=0),
+    ])
+    _write_reqlog(tmp_path / "run.rank1.reqlog.jsonl", 1, 1002, [
+        _ev("seat", 21, rid=0, slot=0),
+        _ev("stream", 23, rid=0, n=1, ttft_s=0.024),
+        _ev("stream", 28, rid=0, n=1, itl_s=0.005),
+        _ev("stream", 33, rid=0, n=1, itl_s=0.005),
+        _ev("finish", 33, rid=0, new_tokens=3),
+        _ev("reject", 40, rid=1, reason="queue_full"),
+    ])
+    return [str(tmp_path / f"run.rank{r}.reqlog.jsonl") for r in (0, 1)]
+
+
+def test_request_wall_fallback_attribution(tmp_path):
+    """Without traces the init lines' paired (t_mono_ns, t_wall_ms)
+    anchor each rank; the cross-rank journey reconstructs and each
+    phase lands where the synthetic timeline put it."""
+    inputs = _two_rank_journey(tmp_path)
+    out = tmp_path / "report.json"
+    r = _tool("--json", str(out), *inputs)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    summary = json.loads(r.stdout)
+    assert summary["ranks"] == [0, 1]
+    assert summary["skew_source"] == {"0": "wall", "1": "wall"}
+    assert summary["rids"] == 2 and summary["rejected"] == 1
+    assert summary["reconstructed"] == 1  # rejected rid 1 not a candidate
+    assert summary["reconstructed_rate"] == 1.0
+    assert summary["unknown_kinds"] == {}
+    assert summary["dominant_phase"] == "prefill"
+
+    rep = json.loads(out.read_text())
+    ph = rep["phase_breakdown"]
+    assert abs(ph["queue"]["total_s"] - 0.001) < 1e-6
+    assert abs(ph["prefill"]["total_s"] - 0.020) < 1e-6
+    # ship = prefill_end (fleet 22 ms) -> seat (local 21 + 2 ms skew)
+    assert abs(ph["ship"]["total_s"] - 0.001) < 1e-6
+    # decode from the stream events (2 itl x 1 token x 5 ms), NOT the
+    # seat->finish window (12 ms) that holds interference.
+    assert abs(ph["decode"]["total_s"] - 0.010) < 1e-6
+
+
+def test_request_dominance_ignores_queue_backlog(tmp_path):
+    """A request that queued 500 ms behind a busy fleet but was served
+    in 20 ms must NOT report queue as dominant: queue is the symptom of
+    a slow service leg, so dominance is judged over service phases
+    only."""
+    _write_reqlog(tmp_path / "run.rank0.reqlog.jsonl", 0, 1000, [
+        _ev("admit", 0, rid=0),
+        _ev("prefill_start", 500, rid=0),
+        _ev("prefill_end", 515, rid=0),
+        _ev("seat", 516, rid=0, slot=0),
+        _ev("finish", 520, rid=0),
+    ])
+    r = _tool(str(tmp_path / "run.rank0.reqlog.jsonl"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    summary = json.loads(r.stdout)
+    assert summary["dominant_phase"] == "prefill"
+
+
+def test_request_burn_rate_and_waterfall(tmp_path):
+    """With a TTFT target below the observed TTFT every finished
+    request violates: burn = violation_fraction / budget. The waterfall
+    renders the slowest journey with the phase glyph legend."""
+    inputs = _two_rank_journey(tmp_path)
+    r = _tool("--ttft-ms", "5", "--budget", "0.01", "--waterfall", "1",
+              *inputs)
+    assert r.returncode == 0, r.stdout + r.stderr
+    summary = json.loads(r.stdout.splitlines()[0])
+    burn = summary["burn"]
+    assert burn["ttft_target_s"] == 0.005
+    assert burn["windows"] and burn["windows"][0]["violations"] == 1
+    assert burn["max_burn"] == 100.0  # 1.0 violation fraction / 1% budget
+    assert "waterfall" in r.stdout and "rid    0" in r.stdout
+
+    # ...and with a generous target the same journeys burn nothing.
+    r2 = _tool("--ttft-ms", "60000", *inputs)
+    s2 = json.loads(r2.stdout)
+    assert s2["burn"]["max_burn"] == 0.0
+
+
+def test_request_burn_section_present_without_targets(tmp_path):
+    """No targets -> the burn section still exists with null burn (so
+    --check can assert its presence instead of silently skipping)."""
+    inputs = _two_rank_journey(tmp_path)
+    r = _tool(*inputs)
+    assert r.returncode == 0, r.stdout + r.stderr
+    burn = json.loads(r.stdout)["burn"]
+    assert burn["ttft_target_s"] is None and burn["max_burn"] is None
+
+
+def test_request_check_gate(tmp_path):
+    """--check passes on the healthy fleet, fails (exit 1) when the
+    expected dominant phase disagrees, and fails on an unknown journey
+    kind with a decode-table warning."""
+    inputs = _two_rank_journey(tmp_path)
+    assert _tool("--check", "--min-reconstructed", "0.95",
+                 *inputs).returncode == 0
+
+    r = _tool("--check", "--expect-dominant", "ship", *inputs)
+    assert r.returncode == 1
+    assert "dominant phase 'prefill', expected 'ship'" in r.stderr
+
+    # An event kind the decode table does not know: warned, and fatal
+    # under --check (schema drift must not pass CI).
+    extra = tmp_path / "run.rank2.reqlog.jsonl"
+    _write_reqlog(extra, 2, 1000, [_ev("warp", 1, rid=5)])
+    r = _tool("--check", *inputs, str(extra))
+    assert r.returncode == 1
+    assert "unknown journey kind 'warp'" in r.stderr
+
+
+def test_request_check_fails_on_torn_journeys(tmp_path):
+    """Journeys missing their finish (rank died mid-serve) drop the
+    reconstruction rate below the bar -> --check exits 1."""
+    _write_reqlog(tmp_path / "run.rank0.reqlog.jsonl", 0, 1000, [
+        _ev("admit", 1, rid=0),
+        _ev("prefill_start", 2, rid=0),
+    ])
+    r = _tool("--check", str(tmp_path / "run.rank0.reqlog.jsonl"))
+    assert r.returncode == 1
+    assert "reconstructed 0/1" in r.stderr
+
+
+def test_request_torn_tail_tolerated(tmp_path):
+    """A torn final line (rank killed mid-write) is skipped and counted,
+    never fatal — the tseries reader discipline."""
+    path = tmp_path / "run.rank0.reqlog.jsonl"
+    _write_reqlog(path, 0, 1000, [
+        _ev("admit", 1, rid=0),
+        _ev("prefill_start", 2, rid=0),
+        _ev("prefill_end", 3, rid=0),
+        _ev("seat", 4, rid=0, slot=0),
+        _ev("finish", 9, rid=0),
+    ])
+    with open(path, "a") as f:
+        f.write('{"k":"fini')  # torn mid-write
+    r = _tool(str(path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    summary = json.loads(r.stdout)
+    assert summary["torn_lines"] == {"0": 1}
+    assert summary["reconstructed_rate"] == 1.0
+
+
+def test_request_no_reqlog_inputs_exits_2(tmp_path):
+    """Only traces (or nothing decodable) -> exit 2 with a clear
+    message, distinct from a failed --check."""
+    r = _tool(str(tmp_path / "run.rank0.trace.json"))
+    assert r.returncode == 2
+    assert "no .reqlog.jsonl inputs" in r.stderr
+
+
+# -- Prometheus text exposition round-trip ----------------------------------
+
+
+@pytest.fixture(scope="module")
+def _built_lib():
+    r = subprocess.run(["make", "-C", REPO, "lib"],
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_metrics_prom_round_trip(_built_lib):
+    """Runtime.metrics_prom() is valid Prometheus 0.0.4 text and
+    round-trips the whole registry: every counter/gauge from
+    rt.metrics() appears as acx_<name> under a # TYPE line, every
+    histogram becomes a cumulative _bucket{le=...} series ending at
+    +Inf with matching _sum/_count."""
+    prog = textwrap.dedent("""
+        import re
+        import numpy as np
+        from mpi_acx_tpu import runtime
+        rt = runtime.Runtime()
+        src = np.arange(32, dtype=np.float32)
+        dst = np.zeros(32, dtype=np.float32)
+        s = rt.isend_enqueue(src, dest=0, tag=9)
+        r = rt.irecv_enqueue(dst, source=0, tag=9)
+        rt.wait(r); rt.wait(s)
+        m = rt.metrics()
+        text = rt.metrics_prom()
+        rt.finalize()
+
+        types, values = {}, {}
+        sample_re = re.compile(
+            r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+            r'(\\{[a-zA-Z0-9_]+="[^"]*"(,[a-zA-Z0-9_]+="[^"]*")*\\})?'
+            r' (-?[0-9.eE+]+|\\+Inf|NaN)$')
+        for line in text.splitlines():
+            if line.startswith("# TYPE "):
+                _h, _t, name, kind = line.split()
+                assert kind in ("counter", "gauge", "histogram"), line
+                assert name not in types, f"duplicate TYPE for {name}"
+                types[name] = kind
+            elif line.startswith("#"):
+                continue
+            else:
+                mo = sample_re.match(line)
+                assert mo, f"malformed sample line: {line!r}"
+                values.setdefault(mo.group(1), []).append(
+                    (mo.group(2) or "", float(mo.group(4))))
+
+        # Every sample belongs to a declared family (histogram series
+        # hang off their family name).
+        for name in values:
+            fam = re.sub(r'_(bucket|sum|count)$', '', name)
+            assert name in types or fam in types, f"undeclared {name}"
+
+        # Round trip: every registry counter/gauge name...
+        for cname in m["counters"]:
+            pname = "acx_" + cname
+            assert types.get(pname) in ("counter", "gauge"), pname
+            assert pname in values, pname
+        # ...and every histogram, as a well-formed cumulative series.
+        for hname in m["histograms"]:
+            pname = "acx_" + hname
+            assert types.get(pname) == "histogram", pname
+            buckets = values[pname + "_bucket"]
+            les = [lbl for lbl, _v in buckets]
+            assert les[-1] == '{le="+Inf"}', les
+            counts = [v for _lbl, v in buckets]
+            assert counts == sorted(counts), f"{pname} not cumulative"
+            # count is loaded after the buckets, so a concurrent proxy
+            # sample can only make it >=, never <.
+            assert values[pname + "_count"][0][1] >= counts[-1]
+            assert values[pname + "_sum"][0][1] >= 0
+        # The derived utilization gauge rides along for scrapers.
+        assert types.get("acx_proxy_util_pct") == "gauge"
+        print("PROM_OK counters=%d hists=%d" %
+              (len(m["counters"]), len(m["histograms"])))
+    """)
+    env = dict(os.environ)
+    env["ACX_METRICS"] = "1"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", prog], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PROM_OK" in r.stdout
+
+
+# -- make target ------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_makefile_request_check_target():
+    """`make request-check` (wired into `make check`) goes green: the
+    3-rank journaled fleet, the offline reconstruction gate, and the
+    stalled-wire leg naming ship as the dominant phase."""
+    r = subprocess.run(["make", "-C", REPO, "request-check"],
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "REQUEST CHECK PASSED" in r.stdout

@@ -415,3 +415,67 @@ def test_serving_telemetry_counts_requeues():
         want = mod.generate(params, cfg, jnp.asarray(p)[None], 4,
                             max_len=24)
         np.testing.assert_array_equal(np.asarray(g), np.asarray(want)[0])
+
+
+# -- RollingSLO window semantics (docs/DESIGN.md §13/§20) -------------------
+
+
+def test_rolling_slo_empty_window():
+    """A fresh (or fully-expired) window reports zeroed percentiles and
+    empty lifecycle counters — never a crash on the empty deque."""
+    s = serving.RollingSLO(window_s=30.0)
+    d = s.live_slos()
+    assert d["ttft_n"] == 0 and d["itl_n"] == 0
+    assert d["ttft_p50_s"] == 0.0 and d["ttft_p99_s"] == 0.0
+    assert d["itl_p50_s"] == 0.0 and d["itl_p99_s"] == 0.0
+    assert d["rejections"] == 0 and d["rejects"] == {}
+    assert d["preemptions"] == 0 and d["resumes"] == 0
+
+
+def test_rolling_slo_single_sample():
+    """With one sample every percentile IS that sample (nearest-rank,
+    no interpolation against phantom neighbors)."""
+    s = serving.RollingSLO()
+    s.note_ttft(0.25)
+    s.note_itl(0.01)
+    d = s.live_slos()
+    assert d["ttft_n"] == 1
+    assert d["ttft_p50_s"] == d["ttft_p99_s"] == 0.25
+    assert d["itl_p50_s"] == d["itl_p99_s"] == 0.01
+
+
+def test_rolling_slo_window_expiry(monkeypatch):
+    """Samples older than window_s fall out of the percentiles — the
+    30 s default window forgets a slow start once it is 30 s in the
+    past, unlike ServingMetrics' whole-batch aggregates."""
+    now = {"t": 100.0}
+    monkeypatch.setattr(serving.time, "monotonic", lambda: now["t"])
+    s = serving.RollingSLO(window_s=30.0)
+    s.note_ttft(1.0)
+    now["t"] = 110.0
+    s.note_ttft(2.0)
+    now["t"] = 131.0  # first sample now 31 s old, second only 21 s
+    d = s.live_slos()
+    assert d["ttft_n"] == 1 and d["ttft_p50_s"] == 2.0
+    now["t"] = 200.0  # everything expired
+    d = s.live_slos()
+    assert d["ttft_n"] == 0 and d["ttft_p50_s"] == 0.0
+
+
+def test_rolling_slo_lifecycle_counters_cumulative(monkeypatch):
+    """Rejections/preemptions/resumes are cumulative, NOT windowed: a
+    rejection burst 40 s ago still matters to an operator triaging
+    goodput, so expiry must not erase it."""
+    now = {"t": 0.0}
+    monkeypatch.setattr(serving.time, "monotonic", lambda: now["t"])
+    s = serving.RollingSLO(window_s=30.0)
+    s.note_reject("queue_full")
+    s.note_reject("queue_full")
+    s.note_reject("ttft_budget")
+    s.note_preempt()
+    s.note_resume()
+    now["t"] = 1000.0  # far past any window
+    d = s.live_slos()
+    assert d["rejections"] == 3
+    assert d["rejects"] == {"queue_full": 2, "ttft_budget": 1}
+    assert d["preemptions"] == 1 and d["resumes"] == 1

@@ -20,6 +20,11 @@ turns each convention into an enforced rule:
                consumers (tseries.cc, acx_top.py) still consume them
   flight_kinds every event kind name in flightrec.cc is decodable by
                acx_doctor.py's KNOWN_KINDS table, and vice versa
+  journey_kinds every request-journey kind emitted by the serving
+               loops (serving.py/disagg.py/kvpage.py via reqlog.emit)
+               is declared in mpi_acx_tpu/reqlog.py KINDS and
+               decodable by tools/acx_request.py's KINDS table, and
+               neither table carries stale rows
   signal_path  functions reachable from the crash-flusher registry
                (trace.cc RegisterCrashFlusher roots) never call a
                denylist of non-async-signal-safe / blocking
@@ -502,6 +507,89 @@ def audit_flight_kinds(root, allow):
 
 
 # --------------------------------------------------------------------------
+# rule 4b: journey-kind audit (the flight_kinds rule, one layer up: the
+# request-journey plane of DESIGN.md §20 instead of the flight recorder)
+
+REQLOG_REL = os.path.join("mpi_acx_tpu", "reqlog.py")
+REQUEST_TOOL_REL = os.path.join("tools", "acx_request.py")
+JOURNEY_EMITTERS = (
+    os.path.join("mpi_acx_tpu", "models", "serving.py"),
+    os.path.join("mpi_acx_tpu", "models", "disagg.py"),
+    os.path.join("mpi_acx_tpu", "models", "kvpage.py"),
+)
+
+
+def _brace_table(text, head_re, rel, what, key_re=r'"([a-z0-9_]+)"'):
+    """Quoted names inside the first brace block after head_re.
+    Returns (dict name -> line, header_line)."""
+    m = re.search(head_re, text)
+    if not m:
+        raise AuditError("%s: %s not found" % (rel, what))
+    start = text.index("{", m.start())
+    end = match_brace(text, start)
+    if end < 0:
+        raise AuditError("%s: %s: unbalanced braces" % (rel, what))
+    names = {}
+    for km in re.finditer(key_re, text[start:end]):
+        names.setdefault(km.group(1), line_of(text, start + km.start()))
+    return names, line_of(text, m.start())
+
+
+def audit_journey_kinds(root, allow):
+    del allow  # no exceptions: every emitted kind must be decodable
+    violations = []
+
+    # The literal kinds the serving loops emit (first site per kind).
+    emitted = {}
+    for rel in JOURNEY_EMITTERS:
+        text = read_file(root, rel)
+        for m in re.finditer(r'reqlog\.emit\(\s*"([a-z0-9_]+)"', text):
+            emitted.setdefault(m.group(1), (rel, line_of(text, m.start())))
+
+    # The declared vocabulary (reqlog.KINDS frozenset).
+    vocab, vocab_line = _brace_table(
+        read_file(root, REQLOG_REL),
+        r"KINDS\s*=\s*frozenset\(\s*\{", REQLOG_REL, "KINDS frozenset")
+    # The offline decode table (acx_request.KINDS dict — keys only; the
+    # values are free-text descriptions).
+    decode, decode_line = _brace_table(
+        read_file(root, REQUEST_TOOL_REL),
+        r"(?m)^KINDS\s*=\s*\{", REQUEST_TOOL_REL, "KINDS decode table",
+        key_re=r'(?m)^\s*"([a-z0-9_]+)"\s*:')
+
+    for name in sorted(set(emitted) - set(vocab)):
+        rel, line = emitted[name]
+        violations.append(Violation(
+            "journey_kinds", rel, line,
+            'journey kind "%s" is emitted but not declared in %s KINDS '
+            "(line %d)" % (name, REQLOG_REL, vocab_line)))
+    for name in sorted(set(emitted) - set(decode)):
+        rel, line = emitted[name]
+        violations.append(Violation(
+            "journey_kinds", rel, line,
+            'journey kind "%s" is emitted but not decodable by %s KINDS '
+            "(line %d) — acx_request.py would warn it unknown at merge "
+            "time" % (name, REQUEST_TOOL_REL, decode_line)))
+    for name in sorted(set(vocab) - set(emitted)):
+        violations.append(Violation(
+            "journey_kinds", REQLOG_REL, vocab[name],
+            'KINDS declares "%s" but no serving loop (%s) emits it '
+            "(stale vocabulary entry?)"
+            % (name, ", ".join(JOURNEY_EMITTERS))))
+    for name in sorted(set(vocab) - set(decode)):
+        violations.append(Violation(
+            "journey_kinds", REQLOG_REL, vocab[name],
+            'KINDS declares "%s" but %s cannot decode it (add a decode '
+            "table row)" % (name, REQUEST_TOOL_REL)))
+    for name in sorted(set(decode) - set(vocab)):
+        violations.append(Violation(
+            "journey_kinds", REQUEST_TOOL_REL, decode[name],
+            'decode table row "%s" matches no kind in %s KINDS (stale '
+            "row?)" % (name, REQLOG_REL)))
+    return violations
+
+
+# --------------------------------------------------------------------------
 # rule 5: signal-path audit
 
 SIGNAL_DIRS = (os.path.join("src", "core"), os.path.join("src", "net"),
@@ -666,6 +754,7 @@ RULES = (
     ("bindings", audit_bindings),
     ("registry", audit_registry),
     ("flight_kinds", audit_flight_kinds),
+    ("journey_kinds", audit_journey_kinds),
     ("signal_path", audit_signal_path),
 )
 

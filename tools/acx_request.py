@@ -1,0 +1,468 @@
+#!/usr/bin/env python3
+"""Reconstruct per-request journeys from ACX request logs and report
+fleet phase breakdowns + SLO burn rate (docs/DESIGN.md §20).
+
+Each rank with ``ACX_REQLOG=<prefix>`` set appends one JSON line per
+request-lifecycle event to ``<prefix>.rank<r>.reqlog.jsonl``
+(mpi_acx_tpu/reqlog.py). A request's journey usually spans ranks — in
+the disaggregated fleet a prefill rank emits admit/queue/prefill/ship
+while a decode rank emits seat/stream/finish — so this tool:
+
+  * merges the per-rank logs onto one timeline. When sibling
+    ``*.trace.json`` files are given, the barrier-anchored skew from
+    tools/acx_trace_merge.compute_skew (THE skew definition — shared,
+    not re-derived) applies verbatim because reqlog stamps the same
+    trace::NowSinceStartNs clock. Without traces, the init line's
+    paired (t_mono_ns, t_wall_ms) reading anchors each rank on the
+    wall clock — coarser (ms-granular, NTP-subject) but always there;
+  * reconstructs each rid's journey and attributes wall time to
+    phases: queue (admit→prefill_start), prefill
+    (prefill_start→prefill_end), ship (prefill_end→seat — the
+    cross-rank KV handoff leg), decode (seat→finish minus preempted),
+    preempted (Σ preempt→resume);
+  * prints fleet phase-breakdown percentiles (p50/p95/p99 per phase)
+    and names the dominant phase — where the fleet's wall time went;
+  * computes a rolling SLO burn rate: with TTFT/ITL targets (the
+    ``ACX_SERVE_ADMIT_TTFT_MS`` / ``ACX_SERVE_ADMIT_ITL_MS`` knobs, or
+    --ttft-ms/--itl-ms), requests finishing in each window are checked
+    against the targets and burn = violation_rate / error_budget
+    (--budget, default 1%). burn > 1 means the fleet is eating budget
+    faster than the SLO allows;
+  * renders a per-request waterfall (--waterfall N: the N slowest);
+  * ``--check`` gates CI: >= --min-reconstructed of the rids seen must
+    have a complete journey (an entry event AND a finish), the
+    burn-rate section must be emitted, and with --expect-dominant
+    PHASE the fleet-dominant phase must match — the bar the Makefile's
+    request-check holds a fault-injected fleet to.
+
+Unknown event kinds warn at merge time: the KINDS table below is the
+decode vocabulary, and tools/acx_audit.py's ``journey_kinds`` rule
+pins it to the literal kinds the serving loops emit.
+
+Usage:
+    python3 tools/acx_request.py [--json out.json] [--waterfall 5]
+        [--check] [--min-reconstructed 0.95] [--expect-dominant ship]
+        [--ttft-ms 500] [--itl-ms 100] [--budget 0.01] [--window-s 5]
+        run.rank*.reqlog.jsonl [run.rank*.trace.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from acx_trace_merge import compute_skew, load, parse_rank  # noqa: E402
+
+# Decode table: journey kind -> meaning. tools/acx_audit.py's
+# journey_kinds rule brace-matches this dict and asserts it equals the
+# set of kinds literally emitted by serving.py/disagg.py/kvpage.py and
+# the KINDS frozenset in mpi_acx_tpu/reqlog.py.
+KINDS = {
+    "admit": "request accepted by typed admission",
+    "reject": "typed admission rejection (reason)",
+    "queue": "request enqueued on the scheduler queue",
+    "prefill_start": "prompt pass begins",
+    "prefill_layer": "one layerwise-prefill layer done",
+    "prefill_end": "prompt pass done, first token known",
+    "ship_hdr": "KV handoff header sent/received",
+    "ship_pready": "one KV partition published",
+    "ship_fin": "KV handoff FIN sent/received",
+    "seat": "request seated in a cache slot",
+    "prefix_hit": "radix prefix-cache prompt match",
+    "decode_step": "one batched decode step (rid-less)",
+    "stream": "tokens streamed to the request",
+    "preempt": "request evicted by page pressure",
+    "resume": "preempted request re-seated",
+    "requeue": "failure-path restart",
+    "finish": "request retired",
+}
+
+PHASES = ("queue", "prefill", "ship", "decode", "preempted")
+# Dominance is judged over SERVICE phases only: queue time is backlog —
+# the consequence of whichever service leg is slow (every later request
+# queues behind it), so including it would let the symptom outvote the
+# cause on any serially-scheduled fleet.
+SERVICE_PHASES = ("prefill", "ship", "decode", "preempted")
+
+
+def load_reqlog(path):
+    """Returns (init_line_or_None, events, torn). Torn-tolerant like
+    every other ACX JSONL reader: a rank killed mid-write leaves one
+    torn final line, which is skipped and counted, never fatal."""
+    init, events, torn = None, [], 0
+    with open(path, errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                torn += 1
+                continue
+            if d.get("init"):
+                init = d
+            else:
+                events.append(d)
+    return init, events, torn
+
+
+def rank_skews(reqlogs, traces):
+    """Per-rank shift (µs) onto one fleet timeline.
+
+    Preferred: the traces' barrier-anchored skew (reqlog stamps the
+    same clock). Fallback: align each rank's init line so that its
+    paired wall reading lands where the wall clock says — skew_us[r] =
+    (t_wall_us - t_mono_us) normalized to the minimum across ranks.
+    A rank whose init line recorded clock="mono" (no native runtime)
+    can only use the wall fallback even when traces exist, because its
+    zero is process-local, not trace-start.
+    """
+    skew = {}
+    if traces:
+        skew = dict(compute_skew(traces))
+    wall = {}
+    for r, init, _evs, _torn in reqlogs:
+        if init and "t_wall_ms" in init and "t_mono_ns" in init:
+            wall[r] = (float(init["t_wall_ms"]) * 1e3
+                       - float(init["t_mono_ns"]) / 1e3)
+    base = min(wall.values()) if wall else 0.0
+    out, source = {}, {}
+    for r, init, _evs, _torn in reqlogs:
+        native = bool(init) and init.get("clock") == "native"
+        if native and skew.get(r) is not None:
+            out[r], source[r] = skew[r], "barrier"
+        elif r in wall:
+            out[r], source[r] = wall[r] - base, "wall"
+        else:
+            out[r], source[r] = 0.0, "none"
+    return out, source
+
+
+def build_journeys(reqlogs, skew):
+    """rid -> time-sorted [(corrected_us, rank, event)] plus fleet-wide
+    rid-less event tallies and the unknown-kind set."""
+    journeys, unknown = {}, {}
+    fleet = {"decode_steps": 0, "decode_time_s": 0.0, "events": 0}
+    for r, _init, events, _torn in reqlogs:
+        sh = skew.get(r, 0.0)
+        for e in events:
+            fleet["events"] += 1
+            k = e.get("k")
+            if k not in KINDS:
+                unknown[k] = unknown.get(k, 0) + 1
+                continue
+            if k == "decode_step":
+                fleet["decode_steps"] += 1
+                fleet["decode_time_s"] += float(e.get("dt_s", 0.0))
+                continue
+            rid = e.get("rid")
+            if rid is None:
+                continue
+            t = float(e.get("t_mono_ns", 0)) / 1e3 + sh
+            journeys.setdefault(int(rid), []).append((t, r, e))
+    for evs in journeys.values():
+        evs.sort(key=lambda x: x[0])
+    return journeys, fleet, unknown
+
+
+def first_t(evs, *kinds):
+    for t, _r, e in evs:
+        if e["k"] in kinds:
+            return t
+    return None
+
+
+def last_t(evs, *kinds):
+    out = None
+    for t, _r, e in evs:
+        if e["k"] in kinds:
+            out = t
+    return out
+
+
+def attribute(evs):
+    """Phase attribution (seconds) for one rid's merged journey.
+    Negative legs — possible under the coarse wall-clock fallback —
+    clamp to 0 rather than poisoning the fleet sums."""
+    admit = first_t(evs, "admit", "queue")
+    pstart = first_t(evs, "prefill_start")
+    pend = first_t(evs, "prefill_end")
+    seat = first_t(evs, "seat", "resume")
+    fin = last_t(evs, "finish")
+    preempted = 0.0
+    t_pre = None
+    for t, _r, e in evs:
+        if e["k"] == "preempt":
+            t_pre = t
+        elif e["k"] == "resume" and t_pre is not None:
+            preempted += max(0.0, t - t_pre) / 1e6
+            t_pre = None
+
+    def leg(a, b):
+        return max(0.0, (b - a) / 1e6) if a is not None and b is not None \
+            else None
+
+    # Wire backpressure INSIDE the overlapped layerwise-prefill window
+    # is ship time, not prefill: the gap between a layer's compute end
+    # (prefill_layer) and its publish returning (ship_pready), and the
+    # descriptor-header send wait (prefill_start -> ship_hdr). A
+    # monolithic journey has neither event and loses nothing.
+    publish_block = 0.0
+    t_layer = None
+    for t, _r, e in evs:
+        if e["k"] == "prefill_layer":
+            t_layer = t
+        elif e["k"] == "ship_pready" and t_layer is not None:
+            publish_block += max(0.0, t - t_layer) / 1e6
+            t_layer = None
+    hdr = first_t(evs, "ship_hdr")
+    hdr_block = (leg(pstart, hdr) or 0.0) if hdr is not None else 0.0
+    wire_in_prefill = publish_block + hdr_block
+
+    ship = leg(pend, seat)
+    if ship is not None:
+        ship += wire_in_prefill
+    prefill = leg(pstart, pend)
+    if prefill is not None:
+        prefill = max(0.0, prefill - wire_in_prefill)
+
+    ph = {"queue": leg(admit, pstart),
+          "prefill": prefill,
+          "ship": ship,
+          "preempted": preempted if preempted > 0 else
+          (0.0 if seat is not None else None)}
+
+    streams = [e for _t, _r, e in evs if e["k"] == "stream"]
+    ttft = next((float(e["ttft_s"]) for e in streams if "ttft_s" in e), None)
+    itls = [float(e["itl_s"]) for e in streams if "itl_s" in e]
+    # Decode SERVICE is this rid's share of the batched steps (tokens x
+    # per-token step time from the stream events), not the seat->finish
+    # wall window: the window also holds head-of-line interference —
+    # the loop blocking on a NEIGHBOR's inbound handoff or an in-loop
+    # refill — which would let a wire fault masquerade as slow decode.
+    # The full window still shows in total_s. Journeys that died before
+    # any inter-token stream fall back to the window.
+    dec = leg(seat, fin)
+    if itls:
+        ph["decode"] = sum(float(e["itl_s"]) * int(e.get("n", 1))
+                           for e in streams if "itl_s" in e)
+    else:
+        ph["decode"] = max(0.0, dec - preempted) if dec is not None else None
+    entry = admit if admit is not None else pstart
+    return {
+        "phases": ph,
+        "start_us": entry,
+        "finish_us": fin,
+        "total_s": leg(entry, fin),
+        "ttft_s": ttft,
+        "itl_p50_s": percentile(itls, 50),
+        "rejected": any(e["k"] == "reject" for _t, _r, e in evs),
+        "reconstructed": (entry is not None and fin is not None),
+        "ranks": sorted({r for _t, r, _e in evs}),
+    }
+
+
+def percentile(xs, p):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    i = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
+    return xs[i]
+
+
+def fleet_breakdown(journeys_attr):
+    """Per-phase percentiles + totals over reconstructed journeys, and
+    the dominant phase (largest share of summed wall time)."""
+    per_phase = {p: [] for p in PHASES}
+    for a in journeys_attr.values():
+        if not a["reconstructed"]:
+            continue
+        for p in PHASES:
+            v = a["phases"].get(p)
+            if v is not None:
+                per_phase[p].append(v)
+    out, totals = {}, {}
+    for p in PHASES:
+        xs = per_phase[p]
+        if p in SERVICE_PHASES:
+            totals[p] = sum(xs)
+        out[p] = {"n": len(xs), "total_s": round(sum(xs), 6),
+                  "p50_s": percentile(xs, 50), "p95_s": percentile(xs, 95),
+                  "p99_s": percentile(xs, 99)}
+    dominant = max(totals, key=totals.get) if any(totals.values()) else None
+    return out, dominant
+
+
+def burn_rate(journeys_attr, ttft_s, itl_s, budget, window_s):
+    """Rolling SLO burn: bucket finished requests by corrected finish
+    time into window_s windows; per window, violation fraction vs the
+    TTFT/ITL targets; burn = fraction / budget. Emitted even without
+    targets (targets null, burn null) so --check can assert presence.
+    """
+    rep = {"ttft_target_s": ttft_s, "itl_target_s": itl_s,
+           "budget": budget, "window_s": window_s, "windows": []}
+    done = [a for a in journeys_attr.values()
+            if a["reconstructed"] and a["finish_us"] is not None]
+    if not done or (ttft_s is None and itl_s is None):
+        rep["max_burn"] = None
+        rep["last_burn"] = None
+        return rep
+    t0 = min(a["finish_us"] for a in done)
+    buckets = {}
+    for a in done:
+        buckets.setdefault(int((a["finish_us"] - t0) / (window_s * 1e6)),
+                           []).append(a)
+    for w in sorted(buckets):
+        group = buckets[w]
+        bad = 0
+        for a in group:
+            v = (ttft_s is not None and a["ttft_s"] is not None
+                 and a["ttft_s"] > ttft_s)
+            v = v or (itl_s is not None and a["itl_p50_s"] is not None
+                      and a["itl_p50_s"] > itl_s)
+            bad += bool(v)
+        frac = bad / len(group)
+        rep["windows"].append({"window": w, "n": len(group),
+                               "violations": bad,
+                               "burn": round(frac / budget, 3)})
+    burns = [w["burn"] for w in rep["windows"]]
+    rep["max_burn"] = max(burns)
+    rep["last_burn"] = burns[-1]
+    return rep
+
+
+def render_waterfall(journeys_attr, n, out=sys.stdout):
+    """ASCII per-request waterfall: the n slowest reconstructed
+    journeys, one bar per request, one glyph per phase."""
+    glyph = {"queue": "q", "prefill": "P", "ship": "S", "decode": "d",
+             "preempted": "x"}
+    done = sorted(
+        ((rid, a) for rid, a in journeys_attr.items()
+         if a["reconstructed"] and a["total_s"]),
+        key=lambda kv: -kv[1]["total_s"])[:n]
+    if not done:
+        return
+    width = 60
+    tmax = max(a["total_s"] for _rid, a in done)
+    print(f"-- waterfall: {len(done)} slowest requests "
+          f"(q=queue P=prefill S=ship d=decode x=preempted) --", file=out)
+    for rid, a in done:
+        bar = ""
+        for p in PHASES:
+            v = a["phases"].get(p) or 0.0
+            bar += glyph[p] * max(1 if v > 0 else 0,
+                                  int(round(v / tmax * width)))
+        ranks = ",".join(str(r) for r in a["ranks"])
+        print(f"rid {rid:>4} [{bar:<{width + 8}}] "
+              f"{a['total_s'] * 1e3:8.1f} ms  ranks {ranks}", file=out)
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="reconstruct ACX request journeys; fleet phase "
+                    "breakdown + SLO burn rate")
+    ap.add_argument("inputs", nargs="+",
+                    help="*.reqlog.jsonl (and optional sibling "
+                         "*.trace.json for barrier-anchored skew)")
+    ap.add_argument("--json", help="write the full report here")
+    ap.add_argument("--waterfall", type=int, default=0, metavar="N",
+                    help="render the N slowest request waterfalls")
+    ap.add_argument("--check", action="store_true",
+                    help="CI gate: exit 1 unless enough journeys "
+                         "reconstruct and the burn section is emitted")
+    ap.add_argument("--min-reconstructed", type=float, default=0.95)
+    ap.add_argument("--expect-dominant", choices=SERVICE_PHASES,
+                    help="with --check: require this fleet-dominant "
+                         "service phase (queue is backlog, not service)")
+    ap.add_argument("--ttft-ms", type=float, default=float(
+        os.environ.get("ACX_SERVE_ADMIT_TTFT_MS", "0") or 0))
+    ap.add_argument("--itl-ms", type=float, default=float(
+        os.environ.get("ACX_SERVE_ADMIT_ITL_MS", "0") or 0))
+    ap.add_argument("--budget", type=float, default=0.01,
+                    help="SLO error budget (violation fraction allowed)")
+    ap.add_argument("--window-s", type=float, default=5.0)
+    args = ap.parse_args()
+
+    reqlogs, traces, missing = [], [], []
+    for i, path in enumerate(args.inputs):
+        r = parse_rank(path, i)
+        if path.endswith(".reqlog.jsonl"):
+            try:
+                init, events, torn = load_reqlog(path)
+            except OSError as exc:
+                missing.append({"path": path, "rank": r, "reason": str(exc)})
+                continue
+            reqlogs.append((r, init, events, torn))
+        elif path.endswith(".trace.json"):
+            try:
+                traces.append((r, load(path)))
+            except (OSError, json.JSONDecodeError) as exc:
+                missing.append({"path": path, "rank": r, "reason": str(exc)})
+        else:
+            print(f"acx_request: ignoring unrecognized input {path}",
+                  file=sys.stderr)
+    if not reqlogs:
+        print("acx_request: no .reqlog.jsonl inputs", file=sys.stderr)
+        sys.exit(2)
+
+    skew, skew_source = rank_skews(reqlogs, traces)
+    journeys, fleet, unknown = build_journeys(reqlogs, skew)
+    for k, n in sorted(unknown.items()):
+        print(f"acx_request: WARNING: unknown journey kind {k!r} "
+              f"x{n} — decode table out of date?", file=sys.stderr)
+
+    attr = {rid: attribute(evs) for rid, evs in journeys.items()}
+    rejected = sum(a["rejected"] for a in attr.values())
+    candidates = {rid: a for rid, a in attr.items() if not a["rejected"]}
+    recon = sum(a["reconstructed"] for a in candidates.values())
+    rate = recon / len(candidates) if candidates else 0.0
+    breakdown, dominant = fleet_breakdown(candidates)
+    burn = burn_rate(candidates, args.ttft_ms / 1e3 or None,
+                     args.itl_ms / 1e3 or None, args.budget, args.window_s)
+
+    report = {
+        "ranks": sorted(r for r, _i, _e, _t in reqlogs),
+        "skew_source": {str(r): skew_source[r] for r in skew_source},
+        "torn_lines": {str(r): t for r, _i, _e, t in reqlogs},
+        "events": fleet["events"],
+        "decode_steps": fleet["decode_steps"],
+        "rids": len(attr),
+        "rejected": rejected,
+        "reconstructed": recon,
+        "reconstructed_rate": round(rate, 4),
+        "unknown_kinds": unknown,
+        "phase_breakdown": breakdown,
+        "dominant_phase": dominant,
+        "burn": burn,
+    }
+    if missing:
+        report["missing"] = missing
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1)
+    print(json.dumps({k: v for k, v in report.items()
+                      if k not in ("phase_breakdown",)}))
+    if args.waterfall:
+        render_waterfall(candidates, args.waterfall)
+
+    if args.check:
+        errors = []
+        if rate < args.min_reconstructed:
+            errors.append(f"reconstructed {recon}/{len(candidates)} "
+                          f"({rate:.1%}) < {args.min_reconstructed:.0%}")
+        if "max_burn" not in burn:
+            errors.append("burn-rate section missing")
+        if args.expect_dominant and dominant != args.expect_dominant:
+            errors.append(f"dominant phase {dominant!r}, expected "
+                          f"{args.expect_dominant!r}")
+        if unknown:
+            errors.append(f"unknown kinds: {sorted(unknown)}")
+        for e in errors:
+            print(f"acx_request: CHECK FAIL: {e}", file=sys.stderr)
+        sys.exit(1 if errors else 0)
+
+
+if __name__ == "__main__":
+    main()

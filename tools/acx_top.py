@@ -23,6 +23,11 @@ Modes:
                                       (>= 2 samples/rank, monotone
                                       clocks, wire >= payload per link)
                                       and exit nonzero on violation
+  acx_top.py --prom <prefix>          one-shot Prometheus text
+                                      exposition of the newest reading
+  acx_top.py --prom-port 9100 <prefix>
+                                      serve it at :9100/metrics,
+                                      re-read per scrape
 
 The reader tolerates a torn final line (a rank mid-write or killed
 mid-sample): any line that fails to parse is skipped. Everything here is
@@ -125,6 +130,10 @@ def summarize(series):
         "queue_depth": None,
         "ttft_p99_s": None,
         "itl_p99_s": None,
+        "rejections": None,
+        "rejects": None,
+        "preemptions": None,
+        "resumes": None,
         "link_health": "-",
         "subflows": "-",
         "part_inflight": None,
@@ -176,6 +185,14 @@ def summarize(series):
         row["queue_depth"] = app.get("queue_depth")
         row["ttft_p99_s"] = app.get("ttft_p99_s")
         row["itl_p99_s"] = app.get("itl_p99_s")
+        # Admission-health breakdown (DESIGN.md §20): cumulative typed
+        # rejection counts plus page-pressure preempt/resume churn, so
+        # "why is goodput down" is answerable from the console — shed
+        # load and thrashing seats both live here, not in the op plane.
+        row["rejections"] = app.get("rejections")
+        row["rejects"] = app.get("rejects")
+        row["preemptions"] = app.get("preemptions")
+        row["resumes"] = app.get("resumes")
     # Newest non-empty links section (the tail sample's is empty).
     links = next((s["links"] for s in reversed(samples) if s.get("links")),
                  None)
@@ -260,9 +277,11 @@ def render_table(all_series):
     hdr = (f"{'rank':>4} {'epoch':>5} {'smpls':>5} {'ops/s':>9} "
            f"{'good MB/s':>9} {'wire MB/s':>9} {'proxy%':>6} "
            f"{'txq µs':>7} {'rxt µs':>7} "
-           f"{'qdepth':>6} {'p99 TTFT':>9} {'pif':>4} {'pages':>9} "
+           f"{'qdepth':>6} {'p99 TTFT':>9} {'rej':>4} {'pre':>4} "
+           f"{'pif':>4} {'pages':>9} "
            f"{'link':>5} {'sf':>5}")
     lines = [hdr, "-" * len(hdr)]
+    rej_detail = []
     for r in rows:
         ttft = (_fmt(r["ttft_p99_s"], ".3f") + "s"
                 if r["ttft_p99_s"] is not None else "-")
@@ -275,11 +294,98 @@ def render_table(all_series):
             f"{r['wire_mbps']:>9.2f} {r['proxy_util_pct']:>6.1f} "
             f"{_fmt(r['txq_us'], '.1f'):>7} {_fmt(r['rxt_us'], '.1f'):>7} "
             f"{_fmt(r['queue_depth'], 'd'):>6} {ttft:>9} "
+            f"{_fmt(r['rejections'], 'd'):>4} "
+            f"{_fmt(r['preemptions'], 'd'):>4} "
             f"{_fmt(r['part_inflight'], 'd'):>4} {pages:>9} "
             f"{r['link_health']:>5} {r['subflows']:>5}")
+        if r["rejects"]:
+            detail = " ".join(f"{k}={v}"
+                              for k, v in sorted(r["rejects"].items()))
+            rej_detail.append(f"  rank {r['rank']} rejects: {detail}"
+                              + (f"  resumes={r['resumes']}"
+                                 if r["resumes"] else ""))
+    # Per-reason rejection breakdown under the table — the serving
+    # loop's typed admission reasons, not a bare count.
+    lines.extend(rej_detail)
     if not rows:
         lines.append("  (no .tseries.jsonl files yet)")
     return "\n".join(lines)
+
+
+# Registry names that are level readings, not cumulative counts — must
+# match metrics::IsGauge in src/core/metrics.cc so both Prometheus
+# surfaces (this file-plane bridge and the native acx_metrics_prom
+# export) agree on instrument types.
+PROM_GAUGES = {"fleet_epoch", "slot_hwm", "pages_free", "pages_shared"}
+
+
+def render_prom(all_series):
+    """Prometheus text exposition (0.0.4) of the newest per-rank
+    reading, rank-labelled. This is the file-plane bridge for fleets
+    scraped from an operator box: the authoritative in-process export
+    is ``acx_metrics_prom`` / ``Runtime.metrics_prom()`` — same names,
+    same types, so dashboards work against either."""
+    by_name = {}
+    for s in all_series:
+        if s["counters"]:
+            for k, v in s["counters"][-1].items():
+                by_name.setdefault(k, {})[s["rank"]] = v
+    lines = []
+    for k in sorted(by_name):
+        kind = "gauge" if k in PROM_GAUGES else "counter"
+        lines.append(f"# TYPE acx_{k} {kind}")
+        for r in sorted(by_name[k]):
+            lines.append(f'acx_{k}{{rank="{r}"}} {by_name[k][r]}')
+    # Serving-layer SLO fragment as derived gauges/counters.
+    app_num = [("queue_depth", "gauge"), ("ttft_p99_s", "gauge"),
+               ("itl_p99_s", "gauge"), ("rejections", "counter"),
+               ("preemptions", "counter"), ("resumes", "counter")]
+    rows = [(s["rank"], _latest(s, "app")) for s in all_series]
+    rows = [(r, a) for r, a in rows if isinstance(a, dict)]
+    for key, kind in app_num:
+        vals = [(r, a.get(key)) for r, a in rows if a.get(key) is not None]
+        if not vals:
+            continue
+        lines.append(f"# TYPE acx_app_{key} {kind}")
+        for r, v in vals:
+            lines.append(f'acx_app_{key}{{rank="{r}"}} {v}')
+    rej = [(r, a["rejects"]) for r, a in rows
+           if isinstance(a.get("rejects"), dict) and a["rejects"]]
+    if rej:
+        lines.append("# TYPE acx_app_rejects counter")
+        for r, d in rej:
+            for reason, v in sorted(d.items()):
+                lines.append(f'acx_app_rejects{{rank="{r}",'
+                             f'reason="{reason}"}} {v}')
+    return "\n".join(lines) + "\n"
+
+
+def serve_prom(prefix, port):
+    """Tiny stdlib scrape endpoint: GET /metrics re-reads the tseries
+    files per scrape, so a Prometheus server pointed here follows a
+    live fleet with no sidecar beyond this script."""
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — http.server API
+            body = render_prom(collect(prefix)).encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet: this is a console tool
+            pass
+
+    srv = http.server.HTTPServer(("", port), Handler)
+    print(f"acx_top: serving Prometheus metrics on :{port}/metrics",
+          file=sys.stderr)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        return 0
 
 
 def main(argv=None):
@@ -295,7 +401,19 @@ def main(argv=None):
                     help="run CI series assertions; nonzero exit on failure")
     ap.add_argument("--interval", type=float, default=1.0,
                     help="live-mode refresh period in seconds (default 1.0)")
+    ap.add_argument("--prom", action="store_true",
+                    help="emit a Prometheus text exposition of the "
+                         "newest reading and exit (one-shot)")
+    ap.add_argument("--prom-port", type=int, metavar="PORT",
+                    help="serve the exposition at :PORT/metrics, "
+                         "re-reading the files per scrape")
     args = ap.parse_args(argv)
+
+    if args.prom_port:
+        return serve_prom(args.prefix, args.prom_port)
+    if args.prom:
+        sys.stdout.write(render_prom(collect(args.prefix)))
+        return 0
 
     if args.as_json or args.check:
         args.once = True

@@ -309,7 +309,15 @@ def main():
     args = ap.parse_args()
 
     traces, metrics, tseries, errors, missing = [], [], [], [], []
+    reqlogs = 0
     for i, path in enumerate(args.inputs):
+        # Request-journey logs (mpi_acx_tpu/reqlog.py) are JSONL too, and
+        # their consumer is tools/acx_request.py — count them so a mixed
+        # glob over a run directory passes through without choking the
+        # whole-file json.load below.
+        if path.endswith(".reqlog.jsonl"):
+            reqlogs += 1
+            continue
         # Time-series files are JSONL — one JSON object per line — so the
         # whole-file json.load below would choke on line two. Classify
         # them by suffix BEFORE loading.
@@ -345,6 +353,8 @@ def main():
 
     summary = {"traces": len(traces), "metrics": len(metrics),
                "tseries": len(tseries)}
+    if reqlogs:
+        summary["reqlogs_skipped"] = reqlogs
     if missing:
         summary["missing"] = missing
     # The tseries merge reuses the traces' barrier-anchored skew, so run
